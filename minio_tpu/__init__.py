@@ -1,0 +1,17 @@
+"""minio_tpu — a TPU-native object-storage framework with the capabilities of MinIO.
+
+Re-designed TPU-first (JAX/XLA/Pallas for the erasure-coding hot path, C++ for
+native runtime pieces) rather than ported from the Go reference. Layer map
+mirrors SURVEY.md §1:
+
+- ``minio_tpu.ops``         GF(256) math + bit-sliced Reed-Solomon kernels (JAX + Pallas)
+- ``minio_tpu.erasure``     erasure codec wrapper, streaming encode/decode/heal, bitrot
+- ``minio_tpu.runtime``     device dispatch/batching queue, buffer pools
+- ``minio_tpu.storage``     StorageAPI, xl.meta journal, local posix backend
+- ``minio_tpu.objectlayer`` ObjectLayer: erasure objects, sets, pools
+- ``minio_tpu.server``      S3-compatible HTTP API, SigV4 auth, admin plane
+- ``minio_tpu.dist``        REST-RPC, dsync distributed locks, topology
+- ``minio_tpu.utils``       shared helpers (quorum errors, hashing, env)
+"""
+
+__version__ = "0.1.0"

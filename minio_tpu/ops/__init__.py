@@ -1,0 +1,2 @@
+"""Device math for the erasure hot path: GF(256) tables/matrices (numpy, host)
+and bit-sliced Reed-Solomon encode/reconstruct/verify (JAX + Pallas, device)."""

@@ -1,0 +1,171 @@
+"""GF(256) arithmetic and Reed-Solomon matrix construction (host side, numpy).
+
+Field: GF(2^8) with the primitive polynomial x^8+x^4+x^3+x^2+1 (0x11D), the
+same field the reference's codec uses (klauspost/reedsolomon, wrapped by
+cmd/erasure-coding.go:28-113). Everything here is tiny host math: tables,
+encode-matrix generation (systematic Vandermonde — the reference default — and
+Cauchy), Gaussian inversion for reconstruction matrices. The heavy per-byte
+work happens on device in rs_jax.py / rs_pallas.py.
+"""
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+# Primitive polynomial 0x11D (285), generator alpha = 2.
+_POLY = 0x11D
+
+# --- exp/log tables ---------------------------------------------------------
+
+
+def _build_tables() -> tuple[np.ndarray, np.ndarray]:
+    exp = np.zeros(512, dtype=np.uint8)
+    log = np.zeros(256, dtype=np.int32)
+    x = 1
+    for i in range(255):
+        exp[i] = x
+        log[x] = i
+        x <<= 1
+        if x & 0x100:
+            x ^= _POLY
+    exp[255:510] = exp[0:255]  # wraparound so exp[log a + log b] needs no mod
+    log[0] = -1  # log(0) undefined; sentinel
+    return exp, log
+
+
+GF_EXP, GF_LOG = _build_tables()
+
+# 256x256 full multiplication table: 64 KiB, makes numpy matrix ops trivial.
+_a = np.arange(256)
+_MUL = np.zeros((256, 256), dtype=np.uint8)
+_nz = _a[1:]
+_MUL[1:, 1:] = GF_EXP[(GF_LOG[_nz][:, None] + GF_LOG[_nz][None, :]) % 255]
+GF_MUL = _MUL
+
+GF_INV = np.zeros(256, dtype=np.uint8)
+GF_INV[1:] = GF_EXP[255 - GF_LOG[_nz]]
+del _a, _MUL, _nz
+
+
+def gf_mul(a, b):
+    """Elementwise GF(256) multiply of uint8 arrays/scalars."""
+    return GF_MUL[np.asarray(a, dtype=np.uint8), np.asarray(b, dtype=np.uint8)]
+
+
+def gf_pow(a: int, n: int) -> int:
+    if n == 0:
+        return 1
+    if a == 0:
+        return 0
+    return int(GF_EXP[(int(GF_LOG[a]) * n) % 255])
+
+
+def gf_matmul_ref(m: np.ndarray, x: np.ndarray) -> np.ndarray:
+    """Reference (slow, host) GF(256) matrix multiply: [o,i] x [i,...] -> [o,...].
+
+    Used as the golden model in tests; the device kernels must match it bit
+    for bit.
+    """
+    m = np.asarray(m, dtype=np.uint8)
+    x = np.asarray(x, dtype=np.uint8)
+    out = np.zeros((m.shape[0],) + x.shape[1:], dtype=np.uint8)
+    for o in range(m.shape[0]):
+        acc = np.zeros(x.shape[1:], dtype=np.uint8)
+        for i in range(m.shape[1]):
+            acc ^= GF_MUL[m[o, i], x[i]]
+        out[o] = acc
+    return out
+
+
+# --- matrices ---------------------------------------------------------------
+
+
+def matrix_invert(m: np.ndarray) -> np.ndarray:
+    """Invert a square GF(256) matrix by Gauss-Jordan. Raises on singular."""
+    m = np.asarray(m, dtype=np.uint8)
+    n = m.shape[0]
+    assert m.shape == (n, n)
+    aug = np.concatenate([m.copy(), np.eye(n, dtype=np.uint8)], axis=1)
+    for col in range(n):
+        pivot = None
+        for r in range(col, n):
+            if aug[r, col] != 0:
+                pivot = r
+                break
+        if pivot is None:
+            raise np.linalg.LinAlgError("singular GF(256) matrix")
+        if pivot != col:
+            aug[[col, pivot]] = aug[[pivot, col]]
+        aug[col] = GF_MUL[GF_INV[aug[col, col]], aug[col]]
+        for r in range(n):
+            if r != col and aug[r, col] != 0:
+                aug[r] ^= GF_MUL[aug[r, col], aug[col]]
+    return aug[:, n:].copy()
+
+
+def vandermonde(rows: int, cols: int) -> np.ndarray:
+    """V[r, c] = r^c in GF(256) — the reference codec's raw generator matrix."""
+    out = np.zeros((rows, cols), dtype=np.uint8)
+    for r in range(rows):
+        for c in range(cols):
+            out[r, c] = gf_pow(r, c)
+    return out
+
+
+@functools.lru_cache(maxsize=None)
+def build_matrix(k: int, m: int, kind: str = "vandermonde") -> np.ndarray:
+    """Systematic (k+m, k) encode matrix: top k rows identity, bottom m parity.
+
+    ``vandermonde``: raw Vandermonde made systematic by right-multiplying with
+    the inverse of its top square (reference default). ``cauchy``: identity on
+    top, parity rows P[r, c] = 1/(r ^ c) with r in [k, k+m).
+    """
+    n = k + m
+    if n > 256:
+        raise ValueError(f"k+m = {n} exceeds GF(256) shard limit of 256")
+    if k <= 0 or m < 0:
+        raise ValueError(f"invalid erasure geometry k={k} m={m}")
+    if kind == "vandermonde":
+        vm = vandermonde(n, k)
+        enc = gf_matmul_ref(vm, matrix_invert(vm[:k]))
+        # numerically the top block is exactly identity
+        assert np.array_equal(enc[:k], np.eye(k, dtype=np.uint8))
+        return enc
+    elif kind == "cauchy":
+        enc = np.zeros((n, k), dtype=np.uint8)
+        enc[:k] = np.eye(k, dtype=np.uint8)
+        for r in range(k, n):
+            for c in range(k):
+                enc[r, c] = GF_INV[r ^ c]
+        return enc
+    raise ValueError(f"unknown matrix kind {kind!r}")
+
+
+def decode_matrix(enc: np.ndarray, k: int, present: tuple[int, ...]) -> np.ndarray:
+    """Matrix mapping k chosen present shards -> the k data shards.
+
+    ``present`` are the indices (into the k+m shard list) of exactly k
+    available shards. Rows of the encode matrix for those shards form an
+    invertible k x k system; its inverse reconstructs the data shards.
+    """
+    if len(present) != k:
+        raise ValueError(f"need exactly {k} present shards, got {len(present)}")
+    sub = enc[list(present), :]
+    return matrix_invert(sub)
+
+
+# --- bit-plane mask expansion (for the device kernels) ----------------------
+
+
+def coeff_masks(m: np.ndarray) -> np.ndarray:
+    """Expand a GF coefficient matrix [o, i] into per-bit full-word masks.
+
+    Returns uint32 [8, o, i]: masks[b, o, i] = 0xFFFFFFFF if bit b of m[o, i]
+    is set else 0. The device kernels compute, for data packed 4 bytes per
+    uint32 lane,  out[o] = XOR_{i,b} masks[b,o,i] & (x[i] * 2^b)  — the
+    bit-sliced equivalent of the GF multiply-accumulate (SURVEY.md §7.1).
+    """
+    m = np.asarray(m, dtype=np.uint8)
+    bits = (m[None, :, :] >> np.arange(8, dtype=np.uint8)[:, None, None]) & 1
+    return (bits.astype(np.uint32) * np.uint32(0xFFFFFFFF)).astype(np.uint32)

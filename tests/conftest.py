@@ -1,0 +1,13 @@
+"""Test harness: force an 8-device virtual CPU mesh before JAX initializes.
+
+Mirrors the reference strategy of simulating a multi-disk/multi-node cluster
+with local resources (SURVEY.md §4: temp-dir disks, in-process multi-set
+layouts) — here, multi-chip shardings run on virtual CPU devices.
+"""
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()

@@ -1,0 +1,80 @@
+"""GF(256) table/matrix unit tests (host math golden checks)."""
+import numpy as np
+import pytest
+
+from minio_tpu.ops import gf256
+
+
+def test_field_axioms():
+    rng = np.random.default_rng(0)
+    a = rng.integers(0, 256, 200, dtype=np.uint8)
+    b = rng.integers(0, 256, 200, dtype=np.uint8)
+    c = rng.integers(0, 256, 200, dtype=np.uint8)
+    # commutativity, associativity over the mul table
+    assert np.array_equal(gf256.gf_mul(a, b), gf256.gf_mul(b, a))
+    assert np.array_equal(
+        gf256.gf_mul(gf256.gf_mul(a, b), c), gf256.gf_mul(a, gf256.gf_mul(b, c)))
+    # distributivity over xor
+    assert np.array_equal(
+        gf256.gf_mul(a, b ^ c), gf256.gf_mul(a, b) ^ gf256.gf_mul(a, c))
+    # identities
+    assert np.array_equal(gf256.gf_mul(a, 1), a)
+    assert np.all(gf256.gf_mul(a, 0) == 0)
+
+
+def test_inverse_table():
+    for x in range(1, 256):
+        assert gf256.GF_MUL[x, gf256.GF_INV[x]] == 1
+
+
+def test_primitive_poly_is_0x11d():
+    # alpha = 2; 2^8 = 0x11D - 0x100 = 0x1D in this field
+    assert gf256.gf_pow(2, 8) == 0x1D
+
+
+def test_matrix_invert_roundtrip():
+    rng = np.random.default_rng(1)
+    for n in (1, 2, 5, 16):
+        while True:
+            m = rng.integers(0, 256, (n, n), dtype=np.uint8)
+            try:
+                inv = gf256.matrix_invert(m)
+                break
+            except np.linalg.LinAlgError:
+                continue
+        prod = np.zeros((n, n), dtype=np.uint8)
+        for r in range(n):
+            for c in range(n):
+                prod[r, c] = np.bitwise_xor.reduce(gf256.GF_MUL[m[r], inv[:, c]])
+        assert np.array_equal(prod, np.eye(n, dtype=np.uint8))
+
+
+@pytest.mark.parametrize("kind", ["vandermonde", "cauchy"])
+@pytest.mark.parametrize("k,m", [(2, 2), (4, 2), (8, 4), (16, 4)])
+def test_build_matrix_systematic_and_mds(kind, k, m):
+    enc = gf256.build_matrix(k, m, kind)
+    assert enc.shape == (k + m, k)
+    assert np.array_equal(enc[:k], np.eye(k, dtype=np.uint8))
+    # MDS property spot-check: every sampled k-subset of rows is invertible
+    rng = np.random.default_rng(2)
+    import itertools
+    all_subsets = list(itertools.combinations(range(k + m), k))
+    picks = all_subsets if len(all_subsets) <= 40 else [
+        all_subsets[i] for i in rng.choice(len(all_subsets), 40, replace=False)]
+    for rows in picks:
+        gf256.matrix_invert(enc[list(rows)])  # raises if singular
+
+
+def test_decode_matrix_identity_when_data_present():
+    enc = gf256.build_matrix(4, 2)
+    dec = gf256.decode_matrix(enc, 4, (0, 1, 2, 3))
+    assert np.array_equal(dec, np.eye(4, dtype=np.uint8))
+
+
+def test_coeff_masks():
+    m = np.array([[0x03, 0x80]], dtype=np.uint8)
+    masks = gf256.coeff_masks(m)
+    assert masks.shape == (8, 1, 2)
+    assert masks[0, 0, 0] == 0xFFFFFFFF and masks[1, 0, 0] == 0xFFFFFFFF
+    assert masks[2, 0, 0] == 0
+    assert masks[7, 0, 1] == 0xFFFFFFFF and masks[0, 0, 1] == 0
