@@ -1,0 +1,70 @@
+"""Native (C++) components: build-on-demand via g++, loaded through ctypes.
+
+The reference keeps its hot math in assembly-backed Go modules (SURVEY.md
+§2.10); here the native layer provides the CPU fallback codec and the
+measured AVX2 baseline for the benchmarks, while the TPU path lives in
+minio_tpu.ops.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_BUILD = os.path.join(_DIR, "_build")
+_LOCK = threading.Lock()
+_lib = None
+
+
+def _compile(src: str, out: str) -> None:
+    os.makedirs(_BUILD, exist_ok=True)
+    cmds = [
+        ["g++", "-O3", "-march=native", "-shared", "-fPIC", src, "-o", out],
+        ["g++", "-O3", "-mavx2", "-shared", "-fPIC", src, "-o", out],
+        ["g++", "-O3", "-shared", "-fPIC", src, "-o", out],
+    ]
+    last = None
+    for cmd in cmds:
+        try:
+            subprocess.run(cmd, check=True, capture_output=True)
+            return
+        except subprocess.CalledProcessError as e:  # pragma: no cover
+            last = e
+    raise RuntimeError(f"native build failed: {last.stderr.decode()[:500]}")
+
+
+def load_gf256() -> ctypes.CDLL:
+    """Build (once) and load the GF(256) SIMD library."""
+    global _lib
+    with _LOCK:
+        if _lib is not None:
+            return _lib
+        src = os.path.join(_DIR, "gf256_simd.cpp")
+        out = os.path.join(_BUILD, "libgf256.so")
+        if not os.path.exists(out) or os.path.getmtime(out) < os.path.getmtime(src):
+            _compile(src, out)
+        lib = ctypes.CDLL(out)
+        lib.gf256_encode.argtypes = [
+            ctypes.c_char_p, ctypes.c_int, ctypes.c_int,
+            ctypes.c_char_p, ctypes.c_char_p, ctypes.c_long]
+        lib.gf256_encode.restype = None
+        lib.gf256_has_avx2.restype = ctypes.c_int
+        _lib = lib
+        return lib
+
+
+def cpu_encode(matrix, data, rows_out: int):
+    """numpy convenience wrapper: matrix [o,i] uint8, data [i,S] uint8 -> [o,S]."""
+    import numpy as np
+    lib = load_gf256()
+    matrix = np.ascontiguousarray(matrix, dtype=np.uint8)
+    data = np.ascontiguousarray(data, dtype=np.uint8)
+    o, i = rows_out, data.shape[0]
+    out = np.empty((o, data.shape[1]), dtype=np.uint8)
+    lib.gf256_encode(
+        matrix.ctypes.data_as(ctypes.c_char_p), o, i,
+        data.ctypes.data_as(ctypes.c_char_p),
+        out.ctypes.data_as(ctypes.c_char_p), data.shape[1])
+    return out

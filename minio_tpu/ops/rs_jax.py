@@ -45,8 +45,12 @@ def pack_shards(shards: np.ndarray) -> np.ndarray:
 
 
 def unpack_shards(words: np.ndarray) -> np.ndarray:
-    """uint32 [..., W] -> uint8 [..., 4W]."""
-    return np.ascontiguousarray(words).view(np.uint8)
+    """uint32 [..., W] -> uint8 [..., 4W] (always writable: device transfers
+    surface as read-only views, but heal/repair callers patch shard bytes)."""
+    out = np.ascontiguousarray(words)
+    if not out.flags.writeable:
+        out = out.copy()
+    return out.view(np.uint8)
 
 
 def gf_matmul_packed(masks: jnp.ndarray, x: jnp.ndarray) -> jnp.ndarray:
@@ -74,6 +78,25 @@ _matmul_batch_shared = jax.jit(jax.vmap(gf_matmul_packed, in_axes=(None, 0)))
 _matmul_batch_per = jax.jit(jax.vmap(gf_matmul_packed, in_axes=(0, 0)))
 
 
+def _resolve_backend(backend: str):
+    """Pick the device kernels: 'pallas' (hand-tiled, default on TPU),
+    'xla' (pure jnp, default elsewhere), or 'auto'. Overridable via the
+    MINIO_TPU_RS_BACKEND env knob — the analogue of the reference gating its
+    accelerated codec behind config (cmd/config/, MINIO_ERASURE_*)."""
+    import os
+    if backend == "auto":
+        backend = os.environ.get("MINIO_TPU_RS_BACKEND", "auto")
+    if backend == "auto":
+        backend = "pallas" if jax.default_backend() == "tpu" else "xla"
+    if backend == "pallas":
+        from . import rs_pallas
+        return rs_pallas.gf_matmul, rs_pallas.gf_matmul_batch, \
+            rs_pallas.gf_matmul_batch_per
+    if backend == "xla":
+        return _matmul_j, _matmul_batch_shared, _matmul_batch_per
+    raise ValueError(f"unknown RS backend {backend!r}")
+
+
 def _device_masks(mat: np.ndarray) -> jnp.ndarray:
     return jnp.asarray(gf256.coeff_masks(mat))
 
@@ -86,7 +109,8 @@ class ReedSolomon:
     erasure layer's shard-size math guarantees alignment).
     """
 
-    def __init__(self, k: int, m: int, matrix_kind: str = "vandermonde"):
+    def __init__(self, k: int, m: int, matrix_kind: str = "vandermonde",
+                 backend: str = "auto"):
         if m < 1:
             raise ValueError(f"parity shard count must be >= 1, got {m}")
         self.k = k
@@ -94,21 +118,23 @@ class ReedSolomon:
         self.n = k + m
         self.matrix = gf256.build_matrix(k, m, matrix_kind)
         self.parity_rows = self.matrix[k:]
-        self._enc_masks = _device_masks(self.parity_rows) if m else None
+        self._enc_masks = _device_masks(self.parity_rows)
         self._decode_cache: dict[tuple[int, ...], np.ndarray] = {}
+        self._mask_cache: dict[tuple, jnp.ndarray] = {}
+        self._mm, self._mm_batch, self._mm_batch_per = _resolve_backend(backend)
 
     # -- encode --------------------------------------------------------------
 
     def encode(self, data: np.ndarray) -> np.ndarray:
         """data uint8 [k, S] -> parity uint8 [m, S]."""
         w = jnp.asarray(pack_shards(data))
-        out = _matmul_j(self._enc_masks, w)
+        out = self._mm(self._enc_masks, w)
         return unpack_shards(np.asarray(out))
 
     def encode_batch(self, data: np.ndarray) -> np.ndarray:
         """data uint8 [B, k, S] -> parity uint8 [B, m, S] in one dispatch."""
         w = jnp.asarray(pack_shards(data))
-        out = _matmul_batch_shared(self._enc_masks, w)
+        out = self._mm_batch(self._enc_masks, w)
         return unpack_shards(np.asarray(out))
 
     # -- reconstruct ---------------------------------------------------------
@@ -119,6 +145,17 @@ class ReedSolomon:
             mat = gf256.decode_matrix(self.matrix, self.k, present)
             self._decode_cache[present] = mat
         return mat
+
+    def _decode_masks(self, present: tuple[int, ...],
+                      rows: tuple[int, ...]) -> jnp.ndarray:
+        """Device-resident masks for decode-matrix rows, cached per loss
+        pattern so repeated degraded reads skip the host->device upload."""
+        key = (present, rows)
+        masks = self._mask_cache.get(key)
+        if masks is None:
+            masks = _device_masks(self._decode_mat(present)[list(rows), :])
+            self._mask_cache[key] = masks
+        return masks
 
     def _choose_present(self, shards: list[np.ndarray | None]) -> tuple[int, ...]:
         present = tuple(i for i, s in enumerate(shards) if s is not None)
@@ -144,16 +181,21 @@ class ReedSolomon:
         if missing_data:
             chosen = self._choose_present(shards)
             w = jnp.asarray(pack_shards(np.stack([shards[i] for i in chosen])))
-            dec = self._decode_mat(chosen)[missing_data, :]
-            out = unpack_shards(np.asarray(_matmul_j(_device_masks(dec), w)))
+            masks = self._decode_masks(chosen, tuple(missing_data))
+            out = unpack_shards(np.asarray(self._mm(masks, w)))
             for row, i in enumerate(missing_data):
                 shards[i] = out[row]
 
         if missing_parity and not data_only:
             data = np.stack(shards[: self.k])
-            rows = self.parity_rows[[i - self.k for i in missing_parity], :]
+            key = ("parity", tuple(missing_parity))
+            masks = self._mask_cache.get(key)
+            if masks is None:
+                masks = _device_masks(
+                    self.parity_rows[[i - self.k for i in missing_parity], :])
+                self._mask_cache[key] = masks
             out = unpack_shards(np.asarray(
-                _matmul_j(_device_masks(rows), jnp.asarray(pack_shards(data)))))
+                self._mm(masks, jnp.asarray(pack_shards(data)))))
             for row, i in enumerate(missing_parity):
                 shards[i] = out[row]
         return shards
@@ -182,7 +224,7 @@ class ReedSolomon:
             # parity rows: parity = P @ data = (P @ dec) @ chosen
             full[self.k:] = gf256.gf_matmul_ref(self.parity_rows, dec)
             masks[b] = gf256.coeff_masks(full)
-        out = _matmul_batch_per(jnp.asarray(masks), jnp.asarray(pack_shards(gathered)))
+        out = self._mm_batch_per(jnp.asarray(masks), jnp.asarray(pack_shards(gathered)))
         return unpack_shards(np.asarray(out))
 
     # -- verify --------------------------------------------------------------
@@ -191,7 +233,7 @@ class ReedSolomon:
         """shards uint8 [k+m, S] -> True iff parity matches data."""
         shards = np.asarray(shards, dtype=np.uint8)
         w = jnp.asarray(pack_shards(shards[: self.k]))
-        par = _matmul_j(self._enc_masks, w)
+        par = self._mm(self._enc_masks, w)
         want = jnp.asarray(pack_shards(shards[self.k:]))
         return bool(jnp.all(par == want))
 
@@ -217,6 +259,7 @@ class ReedSolomon:
 
 
 @functools.lru_cache(maxsize=64)
-def get_codec(k: int, m: int, matrix_kind: str = "vandermonde") -> ReedSolomon:
+def get_codec(k: int, m: int, matrix_kind: str = "vandermonde",
+              backend: str = "auto") -> ReedSolomon:
     """Process-wide codec cache (matrix build + mask upload amortized)."""
-    return ReedSolomon(k, m, matrix_kind)
+    return ReedSolomon(k, m, matrix_kind, backend)

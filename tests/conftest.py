@@ -6,8 +6,17 @@ layouts) — here, multi-chip shardings run on virtual CPU devices.
 """
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
+
+# The environment's sitecustomize force-registers the axon TPU platform and
+# overrides JAX_PLATFORMS, so the env var alone is not enough — the config
+# must be updated after import (before backends initialize). Set
+# MINIO_TPU_TEST_ON_DEVICE=1 to run the suite against the real chip instead.
+if os.environ.get("MINIO_TPU_TEST_ON_DEVICE") != "1":
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
