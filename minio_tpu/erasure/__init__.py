@@ -1,0 +1,8 @@
+"""Erasure engine: codec wrapper, bitrot protection, streaming encode /
+decode / heal (the TPU-native rebuild of reference L3 — SURVEY.md §1)."""
+from .codec import Erasure
+from .bitrot import (BitrotAlgorithm, new_bitrot_writer, new_bitrot_reader,
+                     bitrot_shard_file_size, DEFAULT_BITROT_ALGO)
+
+__all__ = ["Erasure", "BitrotAlgorithm", "new_bitrot_writer",
+           "new_bitrot_reader", "bitrot_shard_file_size", "DEFAULT_BITROT_ALGO"]

@@ -1,0 +1,276 @@
+"""Bitrot protection — per-shard checksums in the reference's two modes
+(cmd/bitrot.go, cmd/bitrot-streaming.go, cmd/bitrot-whole.go):
+
+- **streaming** (default): the shard file interleaves a fixed-size digest
+  before every up-to-shard_size chunk: ``[H][chunk][H][chunk]...``; total
+  file size = ceil(len/shard_size)*H + len (bitrotShardFileSize,
+  cmd/bitrot.go:140). Reads must be chunk-aligned; each chunk is verified on
+  read (cmd/bitrot-streaming.go:115-151).
+- **whole-file**: one digest over the whole shard, stored in xl.meta; file
+  holds raw bytes (cmd/bitrot-whole.go).
+
+Algorithms: the reference's HighwayHash256/256S keyed hash will be served by
+the native C++ library once minio_tpu/native/highwayhash.cpp lands (until
+then those two enum members exist but .available is False and .new() raises);
+BLAKE2b-256 (hashlib) is the fallback/default. SHA256 and BLAKE2b-512
+complete the algorithm table (cmd/bitrot.go:33-44).
+"""
+from __future__ import annotations
+
+import enum
+import hashlib
+from dataclasses import dataclass
+
+from ..utils import errors
+
+#: The reference's fixed HighwayHash key (cmd/bitrot.go:31) is a magic
+#: constant; we use our own framework-wide key (any fixed key works — the
+#: hash is for corruption detection, not authentication).
+HIGHWAY_KEY = bytes.fromhex(
+    "4be734fa8e238acd263e83e6bb968552040f935da39f441497e09d1322de36a0")
+
+
+class BitrotAlgorithm(enum.Enum):
+    SHA256 = "sha256"
+    BLAKE2B512 = "blake2b"
+    HIGHWAYHASH256 = "highwayhash256"
+    HIGHWAYHASH256S = "highwayhash256S"
+    BLAKE2B256S = "blake2b256S"  # TPU-build streaming default (32-byte blake2b)
+
+    @property
+    def streaming(self) -> bool:
+        return self in (BitrotAlgorithm.HIGHWAYHASH256S,
+                        BitrotAlgorithm.BLAKE2B256S)
+
+    @property
+    def digest_size(self) -> int:
+        return _ALGOS[self]().digest_size
+
+    def new(self):
+        return _ALGOS[self]()
+
+    @property
+    def available(self) -> bool:
+        try:
+            self.new()
+            return True
+        except Exception:
+            return False
+
+
+def _blake2b256():
+    return hashlib.blake2b(digest_size=32)
+
+
+def _blake2b512():
+    return hashlib.blake2b(digest_size=64)
+
+
+def _highwayhash256():
+    from ..native import highwayhash
+    return highwayhash.HighwayHash256(HIGHWAY_KEY)
+
+
+_ALGOS = {
+    BitrotAlgorithm.SHA256: hashlib.sha256,
+    BitrotAlgorithm.BLAKE2B512: _blake2b512,
+    BitrotAlgorithm.HIGHWAYHASH256: _highwayhash256,
+    BitrotAlgorithm.HIGHWAYHASH256S: _highwayhash256,
+    BitrotAlgorithm.BLAKE2B256S: _blake2b256,
+}
+
+
+def default_bitrot_algo() -> BitrotAlgorithm:
+    """Streaming HighwayHash if the native library is built, else blake2b."""
+    a = BitrotAlgorithm.HIGHWAYHASH256S
+    return a if a.available else BitrotAlgorithm.BLAKE2B256S
+
+
+DEFAULT_BITROT_ALGO = default_bitrot_algo()
+
+
+def bitrot_shard_file_size(size: int, shard_size: int,
+                           algo: BitrotAlgorithm) -> int:
+    """On-disk size of a shard file of ``size`` logical bytes
+    (cmd/bitrot.go:140-145)."""
+    if not algo.streaming:
+        return size
+    if size == 0:
+        return 0
+    h = algo.digest_size
+    return -(-size // shard_size) * h + size
+
+
+def bitrot_logical_size(file_size: int, shard_size: int,
+                        algo: BitrotAlgorithm) -> int:
+    """Inverse of bitrot_shard_file_size: logical shard bytes in a file."""
+    if not algo.streaming or file_size == 0:
+        return file_size
+    h = algo.digest_size
+    chunks = -(-file_size // (shard_size + h))
+    return file_size - chunks * h
+
+
+# --- streaming writer/reader -------------------------------------------------
+
+
+class StreamingBitrotWriter:
+    """Writes ``[digest][chunk]`` per shard_size chunk into a byte sink.
+
+    The sink is any object with write(bytes) and close(); buffering chunk
+    alignment is handled here: callers may write() arbitrary sizes, digests
+    are emitted every shard_size logical bytes (matching the reference, where
+    the encode loop writes exactly one shard-block per call —
+    cmd/bitrot-streaming.go:74-89).
+    """
+
+    def __init__(self, sink, algo: BitrotAlgorithm, shard_size: int):
+        assert algo.streaming
+        self.sink = sink
+        self.algo = algo
+        self.shard_size = shard_size
+        self._buf = bytearray()
+
+    def write(self, b: bytes):
+        self._buf += b
+        while len(self._buf) >= self.shard_size:
+            chunk = bytes(self._buf[: self.shard_size])
+            del self._buf[: self.shard_size]
+            self._emit(chunk)
+
+    def _emit(self, chunk: bytes):
+        h = self.algo.new()
+        h.update(chunk)
+        self.sink.write(h.digest())
+        self.sink.write(chunk)
+
+    def close(self):
+        if self._buf:
+            self._emit(bytes(self._buf))
+            self._buf.clear()
+        self.sink.close()
+
+    def abort(self):
+        if hasattr(self.sink, "abort"):
+            self.sink.abort()
+        else:
+            self.sink.close()
+
+
+class StreamingBitrotReader:
+    """Chunk-aligned verified reads over a ``[digest][chunk]`` stream.
+
+    ``src`` exposes read_at(offset, length) over the *physical* file.
+    read_at() here takes *logical* shard offsets; offset must be chunk
+    aligned (the erasure decode path always reads whole shard blocks —
+    cmd/bitrot-streaming.go:115-151).
+    """
+
+    def __init__(self, src, till_offset: int, algo: BitrotAlgorithm,
+                 shard_size: int):
+        assert algo.streaming
+        self.src = src
+        self.algo = algo
+        self.shard_size = shard_size
+        self.till_offset = till_offset  # logical end offset we may read to
+
+    def read_at(self, offset: int, length: int) -> bytes:
+        if length == 0:
+            return b""
+        if offset % self.shard_size:
+            raise ValueError(f"unaligned bitrot read at {offset}")
+        if offset + length > self.till_offset:
+            raise errors.FileCorrupt(
+                f"bitrot read [{offset}, {offset + length}) past shard end "
+                f"{self.till_offset}")
+        h = self.algo.digest_size
+        out = bytearray()
+        while length > 0:
+            chunk_len = min(self.shard_size, length)
+            phys = (offset // self.shard_size) * (self.shard_size + h) \
+                + (offset % self.shard_size)
+            blob = self.src.read_at(phys, h + chunk_len)
+            if len(blob) < h:
+                raise errors.FileCorrupt("short bitrot stream")
+            digest, chunk = blob[:h], blob[h: h + chunk_len]
+            if len(chunk) < chunk_len:
+                raise errors.FileCorrupt("short bitrot chunk")
+            hh = self.algo.new()
+            hh.update(chunk)
+            if hh.digest() != digest:
+                raise errors.FileCorrupt("bitrot hash mismatch")
+            out += chunk
+            offset += chunk_len
+            length -= chunk_len
+        return bytes(out)
+
+
+# --- whole-file writer/reader ------------------------------------------------
+
+
+class WholeBitrotWriter:
+    """Raw passthrough writer accumulating one digest for xl.meta
+    (cmd/bitrot-whole.go)."""
+
+    def __init__(self, sink, algo: BitrotAlgorithm):
+        self.sink = sink
+        self._h = algo.new()
+
+    def write(self, b: bytes):
+        self._h.update(b)
+        self.sink.write(b)
+
+    def digest(self) -> bytes:
+        return self._h.digest()
+
+    def close(self):
+        self.sink.close()
+
+
+class WholeBitrotReader:
+    """Reads the whole shard once, verifies against the stored digest, then
+    serves read_at from memory (the reference verifies lazily on first read —
+    cmd/bitrot-whole.go:55-80)."""
+
+    def __init__(self, src, expected_digest: bytes, algo: BitrotAlgorithm,
+                 file_size: int):
+        self.src = src
+        self.expected = expected_digest
+        self.algo = algo
+        self.file_size = file_size
+        self._data: bytes | None = None
+
+    def read_at(self, offset: int, length: int) -> bytes:
+        if self._data is None:
+            data = self.src.read_at(0, self.file_size)
+            h = self.algo.new()
+            h.update(data)
+            if self.expected and h.digest() != self.expected:
+                raise errors.FileCorrupt("bitrot whole-file hash mismatch")
+            self._data = data
+        if offset + length > len(self._data):
+            raise errors.FileCorrupt("bitrot read past end")
+        return self._data[offset: offset + length]
+
+
+@dataclass
+class ChecksumInfo:
+    """Per-part checksum record persisted in xl.meta (reference
+    ChecksumInfo, cmd/erasure-metadata.go)."""
+    part_number: int
+    algorithm: str
+    hash: bytes
+
+
+def new_bitrot_writer(sink, algo: BitrotAlgorithm, shard_size: int):
+    if algo.streaming:
+        return StreamingBitrotWriter(sink, algo, shard_size)
+    return WholeBitrotWriter(sink, algo)
+
+
+def new_bitrot_reader(src, algo: BitrotAlgorithm, till_offset: int,
+                      shard_size: int, expected_digest: bytes = b"",
+                      file_size: int = 0):
+    if algo.streaming:
+        return StreamingBitrotReader(src, till_offset, algo, shard_size)
+    return WholeBitrotReader(src, expected_digest, algo, file_size)

@@ -1,0 +1,157 @@
+"""Erasure codec wrapper — the equivalent of the reference's ``Erasure``
+struct (cmd/erasure-coding.go:28-143): geometry + shard-size math + blockwise
+encode/decode entry points, delegating the GF(256) math to the device codec
+(minio_tpu.ops.rs_jax.ReedSolomon, optionally batched via the dispatch
+runtime).
+
+Shard-size math is kept bit-identical to the reference:
+- ShardSize            = ceil(blockSize / dataBlocks)         (:115)
+- ShardFileSize(total) = fullBlocks*ShardSize + ceil(last/k)  (:120-131)
+- ShardFileOffset      = endBlock*ShardSize + ceil(tail/k)    (:134-141)
+
+The device kernels need 4-byte-aligned shard lengths; alignment padding is
+internal to encode/decode (shards on disk keep the exact reference sizes, so
+on-disk layout stays interoperable with the math above).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..ops.rs_jax import ReedSolomon, get_codec
+
+
+def ceil_div(a: int, b: int) -> int:
+    return -(-a // b)
+
+
+class Erasure:
+    """Erasure codec for one (data, parity, block_size) geometry."""
+
+    def __init__(self, data_blocks: int, parity_blocks: int, block_size: int,
+                 matrix_kind: str = "vandermonde", backend: str = "auto"):
+        if data_blocks <= 0 or parity_blocks < 1:
+            # parity >= 1 is required by the codec; validate at configuration
+            # time, not on first encode
+            raise ValueError(
+                f"invalid erasure geometry {data_blocks}+{parity_blocks}")
+        if data_blocks + parity_blocks > 256:
+            # reference cap: shard count <= 256 (cmd/erasure-coding.go:41)
+            raise ValueError("total shard count exceeds 256")
+        self.data_blocks = data_blocks
+        self.parity_blocks = parity_blocks
+        self.block_size = block_size
+        self._codec: ReedSolomon | None = None
+        self._codec_args = (data_blocks, parity_blocks, matrix_kind, backend)
+
+    @property
+    def codec(self) -> ReedSolomon:
+        if self._codec is None:
+            self._codec = get_codec(*self._codec_args)
+        return self._codec
+
+    # --- shard-size math (bit-identical to cmd/erasure-coding.go:115-141) ---
+
+    def shard_size(self) -> int:
+        """Size of each shard for one full block."""
+        return ceil_div(self.block_size, self.data_blocks)
+
+    def shard_file_size(self, total_length: int) -> int:
+        """Final erasure-shard file size on disk for an object of
+        ``total_length`` bytes."""
+        if total_length == 0:
+            return 0
+        if total_length < 0:
+            return -1
+        full, last = divmod(total_length, self.block_size)
+        size = full * self.shard_size()
+        if last:
+            size += ceil_div(last, self.data_blocks)
+        return size
+
+    def shard_file_offset(self, start_offset: int, length: int,
+                          total_length: int) -> int:
+        """Offset within the shard file where a read ending at
+        start_offset+length stops."""
+        shard_size = self.shard_size()
+        shard_file_size = self.shard_file_size(total_length)
+        end_shard = (start_offset + length) // self.block_size
+        till_offset = end_shard * shard_size + shard_size
+        if till_offset > shard_file_size:
+            till_offset = shard_file_size
+        return till_offset
+
+    # --- blockwise encode/decode -------------------------------------------
+
+    def encode_data(self, data: bytes | bytearray | memoryview | np.ndarray
+                    ) -> list[np.ndarray]:
+        """Split one block into k data shards, compute m parity shards on
+        device, return all k+m (reference EncodeData, cmd/erasure-coding.go:70).
+
+        The split pads the last shard with zeros to equalize shard lengths
+        (and to 4-byte alignment for the packed kernel); the true shard length
+        on disk is ceil(len/k), so callers truncate via shard_file_size math.
+        """
+        buf = np.frombuffer(data, dtype=np.uint8) if not isinstance(
+            data, np.ndarray) else np.asarray(data, dtype=np.uint8)
+        if buf.size == 0:
+            return [np.empty(0, np.uint8) for _ in range(self.data_blocks + self.parity_blocks)]
+        # Split stride is the exact reference shard length ceil(len/k) — the
+        # on-disk layout. Kernel alignment padding is applied per shard
+        # (trailing zeros), so truncating the resulting parity back to the
+        # true length matches parity of the exact-size shards byte for byte.
+        true_shard = ceil_div(buf.size, self.data_blocks)
+        shards = self.codec.split(buf, true_shard)
+        pad = (-true_shard) % 4
+        if pad:
+            padded = np.concatenate(
+                [shards, np.zeros((self.data_blocks, pad), np.uint8)], axis=1)
+        else:
+            padded = shards
+        parity = self.codec.encode(padded)
+        return [shards[i] for i in range(self.data_blocks)] + \
+               [parity[i][:true_shard] for i in range(self.parity_blocks)]
+
+    def decode_data_blocks(self, shards: list[np.ndarray | None]
+                           ) -> list[np.ndarray]:
+        """Reconstruct missing *data* shards only (reference DecodeDataBlocks,
+        cmd/erasure-coding.go:89). Input: length k+m list, None for missing.
+        All present shards must share one length."""
+        aligned, true_len = self._aligned(shards)
+        out = self.codec.reconstruct(aligned, data_only=True)
+        return self._unaligned(out, true_len)
+
+    def decode_data_and_parity_blocks(self, shards: list[np.ndarray | None]
+                                      ) -> list[np.ndarray]:
+        """Reconstruct all missing shards (reference DecodeDataAndParityBlocks,
+        cmd/erasure-coding.go:106)."""
+        aligned, true_len = self._aligned(shards)
+        out = self.codec.reconstruct(aligned, data_only=False)
+        return self._unaligned(out, true_len)
+
+    @staticmethod
+    def _aligned(shards):
+        """Pad present shards to 4-byte alignment for the packed kernel;
+        returns (padded_shards, true_len). Stateless — one Erasure instance
+        serves concurrent requests."""
+        lens = {s.shape[-1] for s in shards if s is not None}
+        if not lens:
+            raise ValueError("no shards present")
+        if len(lens) != 1:
+            raise ValueError(f"inconsistent shard sizes {sorted(lens)}")
+        (true_len,) = lens
+        pad = (-true_len) % 4
+        if pad == 0:
+            return list(shards), true_len
+        return [None if s is None else
+                np.concatenate([np.asarray(s, np.uint8),
+                                np.zeros(pad, np.uint8)]) for s in shards], \
+            true_len
+
+    @staticmethod
+    def _unaligned(shards, true_len):
+        return [None if s is None else s[:true_len] for s in shards]
+
+    def verify(self, shards: list[np.ndarray]) -> bool:
+        """True iff parity shards are consistent with data shards."""
+        aligned, _ = self._aligned(shards)
+        return self.codec.verify(np.stack(aligned))

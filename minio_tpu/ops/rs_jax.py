@@ -146,16 +146,20 @@ class ReedSolomon:
             self._decode_cache[present] = mat
         return mat
 
-    def _decode_masks(self, present: tuple[int, ...],
-                      rows: tuple[int, ...]) -> jnp.ndarray:
-        """Device-resident masks for decode-matrix rows, cached per loss
-        pattern so repeated degraded reads skip the host->device upload."""
-        key = (present, rows)
+    def _cached_masks(self, key: tuple, build) -> jnp.ndarray:
+        """Device-resident coefficient masks cached per loss pattern so
+        repeated degraded reads skip matrix build + host->device upload."""
         masks = self._mask_cache.get(key)
         if masks is None:
-            masks = _device_masks(self._decode_mat(present)[list(rows), :])
+            masks = _device_masks(build())
             self._mask_cache[key] = masks
         return masks
+
+    def _decode_masks(self, present: tuple[int, ...],
+                      rows: tuple[int, ...]) -> jnp.ndarray:
+        return self._cached_masks(
+            (present, rows),
+            lambda: self._decode_mat(present)[list(rows), :])
 
     def _choose_present(self, shards: list[np.ndarray | None]) -> tuple[int, ...]:
         present = tuple(i for i, s in enumerate(shards) if s is not None)
@@ -188,12 +192,9 @@ class ReedSolomon:
 
         if missing_parity and not data_only:
             data = np.stack(shards[: self.k])
-            key = ("parity", tuple(missing_parity))
-            masks = self._mask_cache.get(key)
-            if masks is None:
-                masks = _device_masks(
-                    self.parity_rows[[i - self.k for i in missing_parity], :])
-                self._mask_cache[key] = masks
+            masks = self._cached_masks(
+                ("parity", tuple(missing_parity)),
+                lambda: self.parity_rows[[i - self.k for i in missing_parity], :])
             out = unpack_shards(np.asarray(
                 self._mm(masks, jnp.asarray(pack_shards(data)))))
             for row, i in enumerate(missing_parity):

@@ -1,0 +1,1 @@
+"""Shared utilities: storage error taxonomy, quorum reduction, helpers."""

@@ -1,0 +1,212 @@
+"""Erasure streaming-layer tests, mirroring the reference's grid:
+cmd/erasure-encode_test.go (offline disks), cmd/erasure-decode_test.go
+(drives down, corrupted shards), cmd/erasure-heal_test.go (heal roundtrip),
+plus ShardSize/ShardFileSize math checks against cmd/erasure-coding.go."""
+import io
+
+import numpy as np
+import pytest
+
+from minio_tpu.erasure import (Erasure, BitrotAlgorithm, new_bitrot_writer,
+                               new_bitrot_reader, bitrot_shard_file_size)
+from minio_tpu.erasure.bitrot import bitrot_logical_size
+from minio_tpu.erasure.streaming import (BufferSink, BufferSource,
+                                         erasure_encode, erasure_decode,
+                                         erasure_heal)
+from minio_tpu.utils import errors
+
+ALGO = BitrotAlgorithm.BLAKE2B256S
+
+
+def rng_bytes(n, seed=0):
+    return np.random.default_rng(seed).integers(
+        0, 256, size=n, dtype=np.uint8).tobytes()
+
+
+def encode_to_buffers(k, m, block_size, data, offline=()):
+    """Encode data through bitrot writers into in-memory shard files."""
+    er = Erasure(k, m, block_size)
+    sinks = [BufferSink() for _ in range(k + m)]
+    shard_size = er.shard_size()
+    writers = [None if i in offline else
+               new_bitrot_writer(sinks[i], ALGO, shard_size)
+               for i in range(k + m)]
+    quorum = k + 1 if k == m else k
+    n = erasure_encode(er, io.BytesIO(data), writers, quorum)
+    assert n == len(data)
+    for w in writers:
+        if w is not None:
+            w.close()
+    return er, sinks
+
+
+def readers_from(sinks, er, total_length, drop=()):
+    shard_size = er.shard_size()
+    till = er.shard_file_size(total_length)
+    out = []
+    for i, s in enumerate(sinks):
+        if i in drop or not s.closed:
+            out.append(None)
+        else:
+            out.append(new_bitrot_reader(
+                BufferSource(s.getvalue()), ALGO, till, shard_size))
+    return out
+
+
+GRID = [
+    (2, 2, 64 << 10, 1 << 20),
+    (4, 2, 1 << 20, 3 << 20),
+    (8, 4, 1 << 20, (4 << 20) + 123457),
+    (16, 4, 1 << 20, 2 << 20),
+    (5, 3, 1 << 20, 1 << 20),  # k not a power of two, odd shard sizes
+]
+
+
+@pytest.mark.parametrize("k,m,bs,size", GRID)
+def test_encode_decode_roundtrip(k, m, bs, size):
+    data = rng_bytes(size, seed=k * 31 + m)
+    er, sinks = encode_to_buffers(k, m, bs, data)
+    # verify on-disk shard file sizes match reference math
+    for s in sinks:
+        assert len(s.getvalue()) == bitrot_shard_file_size(
+            er.shard_file_size(size), er.shard_size(), ALGO)
+    out = BufferSink()
+    stats = erasure_decode(er, out, readers_from(sinks, er, size), 0, size, size)
+    assert out.getvalue() == data
+    assert stats.bytes_written == size
+
+
+@pytest.mark.parametrize("k,m,bs,size", GRID)
+def test_decode_with_drives_down(k, m, bs, size):
+    data = rng_bytes(size, seed=1)
+    er, sinks = encode_to_buffers(k, m, bs, data)
+    # drop up to m shards (mix of data+parity)
+    drop = tuple(range(0, m, 2)) + tuple(range(k, k + (m + 1) // 2))
+    drop = drop[:m]
+    out = BufferSink()
+    erasure_decode(er, out, readers_from(sinks, er, size, drop=drop),
+                   0, size, size)
+    assert out.getvalue() == data
+
+
+def test_decode_insufficient_shards():
+    k, m, bs, size = 4, 2, 1 << 20, 2 << 20
+    data = rng_bytes(size)
+    er, sinks = encode_to_buffers(k, m, bs, data)
+    drop = (0, 1, 4)  # m+1 drives down
+    out = BufferSink()
+    with pytest.raises(errors.StorageError):
+        erasure_decode(er, out, readers_from(sinks, er, size, drop=drop),
+                       0, size, size)
+
+
+def test_decode_range_reads():
+    k, m, bs = 4, 2, 1 << 20
+    size = (3 << 20) + 789
+    data = rng_bytes(size, seed=7)
+    er, sinks = encode_to_buffers(k, m, bs, data)
+    for off, ln in [(0, 100), (size - 100, 100), (bs - 3, 7),
+                    (bs, bs), ((1 << 20) + 17, (1 << 20) + 100), (size, 0),
+                    (123, 0)]:
+        out = BufferSink()
+        erasure_decode(er, out, readers_from(sinks, er, size), off, ln, size)
+        assert out.getvalue() == data[off: off + ln], (off, ln)
+
+
+def test_decode_detects_bitrot_and_reconstructs():
+    k, m, bs, size = 4, 2, 1 << 20, 2 << 20
+    data = rng_bytes(size, seed=3)
+    er, sinks = encode_to_buffers(k, m, bs, data)
+    # corrupt one byte mid-chunk in shard 1
+    blob = bytearray(sinks[1].getvalue())
+    blob[len(blob) // 2] ^= 0xFF
+    sinks[1].buf = io.BytesIO(blob)
+
+    out = BufferSink()
+    stats = erasure_decode(er, out, readers_from(sinks, er, size), 0, size, size)
+    assert out.getvalue() == data
+    # the corrupted reader must be flagged for heal-on-read
+    assert isinstance(stats.errs[1], errors.FileCorrupt)
+
+
+def test_encode_with_offline_disks_quorum():
+    k, m, bs, size = 4, 2, 1 << 20, 1 << 20
+    data = rng_bytes(size, seed=9)
+    # m offline: still meets write quorum k
+    er, sinks = encode_to_buffers(k, m, bs, data, offline=(1, 5))
+    out = BufferSink()
+    erasure_decode(er, out, readers_from(sinks, er, size), 0, size, size)
+    assert out.getvalue() == data
+    # too many offline: write quorum failure
+    with pytest.raises(errors.StorageError):
+        encode_to_buffers(k, m, bs, data, offline=(0, 1, 4))
+
+
+def test_heal_roundtrip():
+    """cmd/erasure-heal_test.go analogue: wipe shards, heal, verify."""
+    k, m, bs = 8, 4, 1 << 20
+    size = (2 << 20) + 4321
+    data = rng_bytes(size, seed=11)
+    er, sinks = encode_to_buffers(k, m, bs, data)
+    wiped = (2, 9, 11)
+    readers = readers_from(sinks, er, size, drop=wiped)
+    heal_sinks = {i: BufferSink() for i in wiped}
+    writers = [None] * (k + m)
+    for i in wiped:
+        writers[i] = new_bitrot_writer(heal_sinks[i], ALGO, er.shard_size())
+    erasure_heal(er, writers, readers, size)
+    for i in wiped:
+        assert heal_sinks[i].getvalue() == sinks[i].getvalue()
+    # decode reading ONLY from healed shards + minimum others
+    drop = tuple(j for j in range(k + m) if j not in wiped)[:m]
+    merged = list(sinks)
+    for i in wiped:
+        merged[i] = heal_sinks[i]
+    out = BufferSink()
+    erasure_decode(er, out, readers_from(merged, er, size, drop=drop),
+                   0, size, size)
+    assert out.getvalue() == data
+
+
+def test_empty_object():
+    er, sinks = encode_to_buffers(4, 2, 1 << 20, b"")
+    for s in sinks:
+        assert s.getvalue() == b""
+    out = BufferSink()
+    erasure_decode(er, out, readers_from(sinks, er, 0), 0, 0, 0)
+    assert out.getvalue() == b""
+
+
+def test_shard_math_reference_values():
+    """Check against hand-computed cmd/erasure-coding.go:115-141 values."""
+    er = Erasure(4, 2, 10 << 20)
+    assert er.shard_size() == (10 << 20) // 4
+    # 15 MiB object: one full block (shard 2.5MiB) + 5MiB tail -> ceil(5M/4)
+    size = 15 << 20
+    assert er.shard_file_size(size) == (10 << 20) // 4 + -(-(5 << 20) // 4)
+    assert er.shard_file_size(0) == 0
+    assert er.shard_file_size(-1) == -1
+    # offsets clamp to shard file size
+    assert er.shard_file_offset(0, size, size) == er.shard_file_size(size)
+    er2 = Erasure(16, 4, 1 << 20)
+    assert er2.shard_size() == (1 << 20) // 16
+    assert bitrot_logical_size(
+        bitrot_shard_file_size(123457, er2.shard_size(), ALGO),
+        er2.shard_size(), ALGO) == 123457
+
+
+def test_streaming_bitrot_layout():
+    """[digest][chunk] interleave layout (cmd/bitrot-streaming.go:74-89)."""
+    sink = BufferSink()
+    w = new_bitrot_writer(sink, ALGO, shard_size=1024)
+    payload = rng_bytes(2500, seed=5)
+    w.write(payload)
+    w.close()
+    blob = sink.getvalue()
+    h = ALGO.digest_size
+    assert len(blob) == 3 * h + 2500
+    r = new_bitrot_reader(BufferSource(blob), ALGO, 2500, 1024)
+    assert r.read_at(0, 1024) == payload[:1024]
+    assert r.read_at(1024, 1476) == payload[1024:]
+    with pytest.raises(ValueError):
+        r.read_at(100, 10)  # unaligned
