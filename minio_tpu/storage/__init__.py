@@ -1,0 +1,11 @@
+"""Storage layer (reference L2 — SURVEY.md §1): the StorageAPI disk
+abstraction, the xl.meta on-disk version journal, and the local posix
+backend. Remote disks (storage REST client) live in minio_tpu.dist and
+implement the same interface."""
+from .datatypes import (DiskInfo, ErasureInfo, FileInfo, ObjectPartInfo,
+                        VolInfo)
+from .interface import StorageAPI
+from .xlstorage import XLStorage
+
+__all__ = ["StorageAPI", "XLStorage", "FileInfo", "ErasureInfo",
+           "ObjectPartInfo", "DiskInfo", "VolInfo"]

@@ -1,0 +1,163 @@
+"""xl.meta — the per-object-version metadata journal (reference
+cmd/xl-storage-format-v2.go; layout doc SURVEY.md Appendix A.1/A.2).
+
+File layout: 8-byte magic header ``XLT2 1  `` (our format identifier — same
+role as the reference's ``XL2 `` + version ``1   `` at
+cmd/xl-storage-format-v2.go:33-38) followed by one msgpack map:
+
+    {"Versions": [ {"Type": 1|2, "ModTime": f64, "V": {...}} ... ],
+     "Data": {dataDir?: inlined bytes}}          # small-object inlining (A.4)
+
+Versions are kept sorted newest-first. Type 1 = object (full FileInfo incl.
+erasure geometry), Type 2 = delete marker. The legacy v1 type is not carried
+over — this framework has no pre-v2 history to migrate.
+"""
+from __future__ import annotations
+
+import msgpack
+
+from ..utils import errors
+from .datatypes import ErasureInfo, FileInfo, ObjectPartInfo
+
+XL_HEADER = b"XLT2 1  "
+XL_META_FILE = "xl.meta"
+
+TYPE_OBJECT = 1
+TYPE_DELETE_MARKER = 2
+
+#: Objects <= this inline their single part into xl.meta (smallFileThreshold,
+#: cmd/xl-storage.go:67).
+SMALL_FILE_THRESHOLD = 128 << 10
+
+#: Null-version sentinel used in version maps.
+NULL_VERSION = ""
+
+
+def _version_to_dict(fi: FileInfo) -> dict:
+    if fi.deleted:
+        return {"Type": TYPE_DELETE_MARKER, "ModTime": fi.mod_time,
+                "V": {"id": fi.version_id}}
+    return {
+        "Type": TYPE_OBJECT, "ModTime": fi.mod_time,
+        "V": {
+            "id": fi.version_id,
+            "ddir": fi.data_dir,
+            "size": fi.size,
+            "meta": dict(fi.metadata),
+            "parts": [p.to_dict() for p in fi.parts],
+            "ec": fi.erasure.to_dict(),
+        },
+    }
+
+
+def _version_to_fileinfo(d: dict, volume: str, name: str) -> FileInfo:
+    v = d.get("V", {})
+    if d["Type"] == TYPE_DELETE_MARKER:
+        return FileInfo(volume=volume, name=name, version_id=v.get("id", ""),
+                        deleted=True, mod_time=d.get("ModTime", 0.0))
+    return FileInfo(
+        volume=volume, name=name, version_id=v.get("id", ""),
+        data_dir=v.get("ddir", ""), mod_time=d.get("ModTime", 0.0),
+        size=v.get("size", 0), metadata=dict(v.get("meta", {})),
+        parts=[ObjectPartInfo.from_dict(p) for p in v.get("parts", [])],
+        erasure=ErasureInfo.from_dict(v.get("ec", {})),
+    )
+
+
+class XLMeta:
+    """Parsed xl.meta: a newest-first version journal + inline data blobs."""
+
+    def __init__(self):
+        self.versions: list[dict] = []
+        self.data: dict[str, bytes] = {}
+
+    # -- serialization -------------------------------------------------------
+
+    @classmethod
+    def load(cls, blob: bytes) -> "XLMeta":
+        if len(blob) < len(XL_HEADER) or blob[:4] != XL_HEADER[:4]:
+            raise errors.FileCorrupt("bad xl.meta header")
+        m = cls()
+        try:
+            doc = msgpack.unpackb(blob[len(XL_HEADER):], raw=False,
+                                  strict_map_key=False)
+        except Exception as e:  # noqa: BLE001
+            raise errors.FileCorrupt(f"xl.meta unpack: {e}") from e
+        m.versions = list(doc.get("Versions", []))
+        m.data = {k: v for k, v in doc.get("Data", {}).items()}
+        return m
+
+    def dump(self) -> bytes:
+        doc = {"Versions": self.versions, "Data": self.data}
+        return XL_HEADER + msgpack.packb(doc, use_bin_type=True)
+
+    # -- journal ops ---------------------------------------------------------
+
+    def _sort(self):
+        self.versions.sort(key=lambda d: d.get("ModTime", 0.0), reverse=True)
+
+    def add_version(self, fi: FileInfo):
+        """Insert/replace a version (AddVersion,
+        cmd/xl-storage-format-v2.go). Replacement key: version_id."""
+        vid = fi.version_id
+        self.versions = [
+            d for d in self.versions if d.get("V", {}).get("id", "") != vid]
+        self.versions.append(_version_to_dict(fi))
+        if fi.data is not None and fi.data_dir:
+            self.data[fi.data_dir] = fi.data
+        self._sort()
+
+    def delete_version(self, fi: FileInfo) -> str:
+        """Remove a version; returns its dataDir uuid (for part cleanup) or
+        "". If fi.deleted, a delete marker is *added* instead."""
+        if fi.deleted:
+            self.add_version(fi)
+            return ""
+        vid = fi.version_id
+        ddir = ""
+        kept = []
+        found = False
+        for d in self.versions:
+            if d.get("V", {}).get("id", "") == vid:
+                found = True
+                ddir = d.get("V", {}).get("ddir", "")
+            else:
+                kept.append(d)
+        if not found:
+            raise errors.FileVersionNotFound(vid)
+        self.versions = kept
+        if ddir and ddir in self.data:
+            del self.data[ddir]
+        return ddir
+
+    def find_version(self, version_id: str) -> dict:
+        if version_id == NULL_VERSION and self.versions:
+            return self.versions[0]  # latest
+        for d in self.versions:
+            if d.get("V", {}).get("id", "") == version_id:
+                return d
+        raise errors.FileVersionNotFound(version_id)
+
+    def to_fileinfo(self, volume: str, name: str, version_id: str = "",
+                    ) -> FileInfo:
+        if not self.versions:
+            raise errors.FileNotFound(name)
+        d = self.find_version(version_id)
+        fi = _version_to_fileinfo(d, volume, name)
+        fi.is_latest = d is self.versions[0]
+        fi.num_versions = len(self.versions)
+        if fi.data_dir and fi.data_dir in self.data:
+            fi.data = self.data[fi.data_dir]
+        return fi
+
+    def latest_mod_time(self) -> float:
+        return self.versions[0].get("ModTime", 0.0) if self.versions else 0.0
+
+    def list_versions(self, volume: str, name: str) -> list[FileInfo]:
+        out = []
+        for i, d in enumerate(self.versions):
+            fi = _version_to_fileinfo(d, volume, name)
+            fi.is_latest = i == 0
+            fi.num_versions = len(self.versions)
+            out.append(fi)
+        return out

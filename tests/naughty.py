@@ -1,0 +1,46 @@
+"""Fault-injection StorageAPI wrapper — the reference's naughtyDisk
+(cmd/naughty-disk_test.go:29-44): returns programmed errors on the Nth API
+call, letting quorum/heal behavior be tested deterministically."""
+from __future__ import annotations
+
+from minio_tpu.storage.interface import StorageAPI
+
+
+class NaughtyDisk(StorageAPI):
+    """Wraps a real disk; raises errs[call_no] (1-based, counted across all
+    API calls) when programmed, else default_err if set, else delegates."""
+
+    def __init__(self, disk: StorageAPI, errs: dict[int, Exception] | None = None,
+                 default_err: Exception | None = None):
+        self.disk = disk
+        self.errs = errs or {}
+        self.default_err = default_err
+        self.call_no = 0
+
+    def _maybe_raise(self):
+        self.call_no += 1
+        if self.call_no in self.errs:
+            raise self.errs[self.call_no]
+        if self.default_err is not None and self.call_no not in self.errs:
+            if self.errs:  # programmed-calls mode: others get default
+                raise self.default_err
+            raise self.default_err
+
+    def __getattr__(self, name):
+        # fall through for non-abstract helpers
+        return getattr(self.disk, name)
+
+
+def _wrap(name):
+    def method(self, *a, **kw):
+        self._maybe_raise()
+        return getattr(self.disk, name)(*a, **kw)
+    method.__name__ = name
+    return method
+
+
+for _m in [m for m in dir(StorageAPI)
+           if not m.startswith("_") and callable(getattr(StorageAPI, m))]:
+    setattr(NaughtyDisk, _m, _wrap(_m))
+# the wrappers satisfy every abstract method; clear ABC's creation-time cache
+NaughtyDisk.__abstractmethods__ = frozenset()

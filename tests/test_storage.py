@@ -1,0 +1,194 @@
+"""Storage-layer tests: xl.meta journal semantics, XLStorage posix backend
+(tmp-write + rename commit, version CRUD, walk), mirroring the reference's
+xl-storage_test.go / xl-storage-format_test.go coverage."""
+import os
+import uuid
+
+import pytest
+
+from minio_tpu.storage import XLStorage, FileInfo, ErasureInfo, ObjectPartInfo
+from minio_tpu.storage.xlmeta import XLMeta, XL_HEADER, XL_META_FILE
+from minio_tpu.storage.xlstorage import META_TMP
+from minio_tpu.utils import errors
+
+
+@pytest.fixture
+def disk(tmp_path):
+    return XLStorage(str(tmp_path / "disk0"), endpoint="local://disk0")
+
+
+def mk_fi(name="obj", vid=None, size=100, ddir=None, deleted=False):
+    return FileInfo(
+        volume="bucket", name=name,
+        version_id=vid if vid is not None else str(uuid.uuid4()),
+        deleted=deleted,
+        data_dir=ddir if ddir is not None else str(uuid.uuid4()),
+        mod_time=FileInfo.now(), size=size,
+        metadata={"content-type": "text/plain"},
+        parts=[ObjectPartInfo(number=1, size=size, actual_size=size)],
+        erasure=ErasureInfo(data_blocks=4, parity_blocks=2,
+                            block_size=1 << 20, index=1,
+                            distribution=list(range(1, 7))))
+
+
+def test_xlmeta_roundtrip():
+    m = XLMeta()
+    fi1, fi2 = mk_fi(vid="v1"), mk_fi(vid="v2")
+    fi2.mod_time = fi1.mod_time + 1
+    m.add_version(fi1)
+    m.add_version(fi2)
+    blob = m.dump()
+    assert blob.startswith(XL_HEADER[:4])
+    m2 = XLMeta.load(blob)
+    assert len(m2.versions) == 2
+    latest = m2.to_fileinfo("bucket", "obj")
+    assert latest.version_id == "v2" and latest.is_latest
+    old = m2.to_fileinfo("bucket", "obj", "v1")
+    assert old.version_id == "v1" and not old.is_latest
+    assert old.erasure.data_blocks == 4
+    assert old.parts[0].size == 100
+
+
+def test_xlmeta_delete_and_markers():
+    m = XLMeta()
+    m.add_version(mk_fi(vid="v1"))
+    dm = mk_fi(vid="v2", deleted=True)
+    dm.mod_time = m.versions[0]["ModTime"] + 1
+    m.delete_version(dm)  # adds delete marker
+    assert m.to_fileinfo("b", "o").deleted
+    assert not m.to_fileinfo("b", "o", "v1").deleted
+    ddir = m.delete_version(mk_fi(vid="v1", ddir=""))
+    assert len(m.versions) == 1
+    with pytest.raises(errors.FileVersionNotFound):
+        m.find_version("v1")
+    assert ddir == m.versions[0].get("V", {}).get("ddir", "") or ddir != ""
+
+
+def test_xlmeta_corrupt():
+    with pytest.raises(errors.FileCorrupt):
+        XLMeta.load(b"garbage!" + b"\x00" * 10)
+
+
+def test_volume_crud(disk):
+    disk.make_vol("bucket")
+    with pytest.raises(errors.VolumeExists):
+        disk.make_vol("bucket")
+    assert [v.name for v in disk.list_vols()] == ["bucket"]
+    assert disk.stat_vol("bucket").name == "bucket"
+    with pytest.raises(errors.VolumeNotFound):
+        disk.stat_vol("nope")
+    disk.write_all("bucket", "x/y", b"data")
+    with pytest.raises(errors.VolumeNotEmpty):
+        disk.delete_vol("bucket")
+    disk.delete_vol("bucket", force=True)
+    with pytest.raises(errors.VolumeNotFound):
+        disk.stat_vol("bucket")
+
+
+def test_raw_file_ops(disk):
+    disk.make_vol("b")
+    disk.write_all("b", "p/q", b"hello")
+    assert disk.read_all("b", "p/q") == b"hello"
+    disk.append_file("b", "p/q", b" world")
+    assert disk.read_all("b", "p/q") == b"hello world"
+    assert disk.stat_file_size("b", "p/q") == 11
+    r = disk.read_file_at("b", "p/q")
+    assert r.read_at(6, 5) == b"world"
+    r.close()
+    with pytest.raises(errors.FileNotFound):
+        disk.read_all("b", "missing")
+    with pytest.raises(errors.VolumeNotFound):
+        disk.read_all("nov", "x")
+    with pytest.raises(errors.FileAccessDenied):
+        disk.read_all("b", "../escape")
+
+
+def test_writer_commit_flow(disk):
+    """Shard write discipline: stream to tmp, rename_data to commit."""
+    disk.make_vol("bucket")
+    tmp_id = str(uuid.uuid4())
+    fi = mk_fi(name="obj")
+    w = disk.create_file_writer(META_TMP, f"{tmp_id}/{fi.data_dir}/part.1")
+    w.write(b"shard-bytes")
+    w.close()
+    disk.rename_data(META_TMP, tmp_id, fi, "bucket", "obj")
+    # tmp dir cleaned, data committed
+    assert disk.read_all("bucket", f"obj/{fi.data_dir}/part.1") == b"shard-bytes"
+    got = disk.read_version("bucket", "obj")
+    assert got.version_id == fi.version_id
+    assert got.size == 100
+    # inline read of small object
+    got = disk.read_version("bucket", "obj", read_data=True)
+    assert got.data == b"shard-bytes"
+
+
+def test_version_crud(disk):
+    disk.make_vol("b")
+    fi1 = mk_fi(vid="v1")
+    fi2 = mk_fi(vid="v2")
+    fi2.mod_time = fi1.mod_time + 1
+    disk.write_metadata("b", "o", fi1)
+    disk.write_metadata("b", "o", fi2)
+    assert disk.read_version("b", "o").version_id == "v2"
+    assert len(disk.list_versions("b", "o")) == 2
+    # update metadata
+    fi2.metadata["x-amz-meta-k"] = "v"
+    disk.update_metadata("b", "o", fi2)
+    assert disk.read_version("b", "o").metadata["x-amz-meta-k"] == "v"
+    with pytest.raises(errors.FileVersionNotFound):
+        disk.update_metadata("b", "o", mk_fi(vid="nope"))
+    # delete one version
+    disk.delete_version("b", "o", fi1)
+    assert [f.version_id for f in disk.list_versions("b", "o")] == ["v2"]
+    # deleting the last version removes the object dir
+    disk.delete_version("b", "o", fi2)
+    with pytest.raises(errors.FileNotFound):
+        disk.read_version("b", "o")
+    assert not os.path.exists(os.path.join(disk.base, "b", "o"))
+
+
+def test_inline_data_in_xlmeta(disk):
+    disk.make_vol("b")
+    fi = mk_fi()
+    fi.data = b"tiny object"
+    disk.write_metadata("b", "small", fi)
+    got = disk.read_version("b", "small", read_data=True)
+    assert got.data == b"tiny object"
+    # no part files on disk
+    assert not os.path.exists(
+        os.path.join(disk.base, "b", "small", fi.data_dir))
+
+
+def test_walk_dir(disk):
+    disk.make_vol("b")
+    for name in ["a/obj1", "a/obj2", "z", "m/n/deep"]:
+        disk.write_metadata("b", name, mk_fi(name=name))
+    assert list(disk.walk_dir("b")) == ["a/obj1", "a/obj2", "m/n/deep", "z"]
+    assert list(disk.walk_dir("b", "a")) == ["a/obj1", "a/obj2"]
+    assert list(disk.walk_dir("b", recursive=False)) == ["a/", "m/", "z"]
+
+
+def test_check_parts(disk):
+    from minio_tpu.erasure.bitrot import bitrot_shard_file_size, BitrotAlgorithm
+    disk.make_vol("b")
+    fi = mk_fi(size=1000)
+    fi.metadata["x-minio-internal-bitrot"] = "blake2b256S"
+    algo = BitrotAlgorithm.BLAKE2B256S
+    shard_len = fi.erasure.shard_file_size(1000)
+    fsize = bitrot_shard_file_size(shard_len, fi.erasure.shard_size(), algo)
+    disk.write_all("b", f"o/{fi.data_dir}/part.1", b"\0" * fsize)
+    disk.write_metadata("b", "o", fi)
+    disk.check_parts("b", "o", fi)  # ok
+    disk.write_all("b", f"o/{fi.data_dir}/part.1", b"\0" * (fsize - 1))
+    with pytest.raises(errors.FileCorrupt):
+        disk.check_parts("b", "o", fi)
+
+
+def test_naughty_disk(disk):
+    from naughty import NaughtyDisk
+    disk.make_vol("b")
+    nd = NaughtyDisk(disk, errs={2: errors.FaultyDisk()})
+    nd.write_all("b", "f", b"x")          # call 1: ok
+    with pytest.raises(errors.FaultyDisk):
+        nd.read_all("b", "f")             # call 2: injected
+    assert nd.read_all("b", "f") == b"x"  # call 3: ok
