@@ -1,0 +1,12 @@
+"""Object layer (reference L4 — SURVEY.md §1): the ObjectLayer backend
+abstraction and its erasure implementations (single set → sets → pools)."""
+from .datatypes import (BucketInfo, ListObjectsInfo, ObjectInfo,
+                        ObjectOptions, api_errors)
+from .interface import ObjectLayer
+from .erasure_objects import ErasureObjects
+from .sets import ErasureSets
+from .pools import ServerPools
+
+__all__ = ["ObjectLayer", "ErasureObjects", "ErasureSets", "ServerPools",
+           "ObjectInfo", "ObjectOptions", "BucketInfo", "ListObjectsInfo",
+           "api_errors"]

@@ -1,0 +1,865 @@
+"""ErasureObjects — the per-set object engine (reference erasureObjects,
+cmd/erasure.go:50 + cmd/erasure-object.go): PutObject/GetObject/Delete/Heal
+for one erasure set with the reference's quorum rules, disk shuffling by
+distribution, and heal-on-read signalling.
+
+TPU-first deltas from the reference (SURVEY.md §7): default erasure block is
+1 MiB (north-star geometry; the reference's 10 MiB suits SIMD-per-core,
+smaller blocks batch better across concurrent requests on one device), and
+all GF(256) math lands on the accelerator via minio_tpu.erasure.
+"""
+from __future__ import annotations
+
+import uuid
+from dataclasses import replace
+
+from ..erasure import (DEFAULT_BITROT_ALGO, Erasure, new_bitrot_reader,
+                       new_bitrot_writer)
+from ..erasure.bitrot import BitrotAlgorithm, bitrot_shard_file_size
+from ..erasure.codec import ceil_div
+from ..erasure.streaming import erasure_decode, erasure_encode, erasure_heal
+from ..storage.datatypes import ErasureInfo, FileInfo, ObjectPartInfo
+from ..storage.xlstorage import META_BUCKET, META_TMP
+from ..utils import errors
+from ..utils.hashreader import HashReader
+from . import datatypes as dt
+from .datatypes import (DRIVE_STATE_CORRUPT, DRIVE_STATE_MISSING,
+                        DRIVE_STATE_OFFLINE, DRIVE_STATE_OK, BucketInfo,
+                        DeletedObject, HealResultItem, ListObjectsInfo,
+                        ListObjectVersionsInfo, ObjectInfo, ObjectOptions)
+from .interface import ObjectLayer
+from .metadata import (find_file_info_in_quorum, hash_order, meta_pool,
+                       object_quorum_from_meta, read_all_fileinfo,
+                       shuffle_disks_by_distribution)
+from .multipart import MultipartMixin
+
+#: TPU-native default erasure block (vs reference blockSizeV1 = 10 MiB,
+#: cmd/object-api-common.go:32) — the north-star bench geometry.
+DEFAULT_BLOCK_SIZE = 1 << 20
+
+BITROT_KEY = "x-minio-internal-bitrot"
+ACTUAL_SIZE_KEY = "x-minio-internal-actual-size"
+
+
+def to_object_err(err: BaseException, bucket: str = "", object: str = ""):
+    """Map storage errors to user-visible API errors (reference toObjectErr,
+    cmd/object-api-errors.go)."""
+    if isinstance(err, dt.ObjectAPIError):
+        return err
+    if isinstance(err, errors.VolumeNotFound):
+        return dt.BucketNotFound(bucket)
+    if isinstance(err, errors.VolumeNotEmpty):
+        return dt.BucketNotEmpty(bucket)
+    if isinstance(err, errors.VolumeExists):
+        return dt.BucketExists(bucket)
+    if isinstance(err, (errors.FileNotFound, errors.IsNotRegular)):
+        return dt.ObjectNotFound(bucket, object)
+    if isinstance(err, errors.FileVersionNotFound):
+        return dt.VersionNotFound(bucket, object)
+    if isinstance(err, errors.ErasureReadQuorum):
+        return dt.InsufficientReadQuorum(bucket, object)
+    if isinstance(err, errors.ErasureWriteQuorum):
+        return dt.InsufficientWriteQuorum(bucket, object)
+    if isinstance(err, errors.DiskFull):
+        return dt.StorageFull(bucket, object)
+    if isinstance(err, errors.LessData):
+        return dt.IncompleteBody(bucket, object)
+    if isinstance(err, errors.MoreData):
+        return dt.IncompleteBody(bucket, object)
+    return err
+
+
+def check_names(bucket: str, object: str = ""):
+    if not bucket or bucket.startswith(".") or "/" in bucket:
+        raise dt.BucketNameInvalid(bucket)
+    if object:
+        if object.startswith("/") or ".." in object.split("/") \
+                or object.endswith("/"):
+            raise dt.ObjectNameInvalid(bucket, object)
+
+
+class ErasureObjects(MultipartMixin, ObjectLayer):
+    """One erasure set over a fixed list of disks (StorageAPI or None)."""
+
+    def __init__(self, disks: list, default_parity: int | None = None,
+                 block_size: int = DEFAULT_BLOCK_SIZE,
+                 bitrot_algo: BitrotAlgorithm = DEFAULT_BITROT_ALGO,
+                 set_index: int = 0, pool_index: int = 0):
+        self._disks = list(disks)
+        n = len(disks)
+        if n < 2:
+            raise ValueError("erasure set needs >= 2 disks")
+        self.default_parity = default_parity if default_parity is not None \
+            else n // 2
+        self.block_size = block_size
+        self.bitrot_algo = bitrot_algo
+        self.set_index = set_index
+        self.pool_index = pool_index
+        #: MRF hook — called with (bucket, object, version_id) when an op
+        #: detects a partial/degraded state (cmd/erasure-object.go:1132).
+        self.on_partial = None
+
+    # fresh list each call — ErasureSets swaps entries on reconnect
+    @property
+    def disks(self) -> list:
+        return list(self._disks)
+
+    def _notify_partial(self, bucket, object, version_id=""):
+        if self.on_partial is not None:
+            try:
+                self.on_partial(bucket, object, version_id)
+            except Exception:  # noqa: BLE001 — MRF is best-effort
+                pass
+
+    # --- buckets ------------------------------------------------------------
+
+    def make_bucket(self, bucket: str, opts: ObjectOptions = None) -> None:
+        check_names(bucket)
+        disks = self.disks
+        errs: list[BaseException | None] = [None] * len(disks)
+        futs = {}
+        for i, d in enumerate(disks):
+            if d is None:
+                errs[i] = errors.DiskNotFound()
+                continue
+            futs[i] = meta_pool().submit(d.make_vol, bucket)
+        for i, f in futs.items():
+            try:
+                f.result()
+            except Exception as e:  # noqa: BLE001
+                errs[i] = e
+        write_quorum = len(disks) // 2 + 1
+        err = errors.reduce_write_quorum_errs(
+            errs, errors.BASE_IGNORED_ERRS, write_quorum)
+        if err is not None:
+            if not isinstance(err, errors.VolumeExists):
+                # undo partial creates (reference undoMakeBucket)
+                for i, d in enumerate(disks):
+                    if d is not None and errs[i] is None:
+                        try:
+                            d.delete_vol(bucket)
+                        except errors.StorageError:
+                            pass
+            raise to_object_err(err, bucket)
+
+    def get_bucket_info(self, bucket: str) -> BucketInfo:
+        check_names(bucket)
+        last: BaseException = dt.BucketNotFound(bucket)
+        for d in self.disks:
+            if d is None:
+                continue
+            try:
+                v = d.stat_vol(bucket)
+                return BucketInfo(name=v.name, created=v.created)
+            except Exception as e:  # noqa: BLE001
+                last = e
+        raise to_object_err(last, bucket)
+
+    def list_buckets(self) -> list[BucketInfo]:
+        for d in self.disks:
+            if d is None:
+                continue
+            try:
+                return [BucketInfo(name=v.name, created=v.created)
+                        for v in d.list_vols()]
+            except errors.StorageError:
+                continue
+        raise dt.InsufficientReadQuorum()
+
+    def delete_bucket(self, bucket: str, force: bool = False) -> None:
+        check_names(bucket)
+        disks = self.disks
+        errs: list[BaseException | None] = [None] * len(disks)
+        futs = {}
+        for i, d in enumerate(disks):
+            if d is None:
+                errs[i] = errors.DiskNotFound()
+                continue
+            futs[i] = meta_pool().submit(d.delete_vol, bucket, force)
+        for i, f in futs.items():
+            try:
+                f.result()
+            except Exception as e:  # noqa: BLE001
+                errs[i] = e
+        write_quorum = len(disks) // 2 + 1
+        err = errors.reduce_write_quorum_errs(
+            errs, errors.BASE_IGNORED_ERRS + (errors.VolumeNotFound,),
+            write_quorum)
+        if err is not None:
+            raise to_object_err(err, bucket)
+
+    # --- put ---------------------------------------------------------------
+
+    def put_object(self, bucket: str, object: str, stream, size: int,
+                   opts: ObjectOptions = None) -> ObjectInfo:
+        opts = opts or ObjectOptions()
+        check_names(bucket, object)
+        self.get_bucket_info(bucket)  # BucketNotFound early
+
+        disks = self.disks
+        n = len(disks)
+        parity = self.default_parity
+        if opts.storage_class == "REDUCED_REDUNDANCY" and n >= 4:
+            parity = max(2, parity // 2)
+        data = n - parity
+        write_quorum = data + 1 if data == parity else data
+
+        fi = FileInfo(
+            volume=bucket, name=object,
+            version_id=FileInfo.new_version_id() if opts.versioned else "",
+            data_dir=str(uuid.uuid4()),
+            mod_time=opts.mod_time or FileInfo.now())
+        distribution = hash_order(f"{bucket}/{object}", n)
+        er = Erasure(data, parity, self.block_size)
+        shard_size = er.shard_size()
+
+        hr = stream if isinstance(stream, HashReader) else \
+            HashReader(stream, size)
+        tmp_id = str(uuid.uuid4())
+        shuffled = shuffle_disks_by_distribution(disks, distribution)
+        writers = []
+        for j, d in enumerate(shuffled):
+            if d is None:
+                writers.append(None)
+                continue
+            try:
+                sink = d.create_file_writer(
+                    META_TMP, f"{tmp_id}/{fi.data_dir}/part.1")
+                writers.append(new_bitrot_writer(
+                    sink, self.bitrot_algo, shard_size))
+            except Exception:  # noqa: BLE001
+                writers.append(None)
+
+        try:
+            total = erasure_encode(er, hr, writers, write_quorum)
+        except Exception as e:  # noqa: BLE001
+            for w in writers:
+                if w is not None:
+                    w.abort()
+            self._cleanup_tmp(tmp_id)
+            raise to_object_err(e, bucket, object) from e
+        for j, w in enumerate(writers):
+            if w is None:
+                continue
+            try:
+                w.close()
+            except Exception:  # noqa: BLE001
+                writers[j] = None
+
+        if size >= 0 and total != size:
+            self._cleanup_tmp(tmp_id)
+            raise dt.IncompleteBody(bucket, object)
+
+        etag = opts.user_defined.pop("etag", "") or hr.etag()
+        fi.size = total
+        fi.parts = [ObjectPartInfo(number=1, etag=etag, size=total,
+                                   actual_size=hr.actual_size
+                                   if hr.actual_size >= 0 else total)]
+        fi.metadata = {
+            "etag": etag,
+            "content-type": opts.user_defined.pop(
+                "content-type", "application/octet-stream"),
+            BITROT_KEY: self.bitrot_algo.value,
+            **opts.user_defined,
+        }
+        fi.erasure = ErasureInfo(
+            data_blocks=data, parity_blocks=parity,
+            block_size=self.block_size, distribution=distribution)
+
+        # commit: rename_data on every disk whose writer survived
+        errs: list[BaseException | None] = [None] * n
+        futs = {}
+        for j, d in enumerate(shuffled):
+            if d is None or writers[j] is None:
+                errs[j] = errors.DiskNotFound()
+                continue
+            fij = replace(fi, erasure=replace(fi.erasure, index=j + 1),
+                          metadata=dict(fi.metadata))
+            futs[j] = meta_pool().submit(
+                d.rename_data, META_TMP, tmp_id, fij, bucket, object)
+        for j, f in futs.items():
+            try:
+                f.result()
+            except Exception as e:  # noqa: BLE001
+                errs[j] = e if isinstance(e, errors.StorageError) \
+                    else errors.FaultyDisk(str(e))
+        err = errors.reduce_write_quorum_errs(
+            errs, errors.BASE_IGNORED_ERRS, write_quorum)
+        if err is not None:
+            raise to_object_err(err, bucket, object)
+        if any(e is not None for e in errs):
+            self._notify_partial(bucket, object, fi.version_id)
+        oi = ObjectInfo.from_file_info(fi, bucket, object, opts.versioned)
+        return oi
+
+    def _cleanup_tmp(self, tmp_id: str):
+        for d in self.disks:
+            if d is None:
+                continue
+            try:
+                d.delete_path(META_TMP, tmp_id, recursive=True)
+            except Exception:  # noqa: BLE001
+                pass
+
+    # --- get ---------------------------------------------------------------
+
+    def _read_quorum_fileinfo(self, bucket: str, object: str,
+                              version_id: str = "", read_data: bool = False
+                              ) -> tuple[FileInfo, list, list]:
+        """(quorum FileInfo, fis, errs) — getObjectFileInfo,
+        cmd/erasure-object.go:387."""
+        disks = self.disks
+        # "" = latest; "null" resolves to the unversioned entry inside the
+        # journal (XLMeta.find_version) — do NOT collapse it to latest here
+        fis, errs = read_all_fileinfo(disks, bucket, object, version_id,
+                                      read_data)
+        read_quorum, _ = object_quorum_from_meta(
+            fis, errs, self.default_parity)
+        err = errors.reduce_read_quorum_errs(
+            errs, errors.BASE_IGNORED_ERRS, read_quorum)
+        if err is not None:
+            raise to_object_err(err, bucket, object)
+        fi = find_file_info_in_quorum(fis, read_quorum)
+        return fi, fis, errs
+
+    def get_object_info(self, bucket: str, object: str,
+                        opts: ObjectOptions = None) -> ObjectInfo:
+        opts = opts or ObjectOptions()
+        check_names(bucket, object)
+        self.get_bucket_info(bucket)
+        try:
+            fi, _, _ = self._read_quorum_fileinfo(
+                bucket, object, opts.version_id)
+        except Exception as e:  # noqa: BLE001
+            raise to_object_err(e, bucket, object) from e
+        if fi.deleted:
+            if not opts.version_id:
+                raise dt.ObjectNotFound(bucket, object)
+            raise dt.MethodNotAllowed(bucket, object)
+        return ObjectInfo.from_file_info(
+            fi, bucket, object,
+            opts.versioned or bool(opts.version_id) or bool(fi.version_id))
+
+    def get_object(self, bucket: str, object: str, writer, offset: int = 0,
+                   length: int = -1, opts: ObjectOptions = None
+                   ) -> ObjectInfo:
+        opts = opts or ObjectOptions()
+        check_names(bucket, object)
+        self.get_bucket_info(bucket)
+        fi, fis, errs = self._read_quorum_fileinfo(
+            bucket, object, opts.version_id, read_data=True)
+        if fi.deleted:
+            if not opts.version_id:
+                raise dt.ObjectNotFound(bucket, object)
+            raise dt.MethodNotAllowed(bucket, object)
+        oi = ObjectInfo.from_file_info(
+            fi, bucket, object,
+            opts.versioned or bool(opts.version_id) or bool(fi.version_id))
+        if length < 0:
+            length = fi.size - offset
+        if offset < 0 or length < 0 or offset + length > fi.size:
+            raise dt.InvalidRange(bucket, object)
+        if fi.size == 0 or length == 0:
+            return oi
+
+        if fi.data is not None and len(fi.data) == fi.size:
+            writer.write(fi.data[offset: offset + length])
+            return oi
+
+        disks = self.disks
+        er = Erasure(fi.erasure.data_blocks, fi.erasure.parity_blocks,
+                     fi.erasure.block_size)
+        algo = BitrotAlgorithm(fi.metadata.get(
+            BITROT_KEY, DEFAULT_BITROT_ALGO.value))
+        shard_size = er.shard_size()
+
+        # disks in shard order via each disk's stored erasure index
+        per_shard_disk: list = [None] * len(disks)
+        for d, dfi in zip(disks, fis):
+            if d is None or dfi is None or dfi.deleted:
+                continue
+            if dfi.data_dir != fi.data_dir or \
+                    round(dfi.mod_time, 3) != round(fi.mod_time, 3):
+                continue  # outdated disk
+            idx = dfi.erasure.index
+            if 1 <= idx <= len(disks) and per_shard_disk[idx - 1] is None:
+                per_shard_disk[idx - 1] = d
+
+        degraded = False
+        part_start = 0  # start byte of current part within the object
+        for part in fi.parts:
+            part_end = part_start + part.size
+            if part_end <= offset:
+                part_start = part_end
+                continue
+            if part_start >= offset + length:
+                break
+            part_offset = max(0, offset - part_start)
+            part_length = min(part_end, offset + length) \
+                - (part_start + part_offset)
+            part_start = part_end
+            if part_length <= 0:
+                continue
+            readers = []
+            till = bitrot_shard_file_size(
+                er.shard_file_size(part.size), shard_size, algo)
+            for j in range(len(disks)):
+                d = per_shard_disk[j]
+                if d is None:
+                    readers.append(None)
+                    continue
+                try:
+                    src = d.read_file_at(
+                        bucket, f"{object}/{fi.data_dir}/part.{part.number}")
+                    logical = er.shard_file_size(part.size)
+                    readers.append(new_bitrot_reader(
+                        src, algo, logical, shard_size))
+                except Exception:  # noqa: BLE001
+                    readers.append(None)
+            try:
+                stats = erasure_decode(er, writer, readers, part_offset,
+                                       part_length, part.size)
+            except Exception as e:  # noqa: BLE001
+                raise to_object_err(e, bucket, object) from e
+            finally:
+                for r in readers:
+                    src = getattr(r, "src", None)
+                    if src is not None and hasattr(src, "close"):
+                        src.close()
+            if any(isinstance(e, (errors.FileCorrupt, errors.FileNotFound))
+                   for e in stats.errs):
+                degraded = True
+        if degraded or any(e is not None for e in errs) \
+                or any(d is None for d in per_shard_disk[
+                    :fi.erasure.data_blocks + fi.erasure.parity_blocks]):
+            # heal-on-read signal (cmd/erasure-object.go:325-336)
+            self._notify_partial(bucket, object, fi.version_id)
+        return oi
+
+    def get_object_bytes(self, bucket: str, object: str,
+                         opts: ObjectOptions = None) -> bytes:
+        from ..erasure.streaming import BufferSink
+        sink = BufferSink()
+        self.get_object(bucket, object, sink, opts=opts)
+        return sink.getvalue()
+
+    # --- delete ------------------------------------------------------------
+
+    def delete_object(self, bucket: str, object: str,
+                      opts: ObjectOptions = None) -> ObjectInfo:
+        opts = opts or ObjectOptions()
+        check_names(bucket, object)
+        self.get_bucket_info(bucket)
+        disks = self.disks
+        write_quorum = len(disks) // 2 + 1
+
+        vid = "" if opts.version_id in ("", "null") else opts.version_id
+        mark_delete = opts.versioned and not opts.version_id
+        if mark_delete:
+            fi = FileInfo(volume=bucket, name=object,
+                          version_id=FileInfo.new_version_id(), deleted=True,
+                          mod_time=FileInfo.now())
+        else:
+            fi = FileInfo(volume=bucket, name=object, version_id=vid,
+                          mod_time=FileInfo.now())
+
+        errs: list[BaseException | None] = [None] * len(disks)
+        futs = {}
+        for i, d in enumerate(disks):
+            if d is None:
+                errs[i] = errors.DiskNotFound()
+                continue
+            futs[i] = meta_pool().submit(
+                d.delete_version, bucket, object, fi)
+        for i, f in futs.items():
+            try:
+                f.result()
+            except errors.FileNotFound:
+                pass  # S3 delete is idempotent: missing object = success
+            except Exception as e:  # noqa: BLE001
+                errs[i] = e if isinstance(e, errors.StorageError) \
+                    else errors.FaultyDisk(str(e))
+        if vid and sum(isinstance(e, errors.FileVersionNotFound)
+                       for e in errs) > len(disks) - write_quorum:
+            raise dt.VersionNotFound(bucket, object)
+        err = errors.reduce_write_quorum_errs(
+            errs, errors.BASE_IGNORED_ERRS + (errors.FileVersionNotFound,),
+            write_quorum)
+        if err is not None:
+            raise to_object_err(err, bucket, object)
+        if any(isinstance(e, (errors.DiskNotFound, errors.FaultyDisk))
+               for e in errs):
+            self._notify_partial(bucket, object, fi.version_id)
+        return ObjectInfo(bucket=bucket, name=object,
+                          version_id=fi.version_id if opts.versioned else "",
+                          delete_marker=fi.deleted, mod_time=fi.mod_time)
+
+    def delete_objects(self, bucket: str, objects: list, opts=None
+                       ) -> tuple[list[DeletedObject], list]:
+        """Bulk delete (reference DeleteObjects vectorizes into per-disk
+        DeleteVersions RPC — cmd/erasure-object.go:877)."""
+        opts = opts or ObjectOptions()
+        deleted: list[DeletedObject] = []
+        errs: list = []
+        for obj in objects:
+            name = obj if isinstance(obj, str) else obj["object"]
+            vid = "" if isinstance(obj, str) else obj.get("version_id", "")
+            try:
+                o = ObjectOptions(version_id=vid, versioned=opts.versioned)
+                oi = self.delete_object(bucket, name, o)
+                deleted.append(DeletedObject(
+                    object_name=name, version_id=vid,
+                    delete_marker=oi.delete_marker,
+                    delete_marker_version_id=oi.version_id
+                    if oi.delete_marker else ""))
+                errs.append(None)
+            except dt.ObjectNotFound:
+                deleted.append(DeletedObject(object_name=name, version_id=vid))
+                errs.append(None)
+            except Exception as e:  # noqa: BLE001
+                deleted.append(None)
+                errs.append(e)
+        return deleted, errs
+
+    # --- list --------------------------------------------------------------
+
+    def _walk_merged(self, bucket: str, prefix: str = "") -> list[str]:
+        """Merged sorted object names across disks (quorum-free union —
+        listing consistency matches the reference's 'listing is advisory'
+        stance)."""
+        names: set[str] = set()
+        found_any_disk = False
+        for d in self.disks:
+            if d is None:
+                continue
+            try:
+                dir_path = prefix if prefix.endswith("/") else \
+                    ("/".join(prefix.split("/")[:-1]) if "/" in prefix else "")
+                names.update(d.walk_dir(bucket, dir_path.rstrip("/")))
+                found_any_disk = True
+            except errors.VolumeNotFound:
+                raise
+            except errors.StorageError:
+                continue
+        if not found_any_disk:
+            raise errors.ErasureReadQuorum()
+        return sorted(n for n in names if n.startswith(prefix))
+
+    def list_objects(self, bucket: str, prefix: str = "", marker: str = "",
+                     delimiter: str = "", max_keys: int = 1000
+                     ) -> ListObjectsInfo:
+        check_names(bucket)
+        self.get_bucket_info(bucket)
+        try:
+            names = self._walk_merged(bucket, prefix)
+        except errors.VolumeNotFound:
+            raise dt.BucketNotFound(bucket) from None
+        out = ListObjectsInfo()
+        seen_prefixes: set[str] = set()
+        count = 0
+        for name in names:
+            if marker and name <= marker:
+                continue
+            if delimiter:
+                rest = name[len(prefix):]
+                if delimiter in rest:
+                    cp = prefix + rest.split(delimiter)[0] + delimiter
+                    if cp not in seen_prefixes:
+                        if count >= max_keys:
+                            out.is_truncated = True
+                            out.next_marker = name
+                            break
+                        seen_prefixes.add(cp)
+                        out.prefixes.append(cp)
+                        count += 1
+                    continue
+            if count >= max_keys:
+                out.is_truncated = True
+                out.next_marker = name
+                break
+            try:
+                oi = self.get_object_info(bucket, name)
+            except (dt.ObjectNotFound, dt.InsufficientReadQuorum):
+                continue  # latest is a delete marker or unhealthy
+            out.objects.append(oi)
+            count += 1
+        return out
+
+    def list_object_versions(self, bucket: str, prefix: str = "",
+                             marker: str = "", version_marker: str = "",
+                             delimiter: str = "", max_keys: int = 1000
+                             ) -> ListObjectVersionsInfo:
+        check_names(bucket)
+        self.get_bucket_info(bucket)
+        names = self._walk_merged(bucket, prefix)
+        out = ListObjectVersionsInfo()
+        count = 0
+        seen_prefixes: set[str] = set()
+        for name in names:
+            if marker and name < marker:
+                continue
+            if marker and name == marker and not version_marker:
+                continue  # key fully listed on a previous page
+            if delimiter:
+                rest = name[len(prefix):]
+                if delimiter in rest:
+                    cp = prefix + rest.split(delimiter)[0] + delimiter
+                    if cp not in seen_prefixes:
+                        seen_prefixes.add(cp)
+                        out.prefixes.append(cp)
+                    continue
+            vers = None
+            for d in self.disks:
+                if d is None:
+                    continue
+                try:
+                    vers = d.list_versions(bucket, name)
+                    break
+                except errors.StorageError:
+                    continue
+            if vers is None:
+                continue
+            # resume inside the marker key: versions are mod_time-ordered,
+            # so skip until the marker version id is passed (identity match,
+            # not lexicographic — uuids don't sort by recency)
+            skipping = bool(version_marker) and name == marker
+            for fi in vers:
+                if skipping:
+                    if fi.version_id == version_marker:
+                        skipping = False
+                    continue
+                if count >= max_keys:
+                    out.is_truncated = True
+                    out.next_key_marker = name
+                    out.next_version_id_marker = \
+                        out.objects[-1].version_id if out.objects else ""
+                    return out
+                out.objects.append(
+                    ObjectInfo.from_file_info(fi, bucket, name, True))
+                count += 1
+        return out
+
+    # --- copy --------------------------------------------------------------
+
+    def copy_object(self, src_bucket, src_object, dst_bucket, dst_object,
+                    src_info, src_opts, dst_opts):
+        """Server-side copy: metadata-only for same-object self-copy, else
+        full read→write through the erasure pipeline."""
+        if src_bucket == dst_bucket and src_object == dst_object:
+            fi, _, _ = self._read_quorum_fileinfo(
+                src_bucket, src_object, src_opts.version_id if src_opts else "")
+            meta = dict(fi.metadata)
+            for k, v in (dst_opts.user_defined if dst_opts else {}).items():
+                meta[k] = v
+            fi.metadata = meta
+            disks = self.disks
+            for d in disks:
+                if d is None:
+                    continue
+                try:
+                    d.update_metadata(src_bucket, src_object, fi)
+                except errors.StorageError:
+                    pass
+            return ObjectInfo.from_file_info(
+                fi, dst_bucket, dst_object, bool(fi.version_id))
+        import io
+        data = self.get_object_bytes(src_bucket, src_object, src_opts)
+        return self.put_object(dst_bucket, dst_object, io.BytesIO(data),
+                               len(data), dst_opts)
+
+    # --- heal --------------------------------------------------------------
+
+    def heal_bucket(self, bucket: str, dry_run: bool = False
+                    ) -> HealResultItem:
+        disks = self.disks
+        res = HealResultItem(heal_item_type="bucket", bucket=bucket,
+                             disk_count=len(disks))
+        for d in disks:
+            if d is None:
+                res.before_state.append(DRIVE_STATE_OFFLINE)
+                res.after_state.append(DRIVE_STATE_OFFLINE)
+                continue
+            try:
+                d.stat_vol(bucket)
+                res.before_state.append(DRIVE_STATE_OK)
+                res.after_state.append(DRIVE_STATE_OK)
+            except errors.StorageError:
+                res.before_state.append(DRIVE_STATE_MISSING)
+                if dry_run:
+                    res.after_state.append(DRIVE_STATE_MISSING)
+                else:
+                    try:
+                        d.make_vol(bucket)
+                        res.after_state.append(DRIVE_STATE_OK)
+                    except errors.StorageError:
+                        res.after_state.append(DRIVE_STATE_MISSING)
+        return res
+
+    def heal_object(self, bucket: str, object: str, version_id: str = "",
+                    dry_run: bool = False, remove_dangling: bool = False,
+                    scan_mode: str = "normal") -> HealResultItem:
+        """Heal one object version (reference healObject,
+        cmd/erasure-healing.go:233): classify per-disk state, rebuild missing
+        /corrupt shards via decode→encode, rewrite xl.meta on healed disks."""
+        disks = self.disks
+        n = len(disks)
+        vid = "" if version_id in ("", "null") else version_id
+        fis, errs = read_all_fileinfo(disks, bucket, object, vid)
+        read_quorum, _ = object_quorum_from_meta(fis, errs,
+                                                 self.default_parity)
+
+        avail = sum(1 for fi in fis if fi is not None)
+        if avail < read_quorum:
+            not_found = sum(1 for e in errs if isinstance(
+                e, (errors.FileNotFound, errors.FileVersionNotFound)))
+            if not_found > n - read_quorum and remove_dangling:
+                # dangling VERSION: remove just that journal entry on each
+                # disk (delete_version drops the object dir only when it was
+                # the last version) — healthy sibling versions survive
+                # (reference :328)
+                purge_vid = "null" if version_id in ("", "null") else version_id
+                pfi = FileInfo(volume=bucket, name=object,
+                               version_id="" if purge_vid == "null"
+                               else purge_vid)
+                for d in disks:
+                    if d is None:
+                        continue
+                    try:
+                        d.delete_version(bucket, object, pfi)
+                    except errors.StorageError:
+                        pass
+                return HealResultItem(bucket=bucket, object=object,
+                                      version_id=version_id, disk_count=n)
+            raise to_object_err(errors.ErasureReadQuorum(), bucket, object)
+
+        fi = find_file_info_in_quorum(fis, read_quorum)
+        res = HealResultItem(
+            bucket=bucket, object=object, version_id=fi.version_id,
+            disk_count=n, data_blocks=fi.erasure.data_blocks,
+            parity_blocks=fi.erasure.parity_blocks, object_size=fi.size)
+
+        if fi.deleted:
+            # propagate the delete marker to disks missing it
+            res.before_state = [
+                DRIVE_STATE_OFFLINE if d is None else
+                (DRIVE_STATE_OK if f is not None and f.deleted
+                 else DRIVE_STATE_MISSING)
+                for d, f in zip(disks, fis)]
+            if not dry_run:
+                for d, f in zip(disks, fis):
+                    if d is not None and (f is None or not f.deleted):
+                        try:
+                            d.write_metadata(bucket, object, fi)
+                        except errors.StorageError:
+                            pass
+            res.after_state = [DRIVE_STATE_OFFLINE if d is None
+                               else DRIVE_STATE_OK for d in disks]
+            return res
+
+        # classify each disk (cmd/erasure-healing.go:261-331)
+        latest_mod = round(fi.mod_time, 3)
+        state: list[str] = []
+        for i, (d, f) in enumerate(zip(disks, fis)):
+            if d is None:
+                state.append(DRIVE_STATE_OFFLINE)
+            elif f is None:
+                state.append(DRIVE_STATE_MISSING if isinstance(
+                    errs[i], (errors.FileNotFound,
+                              errors.FileVersionNotFound))
+                    else DRIVE_STATE_OFFLINE)
+            elif round(f.mod_time, 3) != latest_mod or \
+                    f.data_dir != fi.data_dir:
+                state.append(DRIVE_STATE_MISSING)  # outdated version
+            else:
+                try:
+                    if scan_mode == "deep":
+                        d.verify_file(bucket, object, f)
+                    else:
+                        d.check_parts(bucket, object, f)
+                    state.append(DRIVE_STATE_OK)
+                except errors.StorageError:
+                    state.append(DRIVE_STATE_CORRUPT)
+        res.before_state = list(state)
+
+        to_heal = [i for i, s in enumerate(state)
+                   if s in (DRIVE_STATE_MISSING, DRIVE_STATE_CORRUPT)
+                   and disks[i] is not None]
+        if not to_heal or dry_run:
+            res.after_state = list(state)
+            return res
+
+        if fi.data is not None:
+            # inlined object: just rewrite xl.meta on broken disks
+            for i in to_heal:
+                fih = replace(fi, metadata=dict(fi.metadata))
+                try:
+                    disks[i].write_metadata(bucket, object, fih)
+                    state[i] = DRIVE_STATE_OK
+                except errors.StorageError:
+                    pass
+            res.after_state = state
+            return res
+
+        er = Erasure(fi.erasure.data_blocks, fi.erasure.parity_blocks,
+                     fi.erasure.block_size)
+        algo = BitrotAlgorithm(fi.metadata.get(
+            BITROT_KEY, DEFAULT_BITROT_ALGO.value))
+        shard_size = er.shard_size()
+
+        # shard-ordered source disks (state OK only) and their FileInfos
+        shard_disk: list = [None] * n
+        for i, (d, f) in enumerate(zip(disks, fis)):
+            if state[i] != DRIVE_STATE_OK or f is None:
+                continue
+            idx = f.erasure.index
+            if 1 <= idx <= n and shard_disk[idx - 1] is None:
+                shard_disk[idx - 1] = d
+        # target shard index per healed disk: reuse the quorum distribution
+        dist = fi.erasure.distribution or hash_order(f"{bucket}/{object}", n)
+        tmp_id = str(uuid.uuid4())
+        for part in fi.parts:
+            logical = er.shard_file_size(part.size)
+            readers = []
+            for j in range(n):
+                d = shard_disk[j]
+                if d is None:
+                    readers.append(None)
+                    continue
+                try:
+                    src = d.read_file_at(
+                        bucket, f"{object}/{fi.data_dir}/part.{part.number}")
+                    readers.append(new_bitrot_reader(
+                        src, algo, logical, shard_size))
+                except Exception:  # noqa: BLE001
+                    readers.append(None)
+            writers = [None] * n
+            for i in to_heal:
+                shard_idx = dist[i]
+                try:
+                    sink = disks[i].create_file_writer(
+                        META_TMP,
+                        f"{tmp_id}/{fi.data_dir}/part.{part.number}")
+                    writers[shard_idx - 1] = new_bitrot_writer(
+                        sink, algo, shard_size)
+                except Exception:  # noqa: BLE001
+                    pass
+            try:
+                erasure_heal(er, writers, readers, part.size)
+            except Exception as e:  # noqa: BLE001
+                raise to_object_err(e, bucket, object) from e
+            finally:
+                for r in readers:
+                    src = getattr(r, "src", None)
+                    if src is not None and hasattr(src, "close"):
+                        src.close()
+        for i in to_heal:
+            shard_idx = dist[i]
+            fih = replace(fi, erasure=replace(fi.erasure, index=shard_idx),
+                          metadata=dict(fi.metadata))
+            try:
+                disks[i].rename_data(META_TMP, tmp_id, fih, bucket, object)
+                state[i] = DRIVE_STATE_OK
+            except Exception:  # noqa: BLE001
+                pass
+        res.after_state = state
+        return res

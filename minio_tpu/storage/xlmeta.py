@@ -96,16 +96,28 @@ class XLMeta:
     def _sort(self):
         self.versions.sort(key=lambda d: d.get("ModTime", 0.0), reverse=True)
 
-    def add_version(self, fi: FileInfo):
+    def add_version(self, fi: FileInfo) -> list[str]:
         """Insert/replace a version (AddVersion,
-        cmd/xl-storage-format-v2.go). Replacement key: version_id."""
+        cmd/xl-storage-format-v2.go). Replacement key: version_id. Returns
+        the dataDir uuids of any replaced versions so the caller can delete
+        their part files (otherwise unversioned overwrites leak data dirs)."""
         vid = fi.version_id
-        self.versions = [
-            d for d in self.versions if d.get("V", {}).get("id", "") != vid]
+        old_ddirs: list[str] = []
+        kept = []
+        for d in self.versions:
+            if d.get("V", {}).get("id", "") == vid:
+                ddir = d.get("V", {}).get("ddir", "")
+                if ddir and ddir != fi.data_dir:
+                    old_ddirs.append(ddir)
+                    self.data.pop(ddir, None)
+            else:
+                kept.append(d)
+        self.versions = kept
         self.versions.append(_version_to_dict(fi))
         if fi.data is not None and fi.data_dir:
             self.data[fi.data_dir] = fi.data
         self._sort()
+        return old_ddirs
 
     def delete_version(self, fi: FileInfo) -> str:
         """Remove a version; returns its dataDir uuid (for part cleanup) or
@@ -131,10 +143,13 @@ class XLMeta:
         return ddir
 
     def find_version(self, version_id: str) -> dict:
+        """"" = latest; "null" = the null (unversioned) version, whose
+        journal id is ""; anything else = exact uuid match."""
         if version_id == NULL_VERSION and self.versions:
             return self.versions[0]  # latest
+        want = "" if version_id == "null" else version_id
         for d in self.versions:
-            if d.get("V", {}).get("id", "") == version_id:
+            if d.get("V", {}).get("id", "") == want:
                 return d
         raise errors.FileVersionNotFound(version_id)
 

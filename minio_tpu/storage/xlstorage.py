@@ -290,13 +290,22 @@ class XLStorage(StorageAPI):
                 if os.path.isdir(dst):
                     shutil.rmtree(dst)
                 os.replace(src, dst)
-            meta.add_version(fi)
+            old_ddirs = meta.add_version(fi)
             self._store_meta(dst_volume, dst_path, meta)
+            self._purge_ddirs(dst_volume, dst_path, old_ddirs)
         # clean the tmp parent dir
         try:
             shutil.rmtree(self._abs(src_volume, src_path.split("/")[0]))
         except OSError:
             pass
+
+    def _purge_ddirs(self, volume: str, path: str, ddirs: list[str]):
+        """Remove data dirs of replaced versions (overwrite cleanup)."""
+        for ddir in ddirs:
+            try:
+                shutil.rmtree(self._abs(volume, path, ddir))
+            except OSError:
+                pass
 
     def write_metadata(self, volume: str, path: str, fi: FileInfo) -> None:
         with self._meta_lock:
@@ -304,8 +313,9 @@ class XLStorage(StorageAPI):
                 meta = self._load_meta(volume, path)
             except errors.FileNotFound:
                 meta = XLMeta()
-            meta.add_version(fi)
+            old_ddirs = meta.add_version(fi)
             self._store_meta(volume, path, meta)
+            self._purge_ddirs(volume, path, old_ddirs)
 
     def update_metadata(self, volume: str, path: str, fi: FileInfo) -> None:
         with self._meta_lock:

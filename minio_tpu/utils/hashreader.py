@@ -1,0 +1,91 @@
+"""hash.Reader equivalent (reference pkg/hash/reader.go:62): wraps an input
+stream, enforces declared size, computes MD5 (ETag) + optional SHA256 and
+verifies expected digests on EOF — the PutObject ingress integrity gate."""
+from __future__ import annotations
+
+import binascii
+import hashlib
+
+from . import errors
+
+
+class BadDigestError(Exception):
+    def __init__(self, want: str, got: str):
+        self.want, self.got = want, got
+        super().__init__(f"md5 mismatch want={want} got={got}")
+
+
+class SHA256MismatchError(Exception):
+    def __init__(self, want: str, got: str):
+        self.want, self.got = want, got
+        super().__init__(f"sha256 mismatch want={want} got={got}")
+
+
+class HashReader:
+    def __init__(self, stream, size: int = -1, md5_hex: str = "",
+                 sha256_hex: str = "", actual_size: int = -1):
+        self.stream = stream
+        self.size = size
+        self.actual_size = actual_size if actual_size >= 0 else size
+        self.want_md5 = md5_hex.lower()
+        self.want_sha256 = sha256_hex.lower()
+        self._md5 = hashlib.md5()
+        self._sha256 = hashlib.sha256() if sha256_hex else None
+        self._read = 0
+        self._eof = False
+
+    def read(self, n: int = -1) -> bytes:
+        if self._eof:
+            return b""
+        if self.size >= 0:
+            remaining = self.size - self._read
+            if remaining <= 0:
+                # enforce the declared size even when the source has more
+                if self.stream.read(1):
+                    raise errors.MoreData()
+                self._finish()
+                return b""
+            n = remaining if n < 0 else min(n, remaining)
+        b = self.stream.read(n)
+        if not b:
+            if self.size >= 0 and self._read < self.size:
+                raise errors.LessData()
+            self._finish()
+            return b""
+        self._read += len(b)
+        self._md5.update(b)
+        if self._sha256 is not None:
+            self._sha256.update(b)
+        if self.size >= 0 and self._read == self.size:
+            pass  # digests checked on the EOF read
+        return b
+
+    def _finish(self):
+        self._eof = True
+        if self.want_md5 and self.md5_hex() != self.want_md5:
+            raise BadDigestError(self.want_md5, self.md5_hex())
+        if self._sha256 is not None and self.want_sha256 and \
+                self._sha256.hexdigest() != self.want_sha256:
+            raise SHA256MismatchError(self.want_sha256,
+                                      self._sha256.hexdigest())
+
+    def md5_hex(self) -> str:
+        return self._md5.hexdigest()
+
+    def etag(self) -> str:
+        return self.md5_hex()
+
+    def md5_base64(self) -> str:
+        import base64
+        return base64.b64encode(self._md5.digest()).decode()
+
+    def bytes_read(self) -> int:
+        return self._read
+
+
+def etag_from_parts(part_etags: list[str]) -> str:
+    """S3 multipart ETag: md5(concat(binary md5s))-N."""
+    h = hashlib.md5()
+    for e in part_etags:
+        h.update(binascii.unhexlify(e.split("-")[0]))
+    return f"{h.hexdigest()}-{len(part_etags)}"
