@@ -15,13 +15,34 @@ on-disk layout stays interoperable with the math above).
 """
 from __future__ import annotations
 
+from concurrent.futures import Future
+
 import numpy as np
 
-from ..ops.rs_jax import ReedSolomon, get_codec
+from ..ops.rs_jax import ReedSolomon, get_codec, pack_shards, unpack_shards
 
 
 def ceil_div(a: int, b: int) -> int:
     return -(-a // b)
+
+
+def _done(value) -> Future:
+    f = Future()
+    f.set_result(value)
+    return f
+
+
+def _chain(fut: Future, fn) -> Future:
+    out = Future()
+
+    def cb(f):
+        try:
+            out.set_result(fn(f.result()))
+        except Exception as e:  # noqa: BLE001
+            out.set_exception(e)
+
+    fut.add_done_callback(cb)
+    return out
 
 
 class Erasure:
@@ -110,6 +131,74 @@ class Erasure:
         parity = self.codec.encode(padded)
         return [shards[i] for i in range(self.data_blocks)] + \
                [parity[i][:true_shard] for i in range(self.parity_blocks)]
+
+    # --- async batched entry points (ride the dispatch queue) ---------------
+
+    def encode_data_async(self, data) -> Future:
+        """Like encode_data but returns Future[list[shard]]; parity math is
+        coalesced with other in-flight blocks by the dispatch runtime."""
+        from ..runtime.dispatch import dispatch_enabled, global_queue
+        buf = np.frombuffer(data, dtype=np.uint8) if not isinstance(
+            data, np.ndarray) else np.asarray(data, dtype=np.uint8)
+        if buf.size == 0 or not dispatch_enabled():
+            return _done(self.encode_data(buf))
+        true_shard = ceil_div(buf.size, self.data_blocks)
+        shards = self.codec.split(buf, true_shard)
+        pad = (-true_shard) % 4
+        padded = np.concatenate(
+            [shards, np.zeros((self.data_blocks, pad), np.uint8)], axis=1) \
+            if pad else shards
+        fut = global_queue().encode(self.codec, pack_shards(padded))
+
+        def finish(parity_words):
+            parity = unpack_shards(parity_words)
+            return [shards[i] for i in range(self.data_blocks)] + \
+                   [parity[i][:true_shard]
+                    for i in range(self.parity_blocks)]
+        return _chain(fut, finish)
+
+    def rebuild_targets_async(self, shards: list[np.ndarray | None],
+                              targets: tuple[int, ...]) -> Future:
+        """Rebuild the ``targets`` shard indices (<= parity count, data or
+        parity) from any k present shards; Future[list aligned with
+        targets]. Batches across loss patterns via per-element masks."""
+        from ..runtime.dispatch import dispatch_enabled, global_queue
+        aligned, true_len = self._aligned(shards)
+        present = tuple(i for i, s in enumerate(aligned)
+                        if s is not None)[: self.data_blocks]
+        if len(present) < self.data_blocks:
+            raise ValueError(
+                f"cannot rebuild: {len(present)} shards present, "
+                f"need {self.data_blocks}")
+        if not dispatch_enabled():
+            full = self.codec.reconstruct(aligned, data_only=False)
+            return _done([full[t][:true_len] for t in targets])
+        gathered = np.stack([aligned[i] for i in present])
+        masks = self.codec.target_masks_np(present, tuple(targets))
+        fut = global_queue().masked(
+            self.codec, pack_shards(gathered), masks)
+
+        def finish(out_words):
+            out = unpack_shards(out_words)
+            return [out[i][:true_len] for i in range(len(targets))]
+        return _chain(fut, finish)
+
+    def decode_data_blocks_async(self, shards: list[np.ndarray | None]
+                                 ) -> Future:
+        """Async DecodeDataBlocks: missing data shards rebuilt on the
+        dispatch queue; complete shard lists resolve immediately."""
+        missing = tuple(i for i in range(self.data_blocks)
+                        if shards[i] is None)
+        if not missing:
+            return _done(list(shards))
+        fut = self.rebuild_targets_async(shards, missing)
+
+        def finish(rebuilt):
+            out = list(shards)
+            for t, arr in zip(missing, rebuilt):
+                out[t] = arr
+            return out
+        return _chain(fut, finish)
 
     def decode_data_blocks(self, shards: list[np.ndarray | None]
                            ) -> list[np.ndarray]:

@@ -13,6 +13,8 @@ sharding.
 from __future__ import annotations
 
 import io
+import os
+from collections import deque
 from concurrent.futures import FIRST_COMPLETED, ThreadPoolExecutor, wait
 from dataclasses import dataclass, field
 
@@ -67,27 +69,42 @@ def parallel_write_shards(writers: list, shards: list[np.ndarray],
         raise err
 
 
+#: Blocks in flight per stream: deep enough to fill a dispatch batch from a
+#: single hot PUT, shallow enough to bound buffering (window * block_size
+#: bytes live at once).
+ENCODE_WINDOW = int(os.environ.get("MINIO_TPU_ENCODE_WINDOW", "16"))
+
+
 def erasure_encode(erasure: Erasure, stream, writers: list,
                    write_quorum: int) -> int:
-    """Read the stream block by block, erasure-encode each block on device,
-    fan shards out to ``writers`` (bitrot writers or None for offline disks).
-    Returns total bytes consumed (reference Erasure.Encode,
-    cmd/erasure-encode.go:73-109)."""
+    """Read the stream block by block, erasure-encode on device, fan shards
+    out to ``writers`` (bitrot writers or None for offline disks). Returns
+    total bytes consumed (reference Erasure.Encode,
+    cmd/erasure-encode.go:73-109).
+
+    Pipelined: up to ENCODE_WINDOW blocks are submitted to the dispatch
+    queue before the first result is awaited, so one stream's blocks batch
+    into few device launches and device work overlaps shard I/O; shard
+    writes stay strictly in block order."""
     total = 0
-    while True:
-        buf = _read_full(stream, erasure.block_size)
-        if not buf:
-            if total != 0:
+    window: deque = deque()
+    eof = False
+    while not eof or window:
+        while not eof and len(window) < ENCODE_WINDOW:
+            buf = _read_full(stream, erasure.block_size)
+            if not buf:
+                eof = True
+                if total == 0 and not window:
+                    # empty object: single empty block for quorum accounting
+                    window.append(erasure.encode_data_async(b""))
                 break
-            # empty object: single empty block for quorum accounting
-            shards = erasure.encode_data(b"")
+            if len(buf) < erasure.block_size:
+                eof = True
+            total += len(buf)
+            window.append(erasure.encode_data_async(buf))
+        if window:
+            shards = window.popleft().result()
             parallel_write_shards(writers, shards, write_quorum)
-            break
-        shards = erasure.encode_data(buf)
-        parallel_write_shards(writers, shards, write_quorum)
-        total += len(buf)
-        if len(buf) < erasure.block_size:
-            break
     return total
 
 
@@ -186,14 +203,19 @@ def erasure_decode(erasure: Erasure, writer, readers: list, offset: int,
     bs = erasure.block_size
     start_block = offset // bs
     end_block = (offset + length) // bs
+
+    def emit(fut, block_data_len, boff, blen):
+        shards = fut.result()
+        block = np.concatenate(shards[:k]).tobytes()[:block_data_len]
+        writer.write(block[boff: boff + blen])
+        stats.bytes_written += blen
+
+    window: deque = deque()
     for b in range(start_block, end_block + 1):
         block_data_len = min(bs, total_length - b * bs)
         if block_data_len <= 0:
             break
-        if b == start_block:
-            boff = offset % bs
-        else:
-            boff = 0
+        boff = offset % bs if b == start_block else 0
         if b == end_block:
             blen = (offset + length) - b * bs - boff
         else:
@@ -202,18 +224,25 @@ def erasure_decode(erasure: Erasure, writer, readers: list, offset: int,
             break
         shard_len = ceil_div(block_data_len, k)
         shards = preader.read_block(b * erasure.shard_size(), shard_len)
-        shards = erasure.decode_data_blocks(shards)
-        block = np.concatenate(shards[:k]).tobytes()[:block_data_len]
-        writer.write(block[boff: boff + blen])
-        stats.bytes_written += blen
+        window.append((erasure.decode_data_blocks_async(shards),
+                       block_data_len, boff, blen))
+        if len(window) >= ENCODE_WINDOW:
+            emit(*window.popleft())
+    while window:
+        emit(*window.popleft())
     return stats
 
 
 def erasure_heal(erasure: Erasure, writers: list, readers: list,
                  total_length: int) -> None:
-    """Reconstruct ALL shards blockwise and write to the non-None writers
-    (outdated/offline disks being healed); write quorum 1 (reference
-    Erasure.Heal, cmd/erasure-lowlevel-heal.go:28-48)."""
+    """Rebuild the shards owned by the non-None writers (outdated/offline
+    disks being healed) blockwise and stream them out; write quorum 1
+    (reference Erasure.Heal, cmd/erasure-lowlevel-heal.go:28-48).
+
+    Only the target shards are computed (targets <= parity count or the
+    object would be unrecoverable) and rebuilds ride the dispatch queue, so
+    concurrent heals of many objects coalesce into batched device launches
+    (BASELINE config 5)."""
     if total_length == 0:
         # still commit empty shard files through the writers
         for w in writers:
@@ -222,28 +251,41 @@ def erasure_heal(erasure: Erasure, writers: list, readers: list,
         return
     k = erasure.data_blocks
     bs = erasure.block_size
+    targets = tuple(i for i, w in enumerate(writers) if w is not None)
+    if not targets:
+        return
     preader = _ParallelReader(readers, erasure)
     n_blocks = ceil_div(total_length, bs)
-    for b in range(n_blocks):
-        block_data_len = min(bs, total_length - b * bs)
-        shard_len = ceil_div(block_data_len, k)
-        shards = preader.read_block(b * erasure.shard_size(), shard_len)
-        full = erasure.decode_data_and_parity_blocks(shards)
+
+    def emit(fut):
+        rebuilt = fut.result()
         errs: list[BaseException | None] = [None] * len(writers)
         wrote = 0
-        for i, w in enumerate(writers):
+        for t, arr in zip(targets, rebuilt):
+            w = writers[t]
             if w is None:
                 continue
             try:
-                w.write(full[i].tobytes())
+                w.write(arr.tobytes())
                 wrote += 1
             except Exception as e:  # noqa: BLE001
-                errs[i] = e
-                writers[i] = None
+                errs[t] = e
+                writers[t] = None
         if wrote == 0:
             err = errors.reduce_write_quorum_errs(
                 errs, errors.BASE_IGNORED_ERRS, 1)
             raise err if err is not None else errors.ErasureWriteQuorum()
+
+    window: deque = deque()
+    for b in range(n_blocks):
+        block_data_len = min(bs, total_length - b * bs)
+        shard_len = ceil_div(block_data_len, k)
+        shards = preader.read_block(b * erasure.shard_size(), shard_len)
+        window.append(erasure.rebuild_targets_async(shards, targets))
+        if len(window) >= ENCODE_WINDOW:
+            emit(window.popleft())
+    while window:
+        emit(window.popleft())
     for w in writers:
         if w is not None:
             w.close()

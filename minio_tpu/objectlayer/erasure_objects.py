@@ -250,17 +250,18 @@ class ErasureObjects(MultipartMixin, ObjectLayer):
             self._cleanup_tmp(tmp_id)
             raise dt.IncompleteBody(bucket, object)
 
-        etag = opts.user_defined.pop("etag", "") or hr.etag()
+        user_defined = dict(opts.user_defined)  # never mutate caller's opts
+        etag = user_defined.pop("etag", "") or hr.etag()
         fi.size = total
         fi.parts = [ObjectPartInfo(number=1, etag=etag, size=total,
                                    actual_size=hr.actual_size
                                    if hr.actual_size >= 0 else total)]
         fi.metadata = {
             "etag": etag,
-            "content-type": opts.user_defined.pop(
+            "content-type": user_defined.pop(
                 "content-type", "application/octet-stream"),
             BITROT_KEY: self.bitrot_algo.value,
-            **opts.user_defined,
+            **user_defined,
         }
         fi.erasure = ErasureInfo(
             data_blocks=data, parity_blocks=parity,
@@ -286,8 +287,18 @@ class ErasureObjects(MultipartMixin, ObjectLayer):
         err = errors.reduce_write_quorum_errs(
             errs, errors.BASE_IGNORED_ERRS, write_quorum)
         if err is not None:
+            # roll back: drop the partially committed version from disks
+            # whose rename succeeded and reclaim tmp shards elsewhere
+            for j, d in enumerate(shuffled):
+                if d is not None and errs[j] is None:
+                    try:
+                        d.delete_version(bucket, object, fi)
+                    except errors.StorageError:
+                        pass
+            self._cleanup_tmp(tmp_id)
             raise to_object_err(err, bucket, object)
         if any(e is not None for e in errs):
+            self._cleanup_tmp(tmp_id)  # reclaim tmp on the failed minority
             self._notify_partial(bucket, object, fi.version_id)
         oi = ObjectInfo.from_file_info(fi, bucket, object, opts.versioned)
         return oi
@@ -401,8 +412,7 @@ class ErasureObjects(MultipartMixin, ObjectLayer):
             if part_length <= 0:
                 continue
             readers = []
-            till = bitrot_shard_file_size(
-                er.shard_file_size(part.size), shard_size, algo)
+            logical = er.shard_file_size(part.size)
             for j in range(len(disks)):
                 d = per_shard_disk[j]
                 if d is None:
@@ -411,7 +421,6 @@ class ErasureObjects(MultipartMixin, ObjectLayer):
                 try:
                     src = d.read_file_at(
                         bucket, f"{object}/{fi.data_dir}/part.{part.number}")
-                    logical = er.shard_file_size(part.size)
                     readers.append(new_bitrot_reader(
                         src, algo, logical, shard_size))
                 except Exception:  # noqa: BLE001
@@ -557,6 +566,7 @@ class ErasureObjects(MultipartMixin, ObjectLayer):
         out = ListObjectsInfo()
         seen_prefixes: set[str] = set()
         count = 0
+        last_emitted = ""  # S3 marker semantics: the LAST key returned
         for name in names:
             if marker and name <= marker:
                 continue
@@ -567,21 +577,23 @@ class ErasureObjects(MultipartMixin, ObjectLayer):
                     if cp not in seen_prefixes:
                         if count >= max_keys:
                             out.is_truncated = True
-                            out.next_marker = name
+                            out.next_marker = last_emitted
                             break
                         seen_prefixes.add(cp)
                         out.prefixes.append(cp)
+                        last_emitted = cp
                         count += 1
                     continue
             if count >= max_keys:
                 out.is_truncated = True
-                out.next_marker = name
+                out.next_marker = last_emitted
                 break
             try:
                 oi = self.get_object_info(bucket, name)
             except (dt.ObjectNotFound, dt.InsufficientReadQuorum):
                 continue  # latest is a delete marker or unhealthy
             out.objects.append(oi)
+            last_emitted = name
             count += 1
         return out
 
@@ -629,13 +641,18 @@ class ErasureObjects(MultipartMixin, ObjectLayer):
                         skipping = False
                     continue
                 if count >= max_keys:
+                    # markers = LAST EMITTED (key, version) so the resume
+                    # skip-loop always finds its anchor
                     out.is_truncated = True
-                    out.next_key_marker = name
-                    out.next_version_id_marker = \
-                        out.objects[-1].version_id if out.objects else ""
+                    if out.objects:
+                        out.next_key_marker = out.objects[-1].name
+                        out.next_version_id_marker = \
+                            out.objects[-1].version_id
                     return out
-                out.objects.append(
-                    ObjectInfo.from_file_info(fi, bucket, name, True))
+                oi = ObjectInfo.from_file_info(fi, bucket, name, True)
+                if not oi.version_id:
+                    oi.version_id = "null"
+                out.objects.append(oi)
                 count += 1
         return out
 

@@ -121,6 +121,7 @@ class ReedSolomon:
         self._enc_masks = _device_masks(self.parity_rows)
         self._decode_cache: dict[tuple[int, ...], np.ndarray] = {}
         self._mask_cache: dict[tuple, jnp.ndarray] = {}
+        self._np_mask_cache: dict[tuple, np.ndarray] = {}
         self._mm, self._mm_batch, self._mm_batch_per = _resolve_backend(backend)
 
     # -- encode --------------------------------------------------------------
@@ -160,6 +161,40 @@ class ReedSolomon:
         return self._cached_masks(
             (present, rows),
             lambda: self._decode_mat(present)[list(rows), :])
+
+    # -- arbitrary-target rebuild rows (for the dispatch queue) --------------
+
+    def rebuild_rows(self, present: tuple[int, ...],
+                     targets: tuple[int, ...]) -> np.ndarray:
+        """[len(targets), k] matrix mapping the k chosen present shards to
+        any target shards (data or parity): data rows come from the decode
+        matrix, parity rows from parity_matrix @ decode_matrix."""
+        dec = self._decode_mat(present)
+        rows = np.empty((len(targets), self.k), dtype=np.uint8)
+        for i, t in enumerate(targets):
+            if t < self.k:
+                rows[i] = dec[t]
+            else:
+                rows[i] = gf256.gf_matmul_ref(
+                    self.parity_rows[t - self.k: t - self.k + 1], dec)[0]
+        return rows
+
+    def target_masks_np(self, present: tuple[int, ...],
+                        targets: tuple[int, ...]) -> np.ndarray:
+        """Host-side uint32 [8, m, k] masks for rebuilding ``targets`` from
+        ``present`` — zero-padded to m rows so every loss pattern shares one
+        batch shape (the dispatch queue's 'masked' op). Cached per pattern."""
+        if len(targets) > self.m:
+            raise ValueError(
+                f"{len(targets)} targets > parity {self.m}: unrecoverable")
+        key = ("np-tgt", present, targets)
+        masks = self._np_mask_cache.get(key)
+        if masks is None:
+            rows = np.zeros((self.m, self.k), dtype=np.uint8)
+            rows[: len(targets)] = self.rebuild_rows(present, targets)
+            masks = gf256.coeff_masks(rows)
+            self._np_mask_cache[key] = masks
+        return masks
 
     def _choose_present(self, shards: list[np.ndarray | None]) -> tuple[int, ...]:
         present = tuple(i for i, s in enumerate(shards) if s is not None)

@@ -1,0 +1,7 @@
+"""Device runtime: the dispatch/batching queue that coalesces erasure math
+from concurrent requests into single TPU launches (SURVEY.md §7.2 — the
+idiomatic replacement for the reference's per-disk goroutines + SIMD
+auto-goroutines)."""
+from .dispatch import DispatchQueue, global_queue
+
+__all__ = ["DispatchQueue", "global_queue"]

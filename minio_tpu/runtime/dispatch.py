@@ -1,0 +1,196 @@
+"""DispatchQueue — batches GF(256) shard work across concurrent requests
+into single device launches (SURVEY.md §7.2: "the piece MinIO lacks").
+
+Why: on TPU the per-launch cost (dispatch + host↔device transfer latency,
+~tens of ms through the axon tunnel) dwarfs the math for a single 1 MiB
+block. The reference amortizes SIMD cost with goroutines per request
+(cmd/erasure-coding.go:56 WithAutoGoroutines); the TPU-native equivalent is
+request coalescing: N in-flight blocks with the same geometry become one
+[B, k, W] batched kernel call.
+
+Mechanics:
+- submit encode/rebuild work → Future; requests bucket by
+  (op, geometry, shard words).
+- a dispatcher thread flushes a bucket when it reaches ``max_batch`` or its
+  oldest entry exceeds ``max_delay`` (p99-aware flush, default 1 ms).
+- batch B pads up to the next power of two (bounds jit recompiles); padding
+  lanes replicate row 0 and are dropped on unpack.
+- device results are handed to completer threads so the next batch launches
+  while the previous one's host readback is still in flight (the tunnel
+  round-trip overlaps with compute).
+
+Enable/disable with MINIO_TPU_DISPATCH=1/0 (default: on).
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+from concurrent.futures import Future, ThreadPoolExecutor
+from dataclasses import dataclass, field
+
+import numpy as np
+
+MAX_BATCH = int(os.environ.get("MINIO_TPU_DISPATCH_BATCH", "128"))
+MAX_DELAY_S = float(os.environ.get("MINIO_TPU_DISPATCH_DELAY_MS", "1.0")) / 1e3
+
+
+def dispatch_enabled() -> bool:
+    return os.environ.get("MINIO_TPU_DISPATCH", "1") != "0"
+
+
+@dataclass
+class _Pending:
+    words: np.ndarray            # [k, W] packed input shards
+    masks: np.ndarray | None     # [8, o, k] per-element masks (rebuild only)
+    future: Future = field(default_factory=Future)
+    t: float = field(default_factory=time.monotonic)
+
+
+class _Bucket:
+    def __init__(self, codec, op: str):
+        self.codec = codec
+        self.op = op  # 'encode' | 'rebuild'
+        self.items: list[_Pending] = []
+
+
+def _pad_batch(n: int) -> int:
+    b = 1
+    while b < n:
+        b <<= 1
+    return min(b, MAX_BATCH)
+
+
+class DispatchQueue:
+    def __init__(self, max_batch: int = MAX_BATCH,
+                 max_delay: float = MAX_DELAY_S, completers: int = 4):
+        self.max_batch = max_batch
+        self.max_delay = max_delay
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        self._buckets: dict[tuple, _Bucket] = {}
+        self._completers = ThreadPoolExecutor(
+            max_workers=completers, thread_name_prefix="minio-tpu-complete")
+        self._stop = False
+        self._thread = threading.Thread(
+            target=self._loop, name="minio-tpu-dispatch", daemon=True)
+        self._thread.start()
+        # telemetry
+        self.batches = 0
+        self.items = 0
+
+    # --- submission ---------------------------------------------------------
+
+    def encode(self, codec, words: np.ndarray) -> Future:
+        """words uint32 [k, W] -> Future[uint32 [m, W]] (parity)."""
+        key = ("encode", codec.k, codec.m, words.shape[-1], id(codec.matrix))
+        return self._submit(key, codec, "encode", words, None)
+
+    def masked(self, codec, words: np.ndarray, masks: np.ndarray) -> Future:
+        """words uint32 [k, W] + masks uint32 [8, o, k] -> Future[[o, W]].
+
+        Per-element masks let one batch mix arbitrary loss patterns — the
+        same launch serves degraded reads and multi-object heal (BASELINE
+        configs 3/5). o is fixed at codec.m (rows zero-padded) so all
+        patterns share one compiled shape."""
+        key = ("masked", codec.k, masks.shape[1], words.shape[-1])
+        return self._submit(key, codec, "masked", words, masks)
+
+    def _submit(self, key, codec, op, words, masks) -> Future:
+        p = _Pending(words=words, masks=masks)
+        with self._cv:
+            b = self._buckets.get(key)
+            if b is None:
+                b = self._buckets[key] = _Bucket(codec, op)
+            b.items.append(p)
+            self._cv.notify()
+        return p.future
+
+    # --- dispatcher ---------------------------------------------------------
+
+    def _loop(self):
+        while True:
+            to_flush: list[tuple[tuple, _Bucket, list[_Pending]]] = []
+            with self._cv:
+                while not self._stop:
+                    now = time.monotonic()
+                    deadline = None
+                    for key, b in self._buckets.items():
+                        if not b.items:
+                            continue
+                        age = now - b.items[0].t
+                        if len(b.items) >= self.max_batch or \
+                                age >= self.max_delay:
+                            items, b.items = b.items[:self.max_batch], \
+                                b.items[self.max_batch:]
+                            to_flush.append((key, b, items))
+                        else:
+                            d = b.items[0].t + self.max_delay
+                            deadline = d if deadline is None \
+                                else min(deadline, d)
+                    if to_flush:
+                        break
+                    timeout = None if deadline is None \
+                        else max(0.0, deadline - time.monotonic())
+                    self._cv.wait(timeout=timeout)
+                if self._stop and not to_flush:
+                    return
+            for key, b, items in to_flush:
+                try:
+                    self._flush(b, items)
+                except Exception as e:  # noqa: BLE001
+                    for p in items:
+                        if not p.future.done():
+                            p.future.set_exception(e)
+
+    def _flush(self, b: _Bucket, items: list[_Pending]):
+        import jax.numpy as jnp
+        n = len(items)
+        bsz = _pad_batch(n)
+        stack = np.stack([p.words for p in items] +
+                         [items[0].words] * (bsz - n))
+        self.batches += 1
+        self.items += n
+        if b.op == "encode":
+            out_dev = b.codec._mm_batch(b.codec._enc_masks, jnp.asarray(stack))
+        else:  # 'masked'
+            masks = np.stack([p.masks for p in items] +
+                             [items[0].masks] * (bsz - n))
+            out_dev = b.codec._mm_batch_per(jnp.asarray(masks),
+                                            jnp.asarray(stack))
+        # hand host readback to a completer so the next batch launches now
+        self._completers.submit(self._complete, out_dev, items)
+
+    @staticmethod
+    def _complete(out_dev, items: list[_Pending]):
+        try:
+            out = np.asarray(out_dev)
+            for i, p in enumerate(items):
+                p.future.set_result(out[i])
+        except Exception as e:  # noqa: BLE001
+            for p in items:
+                if not p.future.done():
+                    p.future.set_exception(e)
+
+    def stop(self):
+        with self._cv:
+            self._stop = True
+            self._cv.notify_all()
+        self._thread.join(timeout=5)
+
+    def stats(self) -> dict:
+        return {"batches": self.batches, "items": self.items,
+                "avg_batch": self.items / self.batches if self.batches else 0}
+
+
+_global: DispatchQueue | None = None
+_global_lock = threading.Lock()
+
+
+def global_queue() -> DispatchQueue:
+    global _global
+    if _global is None:
+        with _global_lock:
+            if _global is None:
+                _global = DispatchQueue()
+    return _global
