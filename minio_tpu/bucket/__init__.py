@@ -1,0 +1,6 @@
+"""Per-bucket feature subsystems (reference §2.9: one BucketMetadata record
+carries policy/versioning/lifecycle/tagging/notification/quota config,
+persisted under .minio.sys and cached in-process)."""
+from .metadata import BucketMetadata, BucketMetadataSys
+
+__all__ = ["BucketMetadata", "BucketMetadataSys"]

@@ -1,0 +1,2 @@
+"""Distributed plane (reference L1/L0 — SURVEY.md §1): endpoint topology,
+REST-RPC storage/peer/lock services, dsync quorum locks, bootstrap."""

@@ -1,0 +1,24 @@
+"""Erasure set layout choice (reference cmd/endpoint-ellipses.go:44-160):
+set sizes 4-16, greatest divisor of the drive count within that range;
+symmetric sets only."""
+from __future__ import annotations
+
+SET_SIZES = tuple(range(4, 17))  # setSizes, cmd/endpoint-ellipses.go:44
+
+
+def pick_set_layout(n_drives: int) -> tuple[int, int]:
+    """(set_count, drives_per_set). Drive counts 2-3 form one undersized
+    set (standalone erasure, reference ErasureSD); larger counts must be
+    divisible by a set size in 4..16, preferring the largest."""
+    if n_drives < 2:
+        raise ValueError("erasure mode needs >= 2 drives")
+    if n_drives <= 3:
+        return 1, n_drives
+    best = 0
+    for size in SET_SIZES:
+        if n_drives % size == 0:
+            best = max(best, size)
+    if best == 0:
+        raise ValueError(
+            f"drive count {n_drives} not divisible by any set size 4-16")
+    return n_drives // best, best

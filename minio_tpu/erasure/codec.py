@@ -163,6 +163,10 @@ class Erasure:
         parity) from any k present shards; Future[list aligned with
         targets]. Batches across loss patterns via per-element masks."""
         from ..runtime.dispatch import dispatch_enabled, global_queue
+        if len(targets) > self.parity_blocks:
+            raise ValueError(
+                f"{len(targets)} targets > parity {self.parity_blocks}: "
+                "unrecoverable")
         aligned, true_len = self._aligned(shards)
         present = tuple(i for i, s in enumerate(aligned)
                         if s is not None)[: self.data_blocks]

@@ -1,0 +1,376 @@
+"""FS mode — single-disk ObjectLayer without erasure (reference fs-v1,
+cmd/fs-v1.go: per-object metadata beside data, no bitrot/heal/quorum).
+Reuses the xl.meta journal + XLStorage posix backend with whole objects
+stored as a single part file, so versioning/multipart flow through the
+same code paths as erasure mode."""
+from __future__ import annotations
+
+import uuid
+from dataclasses import replace
+
+from .objectlayer import datatypes as dt
+from .objectlayer.datatypes import (BucketInfo, DeletedObject,
+                                    HealResultItem, ListObjectsInfo,
+                                    ListObjectVersionsInfo, ObjectInfo,
+                                    ObjectOptions)
+from .objectlayer.erasure_objects import check_names, to_object_err
+from .objectlayer.interface import ObjectLayer
+from .objectlayer.multipart import upload_path
+from .storage import XLStorage
+from .storage.datatypes import FileInfo, ObjectPartInfo
+from .storage.xlmeta import SMALL_FILE_THRESHOLD
+from .storage.xlstorage import META_MULTIPART, META_TMP
+from .utils import errors
+from .utils.hashreader import HashReader, etag_from_parts
+
+
+class FSObjects(ObjectLayer):
+    def __init__(self, base_dir: str):
+        self.disk = XLStorage(base_dir, endpoint=f"fs://{base_dir}")
+
+    def backend_type(self) -> str:
+        return "FS"
+
+    # --- buckets ------------------------------------------------------------
+
+    def make_bucket(self, bucket: str, opts=None) -> None:
+        check_names(bucket)
+        try:
+            self.disk.make_vol(bucket)
+        except errors.StorageError as e:
+            raise to_object_err(e, bucket) from e
+
+    def get_bucket_info(self, bucket: str) -> BucketInfo:
+        try:
+            v = self.disk.stat_vol(bucket)
+        except errors.StorageError as e:
+            raise to_object_err(e, bucket) from e
+        return BucketInfo(name=v.name, created=v.created)
+
+    def list_buckets(self) -> list[BucketInfo]:
+        return [BucketInfo(name=v.name, created=v.created)
+                for v in self.disk.list_vols()]
+
+    def delete_bucket(self, bucket: str, force: bool = False) -> None:
+        try:
+            self.disk.delete_vol(bucket, force)
+        except errors.StorageError as e:
+            raise to_object_err(e, bucket) from e
+
+    # --- objects ------------------------------------------------------------
+
+    def put_object(self, bucket: str, object: str, stream, size: int,
+                   opts: ObjectOptions = None) -> ObjectInfo:
+        opts = opts or ObjectOptions()
+        check_names(bucket, object)
+        self.get_bucket_info(bucket)
+        hr = stream if isinstance(stream, HashReader) else \
+            HashReader(stream, size)
+        data = bytearray()
+        while True:
+            b = hr.read(1 << 20)
+            if not b:
+                break
+            data += b
+        data = bytes(data)
+        if size >= 0 and len(data) != size:
+            raise dt.IncompleteBody(bucket, object)
+        user_defined = dict(opts.user_defined)
+        etag = user_defined.pop("etag", "") or hr.etag()
+        fi = FileInfo(
+            volume=bucket, name=object,
+            version_id=FileInfo.new_version_id() if opts.versioned else "",
+            data_dir=str(uuid.uuid4()), mod_time=FileInfo.now(),
+            size=len(data),
+            metadata={"etag": etag,
+                      "content-type": user_defined.pop(
+                          "content-type", "application/octet-stream"),
+                      **user_defined},
+            parts=[ObjectPartInfo(number=1, etag=etag, size=len(data),
+                                  actual_size=len(data))])
+        if len(data) <= SMALL_FILE_THRESHOLD:
+            fi.data = data
+            self.disk.write_metadata(bucket, object, fi)
+        else:
+            self.disk.write_all(bucket,
+                                f"{object}/{fi.data_dir}/part.1", data)
+            self.disk.write_metadata(bucket, object, fi)
+        return ObjectInfo.from_file_info(fi, bucket, object, opts.versioned)
+
+    def _fi(self, bucket, object, opts) -> FileInfo:
+        opts = opts or ObjectOptions()
+        try:
+            return self.disk.read_version(bucket, object, opts.version_id,
+                                          read_data=True)
+        except errors.StorageError as e:
+            raise to_object_err(e, bucket, object) from e
+
+    def get_object_info(self, bucket, object, opts=None) -> ObjectInfo:
+        check_names(bucket, object)
+        self.get_bucket_info(bucket)
+        opts = opts or ObjectOptions()
+        fi = self._fi(bucket, object, opts)
+        if fi.deleted:
+            if not opts.version_id:
+                raise dt.ObjectNotFound(bucket, object)
+            raise dt.MethodNotAllowed(bucket, object)
+        return ObjectInfo.from_file_info(
+            fi, bucket, object,
+            opts.versioned or bool(opts.version_id) or bool(fi.version_id))
+
+    def get_object(self, bucket, object, writer, offset=0, length=-1,
+                   opts=None) -> ObjectInfo:
+        oi = self.get_object_info(bucket, object, opts)
+        fi = self._fi(bucket, object, opts)
+        if length < 0:
+            length = fi.size - offset
+        if offset < 0 or length < 0 or offset + length > fi.size:
+            raise dt.InvalidRange(bucket, object)
+        if fi.data is not None:
+            writer.write(fi.data[offset: offset + length])
+            return oi
+        remaining = length
+        pos = 0
+        for part in fi.parts:
+            if remaining <= 0:
+                break
+            if pos + part.size <= offset:
+                pos += part.size
+                continue
+            poff = max(0, offset - pos)
+            plen = min(part.size - poff, remaining)
+            src = self.disk.read_file_at(
+                bucket, f"{object}/{fi.data_dir}/part.{part.number}")
+            try:
+                writer.write(src.read_at(poff, plen))
+            finally:
+                src.close()
+            remaining -= plen
+            pos += part.size
+        return oi
+
+    def delete_object(self, bucket, object, opts=None) -> ObjectInfo:
+        opts = opts or ObjectOptions()
+        check_names(bucket, object)
+        self.get_bucket_info(bucket)
+        vid = "" if opts.version_id in ("", "null") else opts.version_id
+        if opts.versioned and not opts.version_id:
+            fi = FileInfo(volume=bucket, name=object,
+                          version_id=FileInfo.new_version_id(),
+                          deleted=True, mod_time=FileInfo.now())
+        else:
+            fi = FileInfo(volume=bucket, name=object, version_id=vid,
+                          mod_time=FileInfo.now())
+        try:
+            self.disk.delete_version(bucket, object, fi)
+        except errors.FileNotFound:
+            pass
+        except errors.FileVersionNotFound:
+            raise dt.VersionNotFound(bucket, object) from None
+        return ObjectInfo(bucket=bucket, name=object,
+                          version_id=fi.version_id if opts.versioned else "",
+                          delete_marker=fi.deleted, mod_time=fi.mod_time)
+
+    def delete_objects(self, bucket, objects, opts=None):
+        deleted, errs = [], []
+        opts = opts or ObjectOptions()
+        for obj in objects:
+            name = obj if isinstance(obj, str) else obj["object"]
+            vid = "" if isinstance(obj, str) else obj.get("version_id", "")
+            try:
+                oi = self.delete_object(bucket, name, ObjectOptions(
+                    version_id=vid, versioned=opts.versioned))
+                deleted.append(DeletedObject(
+                    object_name=name, version_id=vid,
+                    delete_marker=oi.delete_marker,
+                    delete_marker_version_id=oi.version_id
+                    if oi.delete_marker else ""))
+                errs.append(None)
+            except Exception as e:  # noqa: BLE001
+                deleted.append(None)
+                errs.append(e)
+        return deleted, errs
+
+    # --- listing (shares the erasure implementation's shape) ---------------
+
+    def list_objects(self, bucket, prefix="", marker="", delimiter="",
+                     max_keys=1000) -> ListObjectsInfo:
+        from .objectlayer.erasure_objects import ErasureObjects
+        return ErasureObjects.list_objects(
+            self, bucket, prefix, marker, delimiter, max_keys)
+
+    def list_object_versions(self, bucket, prefix="", marker="",
+                             version_marker="", delimiter="", max_keys=1000
+                             ) -> ListObjectVersionsInfo:
+        from .objectlayer.erasure_objects import ErasureObjects
+        return ErasureObjects.list_object_versions(
+            self, bucket, prefix, marker, version_marker, delimiter,
+            max_keys)
+
+    def _walk_merged(self, bucket, prefix=""):
+        from .objectlayer.erasure_objects import ErasureObjects
+        return ErasureObjects._walk_merged(self, bucket, prefix)
+
+    @property
+    def disks(self):
+        return [self.disk]
+
+    def copy_object(self, src_bucket, src_object, dst_bucket, dst_object,
+                    src_info, src_opts, dst_opts):
+        import io
+        from .erasure.streaming import BufferSink
+        sink = BufferSink()
+        self.get_object(src_bucket, src_object, sink, opts=src_opts)
+        data = sink.getvalue()
+        return self.put_object(dst_bucket, dst_object, io.BytesIO(data),
+                               len(data), dst_opts)
+
+    # --- multipart (single-disk variant) ------------------------------------
+
+    def new_multipart_upload(self, bucket, object, opts=None) -> str:
+        opts = opts or ObjectOptions()
+        check_names(bucket, object)
+        self.get_bucket_info(bucket)
+        upload_id = str(uuid.uuid4())
+        upath = upload_path(bucket, object, upload_id)
+        fi = FileInfo(volume=bucket, name=object,
+                      data_dir=str(uuid.uuid4()), mod_time=FileInfo.now(),
+                      metadata={
+                          "x-minio-internal-object": f"{bucket}/{object}",
+                          **opts.user_defined})
+        self.disk.write_metadata(META_MULTIPART, upath, fi)
+        return upload_id
+
+    def _upload_fi(self, bucket, object, upload_id) -> FileInfo:
+        upath = upload_path(bucket, object, upload_id)
+        try:
+            return self.disk.read_version(META_MULTIPART, upath)
+        except errors.StorageError:
+            raise dt.NoSuchUpload(bucket, object, upload_id) from None
+
+    def put_object_part(self, bucket, object, upload_id, part_id, stream,
+                        size, opts=None):
+        import msgpack
+        from .objectlayer.datatypes import PartInfo
+        self._upload_fi(bucket, object, upload_id)
+        upath = upload_path(bucket, object, upload_id)
+        hr = stream if isinstance(stream, HashReader) else \
+            HashReader(stream, size)
+        data = bytearray()
+        while True:
+            b = hr.read(1 << 20)
+            if not b:
+                break
+            data += b
+        if size >= 0 and len(data) != size:
+            raise dt.IncompleteBody(bucket, object)
+        etag = hr.etag()
+        self.disk.write_all(META_MULTIPART, f"{upath}/part.{part_id}",
+                            bytes(data))
+        self.disk.write_all(META_MULTIPART, f"{upath}/part.{part_id}.meta",
+                            msgpack.packb({
+                                "etag": etag, "size": len(data),
+                                "actual_size": len(data),
+                                "mtime": FileInfo.now()}, use_bin_type=True))
+        return PartInfo(part_number=part_id, etag=etag, size=len(data),
+                        actual_size=len(data),
+                        last_modified=FileInfo.now())
+
+    def _part_metas(self, upath: str):
+        from .objectlayer.multipart import MultipartMixin
+        return MultipartMixin._part_metas(self, upath)
+
+    def list_object_parts(self, bucket, object, upload_id, part_marker=0,
+                          max_parts=1000):
+        from .objectlayer.multipart import MultipartMixin
+        self._upload_fi(bucket, object, upload_id)
+        return MultipartMixin.list_object_parts(
+            self, bucket, object, upload_id, part_marker, max_parts)
+
+    def _upload_meta(self, bucket, object, upload_id):
+        fi = self._upload_fi(bucket, object, upload_id)
+        return fi, [fi], [None]
+
+    def list_multipart_uploads(self, bucket, prefix="", max_uploads=1000):
+        from .objectlayer.multipart import MultipartMixin
+        return MultipartMixin.list_multipart_uploads(
+            self, bucket, prefix, max_uploads)
+
+    def abort_multipart_upload(self, bucket, object, upload_id):
+        self._upload_fi(bucket, object, upload_id)
+        upath = upload_path(bucket, object, upload_id)
+        try:
+            self.disk.delete_path(META_MULTIPART, upath, recursive=True)
+        except errors.StorageError:
+            pass
+
+    def complete_multipart_upload(self, bucket, object, upload_id, parts,
+                                  opts=None) -> ObjectInfo:
+        from .objectlayer.multipart import MIN_PART_SIZE
+        opts = opts or ObjectOptions()
+        fi = self._upload_fi(bucket, object, upload_id)
+        upath = upload_path(bucket, object, upload_id)
+        metas = self._part_metas(upath)
+        if not parts:
+            raise dt.InvalidPart(bucket, object, "empty part list")
+        nums = [p.part_number for p in parts]
+        if nums != sorted(nums) or len(set(nums)) != len(nums):
+            raise dt.InvalidPartOrder(bucket, object)
+        fi_parts = []
+        total = 0
+        for i, p in enumerate(parts):
+            m = metas.get(p.part_number)
+            if m is None or m["etag"].strip('"') != p.etag.strip('"'):
+                raise dt.InvalidPart(bucket, object, str(p.part_number))
+            if i < len(parts) - 1 and m["actual_size"] < MIN_PART_SIZE:
+                raise dt.EntityTooSmall(bucket, object, str(p.part_number))
+            fi_parts.append(ObjectPartInfo(
+                number=i + 1, etag=m["etag"], size=m["size"],
+                actual_size=m["actual_size"]))
+            total += m["size"]
+        etag = etag_from_parts([p.etag for p in parts])
+        fi = replace(fi, size=total, parts=fi_parts,
+                     mod_time=FileInfo.now(),
+                     version_id=FileInfo.new_version_id()
+                     if opts.versioned else "",
+                     metadata={**fi.metadata, "etag": etag})
+        fi.metadata.pop("x-minio-internal-object", None)
+        for new_num, p in enumerate(parts, start=1):
+            self.disk.rename_file(
+                META_MULTIPART, f"{upath}/part.{p.part_number}",
+                bucket, f"{object}/{fi.data_dir}/part.{new_num}")
+        self.disk.write_metadata(bucket, object, fi)
+        try:
+            self.disk.delete_path(META_MULTIPART, upath, recursive=True)
+        except errors.StorageError:
+            pass
+        return ObjectInfo.from_file_info(fi, bucket, object, opts.versioned)
+
+    # --- heal (no-ops in FS mode, reference fs-v1 has none) -----------------
+
+    def heal_object(self, bucket, object, version_id="", dry_run=False,
+                    remove_dangling=False, scan_mode="normal"):
+        raise dt.NotImplemented(bucket, object)
+
+    def heal_bucket(self, bucket, dry_run=False):
+        raise dt.NotImplemented(bucket)
+
+    # --- config blobs -------------------------------------------------------
+
+    def put_config(self, path: str, data: bytes) -> None:
+        from .storage.xlstorage import META_BUCKET
+        self.disk.write_all(META_BUCKET, f"config/{path}", data)
+
+    def get_config(self, path: str) -> bytes:
+        from .storage.xlstorage import META_BUCKET
+        return self.disk.read_all(META_BUCKET, f"config/{path}")
+
+    def delete_config(self, path: str) -> None:
+        from .storage.xlstorage import META_BUCKET
+        try:
+            self.disk.delete_path(META_BUCKET, f"config/{path}")
+        except errors.StorageError:
+            pass
+
+    def storage_info(self) -> dict:
+        return {"disks_online": 1, "disks_offline": 0, "mode": "fs"}

@@ -574,6 +574,8 @@ class ErasureObjects(MultipartMixin, ObjectLayer):
                 rest = name[len(prefix):]
                 if delimiter in rest:
                     cp = prefix + rest.split(delimiter)[0] + delimiter
+                    if marker and cp <= marker:
+                        continue  # whole prefix listed on a previous page
                     if cp not in seen_prefixes:
                         if count >= max_keys:
                             out.is_truncated = True
@@ -637,7 +639,8 @@ class ErasureObjects(MultipartMixin, ObjectLayer):
             skipping = bool(version_marker) and name == marker
             for fi in vers:
                 if skipping:
-                    if fi.version_id == version_marker:
+                    # output rewrites "" to "null", so compare normalized
+                    if (fi.version_id or "null") == version_marker:
                         skipping = False
                     continue
                 if count >= max_keys:
@@ -683,6 +686,64 @@ class ErasureObjects(MultipartMixin, ObjectLayer):
         data = self.get_object_bytes(src_bucket, src_object, src_opts)
         return self.put_object(dst_bucket, dst_object, io.BytesIO(data),
                                len(data), dst_opts)
+
+    # --- internal config blobs (quorum read/write under .minio.sys) --------
+
+    def put_config(self, path: str, data: bytes) -> None:
+        disks = self.disks
+        errs: list[BaseException | None] = [None] * len(disks)
+        for i, d in enumerate(disks):
+            if d is None:
+                errs[i] = errors.DiskNotFound()
+                continue
+            try:
+                d.write_all(META_BUCKET, f"config/{path}", data)
+            except Exception as e:  # noqa: BLE001
+                errs[i] = e
+        err = errors.reduce_write_quorum_errs(
+            errs, errors.BASE_IGNORED_ERRS, len(disks) // 2 + 1)
+        if err is not None:
+            raise to_object_err(err)
+
+    def get_config(self, path: str) -> bytes:
+        """Majority read: a partially failed put_config must not resurface
+        the superseded blob from the disk it skipped (reference readConfig
+        reads through the quorum path)."""
+        counts: dict[bytes, int] = {}
+        last: BaseException = errors.FileNotFound(path)
+        for d in self.disks:
+            if d is None:
+                continue
+            try:
+                blob = d.read_all(META_BUCKET, f"config/{path}")
+                counts[blob] = counts.get(blob, 0) + 1
+            except Exception as e:  # noqa: BLE001
+                last = e
+        if not counts:
+            raise last
+        return max(counts, key=counts.get)
+
+    def delete_config(self, path: str) -> None:
+        for d in self.disks:
+            if d is None:
+                continue
+            try:
+                d.delete_path(META_BUCKET, f"config/{path}")
+            except errors.StorageError:
+                pass
+
+    def list_config(self, prefix: str) -> list[str]:
+        names: set[str] = set()
+        for d in self.disks:
+            if d is None:
+                continue
+            try:
+                base = f"config/{prefix}".rstrip("/")
+                for entry in d.list_dir(META_BUCKET, base):
+                    names.add(entry)
+            except errors.StorageError:
+                continue
+        return sorted(names)
 
     # --- heal --------------------------------------------------------------
 

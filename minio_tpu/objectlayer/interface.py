@@ -112,6 +112,21 @@ class ObjectLayer(abc.ABC):
     def heal_format(self, dry_run: bool = False) -> HealResultItem:
         raise NotImplementedError
 
+    # --- internal config blobs (reference cmd/config-common.go: saveConfig/
+    # readConfig persist framework state into .minio.sys via the backend) ---
+
+    def put_config(self, path: str, data: bytes) -> None:
+        raise NotImplementedError
+
+    def get_config(self, path: str) -> bytes:
+        raise NotImplementedError
+
+    def delete_config(self, path: str) -> None:
+        raise NotImplementedError
+
+    def list_config(self, prefix: str) -> list[str]:
+        return []
+
     def is_ready(self) -> bool:
         return True
 

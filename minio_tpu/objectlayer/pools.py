@@ -205,6 +205,20 @@ class ServerPools(ObjectLayer):
         return self._pool_with_upload(bucket, object, upload_id) \
             .complete_multipart_upload(bucket, object, upload_id, parts, opts)
 
+    # --- internal config blobs (pool 0 owns framework state) ---------------
+
+    def put_config(self, path: str, data: bytes) -> None:
+        self.pools[0].put_config(path, data)
+
+    def get_config(self, path: str) -> bytes:
+        return self.pools[0].get_config(path)
+
+    def delete_config(self, path: str) -> None:
+        self.pools[0].delete_config(path)
+
+    def list_config(self, prefix: str) -> list[str]:
+        return self.pools[0].list_config(prefix)
+
     # --- heal ---------------------------------------------------------------
 
     def heal_object(self, bucket, object, version_id="", dry_run=False,

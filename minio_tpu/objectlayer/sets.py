@@ -180,6 +180,23 @@ class ErasureSets(ObjectLayer):
         return self.get_hashed_set(object).complete_multipart_upload(
             bucket, object, upload_id, parts, opts)
 
+    # --- internal config blobs (routed like objects, by path hash) ---------
+
+    def put_config(self, path: str, data: bytes) -> None:
+        self.get_hashed_set(path).put_config(path, data)
+
+    def get_config(self, path: str) -> bytes:
+        return self.get_hashed_set(path).get_config(path)
+
+    def delete_config(self, path: str) -> None:
+        self.get_hashed_set(path).delete_config(path)
+
+    def list_config(self, prefix: str) -> list[str]:
+        names: set[str] = set()
+        for s in self.sets:
+            names.update(s.list_config(prefix))
+        return sorted(names)
+
     # --- heal --------------------------------------------------------------
 
     def heal_object(self, bucket, object, version_id="", dry_run=False,

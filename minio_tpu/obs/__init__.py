@@ -1,0 +1,2 @@
+"""Observability: Prometheus metrics, request tracing, structured logging
+(reference §2.7 — cmd/metrics-v2.go, cmd/http-tracer.go, cmd/logger/)."""

@@ -1,0 +1,86 @@
+"""Prometheus metrics endpoint (reference cmd/metrics-v2.go:147: MetricsGroup
+generators → text exposition). Counters are process-wide and lock-free-ish
+(GIL-atomic int adds)."""
+from __future__ import annotations
+
+import threading
+import time
+
+_start = time.time()
+_lock = threading.Lock()
+_counters: dict[str, float] = {}
+_histograms: dict[str, list[float]] = {}
+
+BUCKETS = (0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 30.0)
+
+
+def inc(name: str, value: float = 1.0, **labels):
+    key = _key(name, labels)
+    with _lock:
+        _counters[key] = _counters.get(key, 0.0) + value
+
+
+def observe(name: str, seconds: float, **labels):
+    key = _key(name, labels)
+    with _lock:
+        _histograms.setdefault(key, []).append(seconds)
+        if len(_histograms[key]) > 10_000:
+            _histograms[key] = _histograms[key][-5_000:]
+
+
+def _key(name: str, labels: dict) -> str:
+    if not labels:
+        return name
+    lab = ",".join(f'{k}="{v}"' for k, v in sorted(labels.items()))
+    return f"{name}{{{lab}}}"
+
+
+def render_prometheus(server) -> bytes:
+    """One pass over counters + gauges; server gives cluster state."""
+    lines = [
+        "# HELP minio_tpu_uptime_seconds Server uptime",
+        "# TYPE minio_tpu_uptime_seconds gauge",
+        f"minio_tpu_uptime_seconds {time.time() - _start:.1f}",
+    ]
+    try:
+        info = server.obj.storage_info()
+        lines += [
+            "# TYPE minio_tpu_disks_online gauge",
+            f"minio_tpu_disks_online {info.get('disks_online', 0)}",
+            "# TYPE minio_tpu_disks_offline gauge",
+            f"minio_tpu_disks_offline {info.get('disks_offline', 0)}",
+        ]
+    except Exception:  # noqa: BLE001
+        pass
+    try:
+        from ..runtime.dispatch import _global
+        if _global is not None:
+            st = _global.stats()
+            lines += [
+                "# TYPE minio_tpu_dispatch_batches_total counter",
+                f"minio_tpu_dispatch_batches_total {st['batches']}",
+                "# TYPE minio_tpu_dispatch_items_total counter",
+                f"minio_tpu_dispatch_items_total {st['items']}",
+                "# TYPE minio_tpu_dispatch_avg_batch gauge",
+                f"minio_tpu_dispatch_avg_batch {st['avg_batch']:.2f}",
+            ]
+    except Exception:  # noqa: BLE001
+        pass
+    with _lock:
+        for key, v in sorted(_counters.items()):
+            lines.append(f"{key} {v:g}")
+        for key, vals in sorted(_histograms.items()):
+            base, _, labels = key.partition("{")
+            labels = ("," + labels[:-1]) if labels else ""
+            n = len(vals)
+            total = sum(vals)
+            for b in BUCKETS:
+                c = sum(1 for x in vals if x <= b)
+                lines.append(
+                    f'{base}_bucket{{le="{b}"{labels}}} {c}')
+            lines.append(f'{base}_bucket{{le="+Inf"{labels}}} {n}')
+            lines.append(f"{base}_count{{{labels[1:]}}} {n}"
+                         if labels else f"{base}_count {n}")
+            lines.append(f"{base}_sum{{{labels[1:]}}} {total:.6f}"
+                         if labels else f"{base}_sum {total:.6f}")
+    return ("\n".join(lines) + "\n").encode()

@@ -115,8 +115,12 @@ class DispatchQueue:
                 while not self._stop:
                     now = time.monotonic()
                     deadline = None
-                    for key, b in self._buckets.items():
+                    for key in list(self._buckets):
+                        b = self._buckets[key]
                         if not b.items:
+                            # evict idle buckets so distinct tail-shard
+                            # sizes don't accumulate entries forever
+                            del self._buckets[key]
                             continue
                         age = now - b.items[0].t
                         if len(b.items) >= self.max_batch or \
@@ -133,8 +137,15 @@ class DispatchQueue:
                     timeout = None if deadline is None \
                         else max(0.0, deadline - time.monotonic())
                     self._cv.wait(timeout=timeout)
-                if self._stop and not to_flush:
-                    return
+                stopping = self._stop
+                if stopping:
+                    # drain everything still queued so no waiter hangs
+                    for key, b in self._buckets.items():
+                        while b.items:
+                            items, b.items = b.items[:self.max_batch], \
+                                b.items[self.max_batch:]
+                            to_flush.append((key, b, items))
+                    self._buckets.clear()
             for key, b, items in to_flush:
                 try:
                     self._flush(b, items)
@@ -142,6 +153,8 @@ class DispatchQueue:
                     for p in items:
                         if not p.future.done():
                             p.future.set_exception(e)
+            if stopping:
+                return
 
     def _flush(self, b: _Bucket, items: list[_Pending]):
         import jax.numpy as jnp

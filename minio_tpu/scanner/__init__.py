@@ -1,0 +1,2 @@
+"""Background services (reference §2.4): data scanner + usage accounting,
+auto-heal, MRF. Expanded by the heal/lifecycle managers."""

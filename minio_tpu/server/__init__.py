@@ -1,0 +1,5 @@
+"""S3-compatible HTTP server (reference L5/L6 — SURVEY.md §1): request
+routing, SigV4 auth, S3 API handlers over an ObjectLayer, admin plane."""
+from .s3api import S3Server
+
+__all__ = ["S3Server"]

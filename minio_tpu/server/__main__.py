@@ -1,0 +1,53 @@
+"""CLI entry: ``python -m minio_tpu.server [--address HOST:PORT] DIR...``
+— the analogue of ``minio server`` (reference cmd/server-main.go:404).
+Disk args may use ellipses patterns (``/data/disk{1...8}``, expanded by
+minio_tpu.dist.ellipses) and are grouped into erasure sets of 4-16 drives."""
+from __future__ import annotations
+
+import argparse
+import sys
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(prog="minio-tpu server")
+    ap.add_argument("dirs", nargs="+", help="disk directories or "
+                    "ellipses patterns like /data/disk{1...8}")
+    ap.add_argument("--address", default="0.0.0.0:9000")
+    ap.add_argument("--region", default="us-east-1")
+    ap.add_argument("--parity", type=int, default=None,
+                    help="parity drives per set (default: drives/2)")
+    args = ap.parse_args(argv)
+
+    from ..dist.ellipses import expand_endpoints
+    dirs = expand_endpoints(args.dirs)
+
+    from ..objectlayer import ErasureObjects, ErasureSets
+    from ..storage import XLStorage
+    from ..dist.topology import pick_set_layout
+    disks = [XLStorage(d) for d in dirs]
+    if len(disks) == 1:
+        from ..fs import FSObjects
+        obj = FSObjects(dirs[0])
+        print(f"FS mode on {dirs[0]}", file=sys.stderr)
+    else:
+        set_count, per_set = pick_set_layout(len(disks))
+        if set_count == 1:
+            obj = ErasureObjects(disks, default_parity=args.parity)
+        else:
+            obj = ErasureSets(disks, set_count, per_set,
+                              default_parity=args.parity)
+        print(f"erasure: {set_count} set(s) x {per_set} drives",
+              file=sys.stderr)
+
+    host, _, port = args.address.rpartition(":")
+    from . import S3Server
+    srv = S3Server(obj, host or "0.0.0.0", int(port), args.region)
+    print(f"listening on {args.address}", file=sys.stderr)
+    try:
+        srv.serve_forever()
+    except KeyboardInterrupt:
+        pass
+
+
+if __name__ == "__main__":
+    main()

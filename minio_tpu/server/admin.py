@@ -1,0 +1,81 @@
+"""Admin API (reference cmd/admin-router.go:38-98 subset): server info,
+storage info, heal trigger/status, service signals, config. Routes live
+under /minio/admin/v3/... and require root SigV4 credentials."""
+from __future__ import annotations
+
+import json
+
+from ..objectlayer import datatypes as dt
+from .auth import AuthError
+
+
+def handle_admin(h) -> None:
+    """h is the _S3Handler. Admin calls authenticate like S3 but against the
+    admin service scope; we accept s3-scope signatures too (mc does)."""
+    try:
+        ak = h._authenticate()
+    except AuthError as e:
+        return h._error(e.code, e.message, e.status)
+    if h.s3.lookup_secret(ak) != h.s3.secret_key:
+        return h._error("AccessDenied", "admin requires root credentials",
+                        403)
+    path = h.url_path[len("/minio/admin/"):]
+    _, _, op = path.partition("/")  # strip version segment
+    try:
+        _dispatch_admin(h, op)
+    except dt.ObjectAPIError as e:
+        h._api_error(e)
+    except Exception as e:  # noqa: BLE001
+        h._error("InternalError", str(e), 500)
+
+
+def _dispatch_admin(h, op: str) -> None:
+    if op == "info":
+        info = h.s3.obj.storage_info()
+        body = json.dumps({
+            "mode": "online", "backend": h.s3.obj.backend_type(),
+            "region": h.s3.region, **info}).encode()
+        return h._send(200, body, "application/json")
+    if op == "storageinfo":
+        return h._send(200, json.dumps(h.s3.obj.storage_info()).encode(),
+                       "application/json")
+    if op.startswith("heal/"):
+        return _heal(h, op)
+    if op == "datausageinfo":
+        from ..scanner.usage import load_usage
+        return h._send(200, json.dumps(load_usage(h.s3.obj)).encode(),
+                       "application/json")
+    if op.startswith("service"):
+        # restart/stop accepted; process supervisor owns actual signals
+        return h._send(200, b"{}", "application/json")
+    h._error("NotImplemented", f"admin op {op}", 501)
+
+
+def _heal(h, op: str) -> None:
+    parts = op.split("/")  # heal[/bucket[/prefix...]]
+    bucket = parts[1] if len(parts) > 1 else ""
+    prefix = "/".join(parts[2:]) if len(parts) > 2 else ""
+    dry_run = h.has_q("dryRun")
+    results = []
+    if not bucket:
+        for b in h.s3.obj.list_buckets():
+            results.append(_heal_bucket(h, b.name, "", dry_run))
+    else:
+        results.append(_heal_bucket(h, bucket, prefix, dry_run))
+    h._send(200, json.dumps({"results": results}).encode(),
+            "application/json")
+
+
+def _heal_bucket(h, bucket: str, prefix: str, dry_run: bool) -> dict:
+    res = h.s3.obj.heal_bucket(bucket, dry_run)
+    healed = []
+    listing = h.s3.obj.list_objects(bucket, prefix, max_keys=10_000)
+    for oi in listing.objects:
+        r = h.s3.obj.heal_object(bucket, oi.name, dry_run=dry_run)
+        healed.append({
+            "object": oi.name, "before": r.before_state,
+            "after": r.after_state})
+    return {"bucket": bucket,
+            "bucket_state": {"before": res.before_state,
+                             "after": res.after_state},
+            "objects": healed}

@@ -1,0 +1,766 @@
+"""S3-compatible HTTP API server (reference cmd/api-router.go:82 +
+cmd/object-handlers.go / cmd/bucket-handlers.go): path-style routing over an
+ObjectLayer, SigV4 auth, XML responses.
+
+Threaded stdlib HTTP server: request concurrency maps to the dispatch
+queue's batching (many in-flight PUT/GET blocks coalesce into single device
+launches); the reference's per-node request throttle (cmd/handler-api.go:29)
+is a semaphore here."""
+from __future__ import annotations
+
+import hashlib
+import os
+import threading
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from ..bucket import BucketMetadataSys
+from ..objectlayer import ObjectLayer, ObjectOptions
+from ..objectlayer import datatypes as dt
+from ..utils.hashreader import (BadDigestError, HashReader,
+                                SHA256MismatchError)
+from . import xmlutil as xu
+from .auth import (STREAMING_PAYLOAD, UNSIGNED_PAYLOAD, AuthError,
+                   ChunkedSigV4Reader, SigV4Verifier, parse_auth_header,
+                   signing_key)
+
+MAX_OBJECT_SIZE = 5 << 40       # 5 TiB (docs/minio-limits.md:25)
+MAX_PUT_SIZE = 5 << 30          # single PUT cap 5 GiB
+
+
+class S3Server:
+    """Owns the ObjectLayer, auth, bucket metadata; builds the HTTP server."""
+
+    def __init__(self, objlayer: ObjectLayer, address: str = "0.0.0.0",
+                 port: int = 9000, region: str = "us-east-1",
+                 access_key: str = "", secret_key: str = "",
+                 max_requests: int = 256):
+        self.obj = objlayer
+        self.region = region
+        self.access_key = access_key or os.environ.get(
+            "MINIO_ROOT_USER", "minioadmin")
+        self.secret_key = secret_key or os.environ.get(
+            "MINIO_ROOT_PASSWORD", "minioadmin")
+        self.bucket_meta = BucketMetadataSys(objlayer)
+        #: pluggable credential lookup — IAM replaces this (minio_tpu.iam)
+        self.lookup_secret = lambda ak: (
+            self.secret_key if ak == self.access_key else None)
+        #: optional IAM policy gate: fn(access_key, action, bucket, object)
+        self.authorize = None
+        #: optional event notifier: fn(event_name, bucket, object_info)
+        self.notify = None
+        self.verifier = SigV4Verifier(lambda ak: self.lookup_secret(ak),
+                                      region)
+        self.address = address
+        self.port = port
+        self._sem = threading.BoundedSemaphore(max_requests)
+        self._httpd: ThreadingHTTPServer | None = None
+
+    # --- server lifecycle ---------------------------------------------------
+
+    def build(self) -> ThreadingHTTPServer:
+        server = self
+
+        class Handler(_S3Handler):
+            s3 = server
+
+        httpd = ThreadingHTTPServer((self.address, self.port), Handler)
+        httpd.daemon_threads = True
+        self._httpd = httpd
+        self.port = httpd.server_address[1]
+        return httpd
+
+    def serve_forever(self):
+        self.build().serve_forever()
+
+    def start_background(self) -> threading.Thread:
+        httpd = self.build()
+        t = threading.Thread(target=httpd.serve_forever,
+                             name="minio-tpu-http", daemon=True)
+        t.start()
+        return t
+
+    def shutdown(self):
+        if self._httpd is not None:
+            self._httpd.shutdown()
+
+    def endpoint(self) -> str:
+        return f"http://127.0.0.1:{self.port}"
+
+
+class _S3Handler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+    s3: S3Server = None  # set by subclass factory
+
+    # silence default request logging (trace subsystem handles this)
+    def log_message(self, fmt, *args):  # noqa: A003
+        pass
+
+    # --- plumbing -----------------------------------------------------------
+
+    def _parse(self):
+        split = urllib.parse.urlsplit(self.path)
+        self.url_path = urllib.parse.unquote(split.path)
+        self.query = urllib.parse.parse_qs(split.query,
+                                           keep_blank_values=True)
+        parts = self.url_path.lstrip("/").split("/", 1)
+        self.bucket = parts[0]
+        self.key = parts[1] if len(parts) > 1 else ""
+        self.hdr = {k.lower(): v for k, v in self.headers.items()}
+
+    def q(self, key: str, default: str = "") -> str:
+        v = self.query.get(key)
+        return v[0] if v else default
+
+    def has_q(self, key: str) -> bool:
+        return key in self.query
+
+    def _send(self, status: int, body: bytes = b"",
+              content_type: str = "application/xml",
+              headers: dict | None = None):
+        self.send_response(status)
+        for k, v in (headers or {}).items():
+            if v is not None and v != "":
+                self.send_header(k, v)
+        if body or status not in (204, 304):
+            self.send_header("Content-Type", content_type)
+            self.send_header("Content-Length", str(len(body)))
+        else:
+            self.send_header("Content-Length", "0")
+        self.end_headers()
+        if body and self.command != "HEAD":
+            self.wfile.write(body)
+
+    def _error(self, code: str, message: str, status: int):
+        if status in (204, 304):  # bodiless statuses per RFC 9110
+            return self._send(status)
+        self._send(status, xu.error_xml(code, message, self.url_path))
+
+    def _api_error(self, e: dt.ObjectAPIError):
+        self._error(e.code, str(e), e.http_status)
+
+    def _read_body(self) -> bytes:
+        n = int(self.hdr.get("content-length", "0") or "0")
+        return self.rfile.read(n) if n else b""
+
+    # --- auth ---------------------------------------------------------------
+
+    def _authenticate(self) -> str:
+        headers = dict(self.hdr)
+        headers.setdefault("host", self.headers.get("Host", ""))
+        return self.s3.verifier.verify(
+            self.command, self.url_path, self.query, headers)
+
+    def _authorize(self, access_key: str, action: str):
+        gate = self.s3.authorize
+        if gate is not None and not gate(access_key, action, self.bucket,
+                                         self.key):
+            raise AuthError("AccessDenied", f"not allowed to {action}")
+
+    def _body_stream(self, size: int):
+        """Request-body reader honoring aws-chunked streaming signatures."""
+        sha = self.hdr.get("x-amz-content-sha256", "")
+        if sha == STREAMING_PAYLOAD:
+            auth = parse_auth_header(self.hdr.get("authorization", ""))
+            secret = self.s3.lookup_secret(auth.access_key)
+            key = signing_key(secret, auth.scope_date, auth.region,
+                              auth.service)
+            scope = (f"{auth.scope_date}/{auth.region}/{auth.service}/"
+                     "aws4_request")
+            return ChunkedSigV4Reader(
+                self.rfile, auth.signature, key,
+                self.hdr.get("x-amz-date", ""), scope)
+        return _CappedReader(self.rfile, size)
+
+    # --- routing ------------------------------------------------------------
+
+    def _route(self):
+        self._parse()
+        # unauthenticated health endpoints (cmd/healthcheck-handler.go)
+        if self.url_path.startswith("/minio/health/"):
+            ok = self.s3.obj.is_ready()
+            return self._send(200 if ok else 503, b"",
+                              "text/plain; charset=utf-8")
+        if self.url_path.startswith("/minio/metrics") or \
+                self.url_path.startswith("/minio/v2/metrics"):
+            from ..obs.metrics import render_prometheus
+            return self._send(200, render_prometheus(self.s3),
+                              "text/plain; version=0.0.4")
+        if self.url_path.startswith("/minio/admin/"):
+            from .admin import handle_admin
+            return handle_admin(self)
+        try:
+            access_key = self._authenticate()
+        except AuthError as e:
+            return self._error(e.code, e.message, e.status)
+        try:
+            self._dispatch(access_key)
+        except dt.ObjectAPIError as e:
+            self._api_error(e)
+        except AuthError as e:
+            self._error(e.code, e.message, e.status)
+        except (BadDigestError, SHA256MismatchError) as e:
+            self._error("BadDigest", str(e), 400)
+        except BrokenPipeError:
+            pass
+        except Exception as e:  # noqa: BLE001
+            import traceback
+            traceback.print_exc()
+            self._error("InternalError", str(e), 500)
+
+    def _dispatch(self, access_key: str):
+        m = self.command
+        if not self.bucket:
+            if m == "GET":
+                return self.list_buckets(access_key)
+            return self._error("MethodNotAllowed", "bad service op", 405)
+        if not self.key:
+            return self._bucket_op(m, access_key)
+        return self._object_op(m, access_key)
+
+    def _bucket_op(self, m: str, ak: str):
+        s = self
+        if m == "PUT":
+            if s.has_q("versioning"):
+                return s.put_versioning(ak)
+            if s.has_q("tagging"):
+                return s.put_bucket_tagging(ak)
+            if s.has_q("policy"):
+                return s.put_bucket_policy(ak)
+            if s.has_q("notification"):
+                return s.put_bucket_notification(ak)
+            if s.has_q("lifecycle"):
+                return s.put_bucket_lifecycle(ak)
+            return s.put_bucket(ak)
+        if m in ("GET", "HEAD"):
+            if s.has_q("location"):
+                return s._send(200, xu.location_xml(s.s3.region))
+            if s.has_q("versioning"):
+                return s.get_versioning(ak)
+            if s.has_q("tagging"):
+                return s.get_bucket_tagging(ak)
+            if s.has_q("policy"):
+                return s.get_bucket_policy(ak)
+            if s.has_q("notification"):
+                return s.get_bucket_notification(ak)
+            if s.has_q("lifecycle"):
+                return s.get_bucket_lifecycle(ak)
+            if s.has_q("uploads"):
+                return s.list_uploads(ak)
+            if s.has_q("versions"):
+                return s.list_versions(ak)
+            if m == "HEAD":
+                return s.head_bucket(ak)
+            return s.list_objects(ak)
+        if m == "DELETE":
+            if s.has_q("tagging"):
+                return s.delete_bucket_tagging(ak)
+            if s.has_q("policy"):
+                return s.delete_bucket_policy(ak)
+            if s.has_q("lifecycle"):
+                return s.delete_bucket_lifecycle(ak)
+            return s.delete_bucket(ak)
+        if m == "POST":
+            if s.has_q("delete"):
+                return s.delete_multiple(ak)
+        return s._error("MethodNotAllowed", f"bad bucket op {m}", 405)
+
+    def _object_op(self, m: str, ak: str):
+        s = self
+        if m == "PUT":
+            if s.has_q("partNumber") and s.has_q("uploadId"):
+                return s.put_part(ak)
+            if s.has_q("tagging"):
+                return s.put_object_tagging(ak)
+            if "x-amz-copy-source" in s.hdr:
+                return s.copy_object(ak)
+            return s.put_object(ak)
+        if m == "GET":
+            if s.has_q("uploadId"):
+                return s.list_parts(ak)
+            if s.has_q("tagging"):
+                return s.get_object_tagging(ak)
+            return s.get_object(ak)
+        if m == "HEAD":
+            return s.head_object(ak)
+        if m == "DELETE":
+            if s.has_q("uploadId"):
+                return s.abort_upload(ak)
+            if s.has_q("tagging"):
+                return s.delete_object_tagging(ak)
+            return s.delete_object(ak)
+        if m == "POST":
+            if s.has_q("uploads"):
+                return s.initiate_upload(ak)
+            if s.has_q("uploadId"):
+                return s.complete_upload(ak)
+            if s.has_q("restore"):
+                return s._send(202)
+        return s._error("MethodNotAllowed", f"bad object op {m}", 405)
+
+    # --- HTTP verbs ---------------------------------------------------------
+
+    def do_GET(self):  # noqa: N802
+        self._route()
+
+    def do_PUT(self):  # noqa: N802
+        self._route()
+
+    def do_POST(self):  # noqa: N802
+        self._route()
+
+    def do_DELETE(self):  # noqa: N802
+        self._route()
+
+    def do_HEAD(self):  # noqa: N802
+        self._route()
+
+    # --- service ------------------------------------------------------------
+
+    def list_buckets(self, ak):
+        self._authorize(ak, "s3:ListAllMyBuckets")
+        self._send(200, xu.list_buckets_xml(self.s3.obj.list_buckets()))
+
+    # --- bucket -------------------------------------------------------------
+
+    def put_bucket(self, ak):
+        self._authorize(ak, "s3:CreateBucket")
+        self.s3.obj.make_bucket(self.bucket)
+        from ..bucket.metadata import BucketMetadata
+        meta = BucketMetadata(name=self.bucket)
+        if self.hdr.get("x-amz-bucket-object-lock-enabled", "") == "true":
+            meta.object_lock_enabled = True
+            meta.versioning_enabled = True
+        self.s3.bucket_meta.set(self.bucket, meta)
+        self._send(200, headers={"Location": f"/{self.bucket}"})
+
+    def head_bucket(self, ak):
+        self._authorize(ak, "s3:ListBucket")
+        self.s3.obj.get_bucket_info(self.bucket)
+        self._send(200)
+
+    def delete_bucket(self, ak):
+        self._authorize(ak, "s3:DeleteBucket")
+        force = self.hdr.get("x-minio-force-delete", "") == "true"
+        self.s3.obj.delete_bucket(self.bucket, force=force)
+        self.s3.bucket_meta.remove(self.bucket)
+        self._send(204)
+
+    def list_objects(self, ak):
+        self._authorize(ak, "s3:ListBucket")
+        prefix = self.q("prefix")
+        delimiter = self.q("delimiter")
+        max_keys = min(int(self.q("max-keys", "1000") or "1000"), 10_000)
+        if self.q("list-type") == "2":
+            marker = self.q("continuation-token") or self.q("start-after")
+            r = self.s3.obj.list_objects(self.bucket, prefix, marker,
+                                         delimiter, max_keys)
+            return self._send(200, xu.list_objects_v2_xml(
+                self.bucket, prefix, delimiter, max_keys, r,
+                continuation_token=self.q("continuation-token")))
+        marker = self.q("marker")
+        r = self.s3.obj.list_objects(self.bucket, prefix, marker, delimiter,
+                                     max_keys)
+        self._send(200, xu.list_objects_v1_xml(
+            self.bucket, prefix, delimiter, marker, max_keys, r))
+
+    def list_versions(self, ak):
+        self._authorize(ak, "s3:ListBucketVersions")
+        prefix = self.q("prefix")
+        delimiter = self.q("delimiter")
+        max_keys = min(int(self.q("max-keys", "1000") or "1000"), 10_000)
+        r = self.s3.obj.list_object_versions(
+            self.bucket, prefix, self.q("key-marker"),
+            self.q("version-id-marker"), delimiter, max_keys)
+        self._send(200, xu.list_versions_xml(
+            self.bucket, prefix, delimiter, max_keys, r))
+
+    def put_versioning(self, ak):
+        self._authorize(ak, "s3:PutBucketVersioning")
+        self.s3.obj.get_bucket_info(self.bucket)
+        enabled = xu.parse_versioning(self._read_body())
+        self.s3.bucket_meta.update(self.bucket,
+                                   versioning_enabled=enabled,
+                                   versioning_suspended=not enabled)
+        self._send(200)
+
+    def get_versioning(self, ak):
+        self._authorize(ak, "s3:GetBucketVersioning")
+        self.s3.obj.get_bucket_info(self.bucket)
+        meta = self.s3.bucket_meta.get(self.bucket)
+        self._send(200, xu.versioning_xml(meta.versioning_enabled))
+
+    def put_bucket_tagging(self, ak):
+        self._authorize(ak, "s3:PutBucketTagging")
+        self.s3.obj.get_bucket_info(self.bucket)
+        tags = xu.parse_tagging(self._read_body())
+        self.s3.bucket_meta.update(self.bucket, tagging=tags)
+        self._send(200)
+
+    def get_bucket_tagging(self, ak):
+        self._authorize(ak, "s3:GetBucketTagging")
+        meta = self.s3.bucket_meta.get(self.bucket)
+        if not meta.tagging:
+            return self._error("NoSuchTagSet", "no tags", 404)
+        self._send(200, xu.tagging_xml(meta.tagging))
+
+    def delete_bucket_tagging(self, ak):
+        self._authorize(ak, "s3:PutBucketTagging")
+        self.s3.bucket_meta.update(self.bucket, tagging={})
+        self._send(204)
+
+    def put_bucket_policy(self, ak):
+        self._authorize(ak, "s3:PutBucketPolicy")
+        self.s3.obj.get_bucket_info(self.bucket)
+        self.s3.bucket_meta.update(self.bucket,
+                                   policy_json=self._read_body())
+        self._send(204)
+
+    def get_bucket_policy(self, ak):
+        self._authorize(ak, "s3:GetBucketPolicy")
+        meta = self.s3.bucket_meta.get(self.bucket)
+        if not meta.policy_json:
+            return self._error("NoSuchBucketPolicy", "no policy", 404)
+        self._send(200, meta.policy_json, "application/json")
+
+    def delete_bucket_policy(self, ak):
+        self._authorize(ak, "s3:DeleteBucketPolicy")
+        self.s3.bucket_meta.update(self.bucket, policy_json=b"")
+        self._send(204)
+
+    def put_bucket_notification(self, ak):
+        self._authorize(ak, "s3:PutBucketNotification")
+        self.s3.obj.get_bucket_info(self.bucket)
+        self.s3.bucket_meta.update(self.bucket,
+                                   notification_xml=self._read_body())
+        self._send(200)
+
+    def get_bucket_notification(self, ak):
+        self._authorize(ak, "s3:GetBucketNotification")
+        meta = self.s3.bucket_meta.get(self.bucket)
+        body = meta.notification_xml or \
+            b'<?xml version="1.0" encoding="UTF-8"?>' \
+            b'<NotificationConfiguration ' \
+            b'xmlns="http://s3.amazonaws.com/doc/2006-03-01/"/>'
+        self._send(200, body)
+
+    def put_bucket_lifecycle(self, ak):
+        self._authorize(ak, "s3:PutLifecycleConfiguration")
+        self.s3.obj.get_bucket_info(self.bucket)
+        self.s3.bucket_meta.update(self.bucket,
+                                   lifecycle_xml=self._read_body())
+        self._send(200)
+
+    def get_bucket_lifecycle(self, ak):
+        self._authorize(ak, "s3:GetLifecycleConfiguration")
+        meta = self.s3.bucket_meta.get(self.bucket)
+        if not meta.lifecycle_xml:
+            return self._error("NoSuchLifecycleConfiguration",
+                               "no lifecycle", 404)
+        self._send(200, meta.lifecycle_xml)
+
+    def delete_bucket_lifecycle(self, ak):
+        self._authorize(ak, "s3:PutLifecycleConfiguration")
+        self.s3.bucket_meta.update(self.bucket, lifecycle_xml=b"")
+        self._send(204)
+
+    def delete_multiple(self, ak):
+        self._authorize(ak, "s3:DeleteObject")
+        objs, quiet = xu.parse_delete_objects(self._read_body())
+        versioned = self.s3.bucket_meta.versioning_enabled(self.bucket)
+        deleted, errs = self.s3.obj.delete_objects(
+            self.bucket, objs, ObjectOptions(versioned=versioned))
+        if quiet:
+            deleted = [d for d, e in zip(deleted, errs) if e is not None]
+            errs = [e for e in errs if e is not None]
+        self._send(200, xu.delete_result_xml(deleted, errs))
+        self._notify_each("s3:ObjectRemoved:Delete", deleted)
+
+    def _notify_each(self, event, deleted):
+        if self.s3.notify is None:
+            return
+        for d in deleted:
+            if d is not None:
+                self.s3.notify(event, self.bucket,
+                               dt.ObjectInfo(bucket=self.bucket,
+                                             name=d.object_name))
+
+    # --- object -------------------------------------------------------------
+
+    def _opts(self, versioned=None) -> ObjectOptions:
+        if versioned is None:
+            versioned = self.s3.bucket_meta.versioning_enabled(self.bucket)
+        return ObjectOptions(version_id=self.q("versionId"),
+                             versioned=versioned)
+
+    def put_object(self, ak):
+        self._authorize(ak, "s3:PutObject")
+        size = int(self.hdr.get("content-length", "-1") or "-1")
+        if self.hdr.get("x-amz-content-sha256", "") == STREAMING_PAYLOAD:
+            size = int(self.hdr.get("x-amz-decoded-content-length",
+                                    str(size)))
+        if size > MAX_PUT_SIZE:
+            raise dt.EntityTooLarge(self.bucket, self.key)
+        user_defined = self._user_meta()
+        sha = self.hdr.get("x-amz-content-sha256", "")
+        sha_hex = sha if sha and sha not in (
+            UNSIGNED_PAYLOAD, STREAMING_PAYLOAD) else ""
+        md5_b64 = self.hdr.get("content-md5", "")
+        md5_hex = ""
+        if md5_b64:
+            import base64
+            md5_hex = base64.b64decode(md5_b64).hex()
+        hr = HashReader(self._body_stream(size), size, md5_hex, sha_hex)
+        opts = self._opts()
+        opts.user_defined = user_defined
+        oi = self.s3.obj.put_object(self.bucket, self.key, hr, size, opts)
+        self._send(200, headers={
+            "ETag": f'"{oi.etag}"',
+            "x-amz-version-id": oi.version_id or None})
+        self._notify("s3:ObjectCreated:Put", oi)
+
+    def _user_meta(self) -> dict[str, str]:
+        out = {}
+        ct = self.hdr.get("content-type")
+        if ct:
+            out["content-type"] = ct
+        for k, v in self.hdr.items():
+            if k.startswith("x-amz-meta-"):
+                out[k] = v
+        for k in ("cache-control", "content-disposition",
+                  "content-encoding", "content-language", "expires"):
+            if k in self.hdr:
+                out[k] = self.hdr[k]
+        return out
+
+    def _notify(self, event, oi):
+        if self.s3.notify is not None:
+            self.s3.notify(event, self.bucket, oi)
+
+    def _obj_headers(self, oi) -> dict:
+        h = {
+            "ETag": f'"{oi.etag}"',
+            "Last-Modified": xu.http_date(oi.mod_time),
+            "Content-Type": oi.content_type or "application/octet-stream",
+            "Accept-Ranges": "bytes",
+            "x-amz-version-id": oi.version_id or None,
+        }
+        for k, v in oi.user_defined.items():
+            if k.startswith("x-amz-meta-") or k in (
+                    "cache-control", "content-disposition",
+                    "content-encoding", "content-language", "expires"):
+                h[k] = v
+        return h
+
+    def _parse_range(self, total: int):
+        rng = self.hdr.get("range", "")
+        if not rng.startswith("bytes="):
+            return None
+        spec = rng[len("bytes="):].split(",")[0].strip()
+        start_s, _, end_s = spec.partition("-")
+        try:
+            if start_s == "":
+                n = int(end_s)
+                if n == 0:
+                    raise dt.InvalidRange(self.bucket, self.key)
+                start, end = max(0, total - n), total - 1
+            else:
+                start = int(start_s)
+                end = int(end_s) if end_s else total - 1
+        except ValueError:
+            return None
+        if start >= total or end < start:
+            raise dt.InvalidRange(self.bucket, self.key)
+        return start, min(end, total - 1)
+
+    def get_object(self, ak):
+        self._authorize(ak, "s3:GetObject")
+        opts = self._opts()
+        oi = self.s3.obj.get_object_info(self.bucket, self.key, opts)
+        self._check_preconditions(oi)
+        rng = self._parse_range(oi.size) if oi.size > 0 else None
+        headers = self._obj_headers(oi)
+        if rng is None:
+            offset, length = 0, oi.size
+            status = 200
+        else:
+            offset, length = rng[0], rng[1] - rng[0] + 1
+            status = 206
+            headers["Content-Range"] = \
+                f"bytes {rng[0]}-{rng[1]}/{oi.size}"
+        self.send_response(status)
+        for k, v in headers.items():
+            if v:
+                self.send_header(k, v)
+        self.send_header("Content-Length", str(length))
+        self.end_headers()
+        if length > 0:
+            self.s3.obj.get_object(self.bucket, self.key, self.wfile,
+                                   offset, length, opts)
+        self._notify("s3:ObjectAccessed:Get", oi)
+
+    def head_object(self, ak):
+        self._authorize(ak, "s3:GetObject")
+        oi = self.s3.obj.get_object_info(self.bucket, self.key, self._opts())
+        self._check_preconditions(oi)
+        h = self._obj_headers(oi)
+        h["Content-Length"] = str(oi.size)
+        self.send_response(200)
+        for k, v in h.items():
+            if v:
+                self.send_header(k, v)
+        self.end_headers()
+
+    def _check_preconditions(self, oi):
+        inm = self.hdr.get("if-none-match", "")
+        if inm and inm.strip('"') == oi.etag:
+            raise dt.NotModified(self.bucket, self.key)
+        im = self.hdr.get("if-match", "")
+        if im and im.strip('"') != oi.etag:
+            raise dt.PreconditionFailed(self.bucket, self.key)
+
+    def delete_object(self, ak):
+        self._authorize(ak, "s3:DeleteObject")
+        opts = self._opts()
+        oi = self.s3.obj.delete_object(self.bucket, self.key, opts)
+        self._send(204, headers={
+            "x-amz-version-id": oi.version_id or None,
+            "x-amz-delete-marker": "true" if oi.delete_marker else None})
+        self._notify("s3:ObjectRemoved:Delete", oi)
+
+    def copy_object(self, ak):
+        self._authorize(ak, "s3:PutObject")
+        src = urllib.parse.unquote(self.hdr["x-amz-copy-source"])
+        src_vid = ""
+        if "?versionId=" in src:
+            src, _, src_vid = src.partition("?versionId=")
+        src = src.lstrip("/")
+        src_bucket, _, src_key = src.partition("/")
+        src_opts = ObjectOptions(version_id=src_vid)
+        dst_opts = self._opts()
+        directive = self.hdr.get("x-amz-metadata-directive", "COPY")
+        if directive == "REPLACE":
+            dst_opts.user_defined = self._user_meta()
+        else:
+            si = self.s3.obj.get_object_info(src_bucket, src_key, src_opts)
+            dst_opts.user_defined = dict(si.user_defined)
+            if si.content_type:
+                dst_opts.user_defined["content-type"] = si.content_type
+        oi = self.s3.obj.copy_object(src_bucket, src_key, self.bucket,
+                                     self.key, None, src_opts, dst_opts)
+        self._send(200, xu.copy_object_xml(oi.etag, oi.mod_time),
+                   headers={"x-amz-version-id": oi.version_id or None})
+        self._notify("s3:ObjectCreated:Copy", oi)
+
+    # --- object tagging -----------------------------------------------------
+
+    def put_object_tagging(self, ak):
+        self._authorize(ak, "s3:PutObjectTagging")
+        tags = xu.parse_tagging(self._read_body())
+        enc = urllib.parse.urlencode(tags)
+        opts = self._opts()
+        oi = self.s3.obj.get_object_info(self.bucket, self.key, opts)
+        ud = dict(oi.user_defined)
+        ud["x-amz-meta-internal-tags"] = enc
+        src_opts = ObjectOptions(version_id=opts.version_id)
+        dst = ObjectOptions(version_id=opts.version_id, user_defined=ud)
+        self.s3.obj.copy_object(self.bucket, self.key, self.bucket, self.key,
+                                None, src_opts, dst)
+        self._send(200)
+
+    def get_object_tagging(self, ak):
+        self._authorize(ak, "s3:GetObjectTagging")
+        oi = self.s3.obj.get_object_info(self.bucket, self.key, self._opts())
+        enc = oi.user_defined.get("x-amz-meta-internal-tags", "")
+        tags = dict(urllib.parse.parse_qsl(enc))
+        self._send(200, xu.tagging_xml(tags))
+
+    def delete_object_tagging(self, ak):
+        self._authorize(ak, "s3:PutObjectTagging")
+        opts = self._opts()
+        oi = self.s3.obj.get_object_info(self.bucket, self.key, opts)
+        ud = {k: v for k, v in oi.user_defined.items()
+              if k != "x-amz-meta-internal-tags"}
+        self.s3.obj.copy_object(self.bucket, self.key, self.bucket, self.key,
+                                None, ObjectOptions(version_id=opts.version_id),
+                                ObjectOptions(version_id=opts.version_id,
+                                              user_defined=ud))
+        self._send(204)
+
+    # --- multipart ----------------------------------------------------------
+
+    def initiate_upload(self, ak):
+        self._authorize(ak, "s3:PutObject")
+        opts = self._opts()
+        opts.user_defined = self._user_meta()
+        uid = self.s3.obj.new_multipart_upload(self.bucket, self.key, opts)
+        self._send(200, xu.initiate_multipart_xml(self.bucket, self.key, uid))
+
+    def put_part(self, ak):
+        self._authorize(ak, "s3:PutObject")
+        part_id = int(self.q("partNumber"))
+        uid = self.q("uploadId")
+        size = int(self.hdr.get("content-length", "-1") or "-1")
+        if self.hdr.get("x-amz-content-sha256", "") == STREAMING_PAYLOAD:
+            size = int(self.hdr.get("x-amz-decoded-content-length",
+                                    str(size)))
+        hr = HashReader(self._body_stream(size), size)
+        pi = self.s3.obj.put_object_part(self.bucket, self.key, uid,
+                                         part_id, hr, size)
+        self._send(200, headers={"ETag": f'"{pi.etag}"'})
+
+    def list_parts(self, ak):
+        self._authorize(ak, "s3:ListMultipartUploadParts")
+        info = self.s3.obj.list_object_parts(
+            self.bucket, self.key, self.q("uploadId"),
+            int(self.q("part-number-marker", "0") or "0"),
+            min(int(self.q("max-parts", "1000") or "1000"), 10_000))
+        self._send(200, xu.list_parts_xml(info))
+
+    def list_uploads(self, ak):
+        self._authorize(ak, "s3:ListBucketMultipartUploads")
+        self.s3.obj.get_bucket_info(self.bucket)
+        prefix = self.q("prefix")
+        max_uploads = min(int(self.q("max-uploads", "1000") or "1000"),
+                          10_000)
+        info = self.s3.obj.list_multipart_uploads(self.bucket, prefix,
+                                                  max_uploads)
+        self._send(200, xu.list_uploads_xml(self.bucket, prefix, max_uploads,
+                                            info))
+
+    def abort_upload(self, ak):
+        self._authorize(ak, "s3:AbortMultipartUpload")
+        self.s3.obj.abort_multipart_upload(self.bucket, self.key,
+                                           self.q("uploadId"))
+        self._send(204)
+
+    def complete_upload(self, ak):
+        self._authorize(ak, "s3:PutObject")
+        parts = xu.parse_complete_multipart(self._read_body())
+        opts = self._opts()
+        oi = self.s3.obj.complete_multipart_upload(
+            self.bucket, self.key, self.q("uploadId"), parts, opts)
+        self._send(200, xu.complete_multipart_xml(
+            f"{self.s3.endpoint()}/{self.bucket}/{self.key}",
+            self.bucket, self.key, oi.etag),
+            headers={"x-amz-version-id": oi.version_id or None})
+        self._notify("s3:ObjectCreated:CompleteMultipartUpload", oi)
+
+
+class _CappedReader:
+    """Bound a socket read to the declared Content-Length (socket streams
+    never EOF on keep-alive connections)."""
+
+    def __init__(self, raw, size: int):
+        self.raw = raw
+        self.remaining = max(0, size) if size >= 0 else -1
+
+    def read(self, n: int = -1) -> bytes:
+        if self.remaining == 0:
+            return b""
+        if self.remaining > 0:
+            n = self.remaining if n < 0 else min(n, self.remaining)
+        b = self.raw.read(n)
+        if self.remaining > 0:
+            self.remaining -= len(b)
+        return b

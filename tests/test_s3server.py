@@ -1,0 +1,393 @@
+"""S3 API server tests over real HTTP with real SigV4 signing — the
+analogue of reference server_test.go (table-driven S3 calls against a full
+ObjectLayer + router + live HTTP listener)."""
+import hashlib
+import io
+import xml.etree.ElementTree as ET
+
+import numpy as np
+import pytest
+
+from minio_tpu.objectlayer import ErasureObjects
+from minio_tpu.server import S3Server
+from minio_tpu.storage import XLStorage
+from s3client import S3Client
+
+AK, SK = "testadmin", "testadmin-secret"
+
+
+@pytest.fixture(scope="module")
+def srv(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("s3srv")
+    disks = [XLStorage(str(tmp / f"d{i}")) for i in range(6)]
+    obj = ErasureObjects(disks, default_parity=2)
+    server = S3Server(obj, "127.0.0.1", 0, access_key=AK, secret_key=SK)
+    server.start_background()
+    yield server
+    server.shutdown()
+
+
+@pytest.fixture(scope="module")
+def cl(srv):
+    return S3Client(srv.endpoint(), AK, SK)
+
+
+def xml_root(resp):
+    root = ET.fromstring(resp.content)
+    for el in root.iter():
+        el.tag = el.tag.rsplit("}", 1)[-1]
+    return root
+
+
+def rng_bytes(n, seed=0):
+    return np.random.default_rng(seed).integers(
+        0, 256, size=n, dtype=np.uint8).tobytes()
+
+
+def test_auth_rejects_bad_signature(srv):
+    bad = S3Client(srv.endpoint(), AK, "wrong-secret")
+    r = bad.request("GET", "/")
+    assert r.status_code == 403
+    assert b"SignatureDoesNotMatch" in r.content
+    anon = __import__("requests").get(srv.endpoint() + "/")
+    assert anon.status_code == 403
+
+
+def test_health_endpoints_unauthenticated(srv):
+    import requests
+    assert requests.get(srv.endpoint() + "/minio/health/live").status_code \
+        == 200
+    assert requests.get(srv.endpoint() + "/minio/health/ready").status_code \
+        == 200
+
+
+def test_bucket_lifecycle_http(cl):
+    assert cl.put_bucket("b1").status_code == 200
+    r = cl.put_bucket("b1")
+    assert r.status_code == 409
+    r = cl.request("GET", "/")
+    names = [e.text for e in xml_root(r).iter("Name")]
+    assert "b1" in names
+    assert cl.request("HEAD", "/b1").status_code == 200
+    assert cl.request("HEAD", "/nope").status_code == 404
+    assert cl.delete_bucket("b1").status_code == 204
+    assert cl.request("HEAD", "/b1").status_code == 404
+
+
+def test_object_roundtrip_http(cl):
+    cl.put_bucket("data")
+    body = rng_bytes(512 << 10, seed=1)
+    r = cl.put_object("data", "dir/blob.bin", body,
+                      headers={"content-type": "application/x-test",
+                               "x-amz-meta-color": "teal"})
+    assert r.status_code == 200, r.content
+    etag = r.headers["ETag"].strip('"')
+    assert etag == hashlib.md5(body).hexdigest()
+    r = cl.get_object("data", "dir/blob.bin")
+    assert r.status_code == 200
+    assert r.content == body
+    assert r.headers["Content-Type"] == "application/x-test"
+    assert r.headers["x-amz-meta-color"] == "teal"
+    r = cl.head_object("data", "dir/blob.bin")
+    assert r.status_code == 200
+    assert int(r.headers["Content-Length"]) == len(body)
+    assert not r.content
+    # 404s
+    assert cl.get_object("data", "missing").status_code == 404
+    assert cl.get_object("nobucket", "x").status_code == 404
+
+
+def test_range_request_http(cl):
+    cl.put_bucket("rng")
+    body = rng_bytes(100_000, seed=2)
+    cl.put_object("rng", "o", body)
+    r = cl.get_object("rng", "o", headers={"Range": "bytes=100-199"})
+    assert r.status_code == 206
+    assert r.content == body[100:200]
+    assert r.headers["Content-Range"] == f"bytes 100-199/{len(body)}"
+    r = cl.get_object("rng", "o", headers={"Range": "bytes=-100"})
+    assert r.status_code == 206
+    assert r.content == body[-100:]
+    r = cl.get_object("rng", "o", headers={"Range": "bytes=99999-"})
+    assert r.status_code == 206
+    assert r.content == body[99999:]
+    r = cl.get_object("rng", "o",
+                      headers={"Range": f"bytes={len(body)}-"})
+    assert r.status_code == 416
+
+
+def test_md5_integrity_http(cl):
+    import base64
+    cl.put_bucket("md5b")
+    body = b"integrity-checked"
+    good = base64.b64encode(hashlib.md5(body).digest()).decode()
+    r = cl.put_object("md5b", "ok", body, headers={"content-md5": good})
+    assert r.status_code == 200
+    bad = base64.b64encode(hashlib.md5(b"other").digest()).decode()
+    r = cl.put_object("md5b", "bad", body, headers={"content-md5": bad})
+    assert r.status_code == 400
+    assert b"BadDigest" in r.content
+
+
+def test_signed_payload_sha256(cl):
+    cl.put_bucket("shab")
+    body = b"signed-payload-body"
+    r = cl.put_object("shab", "o", body, sign_payload=True)
+    assert r.status_code == 200
+    assert cl.get_object("shab", "o").content == body
+
+
+def test_list_objects_v2_http(cl):
+    cl.put_bucket("listb")
+    for name in ["a/1.txt", "a/2.txt", "b.txt"]:
+        cl.put_object("listb", name, b"x")
+    r = cl.request("GET", "/listb", query={"list-type": "2"})
+    root = xml_root(r)
+    keys = [e.text for e in root.iter("Key")]
+    assert keys == ["a/1.txt", "a/2.txt", "b.txt"]
+    r = cl.request("GET", "/listb",
+                   query={"list-type": "2", "delimiter": "/"})
+    root = xml_root(r)
+    assert [e.text for e in root.iter("Key")] == ["b.txt"]
+    assert [e.text for e in root.iter("Prefix") if e.text] == ["a/"]
+    # pagination via continuation token
+    r = cl.request("GET", "/listb",
+                   query={"list-type": "2", "max-keys": "2"})
+    root = xml_root(r)
+    assert root.findtext("IsTruncated") == "true"
+    token = root.findtext("NextContinuationToken")
+    r = cl.request("GET", "/listb", query={
+        "list-type": "2", "continuation-token": token})
+    assert [e.text for e in xml_root(r).iter("Key")] == ["b.txt"]
+
+
+def test_delete_multiple_http(cl):
+    cl.put_bucket("delb")
+    for i in range(3):
+        cl.put_object("delb", f"o{i}", b"x")
+    body = (b'<Delete><Object><Key>o0</Key></Object>'
+            b'<Object><Key>o1</Key></Object></Delete>')
+    r = cl.request("POST", "/delb", query={"delete": ""}, body=body)
+    assert r.status_code == 200
+    keys = [e.text for e in xml_root(r).iter("Key")]
+    assert sorted(keys) == ["o0", "o1"]
+    r = cl.request("GET", "/delb", query={"list-type": "2"})
+    assert [e.text for e in xml_root(r).iter("Key")] == ["o2"]
+
+
+def test_copy_object_http(cl):
+    cl.put_bucket("cpb")
+    body = rng_bytes(64 << 10, seed=3)
+    cl.put_object("cpb", "src", body,
+                  headers={"content-type": "text/plain"})
+    r = cl.request("PUT", "/cpb/dst",
+                   headers={"x-amz-copy-source": "/cpb/src"})
+    assert r.status_code == 200
+    assert b"CopyObjectResult" in r.content
+    r = cl.get_object("cpb", "dst")
+    assert r.content == body
+    assert r.headers["Content-Type"] == "text/plain"
+
+
+def test_versioning_http(cl):
+    cl.put_bucket("verb")
+    body = (b'<VersioningConfiguration><Status>Enabled</Status>'
+            b'</VersioningConfiguration>')
+    r = cl.request("PUT", "/verb", query={"versioning": ""}, body=body)
+    assert r.status_code == 200
+    r = cl.request("GET", "/verb", query={"versioning": ""})
+    assert b"Enabled" in r.content
+    r1 = cl.put_object("verb", "v", b"one")
+    r2 = cl.put_object("verb", "v", b"two")
+    v1 = r1.headers["x-amz-version-id"]
+    v2 = r2.headers["x-amz-version-id"]
+    assert v1 != v2
+    assert cl.get_object("verb", "v").content == b"two"
+    r = cl.get_object("verb", "v", query={"versionId": v1})
+    assert r.content == b"one"
+    # soft delete then list versions
+    r = cl.delete_object("verb", "v")
+    assert r.headers.get("x-amz-delete-marker") == "true"
+    assert cl.get_object("verb", "v").status_code == 404
+    r = cl.request("GET", "/verb", query={"versions": ""})
+    root = xml_root(r)
+    assert len(root.findall("DeleteMarker")) == 1
+    assert len(root.findall("Version")) == 2
+
+
+def test_multipart_http(cl):
+    cl.put_bucket("mpb")
+    r = cl.request("POST", "/mpb/big", query={"uploads": ""})
+    uid = xml_root(r).findtext("UploadId")
+    assert uid
+    p1 = rng_bytes(5 << 20, seed=4)
+    p2 = rng_bytes(1 << 20, seed=5)
+    e1 = cl.request("PUT", "/mpb/big",
+                    query={"partNumber": "1", "uploadId": uid},
+                    body=p1).headers["ETag"]
+    e2 = cl.request("PUT", "/mpb/big",
+                    query={"partNumber": "2", "uploadId": uid},
+                    body=p2).headers["ETag"]
+    r = cl.request("GET", "/mpb/big", query={"uploadId": uid})
+    assert [e.text for e in xml_root(r).iter("PartNumber")] == ["1", "2"]
+    body = (f"<CompleteMultipartUpload>"
+            f"<Part><PartNumber>1</PartNumber><ETag>{e1}</ETag></Part>"
+            f"<Part><PartNumber>2</PartNumber><ETag>{e2}</ETag></Part>"
+            f"</CompleteMultipartUpload>").encode()
+    r = cl.request("POST", "/mpb/big", query={"uploadId": uid}, body=body)
+    assert r.status_code == 200, r.content
+    got = cl.get_object("mpb", "big")
+    assert got.content == p1 + p2
+    assert got.headers["ETag"].strip('"').endswith("-2")
+
+
+def test_object_tagging_http(cl):
+    cl.put_bucket("tagb")
+    cl.put_object("tagb", "o", b"x")
+    body = (b"<Tagging><TagSet><Tag><Key>env</Key><Value>prod</Value>"
+            b"</Tag></TagSet></Tagging>")
+    r = cl.request("PUT", "/tagb/o", query={"tagging": ""}, body=body)
+    assert r.status_code == 200
+    r = cl.request("GET", "/tagb/o", query={"tagging": ""})
+    root = xml_root(r)
+    assert root.findtext(".//Key") == "env"
+    assert root.findtext(".//Value") == "prod"
+    r = cl.request("DELETE", "/tagb/o", query={"tagging": ""})
+    assert r.status_code == 204
+
+
+def test_conditional_requests_http(cl):
+    cl.put_bucket("condb")
+    r = cl.put_object("condb", "o", b"cond-body")
+    etag = r.headers["ETag"]
+    r = cl.get_object("condb", "o", headers={"If-None-Match": etag})
+    assert r.status_code == 304
+    r = cl.get_object("condb", "o", headers={"If-Match": '"bogus"'})
+    assert r.status_code == 412
+    r = cl.get_object("condb", "o", headers={"If-Match": etag})
+    assert r.status_code == 200
+
+
+def test_metrics_endpoint(srv):
+    import requests
+    r = requests.get(srv.endpoint() + "/minio/v2/metrics/cluster")
+    assert r.status_code == 200
+    assert b"minio_tpu_uptime_seconds" in r.content
+
+
+def test_admin_info(cl, srv):
+    r = cl.request("GET", "/minio/admin/v3/info")
+    assert r.status_code == 200
+    assert r.json()["backend"] == "Erasure"
+
+
+def test_presigned_url(srv, cl):
+    """Presigned GET built by hand (X-Amz-* query auth)."""
+    import datetime
+    import hashlib as hl
+    import hmac as hm
+    import urllib.parse
+    import requests
+    from minio_tpu.server.auth import (canonical_request, signing_key,
+                                       string_to_sign)
+    cl.put_bucket("presb")
+    cl.put_object("presb", "o", b"presigned-content")
+    now = datetime.datetime.now(datetime.timezone.utc)
+    ts = now.strftime("%Y%m%dT%H%M%SZ")
+    scope_date = ts[:8]
+    scope = f"{scope_date}/us-east-1/s3/aws4_request"
+    q = {
+        "X-Amz-Algorithm": ["AWS4-HMAC-SHA256"],
+        "X-Amz-Credential": [f"{AK}/{scope}"],
+        "X-Amz-Date": [ts],
+        "X-Amz-Expires": ["600"],
+        "X-Amz-SignedHeaders": ["host"],
+    }
+    host = srv.endpoint().split("//")[1]
+    creq = canonical_request("GET", "/presb/o", q, {"host": host},
+                             ["host"], "UNSIGNED-PAYLOAD")
+    sts = string_to_sign(ts, scope, creq)
+    key = signing_key(SK, scope_date, "us-east-1")
+    sig = hm.new(key, sts.encode(), hl.sha256).hexdigest()
+    q["X-Amz-Signature"] = [sig]
+    qs = urllib.parse.urlencode([(k, v[0]) for k, v in q.items()])
+    r = requests.get(f"{srv.endpoint()}/presb/o?{qs}")
+    assert r.status_code == 200, r.content
+    assert r.content == b"presigned-content"
+
+
+def test_streaming_chunked_put(srv, cl):
+    """STREAMING-AWS4-HMAC-SHA256-PAYLOAD upload with per-chunk signatures
+    (reference cmd/streaming-signature-v4.go)."""
+    import datetime
+    import hashlib as hl
+    import hmac as hm
+    import requests
+    from minio_tpu.server.auth import (EMPTY_SHA256, canonical_request,
+                                       signing_key, string_to_sign)
+    cl.put_bucket("chunkb")
+    payload = rng_bytes(150_000, seed=9)
+    chunks = [payload[:65536], payload[65536:131072], payload[131072:]]
+
+    now = datetime.datetime.now(datetime.timezone.utc)
+    ts = now.strftime("%Y%m%dT%H%M%SZ")
+    scope_date = ts[:8]
+    scope = f"{scope_date}/us-east-1/s3/aws4_request"
+    host = srv.endpoint().split("//")[1]
+    headers = {
+        "host": host,
+        "x-amz-date": ts,
+        "x-amz-content-sha256": "STREAMING-AWS4-HMAC-SHA256-PAYLOAD",
+        "x-amz-decoded-content-length": str(len(payload)),
+    }
+    signed = sorted(headers)
+    creq = canonical_request("PUT", "/chunkb/streamed", {}, headers, signed,
+                             "STREAMING-AWS4-HMAC-SHA256-PAYLOAD")
+    sts = string_to_sign(ts, scope, creq)
+    key = signing_key(SK, scope_date, "us-east-1")
+    seed_sig = hm.new(key, sts.encode(), hl.sha256).hexdigest()
+    headers["authorization"] = (
+        f"AWS4-HMAC-SHA256 Credential={AK}/{scope}, "
+        f"SignedHeaders={';'.join(signed)}, Signature={seed_sig}")
+
+    body = bytearray()
+    prev = seed_sig
+    for chunk in chunks + [b""]:
+        chunk_sts = "\n".join([
+            "AWS4-HMAC-SHA256-PAYLOAD", ts, scope, prev, EMPTY_SHA256,
+            hl.sha256(chunk).hexdigest()])
+        sig = hm.new(key, chunk_sts.encode(), hl.sha256).hexdigest()
+        body += f"{len(chunk):x};chunk-signature={sig}\r\n".encode()
+        body += chunk + b"\r\n"
+        prev = sig
+    r = requests.put(f"{srv.endpoint()}/chunkb/streamed", data=bytes(body),
+                     headers=headers)
+    assert r.status_code == 200, r.content
+    assert cl.get_object("chunkb", "streamed").content == payload
+    # tampered chunk data must be rejected
+    tampered = bytearray(body)
+    idx = bytes(tampered).find(b"\r\n") + 2 + 100
+    tampered[idx] ^= 0xFF
+    r = requests.put(f"{srv.endpoint()}/chunkb/tampered",
+                     data=bytes(tampered), headers=headers)
+    assert r.status_code in (400, 403)
+
+
+def test_fs_mode(tmp_path):
+    """FS single-disk backend through the same HTTP stack."""
+    from minio_tpu.fs import FSObjects
+    obj = FSObjects(str(tmp_path / "fsdisk"))
+    server = S3Server(obj, "127.0.0.1", 0, access_key=AK, secret_key=SK)
+    server.start_background()
+    try:
+        c = S3Client(server.endpoint(), AK, SK)
+        assert c.put_bucket("fsb").status_code == 200
+        body = rng_bytes(300 << 10, seed=6)
+        assert c.put_object("fsb", "o", body).status_code == 200
+        assert c.get_object("fsb", "o").content == body
+        r = c.get_object("fsb", "o", headers={"Range": "bytes=10-19"})
+        assert r.content == body[10:20]
+        assert c.delete_object("fsb", "o").status_code == 204
+        assert c.get_object("fsb", "o").status_code == 404
+    finally:
+        server.shutdown()
