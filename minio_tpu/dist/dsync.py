@@ -1,0 +1,181 @@
+"""dsync — distributed RW locks by quorum consensus (reference pkg/dsync:
+DRWMutex broadcasts Lock RPCs to ALL lockers; write lock needs quorum
+n/2+1, read lock n/2; on failed quorum every acquired lock is released
+asynchronously; lock maintenance expires orphans by asking the owner
+(drwmutex.go:49-348, cmd/lock-rest-server.go:257)."""
+from __future__ import annotations
+
+import random
+import threading
+import time
+import uuid
+
+#: reference quorum rule (drwmutex.go:160-171)
+
+
+def write_quorum(n: int) -> int:
+    return n // 2 + 1
+
+
+def read_quorum(n: int) -> int:
+    return n // 2
+
+
+class LocalLocker:
+    """Per-node lock table (reference cmd/local-locker.go): entries keyed by
+    resource, each holding owner/uid/rw state. NetLocker surface: lock,
+    unlock, rlock, runlock, expired, force_unlock."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        #: resource -> list of {uid, owner, writer: bool, ts}
+        self._table: dict[str, list[dict]] = {}
+
+    def lock(self, resource: str, uid: str, owner: str) -> bool:
+        with self._lock:
+            if self._table.get(resource):
+                return False
+            self._table[resource] = [{"uid": uid, "owner": owner,
+                                      "writer": True, "ts": time.time()}]
+            return True
+
+    def unlock(self, resource: str, uid: str) -> bool:
+        with self._lock:
+            entries = self._table.get(resource, [])
+            keep = [e for e in entries if e["uid"] != uid or not e["writer"]]
+            if len(keep) == len(entries):
+                return False
+            if keep:
+                self._table[resource] = keep
+            else:
+                self._table.pop(resource, None)
+            return True
+
+    def rlock(self, resource: str, uid: str, owner: str) -> bool:
+        with self._lock:
+            entries = self._table.get(resource, [])
+            if any(e["writer"] for e in entries):
+                return False
+            entries = self._table.setdefault(resource, [])
+            entries.append({"uid": uid, "owner": owner, "writer": False,
+                            "ts": time.time()})
+            return True
+
+    def runlock(self, resource: str, uid: str) -> bool:
+        with self._lock:
+            entries = self._table.get(resource, [])
+            for i, e in enumerate(entries):
+                if e["uid"] == uid and not e["writer"]:
+                    entries.pop(i)
+                    if not entries:
+                        self._table.pop(resource, None)
+                    return True
+            return False
+
+    def expired(self, resource: str, uid: str) -> bool:
+        """Does this node still hold (resource, uid)? Used by peers'
+        maintenance loops."""
+        with self._lock:
+            return not any(e["uid"] == uid
+                           for e in self._table.get(resource, []))
+
+    def force_unlock(self, resource: str) -> bool:
+        with self._lock:
+            return self._table.pop(resource, None) is not None
+
+    def stale_sweep(self, max_age_s: float = 300.0):
+        """Drop entries older than max_age_s whose owners vanished (called
+        by the maintenance loop)."""
+        cutoff = time.time() - max_age_s
+        with self._lock:
+            for res in list(self._table):
+                self._table[res] = [e for e in self._table[res]
+                                    if e["ts"] > cutoff]
+                if not self._table[res]:
+                    del self._table[res]
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {k: list(v) for k, v in self._table.items()}
+
+
+class DRWMutex:
+    """Distributed RW mutex over N lockers (local or lock-REST clients with
+    the NetLocker surface). Usage:
+
+        mtx = DRWMutex(lockers, "bucket/object", owner="node1")
+        if mtx.get_lock(timeout=5.0): ... mtx.unlock()
+    """
+
+    def __init__(self, lockers: list, resource: str, owner: str = ""):
+        self.lockers = lockers
+        self.resource = resource
+        self.owner = owner or str(uuid.uuid4())
+        self.uid = ""
+        self._held: list[int] = []
+        self._is_write = False
+
+    # -- acquisition ---------------------------------------------------------
+
+    def get_lock(self, timeout: float = 10.0) -> bool:
+        return self._acquire(timeout, writer=True)
+
+    def get_rlock(self, timeout: float = 10.0) -> bool:
+        return self._acquire(timeout, writer=False)
+
+    def _acquire(self, timeout: float, writer: bool) -> bool:
+        deadline = time.monotonic() + timeout
+        n = len(self.lockers)
+        quorum = write_quorum(n) if writer else read_quorum(n)
+        quorum = max(quorum, 1)
+        while True:
+            uid = str(uuid.uuid4())
+            granted: list[int] = []
+            for i, lk in enumerate(self.lockers):
+                try:
+                    ok = (lk.lock(self.resource, uid, self.owner) if writer
+                          else lk.rlock(self.resource, uid, self.owner))
+                except Exception:  # noqa: BLE001 — offline locker = no vote
+                    ok = False
+                if ok:
+                    granted.append(i)
+            if len(granted) >= quorum:
+                self.uid = uid
+                self._held = granted
+                self._is_write = writer
+                return True
+            # failed quorum: async release-all (drwmutex.go:297)
+            self._release(granted, uid, writer)
+            if time.monotonic() >= deadline:
+                return False
+            time.sleep(random.uniform(0.005, 0.05))  # retry with jitter
+
+    def _release(self, indices: list[int], uid: str, writer: bool):
+        for i in indices:
+            try:
+                if writer:
+                    self.lockers[i].unlock(self.resource, uid)
+                else:
+                    self.lockers[i].runlock(self.resource, uid)
+            except Exception:  # noqa: BLE001
+                pass
+
+    def unlock(self):
+        self._release(self._held, self.uid, self._is_write)
+        self._held = []
+
+    runlock = unlock
+
+
+class NSLockMap:
+    """Namespace lock map (reference cmd/namespace-lock.go): bucket/object →
+    DRWMutex over the configured lockers (local-only list in standalone
+    mode, lock-REST clients in distributed mode)."""
+
+    def __init__(self, lockers_fn, owner: str):
+        self.lockers_fn = lockers_fn  # () -> list of NetLockers
+        self.owner = owner
+
+    def new_lock(self, bucket: str, *objects: str) -> DRWMutex:
+        resource = "/".join([bucket, *objects])
+        return DRWMutex(self.lockers_fn(), resource, self.owner)

@@ -1,0 +1,91 @@
+"""Lock REST service + client (reference cmd/lock-rest-server.go /
+lock-rest-client.go): the NetLocker surface over the generic RPC transport,
+plus the maintenance loop that expires orphaned locks by checking back with
+their owners (lock-rest-server.go:257)."""
+from __future__ import annotations
+
+import threading
+
+from .dsync import LocalLocker
+from .rpc import RPCClient
+
+LOCK_MAINTENANCE_INTERVAL_S = 60.0
+
+
+class LockRESTClient:
+    """NetLocker over RPC."""
+
+    def __init__(self, node_url: str, secret: str):
+        self.rpc = RPCClient(node_url, "lock", secret)
+
+    def _call(self, method, resource, uid, owner="") -> bool:
+        try:
+            out = self.rpc.call(method, {"resource": resource, "uid": uid,
+                                         "owner": owner})
+            return out == b"1"
+        except Exception:  # noqa: BLE001 — offline locker grants nothing
+            return False
+
+    def lock(self, resource, uid, owner):
+        return self._call("lock", resource, uid, owner)
+
+    def unlock(self, resource, uid):
+        return self._call("unlock", resource, uid)
+
+    def rlock(self, resource, uid, owner):
+        return self._call("rlock", resource, uid, owner)
+
+    def runlock(self, resource, uid):
+        return self._call("runlock", resource, uid)
+
+    def expired(self, resource, uid):
+        return self._call("expired", resource, uid)
+
+    def force_unlock(self, resource):
+        return self._call("forceunlock", resource, "")
+
+    def is_online(self):
+        return self.rpc.is_online()
+
+
+class LockRESTService:
+    """Server side: the node's LocalLocker over RPC + maintenance."""
+
+    def __init__(self, locker: LocalLocker | None = None):
+        self.locker = locker or LocalLocker()
+        self._stop = threading.Event()
+
+    def handle(self, method: str, params: dict, body: bytes) -> bytes:
+        res = params.get("resource", "")
+        uid = params.get("uid", "")
+        owner = params.get("owner", "")
+        if method == "lock":
+            ok = self.locker.lock(res, uid, owner)
+        elif method == "unlock":
+            ok = self.locker.unlock(res, uid)
+        elif method == "rlock":
+            ok = self.locker.rlock(res, uid, owner)
+        elif method == "runlock":
+            ok = self.locker.runlock(res, uid)
+        elif method == "expired":
+            ok = self.locker.expired(res, uid)
+        elif method == "forceunlock":
+            ok = self.locker.force_unlock(res)
+        elif method == "toplocks":
+            import json
+            return json.dumps(self.locker.snapshot()).encode()
+        else:
+            from ..utils import errors
+            raise errors.MethodNotSupported(method)
+        return b"1" if ok else b"0"
+
+    def start_maintenance(self, interval_s: float =
+                          LOCK_MAINTENANCE_INTERVAL_S):
+        def loop():
+            while not self._stop.wait(interval_s):
+                self.locker.stale_sweep()
+        threading.Thread(target=loop, daemon=True,
+                         name="lock-maintenance").start()
+
+    def stop(self):
+        self._stop.set()

@@ -1,0 +1,153 @@
+"""Node — one server process in a (possibly distributed) deployment
+(reference serverMain, cmd/server-main.go:404): parses endpoints, builds
+local XLStorage + remote StorageRESTClient disks, waits for / initializes
+format.json across the cluster, assembles the ObjectLayer, mounts the
+storage/lock/peer RPC services on the S3 listener, and runs the bootstrap
+config cross-check."""
+from __future__ import annotations
+
+import time
+
+from ..objectlayer import ErasureObjects, ErasureSets
+from ..server import S3Server
+from ..storage import XLStorage
+from ..utils import errors
+from .dsync import LocalLocker, NSLockMap
+from .endpoints import Endpoint, nodes_of, parse_endpoints
+from .format import init_format_erasure
+from .lock_rest import LockRESTClient, LockRESTService
+from .peer import PeerRESTClient, PeerRESTService
+from .storage_rest import StorageRESTClient, StorageRESTService
+from .topology import pick_set_layout
+
+
+class Node:
+    def __init__(self, endpoint_args: list[str], local_url: str = "",
+                 address: str = "0.0.0.0", port: int = 9000,
+                 access_key: str = "", secret_key: str = "",
+                 default_parity: int | None = None,
+                 region: str = "us-east-1"):
+        self.endpoints: list[Endpoint] = parse_endpoints(endpoint_args)
+        self.local_url = local_url.rstrip("/")
+        self._start = time.time()
+
+        #: disk path -> XLStorage (this node's disks, served over RPC)
+        self.local_disks: dict[str, XLStorage] = {}
+        secret = secret_key or "minioadmin"
+        self.secret = secret
+        self.disks: list = []
+        for ep in self.endpoints:
+            if ep.is_local_path or ep.url == self.local_url:
+                d = XLStorage(ep.path, endpoint=str(ep))
+                self.local_disks[ep.path] = d
+                self.disks.append(d)
+            else:
+                self.disks.append(
+                    StorageRESTClient(ep.url, ep.path, secret))
+
+        self.peer_urls = [u for u in nodes_of(self.endpoints)
+                          if u != self.local_url]
+        self.peers = [PeerRESTClient(u, secret) for u in self.peer_urls]
+
+        # lockers: this node's local locker + one lock client per peer
+        self.local_locker = LocalLocker()
+        self._lock_clients = [LockRESTClient(u, secret)
+                              for u in self.peer_urls]
+        self.ns_lock = NSLockMap(
+            lambda: [self.local_locker, *self._lock_clients],
+            owner=self.local_url or "standalone")
+
+        self.set_count, self.drives_per_set = pick_set_layout(
+            len(self.disks))
+        self.obj = None
+        self.bucket_meta = None
+        self.server: S3Server | None = None
+        self._access_key = access_key
+        self._secret_key = secret_key
+        self._address, self._port, self._region = address, port, region
+        self.format = None
+        self.default_parity = default_parity
+
+    def uptime(self) -> float:
+        return time.time() - self._start
+
+    def layout_fingerprint(self) -> dict:
+        return {"endpoints": [str(e) for e in self.endpoints],
+                "sets": self.set_count, "drives": self.drives_per_set}
+
+    # --- startup ------------------------------------------------------------
+
+    def start(self, wait_format_timeout: float = 60.0) -> S3Server:
+        """Mount RPC services + S3 API, then bring storage online."""
+        server = S3Server(self.obj, self._address, self._port,
+                          self._region, self._access_key, self._secret_key)
+        self.server = server
+        lock_svc = LockRESTService(self.local_locker)
+        lock_svc.start_maintenance()
+        server.internal = {
+            "storage": StorageRESTService(self.local_disks),
+            "lock": lock_svc,
+            "peer": PeerRESTService(self),
+        }
+        server.start_background()
+        self.wait_format(wait_format_timeout)
+        self._build_object_layer()
+        server.obj = self.obj
+        from ..bucket import BucketMetadataSys
+        server.bucket_meta = BucketMetadataSys(self.obj)
+        self.bucket_meta = server.bucket_meta
+        server.bucket_meta.on_update = self._broadcast_bucket_update
+        self.bootstrap_verify()
+        return server
+
+    def wait_format(self, timeout: float):
+        """waitForFormatErasure (cmd/prepare-storage.go:331): retry until
+        every disk is reachable and consistently formatted."""
+        deadline = time.monotonic() + timeout
+        while True:
+            try:
+                self.format = init_format_erasure(
+                    self.disks, self.set_count, self.drives_per_set)
+                return
+            except errors.StorageError:
+                if time.monotonic() >= deadline:
+                    raise
+                time.sleep(0.5)
+
+    def _build_object_layer(self):
+        if self.set_count == 1:
+            obj = ErasureObjects(self.disks,
+                                 default_parity=self.default_parity)
+        else:
+            obj = ErasureSets(self.disks, self.set_count,
+                              self.drives_per_set,
+                              deployment_id=self.format["id"],
+                              default_parity=self.default_parity)
+        # wire namespace locks into every set
+        for s in ([obj] if self.set_count == 1 else obj.sets):
+            s.ns_lock = self.ns_lock
+        self.obj = obj
+
+    def _broadcast_bucket_update(self, bucket: str):
+        for p in self.peers:
+            try:
+                p.load_bucket_metadata(bucket)
+            except Exception:  # noqa: BLE001
+                pass
+
+    def bootstrap_verify(self, quorum: bool = False):
+        """verifyServerSystemConfig (cmd/bootstrap-peer-server.go:162):
+        cross-check the endpoint layout with peers (best effort during
+        rolling start; hard failure only on mismatch)."""
+        mine = self.layout_fingerprint()
+        for p in self.peers:
+            try:
+                if not p.verify_config(mine):
+                    raise RuntimeError(
+                        f"bootstrap: {p.url} disagrees on cluster layout")
+            except errors.StorageError:
+                continue  # peer not up yet — it will verify against us
+
+    def shutdown(self):
+        if self.server is not None:
+            self.server.shutdown()

@@ -1,0 +1,77 @@
+"""Peer REST service — node-to-node control plane (reference
+cmd/peer-rest-{client,server}.go: 35 methods for config/bucket-metadata
+sync, server info, trace...; the subset here covers cluster coherence:
+bucket-metadata invalidation, server info, bootstrap verification)."""
+from __future__ import annotations
+
+import json
+import platform
+
+from .rpc import RPCClient
+
+
+class PeerRESTClient:
+    def __init__(self, node_url: str, secret: str):
+        self.url = node_url
+        self.rpc = RPCClient(node_url, "peer", secret)
+
+    def is_online(self) -> bool:
+        return self.rpc.is_online()
+
+    def load_bucket_metadata(self, bucket: str) -> None:
+        self.rpc.call("loadbucketmetadata", {"bucket": bucket})
+
+    def delete_bucket_metadata(self, bucket: str) -> None:
+        self.rpc.call("deletebucketmetadata", {"bucket": bucket})
+
+    def server_info(self) -> dict:
+        return json.loads(self.rpc.call("serverinfo"))
+
+    def get_local_disk_ids(self) -> list[str]:
+        return json.loads(self.rpc.call("getlocaldiskids"))
+
+    def verify_config(self, config: dict) -> bool:
+        """Bootstrap cross-check (reference bootstrap-peer-server.go:162):
+        every node must agree on the endpoint layout."""
+        out = self.rpc.call("verifyconfig", body=json.dumps(config).encode())
+        return out == b"ok"
+
+    def signal_service(self, sig: str) -> None:
+        self.rpc.call("signalservice", {"signal": sig})
+
+
+class PeerRESTService:
+    def __init__(self, node):
+        self.node = node  # dist.node.Node
+
+    def handle(self, method: str, params: dict, body: bytes) -> bytes:
+        if method == "loadbucketmetadata":
+            if self.node.bucket_meta is not None:
+                self.node.bucket_meta.invalidate(params.get("bucket", ""))
+            return b""
+        if method == "deletebucketmetadata":
+            if self.node.bucket_meta is not None:
+                self.node.bucket_meta.invalidate(params.get("bucket", ""))
+            return b""
+        if method == "serverinfo":
+            return json.dumps({
+                "endpoint": self.node.local_url,
+                "uptime": self.node.uptime(),
+                "version": "minio-tpu/0.1",
+                "platform": platform.platform(),
+                "disks": [d.endpoint() for d in
+                          self.node.local_disks.values()],
+            }).encode()
+        if method == "getlocaldiskids":
+            return json.dumps([
+                d.get_disk_id() for d in
+                self.node.local_disks.values()]).encode()
+        if method == "verifyconfig":
+            mine = self.node.layout_fingerprint()
+            theirs = json.loads(body or b"{}")
+            return b"ok" if mine == theirs else \
+                json.dumps(mine).encode()
+        if method == "signalservice":
+            return b""
+        from ..utils import errors
+        raise errors.MethodNotSupported(method)

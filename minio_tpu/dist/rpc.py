@@ -1,0 +1,149 @@
+"""Generic REST-RPC transport (reference cmd/rest/client.go:75-233 +
+SURVEY.md A.7): POST ``/minio/<service>/<version>/<method>?args...`` with an
+HMAC bearer token, msgpack or raw-stream bodies. The client marks itself
+offline on transport errors and a background ping re-marks it online
+(reference :204-211) — this is the disk/peer failure-detection primitive.
+"""
+from __future__ import annotations
+
+import hashlib
+import hmac
+import threading
+import time
+import urllib.parse
+
+import requests
+
+from ..utils import errors
+
+RPC_VERSION = "v1"
+HEALTH_INTERVAL_S = 1.0
+
+#: wire form of typed storage errors (class name travels in a header)
+_ERR_BY_NAME = {c.__name__: c for c in [
+    errors.DiskNotFound, errors.FaultyDisk, errors.DiskFull,
+    errors.DiskAccessDenied, errors.UnformattedDisk, errors.CorruptedFormat,
+    errors.VolumeNotFound, errors.VolumeExists, errors.VolumeNotEmpty,
+    errors.FileNotFound, errors.FileVersionNotFound, errors.FileNameTooLong,
+    errors.FileAccessDenied, errors.FileCorrupt, errors.IsNotRegular,
+    errors.MethodNotSupported, errors.ErasureReadQuorum,
+    errors.ErasureWriteQuorum, errors.LessData, errors.MoreData,
+]}
+
+
+def make_token(secret: str, expiry_s: int = 3600) -> str:
+    """Compact HMAC bearer token (the reference uses JWT with the same root
+    secret — cmd/jwt.go; an HMAC-signed expiry carries the same guarantee
+    without a JWT dependency)."""
+    exp = str(int(time.time()) + expiry_s)
+    mac = hmac.new(secret.encode(), exp.encode(), hashlib.sha256).hexdigest()
+    return f"{exp}.{mac}"
+
+
+def check_token(secret: str, token: str) -> bool:
+    try:
+        exp, mac = token.split(".", 1)
+        want = hmac.new(secret.encode(), exp.encode(),
+                        hashlib.sha256).hexdigest()
+        return hmac.compare_digest(want, mac) and int(exp) >= time.time()
+    except (ValueError, AttributeError):
+        return False
+
+
+class RPCError(errors.RPCError):
+    pass
+
+
+class RPCClient:
+    """One client per remote service endpoint. Offline marking: any
+    transport-level failure flips offline; a daemon ping loop probes
+    ``/minio/health/live`` and flips back online."""
+
+    def __init__(self, base_url: str, service: str, secret: str,
+                 timeout: float = 30.0):
+        self.base = base_url.rstrip("/")
+        self.service = service
+        self.secret = secret
+        self.timeout = timeout
+        self._session = requests.Session()
+        self._online = True
+        self._lock = threading.Lock()
+        self._ping_thread: threading.Thread | None = None
+        self.on_reconnect = None  # hook: called when back online
+
+    def is_online(self) -> bool:
+        return self._online
+
+    def _mark_offline(self):
+        with self._lock:
+            if not self._online:
+                return
+            self._online = False
+            t = threading.Thread(target=self._ping_loop, daemon=True,
+                                 name=f"rpc-ping-{self.base}")
+            self._ping_thread = t
+            t.start()
+
+    def _ping_loop(self):
+        while not self._online:
+            time.sleep(HEALTH_INTERVAL_S)
+            try:
+                r = self._session.get(f"{self.base}/minio/health/live",
+                                      timeout=2)
+                if r.status_code == 200:
+                    self._online = True
+                    if self.on_reconnect is not None:
+                        try:
+                            self.on_reconnect(self)
+                        except Exception:  # noqa: BLE001
+                            pass
+                    return
+            except requests.RequestException:
+                continue
+
+    def call(self, method: str, params: dict | None = None,
+             body: bytes | None = None, stream: bool = False,
+             timeout: float | None = None):
+        """POST the method; returns response bytes (or the raw response when
+        stream=True). Typed storage errors re-raise as their class."""
+        if not self._online:
+            raise errors.DiskNotFound(f"{self.base} offline")
+        qs = urllib.parse.urlencode(
+            {k: str(v) for k, v in (params or {}).items()})
+        url = (f"{self.base}/minio/{self.service}/{RPC_VERSION}/{method}"
+               + (f"?{qs}" if qs else ""))
+        try:
+            r = self._session.post(
+                url, data=body,
+                headers={"Authorization": f"Bearer "
+                         f"{make_token(self.secret)}"},
+                timeout=timeout or self.timeout, stream=stream)
+        except requests.RequestException as e:
+            self._mark_offline()
+            raise errors.DiskNotFound(f"{self.base}: {e}") from e
+        if r.status_code == 200:
+            return r if stream else r.content
+        err_name = r.headers.get("x-minio-tpu-error", "")
+        msg = r.content.decode("utf-8", "replace")[:200]
+        if err_name in _ERR_BY_NAME:
+            raise _ERR_BY_NAME[err_name](msg)
+        if r.status_code in (502, 503, 504):
+            self._mark_offline()
+            raise errors.DiskNotFound(f"{self.base}: {r.status_code}")
+        raise RPCError(f"{method}: HTTP {r.status_code} {msg}")
+
+    def close(self):
+        self._online = False
+        self._session.close()
+
+
+def rpc_error_response(handler, e: BaseException):
+    """Send a typed error over the wire (server side)."""
+    name = type(e).__name__ if type(e).__name__ in _ERR_BY_NAME \
+        else "RPCError"
+    body = str(e).encode()
+    handler.send_response(500)
+    handler.send_header("x-minio-tpu-error", name)
+    handler.send_header("Content-Length", str(len(body)))
+    handler.end_headers()
+    handler.wfile.write(body)

@@ -66,35 +66,55 @@ class FSObjects(ObjectLayer):
         self.get_bucket_info(bucket)
         hr = stream if isinstance(stream, HashReader) else \
             HashReader(stream, size)
-        data = bytearray()
-        while True:
-            b = hr.read(1 << 20)
-            if not b:
-                break
-            data += b
-        data = bytes(data)
-        if size >= 0 and len(data) != size:
+        data_dir = str(uuid.uuid4())
+        tmp_path = f"{uuid.uuid4()}/{data_dir}/part.1"
+        # buffer small bodies for xl.meta inlining; spill to a tmp file the
+        # moment the threshold is crossed so large PUTs never sit in RAM
+        head = bytearray()
+        writer = None
+        total = 0
+        try:
+            while True:
+                b = hr.read(1 << 20)
+                if not b:
+                    break
+                total += len(b)
+                if writer is None:
+                    head += b
+                    if len(head) > SMALL_FILE_THRESHOLD:
+                        writer = self.disk.create_file_writer(META_TMP,
+                                                              tmp_path)
+                        writer.write(bytes(head))
+                        head.clear()
+                else:
+                    writer.write(b)
+        except Exception:
+            if writer is not None:
+                writer.abort()
+            raise
+        if size >= 0 and total != size:
+            if writer is not None:
+                writer.abort()
             raise dt.IncompleteBody(bucket, object)
         user_defined = dict(opts.user_defined)
         etag = user_defined.pop("etag", "") or hr.etag()
         fi = FileInfo(
             volume=bucket, name=object,
             version_id=FileInfo.new_version_id() if opts.versioned else "",
-            data_dir=str(uuid.uuid4()), mod_time=FileInfo.now(),
-            size=len(data),
+            data_dir=data_dir, mod_time=FileInfo.now(), size=total,
             metadata={"etag": etag,
                       "content-type": user_defined.pop(
                           "content-type", "application/octet-stream"),
                       **user_defined},
-            parts=[ObjectPartInfo(number=1, etag=etag, size=len(data),
-                                  actual_size=len(data))])
-        if len(data) <= SMALL_FILE_THRESHOLD:
-            fi.data = data
+            parts=[ObjectPartInfo(number=1, etag=etag, size=total,
+                                  actual_size=total)])
+        if writer is None:
+            fi.data = bytes(head)
             self.disk.write_metadata(bucket, object, fi)
         else:
-            self.disk.write_all(bucket,
-                                f"{object}/{fi.data_dir}/part.1", data)
-            self.disk.write_metadata(bucket, object, fi)
+            writer.close()
+            self.disk.rename_data(META_TMP, tmp_path.split("/")[0], fi,
+                                  bucket, object)
         return ObjectInfo.from_file_info(fi, bucket, object, opts.versioned)
 
     def _fi(self, bucket, object, opts) -> FileInfo:
@@ -256,24 +276,30 @@ class FSObjects(ObjectLayer):
         upath = upload_path(bucket, object, upload_id)
         hr = stream if isinstance(stream, HashReader) else \
             HashReader(stream, size)
-        data = bytearray()
-        while True:
-            b = hr.read(1 << 20)
-            if not b:
-                break
-            data += b
-        if size >= 0 and len(data) != size:
+        w = self.disk.create_file_writer(META_MULTIPART,
+                                         f"{upath}/part.{part_id}")
+        total = 0
+        try:
+            while True:
+                b = hr.read(1 << 20)
+                if not b:
+                    break
+                total += len(b)
+                w.write(b)
+        except Exception:
+            w.abort()
+            raise
+        w.close()
+        if size >= 0 and total != size:
             raise dt.IncompleteBody(bucket, object)
         etag = hr.etag()
-        self.disk.write_all(META_MULTIPART, f"{upath}/part.{part_id}",
-                            bytes(data))
         self.disk.write_all(META_MULTIPART, f"{upath}/part.{part_id}.meta",
                             msgpack.packb({
-                                "etag": etag, "size": len(data),
-                                "actual_size": len(data),
+                                "etag": etag, "size": total,
+                                "actual_size": total,
                                 "mtime": FileInfo.now()}, use_bin_type=True))
-        return PartInfo(part_number=part_id, etag=etag, size=len(data),
-                        actual_size=len(data),
+        return PartInfo(part_number=part_id, etag=etag, size=total,
+                        actual_size=total,
                         last_modified=FileInfo.now())
 
     def _part_metas(self, upath: str):
@@ -345,6 +371,22 @@ class FSObjects(ObjectLayer):
         except errors.StorageError:
             pass
         return ObjectInfo.from_file_info(fi, bucket, object, opts.versioned)
+
+    # --- object tags --------------------------------------------------------
+
+    def put_object_tags(self, bucket, object, tags_enc, opts=None):
+        fi = self._fi(bucket, object, opts)
+        meta = dict(fi.metadata)
+        if tags_enc:
+            meta["x-minio-internal-tags"] = tags_enc
+        else:
+            meta.pop("x-minio-internal-tags", None)
+        fi.metadata = meta
+        self.disk.update_metadata(bucket, object, fi)
+
+    def get_object_tags(self, bucket, object, opts=None):
+        return self._fi(bucket, object, opts).metadata.get(
+            "x-minio-internal-tags", "")
 
     # --- heal (no-ops in FS mode, reference fs-v1 has none) -----------------
 

@@ -98,6 +98,32 @@ class ErasureObjects(MultipartMixin, ObjectLayer):
         #: MRF hook — called with (bucket, object, version_id) when an op
         #: detects a partial/degraded state (cmd/erasure-object.go:1132).
         self.on_partial = None
+        #: namespace lock map (dist.dsync.NSLockMap) — None in library use;
+        #: the Node wires the cluster lockers in distributed mode
+        self.ns_lock = None
+
+    def _locked(self, bucket: str, object: str, write: bool = True):
+        """Context manager taking the namespace lock if configured
+        (reference NSLock; PutObject locks AFTER the data upload —
+        cmd/erasure-object.go:722-727 — so callers scope this to the
+        commit, not the stream)."""
+        import contextlib
+
+        @contextlib.contextmanager
+        def cm():
+            if self.ns_lock is None:
+                yield
+                return
+            mtx = self.ns_lock.new_lock(bucket, object)
+            ok = mtx.get_lock(10.0) if write else mtx.get_rlock(10.0)
+            if not ok:
+                raise dt.InsufficientWriteQuorum(bucket, object) if write \
+                    else dt.InsufficientReadQuorum(bucket, object)
+            try:
+                yield
+            finally:
+                mtx.unlock()
+        return cm()
 
     # fresh list each call — ErasureSets swaps entries on reconnect
     @property
@@ -267,23 +293,34 @@ class ErasureObjects(MultipartMixin, ObjectLayer):
             data_blocks=data, parity_blocks=parity,
             block_size=self.block_size, distribution=distribution)
 
-        # commit: rename_data on every disk whose writer survived
+        # commit under the namespace lock (lock-after-data-upload):
+        # rename_data on every disk whose writer survived
         errs: list[BaseException | None] = [None] * n
-        futs = {}
-        for j, d in enumerate(shuffled):
-            if d is None or writers[j] is None:
-                errs[j] = errors.DiskNotFound()
-                continue
-            fij = replace(fi, erasure=replace(fi.erasure, index=j + 1),
-                          metadata=dict(fi.metadata))
-            futs[j] = meta_pool().submit(
-                d.rename_data, META_TMP, tmp_id, fij, bucket, object)
-        for j, f in futs.items():
-            try:
-                f.result()
-            except Exception as e:  # noqa: BLE001
-                errs[j] = e if isinstance(e, errors.StorageError) \
-                    else errors.FaultyDisk(str(e))
+        try:
+            lock_cm = self._locked(bucket, object)
+            lock_cm.__enter__()
+        except dt.ObjectAPIError:
+            # lock contention after the data upload: reclaim tmp shards
+            self._cleanup_tmp(tmp_id)
+            raise
+        try:
+            futs = {}
+            for j, d in enumerate(shuffled):
+                if d is None or writers[j] is None:
+                    errs[j] = errors.DiskNotFound()
+                    continue
+                fij = replace(fi, erasure=replace(fi.erasure, index=j + 1),
+                              metadata=dict(fi.metadata))
+                futs[j] = meta_pool().submit(
+                    d.rename_data, META_TMP, tmp_id, fij, bucket, object)
+            for j, f in futs.items():
+                try:
+                    f.result()
+                except Exception as e:  # noqa: BLE001
+                    errs[j] = e if isinstance(e, errors.StorageError) \
+                        else errors.FaultyDisk(str(e))
+        finally:
+            lock_cm.__exit__(None, None, None)
         err = errors.reduce_write_quorum_errs(
             errs, errors.BASE_IGNORED_ERRS, write_quorum)
         if err is not None:
@@ -473,21 +510,22 @@ class ErasureObjects(MultipartMixin, ObjectLayer):
                           mod_time=FileInfo.now())
 
         errs: list[BaseException | None] = [None] * len(disks)
-        futs = {}
-        for i, d in enumerate(disks):
-            if d is None:
-                errs[i] = errors.DiskNotFound()
-                continue
-            futs[i] = meta_pool().submit(
-                d.delete_version, bucket, object, fi)
-        for i, f in futs.items():
-            try:
-                f.result()
-            except errors.FileNotFound:
-                pass  # S3 delete is idempotent: missing object = success
-            except Exception as e:  # noqa: BLE001
-                errs[i] = e if isinstance(e, errors.StorageError) \
-                    else errors.FaultyDisk(str(e))
+        with self._locked(bucket, object):
+            futs = {}
+            for i, d in enumerate(disks):
+                if d is None:
+                    errs[i] = errors.DiskNotFound()
+                    continue
+                futs[i] = meta_pool().submit(
+                    d.delete_version, bucket, object, fi)
+            for i, f in futs.items():
+                try:
+                    f.result()
+                except errors.FileNotFound:
+                    pass  # S3 delete is idempotent: missing object = success
+                except Exception as e:  # noqa: BLE001
+                    errs[i] = e if isinstance(e, errors.StorageError) \
+                        else errors.FaultyDisk(str(e))
         if vid and sum(isinstance(e, errors.FileVersionNotFound)
                        for e in errs) > len(disks) - write_quorum:
             raise dt.VersionNotFound(bucket, object)
@@ -526,7 +564,9 @@ class ErasureObjects(MultipartMixin, ObjectLayer):
                 deleted.append(DeletedObject(object_name=name, version_id=vid))
                 errs.append(None)
             except Exception as e:  # noqa: BLE001
-                deleted.append(None)
+                # keep the key so DeleteResult <Error> can name it
+                deleted.append(DeletedObject(object_name=name,
+                                             version_id=vid))
                 errs.append(e)
         return deleted, errs
 
@@ -686,6 +726,45 @@ class ErasureObjects(MultipartMixin, ObjectLayer):
         data = self.get_object_bytes(src_bucket, src_object, src_opts)
         return self.put_object(dst_bucket, dst_object, io.BytesIO(data),
                                len(data), dst_opts)
+
+    # --- object tags --------------------------------------------------------
+
+    TAGS_KEY = "x-minio-internal-tags"
+
+    def put_object_tags(self, bucket: str, object: str, tags_enc: str,
+                        opts: ObjectOptions = None) -> None:
+        """Set (or clear, with "") the object's encoded tag set by updating
+        xl.meta in place on every disk (reference PutObjectTags)."""
+        opts = opts or ObjectOptions()
+        fi, fis, _ = self._read_quorum_fileinfo(bucket, object,
+                                                opts.version_id)
+        if fi.deleted:
+            raise dt.MethodNotAllowed(bucket, object)
+        meta = dict(fi.metadata)
+        if tags_enc:
+            meta[self.TAGS_KEY] = tags_enc
+        else:
+            meta.pop(self.TAGS_KEY, None)
+        fi.metadata = meta
+        with self._locked(bucket, object):
+            for d, dfi in zip(self.disks, fis):
+                if d is None or dfi is None:
+                    continue
+                fid = replace(fi, erasure=dfi.erasure,
+                              metadata=dict(meta))
+                try:
+                    d.update_metadata(bucket, object, fid)
+                except errors.StorageError:
+                    pass
+
+    def get_object_tags(self, bucket: str, object: str,
+                        opts: ObjectOptions = None) -> str:
+        opts = opts or ObjectOptions()
+        fi, _, _ = self._read_quorum_fileinfo(bucket, object,
+                                              opts.version_id)
+        if fi.deleted:
+            raise dt.MethodNotAllowed(bucket, object)
+        return fi.metadata.get(self.TAGS_KEY, "")
 
     # --- internal config blobs (quorum read/write under .minio.sys) --------
 

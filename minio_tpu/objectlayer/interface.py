@@ -112,6 +112,21 @@ class ObjectLayer(abc.ABC):
     def heal_format(self, dry_run: bool = False) -> HealResultItem:
         raise NotImplementedError
 
+    # --- object tags (reference ObjectLayer PutObjectTags/GetObjectTags/
+    # DeleteObjectTags, cmd/object-api-interface.go) ------------------------
+
+    def put_object_tags(self, bucket: str, object: str, tags_enc: str,
+                        opts: ObjectOptions = None) -> None:
+        raise NotImplementedError
+
+    def get_object_tags(self, bucket: str, object: str,
+                        opts: ObjectOptions = None) -> str:
+        raise NotImplementedError
+
+    def delete_object_tags(self, bucket: str, object: str,
+                           opts: ObjectOptions = None) -> None:
+        self.put_object_tags(bucket, object, "", opts)
+
     # --- internal config blobs (reference cmd/config-common.go: saveConfig/
     # readConfig persist framework state into .minio.sys via the backend) ---
 

@@ -127,7 +127,8 @@ class ServerPools(ObjectLayer):
                                              version_id=vid))
                 errs.append(None)
             except Exception as e:  # noqa: BLE001
-                deleted.append(None)
+                deleted.append(DeletedObject(object_name=name,
+                                             version_id=vid))
                 errs.append(e)
         return deleted, errs
 
@@ -204,6 +205,16 @@ class ServerPools(ObjectLayer):
                                   opts=None):
         return self._pool_with_upload(bucket, object, upload_id) \
             .complete_multipart_upload(bucket, object, upload_id, parts, opts)
+
+    # --- object tags --------------------------------------------------------
+
+    def put_object_tags(self, bucket, object, tags_enc, opts=None):
+        self._route(bucket, object, opts).put_object_tags(
+            bucket, object, tags_enc, opts)
+
+    def get_object_tags(self, bucket, object, opts=None):
+        return self._route(bucket, object, opts).get_object_tags(
+            bucket, object, opts)
 
     # --- internal config blobs (pool 0 owns framework state) ---------------
 

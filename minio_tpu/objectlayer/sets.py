@@ -180,6 +180,16 @@ class ErasureSets(ObjectLayer):
         return self.get_hashed_set(object).complete_multipart_upload(
             bucket, object, upload_id, parts, opts)
 
+    # --- object tags --------------------------------------------------------
+
+    def put_object_tags(self, bucket, object, tags_enc, opts=None):
+        self.get_hashed_set(object).put_object_tags(bucket, object,
+                                                    tags_enc, opts)
+
+    def get_object_tags(self, bucket, object, opts=None):
+        return self.get_hashed_set(object).get_object_tags(bucket, object,
+                                                           opts)
+
     # --- internal config blobs (routed like objects, by path hash) ---------
 
     def put_config(self, path: str, data: bytes) -> None:

@@ -55,6 +55,9 @@ class S3Server:
         self.port = port
         self._sem = threading.BoundedSemaphore(max_requests)
         self._httpd: ThreadingHTTPServer | None = None
+        #: internal RPC services mounted under /minio/<name>/v1/<method>
+        #: (storage/lock/peer — populated by dist.node.Node)
+        self.internal: dict[str, object] = {}
 
     # --- server lifecycle ---------------------------------------------------
 
@@ -178,9 +181,18 @@ class _S3Handler(BaseHTTPRequestHandler):
         self._parse()
         # unauthenticated health endpoints (cmd/healthcheck-handler.go)
         if self.url_path.startswith("/minio/health/"):
-            ok = self.s3.obj.is_ready()
+            ok = self.s3.obj is not None and self.s3.obj.is_ready()
             return self._send(200 if ok else 503, b"",
                               "text/plain; charset=utf-8")
+        # internal RPC services (storage/lock/peer — reference
+        # registerDistErasureRouters, cmd/routers.go:26-39)
+        if self.url_path.startswith("/minio/") and self.s3.internal:
+            parts = self.url_path.split("/", 4)
+            if len(parts) >= 5 and parts[2] in self.s3.internal:
+                return self._internal_rpc(parts[2], parts[4])
+        if self.s3.obj is None:
+            return self._error("ServerNotInitialized",
+                               "server still starting", 503)
         if self.url_path.startswith("/minio/metrics") or \
                 self.url_path.startswith("/minio/v2/metrics"):
             from ..obs.metrics import render_prometheus
@@ -207,6 +219,22 @@ class _S3Handler(BaseHTTPRequestHandler):
             import traceback
             traceback.print_exc()
             self._error("InternalError", str(e), 500)
+
+    def _internal_rpc(self, service: str, method: str):
+        """Dispatch an internal RPC call (bearer-token auth, typed errors
+        over headers — SURVEY.md A.7 wire shape)."""
+        from ..dist.rpc import check_token, rpc_error_response
+        auth = self.hdr.get("authorization", "")
+        token = auth[len("Bearer "):] if auth.startswith("Bearer ") else ""
+        if not check_token(self.s3.secret_key, token):
+            return self._send(401, b"invalid rpc token", "text/plain")
+        params = {k: v[0] for k, v in self.query.items()}
+        body = self._read_body()
+        try:
+            out = self.s3.internal[service].handle(method, params, body)
+        except Exception as e:  # noqa: BLE001
+            return rpc_error_response(self, e)
+        self._send(200, out, "application/octet-stream")
 
     def _dispatch(self, access_key: str):
         m = self.command
@@ -378,17 +406,21 @@ class _S3Handler(BaseHTTPRequestHandler):
     def put_versioning(self, ak):
         self._authorize(ak, "s3:PutBucketVersioning")
         self.s3.obj.get_bucket_info(self.bucket)
-        enabled = xu.parse_versioning(self._read_body())
-        self.s3.bucket_meta.update(self.bucket,
-                                   versioning_enabled=enabled,
-                                   versioning_suspended=not enabled)
+        body = self._read_body()
+        enabled = xu.parse_versioning(body)
+        was = self.s3.bucket_meta.get(self.bucket)
+        self.s3.bucket_meta.update(
+            self.bucket, versioning_enabled=enabled,
+            versioning_suspended=not enabled and
+            (was.versioning_enabled or was.versioning_suspended))
         self._send(200)
 
     def get_versioning(self, ak):
         self._authorize(ak, "s3:GetBucketVersioning")
         self.s3.obj.get_bucket_info(self.bucket)
         meta = self.s3.bucket_meta.get(self.bucket)
-        self._send(200, xu.versioning_xml(meta.versioning_enabled))
+        self._send(200, xu.versioning_xml(meta.versioning_enabled,
+                                          meta.versioning_suspended))
 
     def put_bucket_tagging(self, ak):
         self._authorize(ak, "s3:PutBucketTagging")
@@ -470,11 +502,14 @@ class _S3Handler(BaseHTTPRequestHandler):
         versioned = self.s3.bucket_meta.versioning_enabled(self.bucket)
         deleted, errs = self.s3.obj.delete_objects(
             self.bucket, objs, ObjectOptions(versioned=versioned))
+        ok_deleted = [d for d, e in zip(deleted, errs) if e is None]
         if quiet:
-            deleted = [d for d, e in zip(deleted, errs) if e is not None]
-            errs = [e for e in errs if e is not None]
+            # quiet mode reports only failures
+            pairs = [(d, e) for d, e in zip(deleted, errs) if e is not None]
+            deleted = [d for d, _ in pairs]
+            errs = [e for _, e in pairs]
         self._send(200, xu.delete_result_xml(deleted, errs))
-        self._notify_each("s3:ObjectRemoved:Delete", deleted)
+        self._notify_each("s3:ObjectRemoved:Delete", ok_deleted)
 
     def _notify_each(self, event, deleted):
         if self.s3.notify is None:
@@ -499,6 +534,10 @@ class _S3Handler(BaseHTTPRequestHandler):
         if self.hdr.get("x-amz-content-sha256", "") == STREAMING_PAYLOAD:
             size = int(self.hdr.get("x-amz-decoded-content-length",
                                     str(size)))
+        if size < 0:
+            # unbounded socket reads hang keep-alive connections
+            return self._error("MissingContentLength",
+                               "Content-Length required", 411)
         if size > MAX_PUT_SIZE:
             raise dt.EntityTooLarge(self.bucket, self.key)
         user_defined = self._user_meta()
@@ -657,34 +696,20 @@ class _S3Handler(BaseHTTPRequestHandler):
     def put_object_tagging(self, ak):
         self._authorize(ak, "s3:PutObjectTagging")
         tags = xu.parse_tagging(self._read_body())
-        enc = urllib.parse.urlencode(tags)
-        opts = self._opts()
-        oi = self.s3.obj.get_object_info(self.bucket, self.key, opts)
-        ud = dict(oi.user_defined)
-        ud["x-amz-meta-internal-tags"] = enc
-        src_opts = ObjectOptions(version_id=opts.version_id)
-        dst = ObjectOptions(version_id=opts.version_id, user_defined=ud)
-        self.s3.obj.copy_object(self.bucket, self.key, self.bucket, self.key,
-                                None, src_opts, dst)
+        self.s3.obj.put_object_tags(self.bucket, self.key,
+                                    urllib.parse.urlencode(tags),
+                                    self._opts())
         self._send(200)
 
     def get_object_tagging(self, ak):
         self._authorize(ak, "s3:GetObjectTagging")
-        oi = self.s3.obj.get_object_info(self.bucket, self.key, self._opts())
-        enc = oi.user_defined.get("x-amz-meta-internal-tags", "")
-        tags = dict(urllib.parse.parse_qsl(enc))
-        self._send(200, xu.tagging_xml(tags))
+        enc = self.s3.obj.get_object_tags(self.bucket, self.key,
+                                          self._opts())
+        self._send(200, xu.tagging_xml(dict(urllib.parse.parse_qsl(enc))))
 
     def delete_object_tagging(self, ak):
         self._authorize(ak, "s3:PutObjectTagging")
-        opts = self._opts()
-        oi = self.s3.obj.get_object_info(self.bucket, self.key, opts)
-        ud = {k: v for k, v in oi.user_defined.items()
-              if k != "x-amz-meta-internal-tags"}
-        self.s3.obj.copy_object(self.bucket, self.key, self.bucket, self.key,
-                                None, ObjectOptions(version_id=opts.version_id),
-                                ObjectOptions(version_id=opts.version_id,
-                                              user_defined=ud))
+        self.s3.obj.delete_object_tags(self.bucket, self.key, self._opts())
         self._send(204)
 
     # --- multipart ----------------------------------------------------------
@@ -704,6 +729,9 @@ class _S3Handler(BaseHTTPRequestHandler):
         if self.hdr.get("x-amz-content-sha256", "") == STREAMING_PAYLOAD:
             size = int(self.hdr.get("x-amz-decoded-content-length",
                                     str(size)))
+        if size < 0:
+            return self._error("MissingContentLength",
+                               "Content-Length required", 411)
         hr = HashReader(self._body_stream(size), size)
         pi = self.s3.obj.put_object_part(self.bucket, self.key, uid,
                                          part_id, hr, size)

@@ -201,7 +201,10 @@ def delete_result_xml(deleted, errs) -> bytes:
         if e is not None or d is None:
             x.open("Error")
             x.el("Key", getattr(d, "object_name", ""))
-            x.el("Code", "InternalError")
+            vid = getattr(d, "version_id", "")
+            if vid:
+                x.el("VersionId", vid)
+            x.el("Code", getattr(e, "code", "InternalError"))
             x.el("Message", str(e))
             x.close()
         else:
@@ -216,10 +219,12 @@ def delete_result_xml(deleted, errs) -> bytes:
     return x.done()
 
 
-def versioning_xml(enabled: bool) -> bytes:
+def versioning_xml(enabled: bool, suspended: bool = False) -> bytes:
     x = X("VersioningConfiguration", S3_NS)
     if enabled:
         x.el("Status", "Enabled")
+    elif suspended:
+        x.el("Status", "Suspended")
     return x.done()
 
 

@@ -1,0 +1,307 @@
+"""Distributed-plane tests: dsync quorum locks, storage REST round trips,
+multi-node clusters on localhost ports (the in-process analogue of
+buildscripts/verify-build.sh dist-erasure + verify-healing.sh)."""
+import io
+import os
+import shutil
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from minio_tpu.dist.dsync import DRWMutex, LocalLocker, NSLockMap
+from minio_tpu.dist.ellipses import expand
+from minio_tpu.dist.format import (find_disk_slot, init_format_erasure,
+                                   load_format)
+from minio_tpu.dist.node import Node
+from minio_tpu.dist.topology import pick_set_layout
+from minio_tpu.storage import XLStorage
+from minio_tpu.utils import errors
+from s3client import S3Client
+
+AK, SK = "minioadmin", "minioadmin"
+
+
+def free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    p = s.getsockname()[1]
+    s.close()
+    return p
+
+
+def rng_bytes(n, seed=0):
+    return np.random.default_rng(seed).integers(
+        0, 256, size=n, dtype=np.uint8).tobytes()
+
+
+# --- ellipses / topology -----------------------------------------------------
+
+
+def test_ellipses_expansion():
+    assert expand("/data/disk{1...4}") == [
+        "/data/disk1", "/data/disk2", "/data/disk3", "/data/disk4"]
+    assert expand("http://h{1...2}/d{1...2}") == [
+        "http://h1/d1", "http://h1/d2", "http://h2/d1", "http://h2/d2"]
+    assert expand("/plain") == ["/plain"]
+    assert expand("/d{01...03}") == ["/d01", "/d02", "/d03"]
+    with pytest.raises(ValueError):
+        expand("/d{5...2}")
+
+
+def test_set_layout():
+    assert pick_set_layout(6) == (1, 6)
+    assert pick_set_layout(16) == (1, 16)
+    assert pick_set_layout(32) == (2, 16)
+    assert pick_set_layout(20) == (2, 10)
+    with pytest.raises(ValueError):
+        pick_set_layout(17)
+
+
+# --- dsync -------------------------------------------------------------------
+
+
+def test_local_locker_rw_semantics():
+    lk = LocalLocker()
+    assert lk.lock("res", "u1", "o1")
+    assert not lk.lock("res", "u2", "o2")      # exclusive
+    assert not lk.rlock("res", "u3", "o3")     # blocked by writer
+    assert lk.unlock("res", "u1")
+    assert lk.rlock("res", "u4", "o4")
+    assert lk.rlock("res", "u5", "o5")         # shared readers
+    assert not lk.lock("res", "u6", "o6")      # blocked by readers
+    assert lk.runlock("res", "u4")
+    assert lk.runlock("res", "u5")
+    assert lk.lock("res", "u7", "o7")
+
+
+def test_drwmutex_quorum():
+    lockers = [LocalLocker() for _ in range(5)]
+    m1 = DRWMutex(lockers, "bucket/obj", owner="n1")
+    assert m1.get_lock(timeout=1.0)
+    # second writer cannot reach quorum while m1 holds 5/5
+    m2 = DRWMutex(lockers, "bucket/obj", owner="n2")
+    assert not m2.get_lock(timeout=0.3)
+    m1.unlock()
+    assert m2.get_lock(timeout=1.0)
+    m2.unlock()
+    # readers share
+    r1 = DRWMutex(lockers, "bucket/obj", owner="n3")
+    r2 = DRWMutex(lockers, "bucket/obj", owner="n4")
+    assert r1.get_rlock(timeout=1.0)
+    assert r2.get_rlock(timeout=1.0)
+    w = DRWMutex(lockers, "bucket/obj", owner="n5")
+    assert not w.get_lock(timeout=0.3)
+    r1.unlock()
+    r2.unlock()
+
+
+def test_drwmutex_quorum_with_dead_lockers():
+    class Dead:
+        def lock(self, *a):
+            raise ConnectionError
+
+        rlock = unlock = runlock = lock
+
+    lockers = [LocalLocker(), LocalLocker(), LocalLocker(), Dead(), Dead()]
+    m = DRWMutex(lockers, "r", owner="n1")
+    assert m.get_lock(timeout=1.0)  # 3/5 grants = quorum
+    m.unlock()
+    lockers = [LocalLocker(), LocalLocker(), Dead(), Dead(), Dead()]
+    m = DRWMutex(lockers, "r", owner="n1")
+    assert not m.get_lock(timeout=0.3)  # 2/5 < quorum
+
+
+# --- format ------------------------------------------------------------------
+
+
+def test_format_lifecycle(tmp_path):
+    disks = [XLStorage(str(tmp_path / f"d{i}")) for i in range(8)]
+    fmt = init_format_erasure(disks, 2, 4)
+    assert len(fmt["xl"]["sets"]) == 2
+    # idempotent reload keeps ids
+    fmt2 = init_format_erasure(disks, 2, 4)
+    assert fmt2["id"] == fmt["id"]
+    assert disks[5].get_disk_id() == fmt["xl"]["sets"][1][1]
+    assert find_disk_slot(fmt, disks[5].get_disk_id()) == (1, 1)
+    # foreign disk rejected
+    alien = XLStorage(str(tmp_path / "alien"))
+    init_format_erasure([alien], 1, 1)
+    with pytest.raises(errors.CorruptedFormat):
+        init_format_erasure([disks[0], alien], 1, 2)
+
+
+# --- storage REST ------------------------------------------------------------
+
+
+@pytest.fixture
+def rpc_node(tmp_path):
+    """Single node serving 4 local disks over RPC + S3."""
+    port = free_port()
+    dirs = [str(tmp_path / f"nd{i}") for i in range(4)]
+    node = Node(dirs, local_url=f"http://127.0.0.1:{port}",
+                address="127.0.0.1", port=port, access_key=AK,
+                secret_key=SK, default_parity=2)
+    node.start()
+    yield node
+    node.shutdown()
+
+
+def test_storage_rest_roundtrip(rpc_node, tmp_path):
+    """Drive a REMOTE disk client against the node's storage service."""
+    from minio_tpu.dist.storage_rest import StorageRESTClient
+    from minio_tpu.storage.datatypes import FileInfo
+    url = f"http://127.0.0.1:{rpc_node.server.port}"
+    disk_path = list(rpc_node.local_disks)[0]
+    rc = StorageRESTClient(url, disk_path, SK)
+    assert not rc.is_local()
+    rc.make_vol("rpcbucket")
+    assert rc.stat_vol("rpcbucket").name == "rpcbucket"
+    rc.write_all("rpcbucket", "f/x", b"remote-bytes")
+    assert rc.read_all("rpcbucket", "f/x") == b"remote-bytes"
+    rc.append_file("rpcbucket", "f/x", b"++")
+    assert rc.stat_file_size("rpcbucket", "f/x") == 14
+    r = rc.read_file_at("rpcbucket", "f/x")
+    assert r.read_at(6, 6) == b"-bytes"
+    # streaming writer
+    w = rc.create_file_writer("rpcbucket", "stream/s1")
+    w.write(b"block1")
+    w.write(b"block2")
+    w.close()
+    assert rc.read_all("rpcbucket", "stream/s1") == b"block1block2"
+    # version ops over the wire
+    import uuid
+    fi = FileInfo(volume="rpcbucket", name="obj", version_id="",
+                  data_dir=str(uuid.uuid4()), mod_time=time.time(), size=3,
+                  metadata={"etag": "abc"})
+    fi.data = b"xyz"
+    rc.write_metadata("rpcbucket", "obj", fi)
+    got = rc.read_version("rpcbucket", "obj", read_data=True)
+    assert got.data == b"xyz"
+    assert got.metadata["etag"] == "abc"
+    assert [f.version_id for f in rc.list_versions("rpcbucket", "obj")] \
+        == [""]
+    assert list(rc.walk_dir("rpcbucket")) == ["obj"]
+    rc.delete_version("rpcbucket", "obj", fi)
+    with pytest.raises(errors.FileNotFound):
+        rc.read_version("rpcbucket", "obj")
+    # typed errors over the wire
+    with pytest.raises(errors.VolumeNotFound):
+        rc.stat_vol("missing-vol")
+    # invalid token rejected
+    bad = StorageRESTClient(url, disk_path, "wrong-secret")
+    with pytest.raises(errors.StorageError):
+        bad.stat_vol("rpcbucket")
+    rc.close()
+
+
+def test_single_node_rpc_cluster_s3(rpc_node):
+    """S3 traffic against the node built through the Node assembly."""
+    c = S3Client(f"http://127.0.0.1:{rpc_node.server.port}", AK, SK)
+    assert c.put_bucket("nb").status_code == 200
+    data = rng_bytes(256 << 10, seed=1)
+    assert c.put_object("nb", "o", data).status_code == 200
+    assert c.get_object("nb", "o").content == data
+
+
+# --- multi-node cluster ------------------------------------------------------
+
+
+@pytest.fixture
+def cluster(tmp_path):
+    """2 nodes x 3 disks each = one 6-drive erasure set across 'hosts'
+    (both in this process on different ports)."""
+    ports = [free_port(), free_port()]
+    urls = [f"http://127.0.0.1:{p}" for p in ports]
+    args = []
+    for ni in range(2):
+        for di in range(3):
+            d = tmp_path / f"n{ni}" / f"d{di}"
+            d.parent.mkdir(exist_ok=True)
+            args.append(f"{urls[ni]}{d}")
+    nodes = []
+    for ni in range(2):
+        node = Node(args, local_url=urls[ni], address="127.0.0.1",
+                    port=ports[ni], access_key=AK, secret_key=SK,
+                    default_parity=2)
+        nodes.append(node)
+    threads = [threading.Thread(target=n.start) for n in nodes]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=90)
+    for n in nodes:
+        assert n.obj is not None, "node failed to start"
+    yield nodes
+    for n in nodes:
+        n.shutdown()
+
+
+def test_two_node_cluster_put_get(cluster):
+    n0, n1 = cluster
+    c0 = S3Client(f"http://127.0.0.1:{n0.server.port}", AK, SK)
+    c1 = S3Client(f"http://127.0.0.1:{n1.server.port}", AK, SK)
+    assert c0.put_bucket("shared").status_code == 200
+    data = rng_bytes(768 << 10, seed=2)
+    # write through node 0, read through node 1 (shards span both nodes)
+    assert c0.put_object("shared", "cross/obj", data).status_code == 200
+    r = c1.get_object("shared", "cross/obj")
+    assert r.status_code == 200 and r.content == data
+    # every node's local disks hold some shards
+    for n in cluster:
+        held = 0
+        for d in n.local_disks.values():
+            try:
+                d.read_version("shared", "cross/obj")
+                held += 1
+            except errors.StorageError:
+                pass
+        assert held == 3, "shards must spread across both nodes"
+    # delete via node 1, gone on node 0
+    assert c1.delete_object("shared", "cross/obj").status_code == 204
+    assert c0.get_object("shared", "cross/obj").status_code == 404
+
+
+def test_two_node_heal_after_disk_wipe(cluster):
+    """verify-healing.sh analogue: wipe a remote node's disk, heal from
+    the surviving shards, verify the wiped disk is repopulated."""
+    n0, n1 = cluster
+    c0 = S3Client(f"http://127.0.0.1:{n0.server.port}", AK, SK)
+    c0.put_bucket("healb")
+    data = rng_bytes(512 << 10, seed=3)
+    c0.put_object("healb", "obj", data)
+    # wipe one of node1's disks
+    wiped = list(n1.local_disks.values())[0]
+    shutil.rmtree(os.path.join(wiped.base, "healb"))
+    # heal through node 0 (reaches the wiped disk via storage RPC)
+    n0.obj.heal_bucket("healb")
+    res = n0.obj.heal_object("healb", "obj")
+    assert "missing" in res.before_state
+    assert res.after_state.count("ok") == 6
+    wiped.read_version("healb", "obj")  # repopulated
+    assert c0.get_object("healb", "obj").content == data
+
+
+def test_cluster_locks_are_shared(cluster):
+    n0, n1 = cluster
+    m0 = n0.ns_lock.new_lock("b", "o")
+    assert m0.get_lock(timeout=2)
+    m1 = n1.ns_lock.new_lock("b", "o")
+    assert not m1.get_lock(timeout=0.5), \
+        "node1 must see node0's lock via lock RPC"
+    m0.unlock()
+    assert m1.get_lock(timeout=2)
+    m1.unlock()
+
+
+def test_bucket_metadata_propagation(cluster):
+    n0, n1 = cluster
+    c0 = S3Client(f"http://127.0.0.1:{n0.server.port}", AK, SK)
+    c0.put_bucket("metab")
+    body = (b'<VersioningConfiguration><Status>Enabled</Status>'
+            b'</VersioningConfiguration>')
+    c0.request("PUT", "/metab", query={"versioning": ""}, body=body)
+    # node1's cache was invalidated via peer RPC; it reads the new config
+    assert n1.bucket_meta.versioning_enabled("metab")
