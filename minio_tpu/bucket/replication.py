@@ -1,0 +1,152 @@
+"""Bucket replication (reference cmd/bucket-replication.go:562-991): async
+per-object replication to a remote S3-compatible target via a bounded
+worker pool. Targets are registered per bucket (cmd/bucket-targets.go);
+replication triggers on object-created/removed events."""
+from __future__ import annotations
+
+import hashlib
+import queue
+import threading
+import urllib.parse
+
+import requests
+
+from ..server.auth import SigV4Verifier, UNSIGNED_PAYLOAD
+
+
+class S3Target:
+    """Minimal signing S3 client for a replication target (the framework's
+    outbound S3 client, like the reference's internal miniogo client)."""
+
+    def __init__(self, endpoint: str, access_key: str, secret_key: str,
+                 target_bucket: str, region: str = "us-east-1"):
+        self.endpoint = endpoint.rstrip("/")
+        self.bucket = target_bucket
+        self.ak, self.sk = access_key, secret_key
+        self.signer = SigV4Verifier(lambda a: None, region)
+        self.http = requests.Session()
+
+    def _req(self, method: str, key: str, body: bytes = b"",
+             headers: dict | None = None, query: dict | None = None):
+        path = f"/{self.bucket}/{key}" if key else f"/{self.bucket}"
+        q = {k: [v] for k, v in (query or {}).items()}
+        host = self.endpoint.split("//", 1)[1]
+        h = {"host": host}
+        for k, v in (headers or {}).items():
+            h[k.lower()] = v
+        auth = self.signer.sign_request(self.ak, self.sk, method, path, q,
+                                        h, UNSIGNED_PAYLOAD)
+        h["authorization"] = auth
+        qs = urllib.parse.urlencode([(k, v[0]) for k, v in q.items()])
+        url = f"{self.endpoint}{urllib.parse.quote(path)}" + \
+            (f"?{qs}" if qs else "")
+        return self.http.request(method, url, data=body, headers=h,
+                                 timeout=30)
+
+    def put(self, key: str, body: bytes, headers: dict | None = None):
+        return self._req("PUT", key, body, headers)
+
+    def delete(self, key: str):
+        return self._req("DELETE", key)
+
+    def ensure_bucket(self):
+        self._req("PUT", "")
+
+
+class ReplicationPool:
+    """Bounded async workers (reference replication workers,
+    cmd/bucket-replication.go:977): jobs are (bucket, key, op)."""
+
+    def __init__(self, objlayer, workers: int = 4, max_queue: int = 100_000):
+        self.obj = objlayer
+        #: bucket -> S3Target
+        self.targets: dict[str, S3Target] = {}
+        self.q: queue.Queue = queue.Queue(maxsize=max_queue)
+        self._stop = threading.Event()
+        self._threads = [
+            threading.Thread(target=self._worker, daemon=True,
+                             name=f"replication-{i}")
+            for i in range(workers)]
+        self.replicated = 0
+        self.failed = 0
+
+    def set_target(self, bucket: str, target: S3Target):
+        target.ensure_bucket()
+        self.targets[bucket] = target
+
+    def start(self):
+        for t in self._threads:
+            t.start()
+        return self
+
+    def on_event(self, event: str, bucket: str, oi):
+        """Wire as (or into) S3Server.notify."""
+        if bucket not in self.targets:
+            return
+        if event.startswith("s3:ObjectCreated"):
+            self.schedule(bucket, oi.name, "put")
+        elif event.startswith("s3:ObjectRemoved"):
+            self.schedule(bucket, oi.name, "delete")
+
+    def schedule(self, bucket: str, key: str, op: str):
+        try:
+            self.q.put_nowait((bucket, key, op))
+        except queue.Full:
+            self.failed += 1
+
+    def _worker(self):
+        while not self._stop.is_set():
+            try:
+                bucket, key, op = self.q.get(timeout=0.5)
+            except queue.Empty:
+                continue
+            try:
+                self._replicate(bucket, key, op)
+                self.replicated += 1
+            except Exception:  # noqa: BLE001
+                self.failed += 1
+
+    #: objects above this spill to a temp file instead of RAM
+    SPOOL_THRESHOLD = 8 << 20
+
+    def _replicate(self, bucket: str, key: str, op: str):
+        import tempfile
+        tgt = self.targets.get(bucket)
+        if tgt is None:
+            return
+        if op == "delete":
+            r = tgt.delete(key)
+            if r.status_code not in (200, 204, 404):
+                raise RuntimeError(f"replication delete: {r.status_code}")
+            return
+        oi = self.obj.get_object_info(bucket, key)
+        headers = {"content-type": oi.content_type or
+                   "application/octet-stream",
+                   "x-amz-meta-replicated-from": bucket}
+        for k, v in oi.user_defined.items():
+            if k.startswith("x-amz-meta-"):
+                headers[k] = v
+        if oi.size <= self.SPOOL_THRESHOLD:
+            from ..erasure.streaming import BufferSink
+            sink = BufferSink()
+            self.obj.get_object(bucket, key, sink)
+            r = tgt.put(key, sink.getvalue(), headers)
+        else:
+            # spool to disk so multi-GB objects never sit in RAM; requests
+            # streams a file body with a correct Content-Length
+            with tempfile.TemporaryFile() as spool:
+                self.obj.get_object(bucket, key, spool)
+                spool.seek(0)
+                r = tgt.put(key, spool, headers)
+        if r.status_code != 200:
+            raise RuntimeError(f"replication target: {r.status_code}")
+
+    def drain(self, timeout: float = 30.0):
+        import time
+        deadline = time.monotonic() + timeout
+        while not self.q.empty() and time.monotonic() < deadline:
+            time.sleep(0.05)
+        time.sleep(0.2)  # let in-flight workers finish
+
+    def stop(self):
+        self._stop.set()

@@ -1,0 +1,134 @@
+"""Auto-heal: fresh-disk detection + global heal (reference
+cmd/background-newdisks-heal-ops.go:44-113 + cmd/global-heal.go:123).
+
+A persisted per-disk healing tracker (``.minio.sys/healing.bin``) marks a
+disk as under-heal so healing resumes across restarts; the global healer
+walks every bucket and heals objects CONCURRENTLY — on TPU the concurrent
+heal_object calls' shard rebuilds coalesce in the dispatch queue into
+batched device launches (BASELINE config 5: 128 concurrent objects)."""
+from __future__ import annotations
+
+import json
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+
+from ..storage.xlstorage import META_BUCKET
+from ..utils import errors
+
+HEALING_TRACKER = "healing.bin"
+
+
+def set_healing_tracker(disk, info: dict | None = None):
+    disk.write_all(META_BUCKET, HEALING_TRACKER, json.dumps({
+        "started": time.time(), **(info or {})}).encode())
+
+
+def get_healing_tracker(disk) -> dict | None:
+    try:
+        return json.loads(disk.read_all(META_BUCKET, HEALING_TRACKER))
+    except (errors.StorageError, ValueError):
+        return None
+
+
+def clear_healing_tracker(disk):
+    try:
+        disk.delete_path(META_BUCKET, HEALING_TRACKER)
+    except errors.StorageError:
+        pass
+
+
+class GlobalHealer:
+    """healErasureSet analogue: heal every bucket + object, with bounded
+    concurrency so rebuild work batches on device."""
+
+    def __init__(self, objlayer, concurrency: int = 128):
+        self.obj = objlayer
+        self.concurrency = concurrency
+        self.objects_healed = 0
+        self.objects_failed = 0
+
+    def heal_all(self, scan_mode: str = "normal") -> dict:
+        results = {"buckets": 0, "objects_healed": 0, "objects_failed": 0}
+        pool = ThreadPoolExecutor(max_workers=self.concurrency,
+                                  thread_name_prefix="global-heal")
+        futs = []
+        try:
+            for b in self.obj.list_buckets():
+                self.obj.heal_bucket(b.name)
+                results["buckets"] += 1
+                marker = ""
+                while True:
+                    r = self.obj.list_objects(b.name, marker=marker,
+                                              max_keys=1000)
+                    for oi in r.objects:
+                        futs.append(pool.submit(
+                            self._heal_one, b.name, oi.name, scan_mode))
+                    if not r.is_truncated or not r.next_marker:
+                        break
+                    marker = r.next_marker
+            for f in futs:
+                ok = f.result()
+                if ok:
+                    results["objects_healed"] += 1
+                else:
+                    results["objects_failed"] += 1
+        finally:
+            pool.shutdown(wait=True)
+        self.objects_healed += results["objects_healed"]
+        self.objects_failed += results["objects_failed"]
+        return results
+
+    def _heal_one(self, bucket: str, name: str, scan_mode: str) -> bool:
+        try:
+            self.obj.heal_object(bucket, name, scan_mode=scan_mode)
+            return True
+        except Exception:  # noqa: BLE001
+            return False
+
+
+class AutoHealMonitor:
+    """monitorLocalDisksAndHeal analogue: watches for disks carrying a
+    healing tracker (set when a fresh/replaced disk is formatted) or disks
+    that flipped offline→online, and runs a global heal pass."""
+
+    def __init__(self, objlayer, local_disks: list, interval_s: float = 10.0):
+        self.obj = objlayer
+        self.local_disks = local_disks
+        self.interval = interval_s
+        self.healer = GlobalHealer(objlayer)
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self.heal_passes = 0
+
+    def start(self):
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="auto-heal")
+        self._thread.start()
+        return self
+
+    def _loop(self):
+        while not self._stop.wait(self.interval):
+            try:
+                self.check_and_heal()
+            except Exception:  # noqa: BLE001
+                pass
+
+    def check_and_heal(self) -> bool:
+        pending = [d for d in self.local_disks
+                   if get_healing_tracker(d) is not None]
+        if not pending:
+            return False
+        res = self.healer.heal_all()
+        self.heal_passes += 1
+        if res["objects_failed"] == 0:
+            # only a clean pass clears the trackers — a partial pass must
+            # resume on the next cycle (the tracker's whole purpose)
+            for d in pending:
+                clear_healing_tracker(d)
+        return True
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)

@@ -1,0 +1,64 @@
+"""MRF — "most recently failed" heal queue (reference cmd/erasure.go:74
+mrfOpCh + addPartial, cmd/erasure-object.go:1132): operations that detect a
+partial/degraded write or read enqueue the object here; a background worker
+heals them. Queue is bounded and drop-oldest (heal is best-effort; the
+scanner sweeps anything missed)."""
+from __future__ import annotations
+
+import queue
+import threading
+
+
+class MRFHealer:
+    def __init__(self, objlayer, max_queue: int = 10_000):
+        self.obj = objlayer
+        self.q: queue.Queue = queue.Queue(maxsize=max_queue)
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self.healed = 0
+        self.failed = 0
+
+    def add_partial(self, bucket: str, object: str, version_id: str = "",
+                    scan_mode: str = "normal"):
+        """scan_mode='deep' when the enqueuer saw bitrot (a normal heal's
+        size-only check would classify the disk as healthy)."""
+        try:
+            self.q.put_nowait((bucket, object, version_id, scan_mode))
+        except queue.Full:
+            try:  # drop-oldest; racing producers may refill the slot
+                self.q.get_nowait()
+                self.q.put_nowait((bucket, object, version_id, scan_mode))
+            except (queue.Empty, queue.Full):
+                pass
+
+    def start(self):
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="mrf-healer")
+        self._thread.start()
+        return self
+
+    def _loop(self):
+        while not self._stop.is_set():
+            try:
+                bucket, object, version_id, scan_mode = self.q.get(
+                    timeout=0.5)
+            except queue.Empty:
+                continue
+            try:
+                self.obj.heal_object(bucket, object, version_id,
+                                     scan_mode=scan_mode)
+                self.healed += 1
+            except Exception:  # noqa: BLE001
+                self.failed += 1
+
+    def drain(self, timeout: float = 30.0):
+        """Block until the queue is empty (tests / shutdown)."""
+        import time
+        deadline = time.monotonic() + timeout
+        while not self.q.empty() and time.monotonic() < deadline:
+            time.sleep(0.05)
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)

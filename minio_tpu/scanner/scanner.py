@@ -1,0 +1,103 @@
+"""Data scanner (reference cmd/data-scanner.go:65): periodic namespace
+crawl with per-object throttling; refreshes data-usage accounting, applies
+lifecycle rules, probabilistically verifies object health (every
+``deep_every``-th cycle runs a deep bitrot scan — dataScannerCompactLeastObject
+/ healDeepScanCycleMultiplier analogue) and queues degraded objects for
+heal."""
+from __future__ import annotations
+
+import threading
+import time
+
+from . import usage as usage_mod
+
+DEEP_SCAN_EVERY = 16  # healDeepScanCycleMultiplier (cmd/data-scanner.go:48)
+
+
+class DataScanner:
+    def __init__(self, objlayer, interval_s: float = 60.0,
+                 mrf=None, lifecycle=None, sleep_per_object: float = 0.001):
+        self.obj = objlayer
+        self.interval = interval_s
+        self.mrf = mrf
+        self.lifecycle = lifecycle
+        self.sleep_per_object = sleep_per_object
+        self.cycle = 0
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self.last_usage: dict = {}
+
+    def start(self):
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="data-scanner")
+        self._thread.start()
+        return self
+
+    def _loop(self):
+        while not self._stop.wait(self.interval):
+            try:
+                self.scan_cycle()
+            except Exception:  # noqa: BLE001 — scanner must never die
+                pass
+
+    def scan_cycle(self) -> dict:
+        """One full crawl; returns the usage snapshot (also persisted)."""
+        self.cycle += 1
+        deep = (self.cycle % DEEP_SCAN_EVERY == 0)
+        buckets = {}
+        total_objects = total_size = 0
+        for b in self.obj.list_buckets():
+            count = size = versions = 0
+            marker = ""
+            while True:
+                r = self.obj.list_objects(b.name, marker=marker,
+                                          max_keys=1000)
+                for oi in r.objects:
+                    if self._stop.is_set():
+                        return self.last_usage
+                    count += 1
+                    size += oi.size
+                    versions += max(1, oi.num_versions)
+                    self._check_object(b.name, oi, deep)
+                    if self.sleep_per_object:
+                        time.sleep(self.sleep_per_object)
+                if not r.is_truncated or not r.next_marker:
+                    break
+                marker = r.next_marker
+            buckets[b.name] = {"objects": count, "size": size,
+                               "versions": versions}
+            total_objects += count
+            total_size += size
+        snapshot = {"last_update": time.time(),
+                    "objects_total": total_objects,
+                    "size_total": total_size, "buckets": buckets,
+                    "cycle": self.cycle, "deep": deep}
+        try:
+            usage_mod.save_usage(self.obj, snapshot)
+        except Exception:  # noqa: BLE001
+            pass
+        self.last_usage = snapshot
+        return snapshot
+
+    def _check_object(self, bucket: str, oi, deep: bool):
+        # lifecycle first: expired objects need no heal
+        if self.lifecycle is not None:
+            try:
+                if self.lifecycle.apply(bucket, oi):
+                    return
+            except Exception:  # noqa: BLE001
+                pass
+        if deep and self.mrf is not None:
+            try:
+                res = self.obj.heal_object(bucket, oi.name, dry_run=True,
+                                           scan_mode="deep")
+                if any(s != "ok" for s in res.before_state):
+                    self.mrf.add_partial(bucket, oi.name, "",
+                                         scan_mode="deep")
+            except Exception:  # noqa: BLE001
+                pass
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)

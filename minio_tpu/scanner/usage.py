@@ -1,38 +1,13 @@
 """Data-usage accounting (reference cmd/data-usage-cache.go): per-bucket
-object/byte counts computed by the scanner and persisted as a config blob."""
+object/byte counts computed by the scanner's sweep (scanner.scan_cycle)
+and persisted here as a config blob."""
 from __future__ import annotations
 
 import json
-import time
 
 from ..utils import errors
 
 USAGE_PATH = "data-usage/usage.json"
-
-
-def compute_usage(objlayer) -> dict:
-    """One full namespace sweep (the scanner calls this per cycle)."""
-    buckets = {}
-    total_objects = 0
-    total_size = 0
-    for b in objlayer.list_buckets():
-        count = size = versions = 0
-        marker = ""
-        while True:
-            r = objlayer.list_objects(b.name, marker=marker, max_keys=1000)
-            for o in r.objects:
-                count += 1
-                size += o.size
-                versions += max(1, o.num_versions)
-            if not r.is_truncated or not r.next_marker:
-                break
-            marker = r.next_marker
-        buckets[b.name] = {"objects": count, "size": size,
-                           "versions": versions}
-        total_objects += count
-        total_size += size
-    return {"last_update": time.time(), "objects_total": total_objects,
-            "size_total": total_size, "buckets": buckets}
 
 
 def save_usage(objlayer, usage: dict) -> None:
