@@ -2,11 +2,15 @@
 """North-star benchmark: erasure encode/reconstruct GiB/s at 16+4, 1 MiB block.
 
 Prints exactly ONE JSON line on stdout:
-  {"metric": ..., "value": N, "unit": "GiB/s", "vs_baseline": N}
+  {"metric": ..., "value": N, "unit": "GiB/s", "vs_baseline": N, "extra": {...}}
 
-vs_baseline divides the TPU device throughput by a locally measured CPU
-AVX2 single-core encode (the same nibble-shuffle galois kernel the reference
-uses via klauspost/reedsolomon; see minio_tpu/native/gf256_simd.cpp).
+The headline metric is BASELINE config 1/2's shape (16+4 encode at 1 MiB
+blocks, batch 128); "extra" carries the other BASELINE configs measured the
+same way: 2-shard reconstruct (config 3) and the batched heal rebuild
+(config 5's device kernel). vs_baseline divides TPU device throughput by a
+locally measured CPU AVX2 single-core encode (the same nibble-shuffle galois
+kernel the reference uses via klauspost/reedsolomon; see
+minio_tpu/native/gf256_simd.cpp).
 
 Timing note (recorded in .claude/skills/verify/SKILL.md): on the axon TPU
 platform block_until_ready() returns immediately and any device_get costs a
@@ -55,36 +59,66 @@ def main() -> None:
     log(f"cpu avx2 encode 16+4 @1MiB: {cpu_gibs:.2f} GiB/s "
         f"(avx2={native.load_gf256().gf256_has_avx2()})")
 
-    # --- TPU path (Pallas batched encode, device-resident)
+    # --- TPU path (batched kernels, device-resident)
     import jax
     import jax.numpy as jnp
     from minio_tpu.ops import rs_jax
     log(f"jax backend: {jax.default_backend()} devices: {jax.devices()}")
-    _, mm_batch, _ = rs_jax._resolve_backend("auto")
+    _, mm_batch, mm_batch_per = rs_jax._resolve_backend("auto")
 
-    masks = jnp.asarray(gf256.coeff_masks(pmat))
+    def bench_op(label, masks_np, w, batched_per=False):
+        masks = jnp.asarray(masks_np)
+        op = mm_batch_per if batched_per else mm_batch
+        timed = jax.jit(lambda ms, xs: jnp.sum(op(ms, xs)[..., :2]))
+        _ = jax.device_get(timed(masks, w))  # compile + warm
+
+        def chain(n):
+            t0 = time.perf_counter()
+            s = None
+            for _ in range(n):
+                s = timed(masks, w)
+            _ = jax.device_get(s)
+            return time.perf_counter() - t0
+
+        per = measure_slope(chain)
+        gibs = B * BLOCK / per / (1 << 30)
+        log(f"{label}: {per*1e6:.0f} us/batch -> {gibs:.1f} GiB/s")
+        return gibs
+
     data = rng.integers(0, 256, (B, K, shard), dtype=np.uint8)
     w = jnp.asarray(rs_jax.pack_shards(data))
 
-    timed = jax.jit(lambda ms, xs: jnp.sum(mm_batch(ms, xs)[..., :2]))
-    _ = jax.device_get(timed(masks, w))  # compile + warm
+    # config 1/2: encode 16+4 @ 1 MiB, batch 128
+    enc_gibs = bench_op(f"tpu encode 16+4 @1MiB x{B}",
+                        gf256.coeff_masks(pmat), w)
 
-    def chain(n):
-        t0 = time.perf_counter()
-        for _ in range(n):
-            s = timed(masks, w)
-        _ = jax.device_get(s)
-        return time.perf_counter() - t0
+    # config 3: 2-shard reconstruct (shared loss pattern across the batch)
+    codec = rs_jax.get_codec(K, M)
+    present = tuple(i for i in range(K + M) if i not in (2, 9))[:K]
+    rec_masks = codec.target_masks_np(present, (2, 9))
+    rec_gibs = bench_op(f"tpu reconstruct 16+4 2-loss @1MiB x{B}",
+                        rec_masks, w)
 
-    per = measure_slope(chain)
-    tpu_gibs = B * BLOCK / per / (1 << 30)
-    log(f"tpu encode 16+4 @1MiB x{B}: {per*1e6:.0f} us/batch -> {tpu_gibs:.1f} GiB/s")
+    # config 5: batched heal rebuild — per-element masks, mixed loss patterns
+    heal_masks = np.stack([
+        codec.target_masks_np(
+            tuple(j for j in range(K + M) if j not in (i % K, K + i % M))[:K],
+            (i % K, K + i % M))
+        for i in range(B)])
+    heal_gibs = bench_op(f"tpu batched heal rebuild 16+4 x{B} mixed-loss",
+                         jnp.asarray(heal_masks), w, batched_per=True)
 
     print(json.dumps({
         "metric": f"erasure_encode_gibs_16+4_1MiB_batch{B}",
-        "value": round(tpu_gibs, 2),
+        "value": round(enc_gibs, 2),
         "unit": "GiB/s",
-        "vs_baseline": round(tpu_gibs / cpu_gibs, 2),
+        "vs_baseline": round(enc_gibs / cpu_gibs, 2),
+        "extra": {
+            "cpu_avx2_encode_gibs": round(cpu_gibs, 2),
+            "reconstruct_2loss_gibs": round(rec_gibs, 2),
+            "reconstruct_vs_cpu": round(rec_gibs / cpu_gibs, 2),
+            "batched_heal_rebuild_gibs": round(heal_gibs, 2),
+        },
     }))
 
 

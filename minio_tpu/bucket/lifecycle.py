@@ -93,14 +93,14 @@ class LifecycleSys:
         if not rules:
             return False
         now = time.time()
-        tags = {}
+        tags: dict[str, str] | None = None  # fetched at most once
         for r in rules:
             if not r.enabled:
                 continue
             if r.prefix and not oi.name.startswith(r.prefix):
                 continue
             if r.tags:
-                if not tags:
+                if tags is None:
                     try:
                         enc = self.obj.get_object_tags(bucket, oi.name)
                         tags = dict(urllib.parse.parse_qsl(enc))
@@ -128,8 +128,9 @@ class LifecycleSys:
             if r.expiration_days and \
                     now - oi.mod_time >= r.expiration_days * 86400:
                 expired = True
-            if r.expiration_date and now >= r.expiration_date \
-                    and oi.mod_time < r.expiration_date:
+            if r.expiration_date and now >= r.expiration_date:
+                # S3 semantics: once the date passes, every matching
+                # object expires, regardless of creation time
                 expired = True
             if expired and not oi.delete_marker:
                 versioned = self.bucket_meta.versioning_enabled(bucket)

@@ -4,7 +4,6 @@ worker pool. Targets are registered per bucket (cmd/bucket-targets.go);
 replication triggers on object-created/removed events."""
 from __future__ import annotations
 
-import hashlib
 import queue
 import threading
 import urllib.parse
@@ -63,6 +62,8 @@ class ReplicationPool:
         self.targets: dict[str, S3Target] = {}
         self.q: queue.Queue = queue.Queue(maxsize=max_queue)
         self._stop = threading.Event()
+        self._inflight = 0
+        self._inflight_lock = threading.Lock()
         self._threads = [
             threading.Thread(target=self._worker, daemon=True,
                              name=f"replication-{i}")
@@ -100,11 +101,16 @@ class ReplicationPool:
                 bucket, key, op = self.q.get(timeout=0.5)
             except queue.Empty:
                 continue
+            with self._inflight_lock:
+                self._inflight += 1
             try:
                 self._replicate(bucket, key, op)
                 self.replicated += 1
             except Exception:  # noqa: BLE001
                 self.failed += 1
+            finally:
+                with self._inflight_lock:
+                    self._inflight -= 1
 
     #: objects above this spill to a temp file instead of RAM
     SPOOL_THRESHOLD = 8 << 20
@@ -142,11 +148,15 @@ class ReplicationPool:
             raise RuntimeError(f"replication target: {r.status_code}")
 
     def drain(self, timeout: float = 30.0):
+        """Block until the queue is empty AND no worker is mid-replication."""
         import time
         deadline = time.monotonic() + timeout
-        while not self.q.empty() and time.monotonic() < deadline:
+        while time.monotonic() < deadline:
+            with self._inflight_lock:
+                busy = self._inflight
+            if self.q.empty() and busy == 0:
+                return
             time.sleep(0.05)
-        time.sleep(0.2)  # let in-flight workers finish
 
     def stop(self):
         self._stop.set()

@@ -130,9 +130,15 @@ class ErasureObjects(MultipartMixin, ObjectLayer):
     def disks(self) -> list:
         return list(self._disks)
 
-    def _notify_partial(self, bucket, object, version_id=""):
+    def _notify_partial(self, bucket, object, version_id="",
+                        scan_mode="normal"):
+        """scan_mode='deep' when the caller saw bitrot — a normal heal's
+        size-only check cannot find a corrupt-but-right-sized shard."""
         if self.on_partial is not None:
             try:
+                self.on_partial(bucket, object, version_id,
+                                scan_mode=scan_mode)
+            except TypeError:
                 self.on_partial(bucket, object, version_id)
             except Exception:  # noqa: BLE001 — MRF is best-effort
                 pass
@@ -434,6 +440,7 @@ class ErasureObjects(MultipartMixin, ObjectLayer):
                 per_shard_disk[idx - 1] = d
 
         degraded = False
+        saw_bitrot = False
         part_start = 0  # start byte of current part within the object
         for part in fi.parts:
             part_end = part_start + part.size
@@ -475,11 +482,16 @@ class ErasureObjects(MultipartMixin, ObjectLayer):
             if any(isinstance(e, (errors.FileCorrupt, errors.FileNotFound))
                    for e in stats.errs):
                 degraded = True
+                if any(isinstance(e, errors.FileCorrupt)
+                       for e in stats.errs):
+                    saw_bitrot = True
         if degraded or any(e is not None for e in errs) \
                 or any(d is None for d in per_shard_disk[
                     :fi.erasure.data_blocks + fi.erasure.parity_blocks]):
             # heal-on-read signal (cmd/erasure-object.go:325-336)
-            self._notify_partial(bucket, object, fi.version_id)
+            self._notify_partial(bucket, object, fi.version_id,
+                                 scan_mode="deep" if saw_bitrot
+                                 else "normal")
         return oi
 
     def get_object_bytes(self, bucket: str, object: str,

@@ -49,10 +49,21 @@ class GlobalHealer:
         self.objects_failed = 0
 
     def heal_all(self, scan_mode: str = "normal") -> dict:
+        from collections import deque
         results = {"buckets": 0, "objects_healed": 0, "objects_failed": 0}
         pool = ThreadPoolExecutor(max_workers=self.concurrency,
                                   thread_name_prefix="global-heal")
-        futs = []
+        # bounded in-flight window: memory stays O(concurrency) even on
+        # namespaces with millions of objects
+        futs: deque = deque()
+        max_inflight = self.concurrency * 4
+
+        def reap(f):
+            if f.result():
+                results["objects_healed"] += 1
+            else:
+                results["objects_failed"] += 1
+
         try:
             for b in self.obj.list_buckets():
                 self.obj.heal_bucket(b.name)
@@ -64,15 +75,13 @@ class GlobalHealer:
                     for oi in r.objects:
                         futs.append(pool.submit(
                             self._heal_one, b.name, oi.name, scan_mode))
+                        if len(futs) >= max_inflight:
+                            reap(futs.popleft())
                     if not r.is_truncated or not r.next_marker:
                         break
                     marker = r.next_marker
-            for f in futs:
-                ok = f.result()
-                if ok:
-                    results["objects_healed"] += 1
-                else:
-                    results["objects_failed"] += 1
+            while futs:
+                reap(futs.popleft())
         finally:
             pool.shutdown(wait=True)
         self.objects_healed += results["objects_healed"]

@@ -122,10 +122,12 @@ def test_scanner_applies_lifecycle(tmp_path):
     obj.put_object("lb2", "any", io.BytesIO(b"x"), 1)
     lc = LifecycleSys(obj, meta_sys)
     sc = DataScanner(obj, lifecycle=lc, sleep_per_object=0)
-    # Date rule in the past only expires objects modified before that date;
-    # our object is newer, so it stays
+    # S3 semantics: once the Date passes, every matching object expires
     sc.scan_cycle()
-    assert obj.get_object_info("lb2", "any")
+    from minio_tpu.objectlayer import datatypes as dt
+    with pytest.raises(dt.ObjectNotFound):
+        obj.get_object_info("lb2", "any")
+    assert lc.expired == 1
 
 
 def test_autoheal_tracker_and_global_heal(tmp_path):
