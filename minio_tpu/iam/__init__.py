@@ -1,0 +1,6 @@
+"""IAM: users, groups, service accounts, canned + custom policies, STS
+(reference cmd/iam.go + pkg/iam/policy + cmd/sts-handlers.go)."""
+from .policy import Policy, Statement, policy_allows
+from .sys import IAMSys
+
+__all__ = ["IAMSys", "Policy", "Statement", "policy_allows"]

@@ -1,0 +1,266 @@
+"""IAMSys — identity and access management state (reference cmd/iam.go:2187
++ cmd/iam-object-store.go): users, groups, service accounts, policy
+documents and user→policy mappings, persisted under
+``.minio.sys/config/iam/`` through the ObjectLayer and cached in-process.
+STS temporary credentials live in the same table with an expiry."""
+from __future__ import annotations
+
+import base64
+import hashlib
+import hmac
+import json
+import secrets
+import threading
+import time
+from dataclasses import dataclass, field
+
+from ..utils import errors
+from . import policy as pol
+
+IAM_PREFIX = "iam"
+
+
+@dataclass
+class UserIdentity:
+    access_key: str
+    secret_key: str
+    status: str = "enabled"           # enabled | disabled
+    policies: list[str] = field(default_factory=list)
+    groups: list[str] = field(default_factory=list)
+    parent: str = ""                  # service accounts / STS: owning user
+    expiration: float = 0.0           # STS creds: unix expiry (0 = never)
+    session_policy: bytes = b""       # STS/service-account inline policy
+
+    @property
+    def enabled(self) -> bool:
+        return self.status == "enabled" and (
+            self.expiration == 0.0 or self.expiration > time.time())
+
+    def to_dict(self):
+        return {"ak": self.access_key, "sk": self.secret_key,
+                "status": self.status, "policies": self.policies,
+                "groups": self.groups, "parent": self.parent,
+                "exp": self.expiration,
+                "spolicy": base64.b64encode(self.session_policy).decode()}
+
+    @classmethod
+    def from_dict(cls, d):
+        return cls(access_key=d["ak"], secret_key=d["sk"],
+                   status=d.get("status", "enabled"),
+                   policies=list(d.get("policies", [])),
+                   groups=list(d.get("groups", [])),
+                   parent=d.get("parent", ""),
+                   expiration=d.get("exp", 0.0),
+                   session_policy=base64.b64decode(d.get("spolicy", "")))
+
+
+class IAMSys:
+    def __init__(self, objlayer, root_access_key: str, root_secret_key: str):
+        self.obj = objlayer
+        self.root_ak = root_access_key
+        self.root_sk = root_secret_key
+        self._lock = threading.Lock()
+        self.users: dict[str, UserIdentity] = {}
+        self.groups: dict[str, dict] = {}   # name -> {members, policies}
+        self.policies: dict[str, pol.Policy] = dict(pol.CANNED)
+        self.load()
+
+    # --- persistence --------------------------------------------------------
+
+    def _save(self):
+        doc = {
+            "users": {k: u.to_dict() for k, u in self.users.items()},
+            "groups": self.groups,
+            "policies": {name: p.dump().decode()
+                         for name, p in self.policies.items()
+                         if name not in pol.CANNED},
+        }
+        self.obj.put_config(f"{IAM_PREFIX}/state.json",
+                            json.dumps(doc).encode())
+
+    def load(self):
+        try:
+            doc = json.loads(self.obj.get_config(f"{IAM_PREFIX}/state.json"))
+        except (errors.StorageError, ValueError, NotImplementedError):
+            return
+        with self._lock:
+            self.users = {k: UserIdentity.from_dict(u)
+                          for k, u in doc.get("users", {}).items()}
+            self.groups = doc.get("groups", {})
+            self.policies = dict(pol.CANNED)
+            for name, blob in doc.get("policies", {}).items():
+                try:
+                    self.policies[name] = pol.Policy.parse(blob, name)
+                except ValueError:
+                    continue
+
+    # --- credential lookup (the auth layer's hook) --------------------------
+
+    def lookup_secret(self, access_key: str) -> str | None:
+        if access_key == self.root_ak:
+            return self.root_sk
+        u = self.users.get(access_key)
+        if u is not None and u.enabled:
+            return u.secret_key
+        return None
+
+    # --- users --------------------------------------------------------------
+
+    def add_user(self, access_key: str, secret_key: str,
+                 policies: list[str] | None = None):
+        if access_key == self.root_ak:
+            raise ValueError("cannot override root credentials")
+        if len(access_key) < 3:
+            raise ValueError("access key must be at least 3 characters")
+        if len(secret_key) < 8:
+            raise ValueError("secret key must be at least 8 characters")
+        with self._lock:
+            self.users[access_key] = UserIdentity(
+                access_key=access_key, secret_key=secret_key,
+                policies=policies or [])
+            self._save()
+
+    def remove_user(self, access_key: str):
+        with self._lock:
+            self.users.pop(access_key, None)
+            # cascade: drop service accounts / STS creds owned by the user
+            for k in [k for k, u in self.users.items()
+                      if u.parent == access_key]:
+                del self.users[k]
+            self._save()
+
+    def set_user_status(self, access_key: str, status: str):
+        with self._lock:
+            u = self.users[access_key]
+            u.status = status
+            self._save()
+
+    def set_user_policy(self, access_key: str, policy_names: list[str]):
+        with self._lock:
+            self.users[access_key].policies = policy_names
+            self._save()
+
+    # --- groups -------------------------------------------------------------
+
+    def add_group(self, name: str, members: list[str]):
+        with self._lock:
+            g = self.groups.setdefault(name,
+                                       {"members": [], "policies": []})
+            g["members"] = sorted(set(g["members"]) | set(members))
+            for m in members:
+                if m in self.users and name not in self.users[m].groups:
+                    self.users[m].groups.append(name)
+            self._save()
+
+    def set_group_policy(self, name: str, policy_names: list[str]):
+        with self._lock:
+            self.groups.setdefault(name, {"members": []})[
+                "policies"] = policy_names
+            self._save()
+
+    def remove_group(self, name: str):
+        with self._lock:
+            self.groups.pop(name, None)
+            for u in self.users.values():
+                if name in u.groups:
+                    u.groups.remove(name)
+            self._save()
+
+    # --- policies -----------------------------------------------------------
+
+    def set_policy(self, name: str, doc: bytes):
+        p = pol.Policy.parse(doc, name)
+        with self._lock:
+            self.policies[name] = p
+            self._save()
+
+    def delete_policy(self, name: str):
+        if name in pol.CANNED:
+            raise ValueError(f"cannot delete canned policy {name}")
+        with self._lock:
+            self.policies.pop(name, None)
+            self._save()
+
+    # --- service accounts / STS ---------------------------------------------
+
+    def new_service_account(self, parent: str,
+                            session_policy: bytes = b"") -> UserIdentity:
+        ak = "SA" + secrets.token_hex(8).upper()
+        sk = secrets.token_urlsafe(30)
+        u = UserIdentity(access_key=ak, secret_key=sk, parent=parent,
+                         session_policy=session_policy)
+        with self._lock:
+            self.users[ak] = u
+            self._save()
+        return u
+
+    def assume_role(self, access_key: str, duration_s: int = 3600,
+                    session_policy: bytes = b"") -> UserIdentity:
+        """STS AssumeRole (reference cmd/sts-handlers.go:43): temporary
+        credentials inheriting the caller's policies, optionally narrowed
+        by an inline session policy."""
+        duration_s = max(900, min(duration_s, 7 * 24 * 3600))
+        ak = "STS" + secrets.token_hex(8).upper()
+        sk = secrets.token_urlsafe(30)
+        u = UserIdentity(access_key=ak, secret_key=sk, parent=access_key,
+                         expiration=time.time() + duration_s,
+                         session_policy=session_policy)
+        with self._lock:
+            self._purge_expired_locked()
+            self.users[ak] = u
+            self._save()
+        return u
+
+    def _purge_expired_locked(self):
+        """Drop dead temporary credentials so the table and persisted
+        state stay bounded under continuous AssumeRole traffic."""
+        now = time.time()
+        for k in [k for k, u in self.users.items()
+                  if u.expiration and u.expiration < now]:
+            del self.users[k]
+
+    # --- authorization ------------------------------------------------------
+
+    def effective_policies(self, access_key: str) -> list[pol.Policy]:
+        u = self.users.get(access_key)
+        if u is None:
+            return []
+        names = list(u.policies)
+        src = u
+        if u.parent:  # service account / STS inherits the parent's policies
+            parent = self.users.get(u.parent)
+            if parent is not None:
+                names += parent.policies
+                src = parent
+            elif u.parent == self.root_ak:
+                names.append("consoleAdmin")
+        for g in src.groups:
+            names += self.groups.get(g, {}).get("policies", [])
+        out = [self.policies[n] for n in dict.fromkeys(names)
+               if n in self.policies]
+        if u.session_policy:
+            try:
+                out.append(pol.Policy.parse(u.session_policy, "session"))
+            except ValueError:
+                pass
+        return out
+
+    def is_allowed(self, access_key: str, action: str, bucket: str,
+                   object: str = "", ctx: dict | None = None) -> bool:
+        if access_key == self.root_ak:
+            return True
+        u = self.users.get(access_key)
+        if u is None or not u.enabled:
+            return False
+        resource = f"{bucket}/{object}" if object else bucket
+        policies = self.effective_policies(access_key)
+        if u.session_policy:
+            # session policy must ALSO allow (intersection semantics)
+            try:
+                sp = pol.Policy.parse(u.session_policy)
+            except ValueError:
+                return False
+            if not pol.policy_allows([sp], action, resource, ctx):
+                return False
+            policies = [p for p in policies if p.name != "session"]
+        return pol.policy_allows(policies, action, resource, ctx)

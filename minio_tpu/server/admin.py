@@ -48,7 +48,71 @@ def _dispatch_admin(h, op: str) -> None:
     if op.startswith("service"):
         # restart/stop accepted; process supervisor owns actual signals
         return h._send(200, b"{}", "application/json")
+    if _iam_op(h, op):
+        return
     h._error("NotImplemented", f"admin op {op}", 501)
+
+
+def _iam_op(h, op: str) -> bool:
+    """IAM admin surface (reference admin-handlers-users.go). JSON in/out;
+    root credentials only (enforced by the caller)."""
+    iam = h.s3.iam
+    if iam is None:
+        return False
+    q = {k: v[0] for k, v in h.query.items()}
+    if op == "add-user":
+        body = json.loads(h._read_body() or b"{}")
+        iam.add_user(q["accessKey"], body.get("secretKey", ""),
+                     body.get("policies", []))
+        h._send(200, b"{}", "application/json")
+    elif op == "remove-user":
+        iam.remove_user(q["accessKey"])
+        h._send(200, b"{}", "application/json")
+    elif op == "list-users":
+        out = {ak: {"status": u.status, "policies": u.policies,
+                    "groups": u.groups, "parent": u.parent}
+               for ak, u in iam.users.items()}
+        h._send(200, json.dumps(out).encode(), "application/json")
+    elif op == "set-user-status":
+        iam.set_user_status(q["accessKey"], q.get("status", "enabled"))
+        h._send(200, b"{}", "application/json")
+    elif op == "add-canned-policy":
+        iam.set_policy(q["name"], h._read_body())
+        h._send(200, b"{}", "application/json")
+    elif op == "remove-canned-policy":
+        iam.delete_policy(q["name"])
+        h._send(200, b"{}", "application/json")
+    elif op == "list-canned-policies":
+        out = {name: json.loads(p.dump())
+               for name, p in iam.policies.items()}
+        h._send(200, json.dumps(out).encode(), "application/json")
+    elif op == "set-user-or-group-policy":
+        names = [n for n in q.get("policyName", "").split(",") if n]
+        if q.get("isGroup", "") == "true":
+            iam.set_group_policy(q["userOrGroup"], names)
+        else:
+            iam.set_user_policy(q["userOrGroup"], names)
+        h._send(200, b"{}", "application/json")
+    elif op == "add-user-to-group":
+        body = json.loads(h._read_body() or b"{}")
+        iam.add_group(body["group"], body.get("members", []))
+        h._send(200, b"{}", "application/json")
+    elif op == "remove-group":
+        iam.remove_group(q["group"])
+        h._send(200, b"{}", "application/json")
+    elif op == "list-groups":
+        h._send(200, json.dumps(iam.groups).encode(), "application/json")
+    elif op == "add-service-account":
+        body = json.loads(h._read_body() or b"{}")
+        u = iam.new_service_account(
+            body.get("parent", h.s3.access_key),
+            body.get("policy", "").encode())
+        h._send(200, json.dumps({
+            "accessKey": u.access_key,
+            "secretKey": u.secret_key}).encode(), "application/json")
+    else:
+        return False
+    return True
 
 
 def _heal(h, op: str) -> None:

@@ -47,6 +47,7 @@ class S3Server:
             self.secret_key if ak == self.access_key else None)
         #: optional IAM policy gate: fn(access_key, action, bucket, object)
         self.authorize = None
+        self.iam = None
         #: optional event notifier: fn(event_name, bucket, object_info)
         self.notify = None
         self.verifier = SigV4Verifier(lambda ak: self.lookup_secret(ak),
@@ -58,6 +59,33 @@ class S3Server:
         #: internal RPC services mounted under /minio/<name>/v1/<method>
         #: (storage/lock/peer — populated by dist.node.Node)
         self.internal: dict[str, object] = {}
+
+    def enable_iam(self):
+        """Attach the IAM subsystem: per-user credentials, policy
+        enforcement, STS, anonymous bucket-policy access."""
+        from ..iam import IAMSys
+        self.iam = IAMSys(self.obj, self.access_key, self.secret_key)
+        self.lookup_secret = self.iam.lookup_secret
+        self.authorize = self._iam_authorize
+        return self.iam
+
+    def _iam_authorize(self, access_key: str, action: str, bucket: str,
+                       object: str) -> bool:
+        if self.iam.is_allowed(access_key, action, bucket, object):
+            return True
+        # bucket policy may grant the (possibly anonymous) principal
+        if bucket:
+            from ..iam.policy import Policy, policy_allows
+            meta = self.bucket_meta.get(bucket)
+            if meta.policy_json:
+                try:
+                    bp = Policy.parse(meta.policy_json)
+                except ValueError:
+                    return False
+                resource = f"{bucket}/{object}" if object else bucket
+                return policy_allows([bp], action, resource,
+                                     principal=access_key or "*")
+        return False
 
     # --- server lifecycle ---------------------------------------------------
 
@@ -110,6 +138,7 @@ class _S3Handler(BaseHTTPRequestHandler):
         self.bucket = parts[0]
         self.key = parts[1] if len(parts) > 1 else ""
         self.hdr = {k.lower(): v for k, v in self.headers.items()}
+        self._consumed = 0  # request-body bytes read (keep-alive hygiene)
 
     def q(self, key: str, default: str = "") -> str:
         v = self.query.get(key)
@@ -144,7 +173,29 @@ class _S3Handler(BaseHTTPRequestHandler):
 
     def _read_body(self) -> bytes:
         n = int(self.hdr.get("content-length", "0") or "0")
-        return self.rfile.read(n) if n else b""
+        data = self.rfile.read(n) if n else b""
+        self._consumed += len(data)
+        return data
+
+    def _drain_body(self):
+        """Discard any unread request body so the next request on this
+        keep-alive connection parses cleanly; large remainders close the
+        connection instead of burning bandwidth."""
+        try:
+            n = int(self.hdr.get("content-length", "0") or "0")
+        except (AttributeError, ValueError):
+            return
+        remaining = n - getattr(self, "_consumed", 0)
+        if remaining <= 0:
+            return
+        if remaining > (1 << 20):
+            self.close_connection = True
+            return
+        while remaining > 0:
+            chunk = self.rfile.read(min(65536, remaining))
+            if not chunk:
+                break
+            remaining -= len(chunk)
 
     # --- auth ---------------------------------------------------------------
 
@@ -156,9 +207,40 @@ class _S3Handler(BaseHTTPRequestHandler):
 
     def _authorize(self, access_key: str, action: str):
         gate = self.s3.authorize
-        if gate is not None and not gate(access_key, action, self.bucket,
-                                         self.key):
+        if gate is None:
+            if access_key == "":
+                raise AuthError("AccessDenied", "anonymous access denied")
+            return
+        if not gate(access_key, action, self.bucket, self.key):
             raise AuthError("AccessDenied", f"not allowed to {action}")
+
+    def _sts(self, body: bytes):
+        """AssumeRole: temporary credentials for the signing identity
+        (reference cmd/sts-handlers.go:43)."""
+        import datetime
+        try:
+            ak = self._authenticate()
+        except AuthError as e:
+            return self._error(e.code, e.message, e.status)
+        form = dict(urllib.parse.parse_qsl(body.decode("utf-8", "replace")))
+        duration = int(form.get("DurationSeconds", "3600") or "3600")
+        session_policy = form.get("Policy", "").encode()
+        cred = self.s3.iam.assume_role(ak, duration, session_policy)
+        exp = datetime.datetime.fromtimestamp(
+            cred.expiration, tz=datetime.timezone.utc
+        ).strftime("%Y-%m-%dT%H:%M:%SZ")
+        xml = (
+            '<?xml version="1.0" encoding="UTF-8"?>'
+            '<AssumeRoleResponse xmlns='
+            '"https://sts.amazonaws.com/doc/2011-06-15/">'
+            "<AssumeRoleResult><Credentials>"
+            f"<AccessKeyId>{cred.access_key}</AccessKeyId>"
+            f"<SecretAccessKey>{cred.secret_key}</SecretAccessKey>"
+            f"<SessionToken>minio-tpu-session</SessionToken>"
+            f"<Expiration>{exp}</Expiration>"
+            "</Credentials></AssumeRoleResult></AssumeRoleResponse>"
+        ).encode()
+        self._send(200, xml)
 
     def _body_stream(self, size: int):
         """Request-body reader honoring aws-chunked streaming signatures."""
@@ -170,10 +252,14 @@ class _S3Handler(BaseHTTPRequestHandler):
                               auth.service)
             scope = (f"{auth.scope_date}/{auth.region}/{auth.service}/"
                      "aws4_request")
+            # chunked framing makes residual length unknowable: if the
+            # handler errors mid-stream, close rather than drain
+            self._consumed = 1 << 62
+            self.close_connection = True
             return ChunkedSigV4Reader(
                 self.rfile, auth.signature, key,
                 self.hdr.get("x-amz-date", ""), scope)
-        return _CappedReader(self.rfile, size)
+        return _CappedReader(self.rfile, size, self)
 
     # --- routing ------------------------------------------------------------
 
@@ -201,10 +287,21 @@ class _S3Handler(BaseHTTPRequestHandler):
         if self.url_path.startswith("/minio/admin/"):
             from .admin import handle_admin
             return handle_admin(self)
+        # STS endpoint: POST / with form-encoded Action (cmd/sts-handlers.go)
+        if self.command == "POST" and self.url_path == "/" and \
+                "authorization" in self.hdr and self.s3.iam is not None:
+            body = self._read_body()
+            if b"Action=Assume" in body or b"Action=assume" in body:
+                return self._sts(body)
         try:
             access_key = self._authenticate()
         except AuthError as e:
-            return self._error(e.code, e.message, e.status)
+            # anonymous access rides bucket policies when IAM is on
+            if self.s3.iam is not None and e.code == "AccessDenied" and \
+                    "no authentication" in e.message:
+                access_key = ""
+            else:
+                return self._error(e.code, e.message, e.status)
         try:
             self._dispatch(access_key)
         except dt.ObjectAPIError as e:
@@ -328,20 +425,29 @@ class _S3Handler(BaseHTTPRequestHandler):
 
     # --- HTTP verbs ---------------------------------------------------------
 
+    def _handle(self):
+        try:
+            self._route()
+        finally:
+            try:
+                self._drain_body()
+            except Exception:  # noqa: BLE001
+                self.close_connection = True
+
     def do_GET(self):  # noqa: N802
-        self._route()
+        self._handle()
 
     def do_PUT(self):  # noqa: N802
-        self._route()
+        self._handle()
 
     def do_POST(self):  # noqa: N802
-        self._route()
+        self._handle()
 
     def do_DELETE(self):  # noqa: N802
-        self._route()
+        self._handle()
 
     def do_HEAD(self):  # noqa: N802
-        self._route()
+        self._handle()
 
     # --- service ------------------------------------------------------------
 
@@ -777,11 +883,13 @@ class _S3Handler(BaseHTTPRequestHandler):
 
 class _CappedReader:
     """Bound a socket read to the declared Content-Length (socket streams
-    never EOF on keep-alive connections)."""
+    never EOF on keep-alive connections); reports consumption back to the
+    handler for end-of-request draining."""
 
-    def __init__(self, raw, size: int):
+    def __init__(self, raw, size: int, handler=None):
         self.raw = raw
         self.remaining = max(0, size) if size >= 0 else -1
+        self.handler = handler
 
     def read(self, n: int = -1) -> bytes:
         if self.remaining == 0:
@@ -791,4 +899,6 @@ class _CappedReader:
         b = self.raw.read(n)
         if self.remaining > 0:
             self.remaining -= len(b)
+        if self.handler is not None:
+            self.handler._consumed += len(b)
         return b

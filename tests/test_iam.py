@@ -1,0 +1,196 @@
+"""IAM/policy/STS tests: policy evaluation (allow/deny/wildcards/
+conditions), user + service-account lifecycle, policy enforcement over
+HTTP, anonymous bucket-policy access, STS AssumeRole."""
+import json
+
+import pytest
+
+from minio_tpu.iam.policy import Policy, policy_allows, match_wild
+from minio_tpu.objectlayer import ErasureObjects
+from minio_tpu.server import S3Server
+from minio_tpu.storage import XLStorage
+from s3client import S3Client
+
+AK, SK = "rootadmin", "rootsecret12"
+
+
+def test_policy_evaluation():
+    p = Policy.parse(json.dumps({
+        "Version": "2012-10-17",
+        "Statement": [
+            {"Effect": "Allow", "Action": ["s3:GetObject", "s3:List*"],
+             "Resource": ["arn:aws:s3:::docs/*", "arn:aws:s3:::docs"]},
+            {"Effect": "Deny", "Action": "s3:GetObject",
+             "Resource": "arn:aws:s3:::docs/secret/*"},
+        ]}))
+    assert p.is_allowed("s3:GetObject", "docs/readme.txt")
+    assert p.is_allowed("s3:ListBucket", "docs")
+    assert not p.is_allowed("s3:PutObject", "docs/readme.txt")
+    # explicit deny wins over allow
+    assert not p.is_allowed("s3:GetObject", "docs/secret/key.pem")
+    # resource scoping
+    assert not p.is_allowed("s3:GetObject", "other/file")
+
+
+def test_policy_conditions_and_wildcards():
+    assert match_wild("s3:Get*", "s3:GetObject")
+    assert match_wild("arn:aws:s3:::b/*", "arn:aws:s3:::b/x/y")
+    assert not match_wild("s3:Get?bject", "s3:GetXObject")
+    p = Policy.parse(json.dumps({"Statement": [{
+        "Effect": "Allow", "Action": "s3:GetObject",
+        "Resource": "arn:aws:s3:::b/*",
+        "Condition": {"IpAddress": {"aws:SourceIp": "10.0.0.0/8"}}}]}))
+    assert p.is_allowed("s3:GetObject", "b/o", {"aws:sourceip": "10.1.2.3"})
+    assert not p.is_allowed("s3:GetObject", "b/o",
+                            {"aws:sourceip": "192.168.1.1"})
+
+
+@pytest.fixture(scope="module")
+def iam_srv(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("iamsrv")
+    disks = [XLStorage(str(tmp / f"d{i}")) for i in range(4)]
+    obj = ErasureObjects(disks, default_parity=2)
+    srv = S3Server(obj, "127.0.0.1", 0, access_key=AK, secret_key=SK)
+    iam = srv.enable_iam()
+    srv.start_background()
+    yield srv, iam
+    srv.shutdown()
+
+
+def test_iam_user_enforcement(iam_srv):
+    srv, iam = iam_srv
+    root = S3Client(srv.endpoint(), AK, SK)
+    assert root.put_bucket("iamb").status_code == 200
+    root.put_object("iamb", "o", b"data")
+
+    # reader can GET but not PUT
+    iam.add_user("reader1", "readersecret", ["readonly"])
+    rd = S3Client(srv.endpoint(), "reader1", "readersecret")
+    assert rd.get_object("iamb", "o").status_code == 200
+    r = rd.put_object("iamb", "new", b"x")
+    assert r.status_code == 403
+    # writer can PUT but not GET
+    iam.add_user("writer1", "writersecret", ["writeonly"])
+    wr = S3Client(srv.endpoint(), "writer1", "writersecret")
+    assert wr.put_object("iamb", "w", b"x").status_code == 200
+    assert wr.get_object("iamb", "o").status_code == 403
+    # disabled user rejected at auth
+    iam.set_user_status("reader1", "disabled")
+    assert rd.get_object("iamb", "o").status_code == 403
+    iam.set_user_status("reader1", "enabled")
+    # unknown key
+    bad = S3Client(srv.endpoint(), "ghost", "nosecret123")
+    assert bad.get_object("iamb", "o").status_code == 403
+
+
+def test_iam_custom_policy_and_groups(iam_srv):
+    srv, iam = iam_srv
+    root = S3Client(srv.endpoint(), AK, SK)
+    root.put_bucket("teambucket")
+    root.put_object("teambucket", "shared/doc", b"team data")
+    iam.set_policy("team-read", json.dumps({"Statement": [{
+        "Effect": "Allow",
+        "Action": ["s3:GetObject", "s3:GetBucketLocation"],
+        "Resource": "arn:aws:s3:::teambucket/shared/*"}]}).encode())
+    iam.add_user("member1", "membersecret", [])
+    iam.add_group("team", ["member1"])
+    iam.set_group_policy("team", ["team-read"])
+    m = S3Client(srv.endpoint(), "member1", "membersecret")
+    assert m.get_object("teambucket", "shared/doc").status_code == 200
+    assert m.get_object("teambucket", "private").status_code in (403, 404)
+    r = m.put_object("teambucket", "shared/x", b"no")
+    assert r.status_code == 403
+
+
+def test_iam_persistence(iam_srv, tmp_path):
+    srv, iam = iam_srv
+    iam.add_user("durable1", "durablesecret", ["readwrite"])
+    from minio_tpu.iam import IAMSys
+    iam2 = IAMSys(srv.obj, AK, SK)  # fresh load from storage
+    assert iam2.lookup_secret("durable1") == "durablesecret"
+    assert iam2.users["durable1"].policies == ["readwrite"]
+
+
+def test_service_account(iam_srv):
+    srv, iam = iam_srv
+    iam.add_user("parent1", "parentsecret", ["readonly"])
+    sa = iam.new_service_account("parent1")
+    root = S3Client(srv.endpoint(), AK, SK)
+    root.put_bucket("sab")
+    root.put_object("sab", "o", b"x")
+    c = S3Client(srv.endpoint(), sa.access_key, sa.secret_key)
+    assert c.get_object("sab", "o").status_code == 200  # inherits readonly
+    assert c.put_object("sab", "n", b"y").status_code == 403
+
+
+def test_sts_assume_role(iam_srv):
+    import xml.etree.ElementTree as ET
+    srv, iam = iam_srv
+    iam.add_user("stsuser", "stssecret99", ["readwrite"])
+    c = S3Client(srv.endpoint(), "stsuser", "stssecret99")
+    r = c.request("POST", "/",
+                  body=b"Action=AssumeRole&Version=2011-06-15"
+                       b"&DurationSeconds=900",
+                  headers={"content-type":
+                           "application/x-www-form-urlencoded"})
+    assert r.status_code == 200, r.content
+    root = ET.fromstring(r.content)
+    ns = {"sts": "https://sts.amazonaws.com/doc/2011-06-15/"}
+    ak = root.findtext(".//sts:AccessKeyId", namespaces=ns)
+    sk = root.findtext(".//sts:SecretAccessKey", namespaces=ns)
+    assert ak and ak.startswith("STS")
+    tmp = S3Client(srv.endpoint(), ak, sk)
+    root_c = S3Client(srv.endpoint(), AK, SK)
+    root_c.put_bucket("stsb")
+    assert tmp.put_object("stsb", "o", b"sts!").status_code == 200
+    assert tmp.get_object("stsb", "o").content == b"sts!"
+    # expiry honored
+    iam.users[ak].expiration = 1.0
+    assert tmp.get_object("stsb", "o").status_code == 403
+
+
+def test_anonymous_bucket_policy(iam_srv):
+    import requests
+    srv, iam = iam_srv
+    root = S3Client(srv.endpoint(), AK, SK)
+    root.put_bucket("publicb")
+    root.put_object("publicb", "index.html", b"<h1>hi</h1>")
+    # no policy: anonymous rejected
+    r = requests.get(f"{srv.endpoint()}/publicb/index.html")
+    assert r.status_code == 403
+    # grant public read
+    policy = json.dumps({"Statement": [{
+        "Effect": "Allow", "Principal": "*",
+        "Action": "s3:GetObject",
+        "Resource": "arn:aws:s3:::publicb/*"}]}).encode()
+    r = root.request("PUT", "/publicb", query={"policy": ""}, body=policy)
+    assert r.status_code == 204
+    r = requests.get(f"{srv.endpoint()}/publicb/index.html")
+    assert r.status_code == 200
+    assert r.content == b"<h1>hi</h1>"
+    # write still rejected
+    r = requests.put(f"{srv.endpoint()}/publicb/evil", data=b"x")
+    assert r.status_code == 403
+
+
+def test_admin_iam_endpoints(iam_srv):
+    srv, iam = iam_srv
+    root = S3Client(srv.endpoint(), AK, SK)
+    r = root.request("PUT", "/minio/admin/v3/add-user",
+                     query={"accessKey": "apiuser"},
+                     body=json.dumps({"secretKey": "apisecret99",
+                                      "policies": ["readonly"]}).encode())
+    assert r.status_code == 200, r.content
+    r = root.request("GET", "/minio/admin/v3/list-users")
+    assert "apiuser" in r.json()
+    r = root.request("PUT", "/minio/admin/v3/add-canned-policy",
+                     query={"name": "p1"},
+                     body=json.dumps({"Statement": [{
+                         "Effect": "Allow", "Action": "s3:*",
+                         "Resource": "*"}]}).encode())
+    assert r.status_code == 200
+    assert "p1" in root.request(
+        "GET", "/minio/admin/v3/list-canned-policies").json()
+    # admin API rejected for non-root
+    nr = S3Client(srv.endpoint(), "apiuser", "apisecret99")
+    assert nr.request("GET", "/minio/admin/v3/list-users").status_code == 403
