@@ -73,6 +73,12 @@ class BadDigest(ObjectAPIError):
     http_status = 400
 
 
+class InvalidDigest(ObjectAPIError):
+    """Content-MD5 header is not valid base64 (reference ErrInvalidDigest)."""
+    code = "InvalidDigest"
+    http_status = 400
+
+
 class SHA256Mismatch(ObjectAPIError):
     code = "XAmzContentSHA256Mismatch"
     http_status = 400
@@ -170,6 +176,9 @@ class ObjectOptions:
     part_number: int = 0
     delete_marker: bool = False
     storage_class: str = ""
+    # CopyObject x-amz-metadata-directive: REPLACE — user_defined fully
+    # replaces the stored user metadata instead of merging over it.
+    metadata_replace: bool = False
     no_lock: bool = False
 
 

@@ -718,20 +718,29 @@ class ErasureObjects(MultipartMixin, ObjectLayer):
         """Server-side copy: metadata-only for same-object self-copy, else
         full read→write through the erasure pipeline."""
         if src_bucket == dst_bucket and src_object == dst_object:
-            fi, _, _ = self._read_quorum_fileinfo(
-                src_bucket, src_object, src_opts.version_id if src_opts else "")
-            meta = dict(fi.metadata)
-            for k, v in (dst_opts.user_defined if dst_opts else {}).items():
-                meta[k] = v
-            fi.metadata = meta
-            disks = self.disks
-            for d in disks:
-                if d is None:
-                    continue
-                try:
-                    d.update_metadata(src_bucket, src_object, fi)
-                except errors.StorageError:
-                    pass
+            new_user = dict(dst_opts.user_defined) if dst_opts else {}
+            replace_dir = dst_opts is not None and dst_opts.metadata_replace
+
+            def mutate(fi, old):
+                if replace_dir:
+                    # x-amz-metadata-directive: REPLACE — keep only system
+                    # keys, then apply exactly the client-supplied map (S3
+                    # semantics; reference CopyObjectHandler).
+                    meta = {k: v for k, v in old.items()
+                            if k == "etag"
+                            or k.startswith("x-minio-internal-")}
+                    if "content-type" not in new_user \
+                            and "content-type" in old:
+                        meta["content-type"] = old["content-type"]
+                else:
+                    meta = old
+                meta.update(new_user)
+                fi.mod_time = FileInfo.now()  # Last-Modified must advance
+                return meta
+
+            fi = self._rewrite_metadata(
+                src_bucket, src_object,
+                src_opts.version_id if src_opts else "", mutate)
             return ObjectInfo.from_file_info(
                 fi, dst_bucket, dst_object, bool(fi.version_id))
         import io
@@ -743,31 +752,47 @@ class ErasureObjects(MultipartMixin, ObjectLayer):
 
     TAGS_KEY = "x-minio-internal-tags"
 
+    def _rewrite_metadata(self, bucket: str, object: str, version_id: str,
+                          mutate) -> "FileInfo":
+        """In-place xl.meta rewrite discipline shared by tags/self-copy:
+        read the quorum FileInfo UNDER the object lock (a read before the
+        lock races a concurrent overwrite and would resurrect a purged
+        data_dir), apply `mutate(fi, meta) -> new_meta`, then write each
+        disk its OWN FileInfo back (own erasure.index, mirroring the
+        reference writing each disk's metaArr[i]); writing the quorum pick
+        to every disk would make all disks claim the same shard index and
+        permanently break read quorum."""
+        with self._locked(bucket, object):
+            fi, fis, _ = self._read_quorum_fileinfo(bucket, object,
+                                                    version_id)
+            if fi.deleted:
+                raise dt.MethodNotAllowed(bucket, object)
+            meta = mutate(fi, dict(fi.metadata))
+            fi.metadata = meta
+            for d, dfi in zip(self.disks, fis):
+                if d is None or dfi is None:
+                    continue
+                fid = replace(fi, erasure=dfi.erasure, metadata=dict(meta))
+                try:
+                    d.update_metadata(bucket, object, fid)
+                except errors.StorageError:
+                    pass
+            return fi
+
     def put_object_tags(self, bucket: str, object: str, tags_enc: str,
                         opts: ObjectOptions = None) -> None:
         """Set (or clear, with "") the object's encoded tag set by updating
         xl.meta in place on every disk (reference PutObjectTags)."""
         opts = opts or ObjectOptions()
-        fi, fis, _ = self._read_quorum_fileinfo(bucket, object,
-                                                opts.version_id)
-        if fi.deleted:
-            raise dt.MethodNotAllowed(bucket, object)
-        meta = dict(fi.metadata)
-        if tags_enc:
-            meta[self.TAGS_KEY] = tags_enc
-        else:
-            meta.pop(self.TAGS_KEY, None)
-        fi.metadata = meta
-        with self._locked(bucket, object):
-            for d, dfi in zip(self.disks, fis):
-                if d is None or dfi is None:
-                    continue
-                fid = replace(fi, erasure=dfi.erasure,
-                              metadata=dict(meta))
-                try:
-                    d.update_metadata(bucket, object, fid)
-                except errors.StorageError:
-                    pass
+
+        def mutate(fi, meta):
+            if tags_enc:
+                meta[self.TAGS_KEY] = tags_enc
+            else:
+                meta.pop(self.TAGS_KEY, None)
+            return meta
+
+        self._rewrite_metadata(bucket, object, opts.version_id, mutate)
 
     def get_object_tags(self, bucket: str, object: str,
                         opts: ObjectOptions = None) -> str:

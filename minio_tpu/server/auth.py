@@ -180,6 +180,10 @@ class SigV4Verifier:
         now = datetime.datetime.now(datetime.timezone.utc)
         if now > t + datetime.timedelta(seconds=expires):
             raise AuthError("AccessDenied", "request has expired")
+        # A far-future X-Amz-Date would keep the URL valid for years,
+        # defeating PRESIGN_EXPIRY_MAX (reference errRequestNotReadyYet).
+        if t > now + datetime.timedelta(seconds=15 * 60):
+            raise AuthError("AccessDenied", "request is not valid yet")
         signed_headers = first(q, "X-Amz-SignedHeaders").split(";")
         signature = first(q, "X-Amz-Signature")
         scope = f"{scope_date}/{region}/{service}/aws4_request"

@@ -647,15 +647,7 @@ class _S3Handler(BaseHTTPRequestHandler):
         if size > MAX_PUT_SIZE:
             raise dt.EntityTooLarge(self.bucket, self.key)
         user_defined = self._user_meta()
-        sha = self.hdr.get("x-amz-content-sha256", "")
-        sha_hex = sha if sha and sha not in (
-            UNSIGNED_PAYLOAD, STREAMING_PAYLOAD) else ""
-        md5_b64 = self.hdr.get("content-md5", "")
-        md5_hex = ""
-        if md5_b64:
-            import base64
-            md5_hex = base64.b64decode(md5_b64).hex()
-        hr = HashReader(self._body_stream(size), size, md5_hex, sha_hex)
+        hr = self._hash_reader(size)
         opts = self._opts()
         opts.user_defined = user_defined
         oi = self.s3.obj.put_object(self.bucket, self.key, hr, size, opts)
@@ -663,6 +655,24 @@ class _S3Handler(BaseHTTPRequestHandler):
             "ETag": f'"{oi.etag}"',
             "x-amz-version-id": oi.version_id or None})
         self._notify("s3:ObjectCreated:Put", oi)
+
+    def _hash_reader(self, size: int) -> HashReader:
+        """Body reader verifying Content-MD5 / x-amz-content-sha256 on the
+        fly — shared by PutObject and UploadPart so the two paths can't
+        diverge."""
+        sha = self.hdr.get("x-amz-content-sha256", "")
+        sha_hex = sha if sha and sha not in (
+            UNSIGNED_PAYLOAD, STREAMING_PAYLOAD) else ""
+        md5_b64 = self.hdr.get("content-md5", "")
+        md5_hex = ""
+        if md5_b64:
+            import base64
+            import binascii
+            try:
+                md5_hex = base64.b64decode(md5_b64, validate=True).hex()
+            except (binascii.Error, ValueError) as e:
+                raise dt.InvalidDigest(self.bucket, self.key) from e
+        return HashReader(self._body_stream(size), size, md5_hex, sha_hex)
 
     def _user_meta(self) -> dict[str, str]:
         out = {}
@@ -786,6 +796,7 @@ class _S3Handler(BaseHTTPRequestHandler):
         directive = self.hdr.get("x-amz-metadata-directive", "COPY")
         if directive == "REPLACE":
             dst_opts.user_defined = self._user_meta()
+            dst_opts.metadata_replace = True
         else:
             si = self.s3.obj.get_object_info(src_bucket, src_key, src_opts)
             dst_opts.user_defined = dict(si.user_defined)
@@ -838,7 +849,10 @@ class _S3Handler(BaseHTTPRequestHandler):
         if size < 0:
             return self._error("MissingContentLength",
                                "Content-Length required", 411)
-        hr = HashReader(self._body_stream(size), size)
+        # Verify Content-MD5 / x-amz-content-sha256 on part bodies exactly
+        # like PutObject — otherwise corrupted parts are accepted and only
+        # surface as a confusing InvalidPart at complete time.
+        hr = self._hash_reader(size)
         pi = self.s3.obj.put_object_part(self.bucket, self.key, uid,
                                          part_id, hr, size)
         self._send(200, headers={"ETag": f'"{pi.etag}"'})

@@ -326,22 +326,12 @@ class XLStorage(StorageAPI):
 
     def read_version(self, volume: str, path: str, version_id: str = "",
                      read_data: bool = False) -> FileInfo:
+        # Inline data (fi.data) comes ONLY from xl.meta's Data section
+        # written at put time, as in the reference (cmd/xl-storage.go:1138).
+        # part.N files hold bitrot-framed SHARD bytes, never object bytes,
+        # so inlining them here would serve digest||shard as object data.
         meta = self._load_meta(volume, path)
-        fi = meta.to_fileinfo(volume, path, version_id)
-        if read_data and fi.data is None and not fi.deleted \
-                and len(fi.parts) == 1 and fi.size <= self._small_threshold():
-            # opportunistic inline of small objects on read (A.4)
-            try:
-                fi.data = self.read_all(
-                    volume, f"{path}/{fi.data_dir}/part.1")
-            except errors.StorageError:
-                pass
-        return fi
-
-    @staticmethod
-    def _small_threshold() -> int:
-        from .xlmeta import SMALL_FILE_THRESHOLD
-        return SMALL_FILE_THRESHOLD
+        return meta.to_fileinfo(volume, path, version_id)
 
     def list_versions(self, volume: str, path: str) -> list[FileInfo]:
         return self._load_meta(volume, path).list_versions(volume, path)

@@ -391,3 +391,54 @@ def test_fs_mode(tmp_path):
         assert c.get_object("fsb", "o").status_code == 404
     finally:
         server.shutdown()
+
+
+def test_multipart_part_md5_verified(cl):
+    """ADVICE r1 medium: Content-MD5 on part uploads must be verified."""
+    import base64
+    cl.put_bucket("mpverify")
+    r = cl.request("POST", "/mpverify/big", query={"uploads": ""})
+    assert r.status_code == 200
+    uid = [e.text for e in xml_root(r).iter("UploadId")][0]
+    body = rng_bytes(6 << 20, seed=3)
+    bad_md5 = base64.b64encode(hashlib.md5(b"other").digest()).decode()
+    r = cl.request("PUT", "/mpverify/big",
+                   query={"partNumber": "1", "uploadId": uid},
+                   body=body, headers={"content-md5": bad_md5})
+    assert r.status_code == 400, r.content
+    good_md5 = base64.b64encode(hashlib.md5(body).digest()).decode()
+    r = cl.request("PUT", "/mpverify/big",
+                   query={"partNumber": "1", "uploadId": uid},
+                   body=body, headers={"content-md5": good_md5})
+    assert r.status_code == 200, r.content
+    cl.request("DELETE", "/mpverify/big", query={"uploadId": uid})
+
+
+def test_presigned_future_date_rejected(srv):
+    """ADVICE r1 low: far-future X-Amz-Date presigned URLs must be refused."""
+    import datetime
+    import requests
+    future = (datetime.datetime.now(datetime.timezone.utc)
+              + datetime.timedelta(days=365)).strftime("%Y%m%dT%H%M%SZ")
+    host = srv.endpoint().split("//", 1)[1]
+    q = {
+        "X-Amz-Algorithm": ["AWS4-HMAC-SHA256"],
+        "X-Amz-Credential": [f"{AK}/{future[:8]}/us-east-1/s3/aws4_request"],
+        "X-Amz-Date": [future],
+        "X-Amz-Expires": ["604800"],
+        "X-Amz-SignedHeaders": ["host"],
+    }
+    import hmac as hmac_mod
+    from minio_tpu.server.auth import (canonical_request, signing_key,
+                                       string_to_sign, UNSIGNED_PAYLOAD)
+    creq = canonical_request("GET", "/", q, {"host": host}, ["host"],
+                             UNSIGNED_PAYLOAD,
+                             drop_query=("X-Amz-Signature",))
+    scope = f"{future[:8]}/us-east-1/s3/aws4_request"
+    sts = string_to_sign(future, scope, creq)
+    key = signing_key(SK, future[:8], "us-east-1", "s3")
+    sig = hmac_mod.new(key, sts.encode(), hashlib.sha256).hexdigest()
+    q["X-Amz-Signature"] = [sig]
+    qs = "&".join(f"{k}={v[0]}" for k, v in q.items())
+    r = requests.get(srv.endpoint() + "/?" + qs)
+    assert r.status_code == 403, r.content

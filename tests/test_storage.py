@@ -117,9 +117,11 @@ def test_writer_commit_flow(disk):
     got = disk.read_version("bucket", "obj")
     assert got.version_id == fi.version_id
     assert got.size == 100
-    # inline read of small object
+    # part.N files hold bitrot-framed SHARD bytes, never object bytes —
+    # read_data must NOT opportunistically inline them (ADVICE r1 high);
+    # inline data comes only from xl.meta's Data section written at put.
     got = disk.read_version("bucket", "obj", read_data=True)
-    assert got.data == b"shard-bytes"
+    assert got.data is None
 
 
 def test_version_crud(disk):
