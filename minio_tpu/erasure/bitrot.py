@@ -9,11 +9,11 @@
 - **whole-file**: one digest over the whole shard, stored in xl.meta; file
   holds raw bytes (cmd/bitrot-whole.go).
 
-Algorithms: the reference's HighwayHash256/256S keyed hash will be served by
-the native C++ library once minio_tpu/native/highwayhash.cpp lands (until
-then those two enum members exist but .available is False and .new() raises);
-BLAKE2b-256 (hashlib) is the fallback/default. SHA256 and BLAKE2b-512
-complete the algorithm table (cmd/bitrot.go:33-44).
+Algorithms: HighwayHash256S (streaming) is the default, served by the native
+C++ library (minio_tpu/native/highwayhash.cpp) on the CPU paths and by the
+device kernel (minio_tpu/ops/hh_jax.py) in the fused verify+reconstruct
+launch; BLAKE2b-256 is the fallback when the native build is unavailable.
+SHA256 and BLAKE2b-512 complete the algorithm table (cmd/bitrot.go:33-44).
 """
 from __future__ import annotations
 
@@ -173,6 +173,33 @@ class StreamingBitrotReader:
         self.algo = algo
         self.shard_size = shard_size
         self.till_offset = till_offset  # logical end offset we may read to
+
+    @property
+    def fusable(self) -> bool:
+        """True when chunk digests can be verified on device in the fused
+        verify+reconstruct launch (minio_tpu.ops.fused): HighwayHash is the
+        only algorithm with a device kernel."""
+        return self.algo is BitrotAlgorithm.HIGHWAYHASH256S
+
+    def read_at_raw(self, offset: int, length: int) -> tuple[bytes, bytes]:
+        """Read ONE chunk's (digest, payload) without verifying — the fused
+        device path (ops/fused.py) checks the digest in the same launch as
+        the reconstruct. offset must be chunk-aligned and the read must not
+        span chunks."""
+        if offset % self.shard_size:
+            raise ValueError(f"unaligned bitrot read at {offset}")
+        if length > self.shard_size:
+            raise ValueError("raw bitrot read spans chunks")
+        if offset + length > self.till_offset:
+            raise errors.FileCorrupt(
+                f"bitrot read [{offset}, {offset + length}) past shard end "
+                f"{self.till_offset}")
+        h = self.algo.digest_size
+        phys = (offset // self.shard_size) * (self.shard_size + h)
+        blob = self.src.read_at(phys, h + length)
+        if len(blob) < h + length:
+            raise errors.FileCorrupt("short bitrot stream")
+        return blob[:h], blob[h: h + length]
 
     def read_at(self, offset: int, length: int) -> bytes:
         if length == 0:

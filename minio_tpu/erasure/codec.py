@@ -187,6 +187,62 @@ class Erasure:
             return [out[i][:true_len] for i in range(len(targets))]
         return _chain(fut, finish)
 
+    def rebuild_targets_verified_async(
+            self, shards: list[np.ndarray | None],
+            digests: list[bytes | None],
+            targets: tuple[int, ...]) -> Future:
+        """Fused bitrot-verify + rebuild (BASELINE config 4, the one-launch
+        replacement for cmd/bitrot-streaming.go verify-then-reconstruct):
+        like rebuild_targets_async, but each chosen source shard's
+        HighwayHash-256 digest is verified ON DEVICE in the same launch.
+
+        ``digests`` aligns with ``shards`` (32-byte digest per present
+        shard). Future resolves to (rebuilt list aligned with targets,
+        corrupt: tuple of global shard indices whose digests mismatched).
+        If corrupt is non-empty the rebuilt data is garbage — callers drop
+        those sources and retry (the reference's replacement-read pattern).
+        """
+        from ..erasure.bitrot import HIGHWAY_KEY
+        from ..runtime.dispatch import dispatch_enabled, global_queue
+        if len(targets) > self.parity_blocks:
+            raise ValueError(
+                f"{len(targets)} targets > parity {self.parity_blocks}: "
+                "unrecoverable")
+        aligned, true_len = self._aligned(shards)
+        present = tuple(i for i, s in enumerate(aligned)
+                        if s is not None)[: self.data_blocks]
+        if len(present) < self.data_blocks:
+            raise ValueError(
+                f"cannot rebuild: {len(present)} shards present, "
+                f"need {self.data_blocks}")
+        if not dispatch_enabled():
+            # MINIO_TPU_DISPATCH=0: verify on the CPU (native HighwayHash)
+            # and rebuild through the non-queued codec path
+            from ..native import highwayhash as hhn
+            corrupt = tuple(
+                i for i in present
+                if hhn.hash256(HIGHWAY_KEY,
+                               np.asarray(shards[i]).tobytes()) != digests[i])
+            if corrupt:
+                return _done(
+                    ([np.empty(0, np.uint8)] * len(targets), corrupt))
+            full = self.codec.reconstruct(aligned, data_only=False)
+            return _done(([full[t][:true_len] for t in targets], ()))
+        gathered = np.stack([aligned[i] for i in present])
+        digs = np.stack([np.frombuffer(digests[i], dtype=np.uint32)
+                         for i in present])
+        masks = self.codec.target_masks_np(present, tuple(targets))
+        fut = global_queue().fused(
+            self.codec, pack_shards(gathered), masks, digs, HIGHWAY_KEY)
+
+        def finish(res):
+            out_words, valid = res
+            corrupt = tuple(present[i] for i in np.nonzero(~valid)[0])
+            out = unpack_shards(out_words)
+            return ([out[i][:true_len] for i in range(len(targets))],
+                    corrupt)
+        return _chain(fut, finish)
+
     def decode_data_blocks_async(self, shards: list[np.ndarray | None]
                                  ) -> Future:
         """Async DecodeDataBlocks: missing data shards rebuilt on the
@@ -202,6 +258,26 @@ class Erasure:
             for t, arr in zip(missing, rebuilt):
                 out[t] = arr
             return out
+        return _chain(fut, finish)
+
+    def decode_data_blocks_verified_async(
+            self, shards: list[np.ndarray | None],
+            digests: list[bytes | None]) -> Future:
+        """Fused DecodeDataBlocks for degraded reads: missing data shards are
+        rebuilt AND every source shard's digest is verified in the same
+        launch. Future -> (shard list with data filled, corrupt indices)."""
+        missing = tuple(i for i in range(self.data_blocks)
+                        if shards[i] is None)
+        if not missing:
+            raise ValueError("verified decode is for degraded reads only")
+        fut = self.rebuild_targets_verified_async(shards, digests, missing)
+
+        def finish(res):
+            rebuilt, corrupt = res
+            out = list(shards)
+            for t, arr in zip(missing, rebuilt):
+                out[t] = arr
+            return out, corrupt
         return _chain(fut, finish)
 
     def decode_data_blocks(self, shards: list[np.ndarray | None]

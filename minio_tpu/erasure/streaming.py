@@ -131,17 +131,32 @@ class _ParallelReader:
         self.readers = list(readers)
         self.erasure = erasure
         self.errs: list[BaseException | None] = [None] * len(readers)
+        self.last_digests: list[bytes | None] = [None] * len(readers)
         for i, r in enumerate(self.readers):
             if r is None:
                 self.errs[i] = errors.DiskNotFound()
 
-    def read_block(self, shard_offset: int, shard_len: int
+    def fusable(self, shard_len: int) -> bool:
+        """True when this block's source digests can be verified on device
+        (fused verify+reconstruct): every live reader supports raw chunk
+        reads and the shard length is word-aligned for the device hash."""
+        if shard_len % 4:
+            return False
+        live = [r for r in self.readers if r is not None]
+        return bool(live) and all(
+            getattr(r, "fusable", False) for r in live)
+
+    def read_block(self, shard_offset: int, shard_len: int, raw: bool = False
                    ) -> list[np.ndarray | None]:
         """Return a k+m shard list with >= k filled entries or raise
-        ErasureReadQuorum."""
+        ErasureReadQuorum. With raw=True, chunk digests are NOT verified on
+        the CPU — they are collected into self.last_digests for the fused
+        device verify (cmd/bitrot-streaming.go:151's per-chunk CPU check
+        moved into the reconstruct launch)."""
         k = self.erasure.data_blocks
         n = len(self.readers)
         shards: list[np.ndarray | None] = [None] * n
+        digests: list[bytes | None] = [None] * n
         pending: dict[object, int] = {}  # future -> reader index
         next_idx = 0
 
@@ -152,8 +167,9 @@ class _ParallelReader:
                 next_idx += 1
                 if self.readers[i] is None:
                     continue
-                f = io_pool().submit(
-                    self.readers[i].read_at, shard_offset, shard_len)
+                fn = self.readers[i].read_at_raw if raw \
+                    else self.readers[i].read_at
+                f = io_pool().submit(fn, shard_offset, shard_len)
                 pending[f] = i
                 return True
             return False
@@ -171,6 +187,8 @@ class _ParallelReader:
                 i = pending.pop(f)
                 try:
                     data = f.result()
+                    if raw:
+                        digests[i], data = data
                     shards[i] = np.frombuffer(data, dtype=np.uint8)
                     done += 1
                 except Exception as e:  # noqa: BLE001
@@ -182,7 +200,16 @@ class _ParallelReader:
             err = errors.reduce_read_quorum_errs(
                 self.errs, errors.BASE_IGNORED_ERRS, k)
             raise err if err is not None else errors.ErasureReadQuorum()
+        self.last_digests = digests
         return shards
+
+    def drop_corrupt(self, corrupt: tuple[int, ...]) -> None:
+        """Mark sources whose device-verified digests mismatched as failed
+        so subsequent blocks use replacements (heal-on-read will see the
+        FileCorrupt votes in self.errs)."""
+        for i in corrupt:
+            self.errs[i] = errors.FileCorrupt("bitrot hash mismatch")
+            self.readers[i] = None
 
 
 def erasure_decode(erasure: Erasure, writer, readers: list, offset: int,
@@ -204,9 +231,20 @@ def erasure_decode(erasure: Erasure, writer, readers: list, offset: int,
     start_block = offset // bs
     end_block = (offset + length) // bs
 
-    def emit(fut, block_data_len, boff, blen):
-        shards = fut.result()
-        block = np.concatenate(shards[:k]).tobytes()[:block_data_len]
+    def emit(fut, block_data_len, boff, blen, retry):
+        res = fut.result()
+        if retry is not None:
+            blocks, corrupt = res
+            if corrupt:
+                # device caught a bitrot mismatch: the rebuilt data is
+                # garbage — drop the corrupt sources and redo this block
+                # through replacement reads (the reference's
+                # readTriggerCh-on-bitrot behavior)
+                preader.drop_corrupt(corrupt)
+                blocks = retry()
+        else:
+            blocks = res
+        block = np.concatenate(blocks[:k]).tobytes()[:block_data_len]
         writer.write(block[boff: boff + blen])
         stats.bytes_written += blen
 
@@ -223,9 +261,31 @@ def erasure_decode(erasure: Erasure, writer, readers: list, offset: int,
         if blen <= 0:
             break
         shard_len = ceil_div(block_data_len, k)
-        shards = preader.read_block(b * erasure.shard_size(), shard_len)
-        window.append((erasure.decode_data_blocks_async(shards),
-                       block_data_len, boff, blen))
+        shard_offset = b * erasure.shard_size()
+        # Degraded data read + device-hash-capable sources -> fused
+        # verify+reconstruct: one launch hashes every source shard AND
+        # rebuilds the missing ones (BASELINE config 4). Healthy streams
+        # keep the CPU per-chunk verify inside read_at (no rebuild launch
+        # to fuse into).
+        degraded = any(preader.readers[i] is None for i in range(k))
+        if degraded and preader.fusable(shard_len):
+            # a dead reader among the first k means read_block fills a
+            # replacement index instead, so >=1 data shard is always missing
+            # here and the rebuild launch is never wasted
+            shards = preader.read_block(shard_offset, shard_len, raw=True)
+            fut = erasure.decode_data_blocks_verified_async(
+                shards, preader.last_digests)
+
+            def mk_retry(so=shard_offset, sl=shard_len):
+                def retry():
+                    return erasure.decode_data_blocks(
+                        preader.read_block(so, sl))
+                return retry
+            window.append((fut, block_data_len, boff, blen, mk_retry()))
+        else:
+            shards = preader.read_block(shard_offset, shard_len)
+            window.append((erasure.decode_data_blocks_async(shards),
+                           block_data_len, boff, blen, None))
         if len(window) >= ENCODE_WINDOW:
             emit(*window.popleft())
     while window:
@@ -257,8 +317,15 @@ def erasure_heal(erasure: Erasure, writers: list, readers: list,
     preader = _ParallelReader(readers, erasure)
     n_blocks = ceil_div(total_length, bs)
 
-    def emit(fut):
-        rebuilt = fut.result()
+    def emit(fut, retry):
+        res = fut.result()
+        if retry is not None:
+            rebuilt, corrupt = res
+            if corrupt:
+                preader.drop_corrupt(corrupt)
+                rebuilt = retry()
+        else:
+            rebuilt = res
         errs: list[BaseException | None] = [None] * len(writers)
         wrote = 0
         for t, arr in zip(targets, rebuilt):
@@ -280,12 +347,29 @@ def erasure_heal(erasure: Erasure, writers: list, readers: list,
     for b in range(n_blocks):
         block_data_len = min(bs, total_length - b * bs)
         shard_len = ceil_div(block_data_len, k)
-        shards = preader.read_block(b * erasure.shard_size(), shard_len)
-        window.append(erasure.rebuild_targets_async(shards, targets))
+        shard_offset = b * erasure.shard_size()
+        if preader.fusable(shard_len):
+            # fused verify+rebuild: source digests checked in the same
+            # launch as the reconstruct (BASELINE config 4); a mismatch
+            # falls back to CPU-verified replacement reads for that block
+            shards = preader.read_block(shard_offset, shard_len, raw=True)
+            fut = erasure.rebuild_targets_verified_async(
+                shards, preader.last_digests, targets)
+
+            def mk_retry(so=shard_offset, sl=shard_len):
+                def retry():
+                    return erasure.rebuild_targets_async(
+                        preader.read_block(so, sl), targets).result()
+                return retry
+            window.append((fut, mk_retry()))
+        else:
+            shards = preader.read_block(shard_offset, shard_len)
+            window.append(
+                (erasure.rebuild_targets_async(shards, targets), None))
         if len(window) >= ENCODE_WINDOW:
-            emit(window.popleft())
+            emit(*window.popleft())
     while window:
-        emit(window.popleft())
+        emit(*window.popleft())
     for w in writers:
         if w is not None:
             w.close()

@@ -1,0 +1,179 @@
+// HighwayHash — portable C++ implementation of Google's keyed hash,
+// re-implemented from the published algorithm specification. This is the
+// TPU-build's native analogue of the reference's assembly-backed
+// minio/highwayhash module (SURVEY.md §2.10; used as the default streaming
+// bitrot algorithm HighwayHash256S, cmd/bitrot.go:33-51).
+//
+// Exposed C ABI (ctypes-consumed by minio_tpu.native):
+//   hh256(key, data, len, out32)         one-shot 256-bit digest
+//   hh256_batch(key, data, n, stride, len, out)  n independent chunks
+//   hh64(key, data, len) -> uint64       for the published test vectors
+//
+// The algorithm state is 16 u64 lanes (v0, v1, mul0, mul1 x 4); each
+// 32-byte packet runs adds, 32x32->64 multiplies and a byte "zipper merge";
+// finalization permutes + updates 10 more times (4 for the 64-bit tag) and
+// folds the state with a modular reduction.
+#include <cstdint>
+#include <cstring>
+#include <cstddef>
+
+namespace {
+
+struct State {
+  uint64_t v0[4];
+  uint64_t v1[4];
+  uint64_t mul0[4];
+  uint64_t mul1[4];
+};
+
+inline uint64_t Read64(const uint8_t* p) {
+  uint64_t v;
+  std::memcpy(&v, p, 8);  // little-endian hosts only (x86/arm LE)
+  return v;
+}
+
+inline void Reset(const uint64_t key[4], State* s) {
+  const uint64_t init0[4] = {0xdbe6d5d5fe4cce2full, 0xa4093822299f31d0ull,
+                             0x13198a2e03707344ull, 0x243f6a8885a308d3ull};
+  const uint64_t init1[4] = {0x3bd39e10cb0ef593ull, 0xc0acf169b5f18a8cull,
+                             0xbe5466cf34e90c6cull, 0x452821e638d01377ull};
+  for (int i = 0; i < 4; ++i) {
+    s->mul0[i] = init0[i];
+    s->mul1[i] = init1[i];
+    s->v0[i] = init0[i] ^ key[i];
+    s->v1[i] = init1[i] ^ ((key[i] >> 32) | (key[i] << 32));
+  }
+}
+
+inline void ZipperMergeAndAdd(const uint64_t v1, const uint64_t v0,
+                              uint64_t* add1, uint64_t* add0) {
+  *add0 += (((v0 & 0xff000000ull) | (v1 & 0xff00000000ull)) >> 24) |
+           (((v0 & 0xff0000000000ull) | (v1 & 0xff000000000000ull)) >> 16) |
+           (v0 & 0xff0000ull) | ((v0 & 0xff00ull) << 32) |
+           ((v1 & 0xff00000000000000ull) >> 8) | (v0 << 56);
+  *add1 += (((v1 & 0xff000000ull) | (v0 & 0xff00000000ull)) >> 24) |
+           (v1 & 0xff0000ull) | ((v1 & 0xff0000000000ull) >> 16) |
+           ((v1 & 0xff00ull) << 24) | ((v0 & 0xff000000000000ull) >> 8) |
+           ((v1 & 0xffull) << 48) | (v0 & 0xff00000000000000ull);
+}
+
+inline void Update(const uint64_t lanes[4], State* s) {
+  for (int i = 0; i < 4; ++i) {
+    s->v1[i] += s->mul0[i] + lanes[i];
+    s->mul0[i] ^= (s->v1[i] & 0xffffffffull) * (s->v0[i] >> 32);
+    s->v0[i] += s->mul1[i];
+    s->mul1[i] ^= (s->v0[i] & 0xffffffffull) * (s->v1[i] >> 32);
+  }
+  ZipperMergeAndAdd(s->v1[1], s->v1[0], &s->v0[1], &s->v0[0]);
+  ZipperMergeAndAdd(s->v1[3], s->v1[2], &s->v0[3], &s->v0[2]);
+  ZipperMergeAndAdd(s->v0[1], s->v0[0], &s->v1[1], &s->v1[0]);
+  ZipperMergeAndAdd(s->v0[3], s->v0[2], &s->v1[3], &s->v1[2]);
+}
+
+inline void UpdatePacket(const uint8_t* packet, State* s) {
+  uint64_t lanes[4] = {Read64(packet), Read64(packet + 8),
+                       Read64(packet + 16), Read64(packet + 24)};
+  Update(lanes, s);
+}
+
+inline void Rotate32By(const uint64_t count, uint64_t lanes[4]) {
+  // count is always in [1, 31] here (only called for non-empty remainders)
+  for (int i = 0; i < 4; ++i) {
+    uint32_t half0 = static_cast<uint32_t>(lanes[i] & 0xffffffffull);
+    uint32_t half1 = static_cast<uint32_t>(lanes[i] >> 32);
+    lanes[i] = static_cast<uint64_t>(
+        (half0 << count) | (half0 >> (32 - count)));
+    lanes[i] |= static_cast<uint64_t>(
+                    (half1 << count) | (half1 >> (32 - count)))
+                << 32;
+  }
+}
+
+inline void UpdateRemainder(const uint8_t* bytes, const size_t size_mod32,
+                            State* s) {
+  const size_t size_mod4 = size_mod32 & 3;
+  const uint8_t* remainder = bytes + (size_mod32 & ~3ull);
+  uint8_t packet[32] = {0};
+  for (int i = 0; i < 4; ++i)
+    s->v0[i] += (static_cast<uint64_t>(size_mod32) << 32) + size_mod32;
+  Rotate32By(size_mod32, s->v1);
+  for (size_t i = 0; i < (size_mod32 & ~3ull); ++i) packet[i] = bytes[i];
+  if (size_mod32 & 16) {
+    for (int i = 0; i < 4; ++i)
+      packet[28 + i] = remainder[i + size_mod4 - 4];
+  } else if (size_mod4) {
+    packet[16 + 0] = remainder[0];
+    packet[16 + 1] = remainder[size_mod4 >> 1];
+    packet[16 + 2] = remainder[size_mod4 - 1];
+  }
+  UpdatePacket(packet, s);
+}
+
+inline void ProcessAll(const uint64_t key[4], const uint8_t* data,
+                       size_t size, State* s) {
+  Reset(key, s);
+  size_t i = 0;
+  for (; i + 32 <= size; i += 32) UpdatePacket(data + i, s);
+  if (size & 31) UpdateRemainder(data + i, size & 31, s);
+}
+
+inline void Permute(const uint64_t v[4], uint64_t* permuted) {
+  permuted[0] = (v[2] >> 32) | (v[2] << 32);
+  permuted[1] = (v[3] >> 32) | (v[3] << 32);
+  permuted[2] = (v[0] >> 32) | (v[0] << 32);
+  permuted[3] = (v[1] >> 32) | (v[1] << 32);
+}
+
+inline void PermuteAndUpdate(State* s) {
+  uint64_t permuted[4];
+  Permute(s->v0, permuted);
+  Update(permuted, s);
+}
+
+inline void ModularReduction(uint64_t a3_unmasked, uint64_t a2, uint64_t a1,
+                             uint64_t a0, uint64_t* m1, uint64_t* m0) {
+  const uint64_t a3 = a3_unmasked & 0x3fffffffffffffffull;
+  *m1 = a1 ^ ((a3 << 1) | (a2 >> 63)) ^ ((a3 << 2) | (a2 >> 62));
+  *m0 = a0 ^ (a2 << 1) ^ (a2 << 2);
+}
+
+inline void Finalize256(State* s, uint64_t hash[4]) {
+  for (int i = 0; i < 10; ++i) PermuteAndUpdate(s);
+  ModularReduction(s->v1[1] + s->mul1[1], s->v1[0] + s->mul1[0],
+                   s->v0[1] + s->mul0[1], s->v0[0] + s->mul0[0], &hash[1],
+                   &hash[0]);
+  ModularReduction(s->v1[3] + s->mul1[3], s->v1[2] + s->mul1[2],
+                   s->v0[3] + s->mul0[3], s->v0[2] + s->mul0[2], &hash[3],
+                   &hash[2]);
+}
+
+}  // namespace
+
+extern "C" {
+
+void hh256(const uint64_t key[4], const uint8_t* data, long size,
+           uint8_t out[32]) {
+  State s;
+  ProcessAll(key, data, static_cast<size_t>(size), &s);
+  uint64_t hash[4];
+  Finalize256(&s, hash);
+  std::memcpy(out, hash, 32);
+}
+
+// Hash n independent chunks laid out with a fixed stride (chunk i starts at
+// data + i*stride, each `size` bytes); out receives n 32-byte digests.
+// Serves batched CPU verify and the bench's host baseline.
+void hh256_batch(const uint64_t key[4], const uint8_t* data, int n,
+                 long stride, long size, uint8_t* out) {
+  for (int i = 0; i < n; ++i)
+    hh256(key, data + static_cast<size_t>(i) * stride, size, out + i * 32);
+}
+
+uint64_t hh64(const uint64_t key[4], const uint8_t* data, long size) {
+  State s;
+  ProcessAll(key, data, static_cast<size_t>(size), &s);
+  for (int i = 0; i < 4; ++i) PermuteAndUpdate(&s);
+  return s.v0[0] + s.v1[0] + s.mul0[0] + s.mul1[0];
+}
+
+}  // extern "C"

@@ -1,0 +1,100 @@
+"""ctypes binding for the native HighwayHash library (highwayhash.cpp).
+
+Provides the hashlib-shaped ``HighwayHash256`` consumed by
+minio_tpu.erasure.bitrot (the HighwayHash256/256S algorithms of the
+reference's bitrot table, cmd/bitrot.go:33-51) plus batch helpers for the
+bench's CPU baseline.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import threading
+
+import numpy as np
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_LOCK = threading.Lock()
+_lib = None
+
+
+def load() -> ctypes.CDLL:
+    global _lib
+    with _LOCK:
+        if _lib is not None:
+            return _lib
+        from . import _compile, _BUILD
+        src = os.path.join(_DIR, "highwayhash.cpp")
+        out = os.path.join(_BUILD, "libhighwayhash.so")
+        if not os.path.exists(out) or \
+                os.path.getmtime(out) < os.path.getmtime(src):
+            _compile(src, out)
+        lib = ctypes.CDLL(out)
+        lib.hh256.argtypes = [ctypes.c_char_p, ctypes.c_char_p,
+                              ctypes.c_long, ctypes.c_char_p]
+        lib.hh256.restype = None
+        lib.hh256_batch.argtypes = [ctypes.c_char_p, ctypes.c_char_p,
+                                    ctypes.c_int, ctypes.c_long,
+                                    ctypes.c_long, ctypes.c_char_p]
+        lib.hh256_batch.restype = None
+        lib.hh64.argtypes = [ctypes.c_char_p, ctypes.c_char_p, ctypes.c_long]
+        lib.hh64.restype = ctypes.c_uint64
+        _lib = lib
+        return lib
+
+
+def hash256(key: bytes, data: bytes) -> bytes:
+    """One-shot 256-bit digest of ``data`` under the 32-byte ``key``."""
+    lib = load()
+    out = ctypes.create_string_buffer(32)
+    lib.hh256(key, bytes(data), len(data), out)
+    return out.raw
+
+
+def hash256_batch(key: bytes, chunks: np.ndarray) -> np.ndarray:
+    """Digest every row of a uint8 [n, L] array -> uint8 [n, 32]."""
+    lib = load()
+    chunks = np.ascontiguousarray(chunks, dtype=np.uint8)
+    n, L = chunks.shape
+    out = np.empty((n, 32), dtype=np.uint8)
+    lib.hh256_batch(key, chunks.ctypes.data_as(ctypes.c_char_p), n, L, L,
+                    out.ctypes.data_as(ctypes.c_char_p))
+    return out
+
+
+def hash64(key: bytes, data: bytes) -> int:
+    return load().hh64(key, bytes(data), len(data))
+
+
+class HighwayHash256:
+    """hashlib-shaped streaming wrapper: buffers updates, hashes once at
+    digest() (bitrot chunks are <= shard_size, so buffering is bounded)."""
+
+    digest_size = 32
+
+    def __init__(self, key: bytes):
+        if len(key) != 32:
+            raise ValueError("HighwayHash key must be 32 bytes")
+        load()  # fail here (availability probe), not on the first digest()
+        self._key = key
+        self._buf = bytearray()
+
+    def update(self, b: bytes) -> None:
+        self._buf += b
+
+    def digest(self) -> bytes:
+        return hash256(self._key, bytes(self._buf))
+
+    def hexdigest(self) -> str:
+        return self.digest().hex()
+
+
+#: Published HighwayHash64 test vectors (google/highwayhash, key
+#: 0x0706050403020100... and data bytes 0,1,2,...) — checked by the test
+#: suite to pin the transcription of the update/permute/finalize rounds.
+TEST_KEY = bytes(range(32))
+TEST_VECTORS_64 = [
+    0x907A56DE22C26E53, 0x7EAB43AAC7CDDD78, 0xB8D0569AB0B53D62,
+    0x5C6BEFAB8A463D80, 0xF205A46893007EDA, 0x2B8A1668E4A94541,
+    0xBD4CCC325BEFCA6F, 0x4D02AE1738F59482, 0xE1205108E55F3171,
+]

@@ -1,0 +1,45 @@
+"""Fused bitrot-verify + reconstruct: ONE device launch hashes every source
+shard (HighwayHash-256, hh_jax) AND rebuilds the requested target shards
+(bit-sliced GF(256), rs_jax/rs_pallas).
+
+This is BASELINE config 4 — the TPU-native replacement for the reference's
+streaming bitrot read path (cmd/bitrot-streaming.go:115-151), where every
+shard chunk is hashed on the CPU before the SIMD reconstruct. Here a
+degraded read or heal ships raw [digest][chunk] shard data to the device;
+hash verification of all k source shards and the GF(256) rebuild of up to m
+targets happen in the same XLA program, so corruption detection costs no
+extra launch and no host round-trip in the common (clean) case. The host
+inspects the returned validity mask and only re-dispatches when a digest
+actually mismatched (the reference handles bitrot the same way: an error
+return triggers replacement reads).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from . import hh_jax
+
+
+@functools.lru_cache(maxsize=64)
+def _jitted(key_words: tuple[int, ...], nbytes: int, backend_mm):
+    """Compile cache per (hash key, chunk bytes, matmul kernel)."""
+
+    def fused(masks, words, digests):
+        # words [B, k, W] uint32; masks [B, 8, m, k]; digests [B, k, 8]
+        computed = hh_jax.hash256_device_words(key_words, nbytes, words)
+        valid = jnp.all(computed == digests, axis=-1)  # [B, k] bool
+        out = backend_mm(masks, words)                  # [B, m, W]
+        return out, valid
+
+    return jax.jit(fused)
+
+
+def fused_rebuild(key: bytes, masks, words, digests, backend_mm):
+    """words uint32 [B,k,W] + per-element masks [B,8,m,k] + expected digests
+    uint32 [B,k,8] -> (rebuilt [B,m,W], valid bool [B,k]) in one launch."""
+    nbytes = int(words.shape[-1]) * 4
+    fn = _jitted(hh_jax._key_words(key), nbytes, backend_mm)
+    return fn(masks, words, digests)

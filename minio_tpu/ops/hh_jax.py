@@ -1,0 +1,281 @@
+"""HighwayHash-256 on device (jax.numpy), batched over chunks.
+
+This is the TPU half of the reference's streaming bitrot pipeline
+(HighwayHash256S, cmd/bitrot.go:51, cmd/bitrot-streaming.go:115-151): shard
+chunks are hashed in bulk on the VPU so a degraded read can verify every
+shard's digest AND reconstruct the missing shards in ONE device launch
+(BASELINE config 4) instead of hashing per-shard on the CPU.
+
+JAX on TPU has no uint64 (x64 disabled), so every 64-bit lane is a
+(lo, hi) uint32 pair: adds carry through a compare, the 32x32->64 multiply
+is done in 16-bit limbs, and the byte "zipper merge" becomes masked
+shifts across the halves. All shapes/loop counts are static per chunk
+length, so each (N, L) bucket compiles once; the packet loop is a
+lax.fori_loop, vectorized across the N chunks.
+
+Bit-for-bit identical to the native C++ (minio_tpu/native/highwayhash.cpp),
+which is pinned to the published test vectors.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_M16 = np.uint32(0xFFFF)
+
+# -- 64-bit helpers over (lo, hi) uint32 pairs --------------------------------
+
+
+def _add64(a, b):
+    lo = a[0] + b[0]
+    carry = (lo < a[0]).astype(jnp.uint32)
+    return lo, a[1] + b[1] + carry
+
+
+def _xor64(a, b):
+    return a[0] ^ b[0], a[1] ^ b[1]
+
+
+def _or64(a, b):
+    return a[0] | b[0], a[1] | b[1]
+
+
+def _and64c(a, c: int):
+    return (a[0] & np.uint32(c & 0xFFFFFFFF),
+            a[1] & np.uint32((c >> 32) & 0xFFFFFFFF))
+
+
+def _shr64(a, s: int):
+    lo, hi = a
+    if s == 0:
+        return a
+    if s < 32:
+        return (lo >> s) | (hi << (32 - s)), hi >> s
+    if s == 32:
+        return hi, jnp.zeros_like(hi)
+    return hi >> (s - 32), jnp.zeros_like(hi)
+
+
+def _shl64(a, s: int):
+    lo, hi = a
+    if s == 0:
+        return a
+    if s < 32:
+        return lo << s, (hi << s) | (lo >> (32 - s))
+    if s == 32:
+        return jnp.zeros_like(lo), lo
+    return jnp.zeros_like(lo), lo << (s - 32)
+
+
+def _mul32(a, b):
+    """uint32 x uint32 -> (lo, hi) exact 64-bit product via 16-bit limbs."""
+    a0, a1 = a & _M16, a >> 16
+    b0, b1 = b & _M16, b >> 16
+    ll = a0 * b0
+    lh = a0 * b1
+    hl = a1 * b0
+    hh = a1 * b1
+    cross = (ll >> 16) + (lh & _M16) + (hl & _M16)
+    lo = (cross << 16) | (ll & _M16)
+    hi = hh + (lh >> 16) + (hl >> 16) + (cross >> 16)
+    return lo, hi
+
+
+# -- HighwayHash state ops ----------------------------------------------------
+
+_INIT0 = (0xdbe6d5d5fe4cce2f, 0xa4093822299f31d0,
+          0x13198a2e03707344, 0x243f6a8885a308d3)
+_INIT1 = (0x3bd39e10cb0ef593, 0xc0acf169b5f18a8c,
+          0xbe5466cf34e90c6c, 0x452821e638d01377)
+
+
+def _const64(c: int, shape):
+    return (jnp.full(shape, np.uint32(c & 0xFFFFFFFF), jnp.uint32),
+            jnp.full(shape, np.uint32(c >> 32), jnp.uint32))
+
+
+def _zipper_merge_add(v1, v0, add1, add0):
+    t0 = _shr64(_or64(_and64c(v0, 0xff000000), _and64c(v1, 0xff00000000)), 24)
+    t0 = _or64(t0, _shr64(_or64(_and64c(v0, 0xff0000000000),
+                                _and64c(v1, 0xff000000000000)), 16))
+    t0 = _or64(t0, _and64c(v0, 0xff0000))
+    t0 = _or64(t0, _shl64(_and64c(v0, 0xff00), 32))
+    t0 = _or64(t0, _shr64(_and64c(v1, 0xff00000000000000), 8))
+    t0 = _or64(t0, _shl64(v0, 56))
+    t1 = _shr64(_or64(_and64c(v1, 0xff000000), _and64c(v0, 0xff00000000)), 24)
+    t1 = _or64(t1, _and64c(v1, 0xff0000))
+    t1 = _or64(t1, _shr64(_and64c(v1, 0xff0000000000), 16))
+    t1 = _or64(t1, _shl64(_and64c(v1, 0xff00), 24))
+    t1 = _or64(t1, _shr64(_and64c(v0, 0xff000000000000), 8))
+    t1 = _or64(t1, _shl64(_and64c(v1, 0xff), 48))
+    t1 = _or64(t1, _and64c(v0, 0xff00000000000000))
+    return _add64(add1, t1), _add64(add0, t0)
+
+
+def _update(lanes, st):
+    """lanes: list of 4 (lo,hi) pairs; st: dict v0/v1/mul0/mul1 -> list[4]."""
+    v0, v1, mul0, mul1 = st["v0"], st["v1"], st["mul0"], st["mul1"]
+    for i in range(4):
+        v1[i] = _add64(v1[i], _add64(mul0[i], lanes[i]))
+        # (v1 & 0xffffffff) * (v0 >> 32)
+        m = _mul32(v1[i][0], v0[i][1])
+        mul0[i] = _xor64(mul0[i], m)
+        v0[i] = _add64(v0[i], mul1[i])
+        m = _mul32(v0[i][0], v1[i][1])
+        mul1[i] = _xor64(mul1[i], m)
+    v0[1], v0[0] = _zipper_merge_add(v1[1], v1[0], v0[1], v0[0])
+    v0[3], v0[2] = _zipper_merge_add(v1[3], v1[2], v0[3], v0[2])
+    v1[1], v1[0] = _zipper_merge_add(v0[1], v0[0], v1[1], v1[0])
+    v1[3], v1[2] = _zipper_merge_add(v0[3], v0[2], v1[3], v1[2])
+
+
+def _rotate32by(count: int, lanes):
+    for i in range(4):
+        lo, hi = lanes[i]
+        lanes[i] = (((lo << count) | (lo >> (32 - count))),
+                    ((hi << count) | (hi >> (32 - count))))
+
+
+def _permute(v):
+    # (v >> 32) | (v << 32) per lane == swap halves; lane order 2,3,0,1
+    return [(v[2][1], v[2][0]), (v[3][1], v[3][0]),
+            (v[0][1], v[0][0]), (v[1][1], v[1][0])]
+
+
+def _modular_reduction(a3, a2, a1, a0):
+    a3 = _and64c(a3, 0x3fffffffffffffff)
+    m1 = _xor64(a1, _or64(_shl64(a3, 1), _shr64(a2, 63)))
+    m1 = _xor64(m1, _or64(_shl64(a3, 2), _shr64(a2, 62)))
+    m0 = _xor64(_xor64(a0, _shl64(a2, 1)), _shl64(a2, 2))
+    return m1, m0
+
+
+def _state_to_flat(st):
+    out = []
+    for g in ("v0", "v1", "mul0", "mul1"):
+        for p in st[g]:
+            out.extend(p)
+    return tuple(out)
+
+
+def _flat_to_state(flat):
+    st, idx = {}, 0
+    for g in ("v0", "v1", "mul0", "mul1"):
+        st[g] = []
+        for _ in range(4):
+            st[g].append((flat[idx], flat[idx + 1]))
+            idx += 2
+    return st
+
+
+def _hash256_impl(key_words: tuple[int, ...], nbytes: int,
+                  data32: jnp.ndarray) -> jnp.ndarray:
+    """data32 uint32 [N, ceil4(nbytes)/4] -> digests uint32 [N, 8].
+
+    nbytes is static; nbytes % 4 == 0 (erasure shard sizes are always
+    4-byte aligned), which removes the sub-word remainder branches of the
+    C implementation."""
+    if nbytes % 4:
+        raise ValueError("device HighwayHash needs 4-byte-aligned chunks")
+    N = data32.shape[0]
+    shape = (N,)
+    st = {"v0": [], "v1": [], "mul0": [], "mul1": []}
+    for i in range(4):
+        k = key_words[i]
+        krot = ((k >> 32) | (k << 32)) & 0xFFFFFFFFFFFFFFFF
+        st["mul0"].append(_const64(_INIT0[i], shape))
+        st["mul1"].append(_const64(_INIT1[i], shape))
+        st["v0"].append(_const64(_INIT0[i] ^ k, shape))
+        st["v1"].append(_const64(_INIT1[i] ^ krot, shape))
+
+    n_pkts = nbytes // 32
+    if n_pkts:
+        # [N, n_pkts, 8] -> [n_pkts, 8, N]: the loop slices contiguously
+        pkts = jnp.transpose(
+            data32[:, : n_pkts * 8].reshape(N, n_pkts, 8), (1, 2, 0))
+
+        def body(i, flat):
+            stl = _flat_to_state(flat)
+            w = jax.lax.dynamic_index_in_dim(pkts, i, axis=0,
+                                             keepdims=False)  # [8, N]
+            lanes = [(w[2 * j], w[2 * j + 1]) for j in range(4)]
+            _update(lanes, stl)
+            return _state_to_flat(stl)
+
+        st = _flat_to_state(jax.lax.fori_loop(
+            0, n_pkts, body, _state_to_flat(st)))
+
+    rem = nbytes & 31
+    if rem:
+        # static remainder (cmd of the C UpdateRemainder with size_mod4 == 0)
+        for i in range(4):
+            st["v0"][i] = _add64(st["v0"][i], _const64(
+                (rem << 32) + rem, shape))
+        _rotate32by(rem, st["v1"])
+        nwords = rem // 4
+        base = n_pkts * 8
+        words = [data32[:, base + w] for w in range(nwords)]
+        zero = jnp.zeros(shape, jnp.uint32)
+        packet = words + [zero] * (8 - nwords)
+        if rem & 16:
+            packet[7] = words[nwords - 1]  # last 4 tail bytes -> bytes 28-31
+        lanes = [(packet[2 * j], packet[2 * j + 1]) for j in range(4)]
+        _update(lanes, st)
+
+    # 10 finalize rounds as a fori_loop: keeping the compiled body to a
+    # single round bounds compile time — XLA:CPU's algebraic simplifier
+    # goes superlinear (minutes) on the unrolled 10-deep carry chains.
+    def fin_body(_, flat):
+        stl = _flat_to_state(flat)
+        _update(_permute(stl["v0"]), stl)
+        return _state_to_flat(stl)
+
+    st = _flat_to_state(jax.lax.fori_loop(0, 10, fin_body,
+                                          _state_to_flat(st)))
+
+    h1, h0 = _modular_reduction(
+        _add64(st["v1"][1], st["mul1"][1]), _add64(st["v1"][0], st["mul1"][0]),
+        _add64(st["v0"][1], st["mul0"][1]), _add64(st["v0"][0], st["mul0"][0]))
+    h3, h2 = _modular_reduction(
+        _add64(st["v1"][3], st["mul1"][3]), _add64(st["v1"][2], st["mul1"][2]),
+        _add64(st["v0"][3], st["mul0"][3]), _add64(st["v0"][2], st["mul0"][2]))
+    return jnp.stack([h0[0], h0[1], h1[0], h1[1],
+                      h2[0], h2[1], h3[0], h3[1]], axis=-1)
+
+
+def _key_words(key: bytes) -> tuple[int, ...]:
+    if len(key) != 32:
+        raise ValueError("HighwayHash key must be 32 bytes")
+    return tuple(int.from_bytes(key[8 * i: 8 * i + 8], "little")
+                 for i in range(4))
+
+
+@functools.lru_cache(maxsize=32)
+def _jitted(key_words: tuple[int, ...], nbytes: int):
+    return jax.jit(functools.partial(_hash256_impl, key_words, nbytes))
+
+
+def hash256_chunks(key: bytes, chunks: np.ndarray) -> np.ndarray:
+    """Hash every row of uint8 [N, L] -> digests uint8 [N, 32] on device."""
+    chunks = np.ascontiguousarray(chunks, dtype=np.uint8)
+    N, L = chunks.shape
+    out = _jitted(_key_words(key), L)(jnp.asarray(chunks.view(np.uint32)))
+    return np.asarray(out).view(np.uint8).reshape(N, 32)
+
+
+def hash256_device(key: bytes, nbytes: int, data32: jnp.ndarray):
+    """Traceable form for fusing into larger jitted programs: uint32
+    [..., W] -> uint32 [..., 8]."""
+    return hash256_device_words(_key_words(key), nbytes, data32)
+
+
+def hash256_device_words(key_words: tuple[int, ...], nbytes: int,
+                         data32: jnp.ndarray):
+    """hash256_device with the key pre-split into u64 words (hashable, for
+    jit-cache keys)."""
+    flat = data32.reshape(-1, data32.shape[-1])
+    dig = _hash256_impl(key_words, nbytes, flat)
+    return dig.reshape(data32.shape[:-1] + (8,))

@@ -43,14 +43,16 @@ def dispatch_enabled() -> bool:
 class _Pending:
     words: np.ndarray            # [k, W] packed input shards
     masks: np.ndarray | None     # [8, o, k] per-element masks (rebuild only)
+    digests: np.ndarray | None = None  # [k, 8] expected digests (fused only)
     future: Future = field(default_factory=Future)
     t: float = field(default_factory=time.monotonic)
 
 
 class _Bucket:
-    def __init__(self, codec, op: str):
+    def __init__(self, codec, op: str, hash_key: bytes | None = None):
         self.codec = codec
-        self.op = op  # 'encode' | 'rebuild'
+        self.op = op  # 'encode' | 'masked' | 'fused'
+        self.hash_key = hash_key
         self.items: list[_Pending] = []
 
 
@@ -96,12 +98,23 @@ class DispatchQueue:
         key = ("masked", codec.k, masks.shape[1], words.shape[-1])
         return self._submit(key, codec, "masked", words, masks)
 
-    def _submit(self, key, codec, op, words, masks) -> Future:
-        p = _Pending(words=words, masks=masks)
+    def fused(self, codec, words: np.ndarray, masks: np.ndarray,
+              digests: np.ndarray, hash_key: bytes) -> Future:
+        """Fused bitrot-verify + rebuild (BASELINE config 4): like masked()
+        but the launch also HighwayHash-verifies each of the k source shards
+        against ``digests`` uint32 [k, 8]. Future resolves to
+        (out_words [o, W], valid bool [k])."""
+        key = ("fused", codec.k, masks.shape[1], words.shape[-1], hash_key)
+        return self._submit(key, codec, "fused", words, masks,
+                            digests=digests, hash_key=hash_key)
+
+    def _submit(self, key, codec, op, words, masks, digests=None,
+                hash_key=None) -> Future:
+        p = _Pending(words=words, masks=masks, digests=digests)
         with self._cv:
             b = self._buckets.get(key)
             if b is None:
-                b = self._buckets[key] = _Bucket(codec, op)
+                b = self._buckets[key] = _Bucket(codec, op, hash_key)
             b.items.append(p)
             self._cv.notify()
         return p.future
@@ -166,20 +179,35 @@ class DispatchQueue:
         self.items += n
         if b.op == "encode":
             out_dev = b.codec._mm_batch(b.codec._enc_masks, jnp.asarray(stack))
-        else:  # 'masked'
+        elif b.op == "masked":
             masks = np.stack([p.masks for p in items] +
                              [items[0].masks] * (bsz - n))
             out_dev = b.codec._mm_batch_per(jnp.asarray(masks),
                                             jnp.asarray(stack))
+        else:  # 'fused': verify source digests + rebuild in one launch
+            from ..ops.fused import fused_rebuild
+            masks = np.stack([p.masks for p in items] +
+                             [items[0].masks] * (bsz - n))
+            digs = np.stack([p.digests for p in items] +
+                            [items[0].digests] * (bsz - n))
+            out_dev = fused_rebuild(
+                b.hash_key, jnp.asarray(masks), jnp.asarray(stack),
+                jnp.asarray(digs), b.codec._mm_batch_per)
         # hand host readback to a completer so the next batch launches now
-        self._completers.submit(self._complete, out_dev, items)
+        self._completers.submit(self._complete, b.op, out_dev, items)
 
     @staticmethod
-    def _complete(out_dev, items: list[_Pending]):
+    def _complete(op: str, out_dev, items: list[_Pending]):
         try:
-            out = np.asarray(out_dev)
-            for i, p in enumerate(items):
-                p.future.set_result(out[i])
+            if op == "fused":
+                out = np.asarray(out_dev[0])
+                valid = np.asarray(out_dev[1])
+                for i, p in enumerate(items):
+                    p.future.set_result((out[i], valid[i]))
+            else:
+                out = np.asarray(out_dev)
+                for i, p in enumerate(items):
+                    p.future.set_result(out[i])
         except Exception as e:  # noqa: BLE001
             for p in items:
                 if not p.future.done():

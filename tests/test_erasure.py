@@ -210,3 +210,106 @@ def test_streaming_bitrot_layout():
     assert r.read_at(1024, 1476) == payload[1024:]
     with pytest.raises(ValueError):
         r.read_at(100, 10)  # unaligned
+
+
+# --- HighwayHash + fused verify/reconstruct (BASELINE config 4) --------------
+
+HH = BitrotAlgorithm.HIGHWAYHASH256S
+
+
+def test_highwayhash_test_vectors():
+    """Native HighwayHash pinned to the published 64-bit vectors, and the
+    device (JAX) kernel bit-identical to it across packet/remainder paths."""
+    from minio_tpu.native import highwayhash as hhn
+    from minio_tpu.ops import hh_jax
+    data = bytes(range(64))
+    for size, want in enumerate(hhn.TEST_VECTORS_64):
+        assert hhn.hash64(hhn.TEST_KEY, data[:size]) == want, size
+    rng = np.random.default_rng(3)
+    for L in (4, 28, 32, 36, 1024, 4096):
+        chunks = rng.integers(0, 256, size=(2, L), dtype=np.uint8)
+        assert np.array_equal(hh_jax.hash256_chunks(hhn.TEST_KEY, chunks),
+                              hhn.hash256_batch(hhn.TEST_KEY, chunks))
+
+
+def test_highwayhash_is_default_and_streaming():
+    from minio_tpu.erasure.bitrot import DEFAULT_BITROT_ALGO
+    assert DEFAULT_BITROT_ALGO is HH
+    assert HH.streaming and HH.available and HH.digest_size == 32
+
+
+def encode_hh(k, m, block_size, data):
+    er = Erasure(k, m, block_size)
+    sinks = [BufferSink() for _ in range(k + m)]
+    writers = [new_bitrot_writer(sinks[i], HH, er.shard_size())
+               for i in range(k + m)]
+    n = erasure_encode(er, io.BytesIO(data), writers, k + 1 if k == m else k)
+    assert n == len(data)
+    for w in writers:
+        w.close()
+    return er, sinks
+
+
+def hh_readers(er, sinks, size, dead=(), corrupt=()):
+    sfs = er.shard_file_size(size)
+    out = []
+    for i, s in enumerate(sinks):
+        if i in dead:
+            out.append(None)
+            continue
+        blob = bytearray(s.getvalue())
+        if i in corrupt:
+            blob[len(blob) // 2] ^= 0xFF
+        out.append(new_bitrot_reader(BufferSource(bytes(blob)), HH, sfs,
+                                     er.shard_size()))
+    return out
+
+
+def test_fused_degraded_decode():
+    """Degraded GET rides the fused device verify+reconstruct launch."""
+    data = rng_bytes((2 << 20) + 777, seed=11)
+    er, sinks = encode_hh(4, 2, 1 << 20, data)
+    out = io.BytesIO()
+    erasure_decode(er, out, hh_readers(er, sinks, len(data), dead=(0, 2)),
+                   0, len(data), len(data))
+    assert out.getvalue() == data
+
+
+def test_fused_decode_detects_corruption_and_retries():
+    data = rng_bytes(2 << 20, seed=12)
+    er, sinks = encode_hh(4, 2, 1 << 20, data)
+    readers = hh_readers(er, sinks, len(data), dead=(0,), corrupt=(1,))
+    out = io.BytesIO()
+    stats = erasure_decode(er, out, readers, 0, len(data), len(data))
+    assert out.getvalue() == data
+    # the corrupt source must carry a FileCorrupt vote for heal-on-read
+    assert any(isinstance(e, errors.FileCorrupt) for e in stats.errs)
+
+
+def test_fused_heal_roundtrip_and_corruption():
+    data = rng_bytes((3 << 20) + 12345, seed=13)
+    er, sinks = encode_hh(16, 4, 1 << 20, data)
+    # heal shards 0 and 19 while source 3 is corrupted
+    targets = (0, 19)
+    healed = {t: BufferSink() for t in targets}
+    writers = [new_bitrot_writer(healed[i], HH, er.shard_size())
+               if i in targets else None for i in range(20)]
+    erasure_heal(er, writers,
+                 hh_readers(er, sinks, len(data), dead=targets, corrupt=(3,)),
+                 len(data))
+    for t in targets:
+        assert healed[t].getvalue() == sinks[t].getvalue(), t
+
+
+def test_raw_read_contract():
+    er, sinks = encode_hh(4, 2, 1 << 20, rng_bytes(1 << 20, seed=14))
+    r = hh_readers(er, sinks, 1 << 20, dead=())[0]
+    assert r.fusable
+    dig, chunk = r.read_at_raw(0, er.shard_size())
+    h = HH.new()
+    h.update(chunk)
+    assert h.digest() == dig
+    with pytest.raises(ValueError):
+        r.read_at_raw(1, 8)  # unaligned
+    with pytest.raises(ValueError):
+        r.read_at_raw(0, er.shard_size() + 4)  # spans chunks
