@@ -19,9 +19,42 @@ from __future__ import annotations
 
 import enum
 import hashlib
+import os
 from dataclasses import dataclass
 
 from ..utils import errors
+
+#: xl.meta key recording the streaming-bitrot chunk size an object was
+#: written with (readers must use the same chunking to find the digests).
+BITROT_CHUNK_KEY = "x-minio-internal-bitrot-chunk"
+
+#: Default streaming chunk. The reference uses the erasure shard size
+#: (cmd/erasure-coding.go:115); we default to 16 KiB because the device
+#: hash is lane-parallel ACROSS chunks and sequential within one, so finer
+#: chunks widen the VPU batch for fused verify+reconstruct. Override with
+#: MINIO_TPU_BITROT_CHUNK (parsed once; malformed values fall back).
+DEFAULT_BITROT_CHUNK = 16384
+
+
+def _env_chunk() -> int:
+    try:
+        return int(os.environ.get("MINIO_TPU_BITROT_CHUNK",
+                                  str(DEFAULT_BITROT_CHUNK)).strip())
+    except ValueError:
+        return DEFAULT_BITROT_CHUNK
+
+
+_CONFIGURED_CHUNK = _env_chunk()
+
+
+def pick_bitrot_chunk(shard_size: int) -> int:
+    """Streaming chunk size for a new object with the given erasure shard
+    size: the configured default when it divides the shard (so block reads
+    stay chunk-aligned), else the shard size itself."""
+    c = _CONFIGURED_CHUNK
+    if c > 0 and shard_size % c == 0:
+        return c
+    return shard_size
 
 #: The reference's fixed HighwayHash key (cmd/bitrot.go:31) is a magic
 #: constant; we use our own framework-wide key (any fixed key works — the
@@ -182,24 +215,34 @@ class StreamingBitrotReader:
         return self.algo is BitrotAlgorithm.HIGHWAYHASH256S
 
     def read_at_raw(self, offset: int, length: int) -> tuple[bytes, bytes]:
-        """Read ONE chunk's (digest, payload) without verifying — the fused
-        device path (ops/fused.py) checks the digest in the same launch as
-        the reconstruct. offset must be chunk-aligned and the read must not
-        span chunks."""
+        """Read (digests, payload) without verifying — the fused device path
+        (ops/fused.py) checks the digests in the same launch as the
+        reconstruct. offset must be chunk-aligned; ``digests`` is the
+        concatenation of the per-chunk digests covering the read (all chunks
+        full-size except possibly the last)."""
         if offset % self.shard_size:
             raise ValueError(f"unaligned bitrot read at {offset}")
-        if length > self.shard_size:
-            raise ValueError("raw bitrot read spans chunks")
         if offset + length > self.till_offset:
             raise errors.FileCorrupt(
                 f"bitrot read [{offset}, {offset + length}) past shard end "
                 f"{self.till_offset}")
         h = self.algo.digest_size
+        n_chunks = -(-length // self.shard_size) if length else 0
         phys = (offset // self.shard_size) * (self.shard_size + h)
-        blob = self.src.read_at(phys, h + length)
-        if len(blob) < h + length:
+        blob = self.src.read_at(phys, n_chunks * h + length)
+        if len(blob) < n_chunks * h + length:
             raise errors.FileCorrupt("short bitrot stream")
-        return blob[:h], blob[h: h + length]
+        digests = bytearray()
+        payload = bytearray()
+        pos = 0
+        left = length
+        while left > 0:
+            clen = min(self.shard_size, left)
+            digests += blob[pos: pos + h]
+            payload += blob[pos + h: pos + h + clen]
+            pos += h + clen
+            left -= clen
+        return bytes(digests), bytes(payload)
 
     def read_at(self, offset: int, length: int) -> bytes:
         if length == 0:
@@ -210,25 +253,29 @@ class StreamingBitrotReader:
             raise errors.FileCorrupt(
                 f"bitrot read [{offset}, {offset + length}) past shard end "
                 f"{self.till_offset}")
+        # ONE backing read for the whole span (a chunk-per-call loop would
+        # turn a block read into n_chunks IO round-trips — ruinous when the
+        # source is a remote-disk RPC), then verify chunk by chunk.
         h = self.algo.digest_size
+        n_chunks = -(-length // self.shard_size)
+        phys = (offset // self.shard_size) * (self.shard_size + h)
+        blob = self.src.read_at(phys, n_chunks * h + length)
         out = bytearray()
-        while length > 0:
-            chunk_len = min(self.shard_size, length)
-            phys = (offset // self.shard_size) * (self.shard_size + h) \
-                + (offset % self.shard_size)
-            blob = self.src.read_at(phys, h + chunk_len)
-            if len(blob) < h:
+        pos = 0
+        left = length
+        while left > 0:
+            chunk_len = min(self.shard_size, left)
+            digest = blob[pos: pos + h]
+            chunk = blob[pos + h: pos + h + chunk_len]
+            if len(digest) < h or len(chunk) < chunk_len:
                 raise errors.FileCorrupt("short bitrot stream")
-            digest, chunk = blob[:h], blob[h: h + chunk_len]
-            if len(chunk) < chunk_len:
-                raise errors.FileCorrupt("short bitrot chunk")
             hh = self.algo.new()
             hh.update(chunk)
             if hh.digest() != digest:
                 raise errors.FileCorrupt("bitrot hash mismatch")
             out += chunk
-            offset += chunk_len
-            length -= chunk_len
+            pos += h + chunk_len
+            left -= chunk_len
         return bytes(out)
 
 

@@ -190,17 +190,21 @@ class Erasure:
     def rebuild_targets_verified_async(
             self, shards: list[np.ndarray | None],
             digests: list[bytes | None],
-            targets: tuple[int, ...]) -> Future:
+            targets: tuple[int, ...],
+            chunk_size: int) -> Future:
         """Fused bitrot-verify + rebuild (BASELINE config 4, the one-launch
         replacement for cmd/bitrot-streaming.go verify-then-reconstruct):
         like rebuild_targets_async, but each chosen source shard's
-        HighwayHash-256 digest is verified ON DEVICE in the same launch.
+        per-chunk HighwayHash-256 digests are verified ON DEVICE in the
+        same launch.
 
-        ``digests`` aligns with ``shards`` (32-byte digest per present
-        shard). Future resolves to (rebuilt list aligned with targets,
-        corrupt: tuple of global shard indices whose digests mismatched).
-        If corrupt is non-empty the rebuilt data is garbage — callers drop
-        those sources and retry (the reference's replacement-read pattern).
+        ``digests`` aligns with ``shards``: the concatenated 32-byte
+        digests of the shard's ``chunk_size`` chunks (shard length must be
+        a chunk multiple — callers gate via _ParallelReader.fusable).
+        Future resolves to (rebuilt list aligned with targets, corrupt:
+        tuple of global shard indices whose digests mismatched). If corrupt
+        is non-empty the rebuilt data is garbage — callers drop those
+        sources and retry (the reference's replacement-read pattern).
         """
         from ..erasure.bitrot import HIGHWAY_KEY
         from ..runtime.dispatch import dispatch_enabled, global_queue
@@ -209,6 +213,8 @@ class Erasure:
                 f"{len(targets)} targets > parity {self.parity_blocks}: "
                 "unrecoverable")
         aligned, true_len = self._aligned(shards)
+        if true_len % chunk_size:
+            raise ValueError("shard length is not a bitrot-chunk multiple")
         present = tuple(i for i, s in enumerate(aligned)
                         if s is not None)[: self.data_blocks]
         if len(present) < self.data_blocks:
@@ -221,8 +227,10 @@ class Erasure:
             from ..native import highwayhash as hhn
             corrupt = tuple(
                 i for i in present
-                if hhn.hash256(HIGHWAY_KEY,
-                               np.asarray(shards[i]).tobytes()) != digests[i])
+                if hhn.hash256_batch(
+                    HIGHWAY_KEY,
+                    np.asarray(shards[i]).reshape(-1, chunk_size)
+                ).tobytes() != digests[i])
             if corrupt:
                 return _done(
                     ([np.empty(0, np.uint8)] * len(targets), corrupt))
@@ -230,10 +238,11 @@ class Erasure:
             return _done(([full[t][:true_len] for t in targets], ()))
         gathered = np.stack([aligned[i] for i in present])
         digs = np.stack([np.frombuffer(digests[i], dtype=np.uint32)
-                         for i in present])
+                         for i in present])  # [k, nc*8]
         masks = self.codec.target_masks_np(present, tuple(targets))
         fut = global_queue().fused(
-            self.codec, pack_shards(gathered), masks, digs, HIGHWAY_KEY)
+            self.codec, pack_shards(gathered), masks, digs, HIGHWAY_KEY,
+            chunk_size)
 
         def finish(res):
             out_words, valid = res
@@ -262,7 +271,7 @@ class Erasure:
 
     def decode_data_blocks_verified_async(
             self, shards: list[np.ndarray | None],
-            digests: list[bytes | None]) -> Future:
+            digests: list[bytes | None], chunk_size: int) -> Future:
         """Fused DecodeDataBlocks for degraded reads: missing data shards are
         rebuilt AND every source shard's digest is verified in the same
         launch. Future -> (shard list with data filled, corrupt indices)."""
@@ -270,7 +279,8 @@ class Erasure:
                         if shards[i] is None)
         if not missing:
             raise ValueError("verified decode is for degraded reads only")
-        fut = self.rebuild_targets_verified_async(shards, digests, missing)
+        fut = self.rebuild_targets_verified_async(shards, digests, missing,
+                                                  chunk_size)
 
         def finish(res):
             rebuilt, corrupt = res

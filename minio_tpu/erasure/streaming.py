@@ -139,12 +139,19 @@ class _ParallelReader:
     def fusable(self, shard_len: int) -> bool:
         """True when this block's source digests can be verified on device
         (fused verify+reconstruct): every live reader supports raw chunk
-        reads and the shard length is word-aligned for the device hash."""
-        if shard_len % 4:
-            return False
+        reads, all share one bitrot chunk size, and the read covers whole
+        word-aligned chunks (tail blocks fall back to the CPU verify)."""
         live = [r for r in self.readers if r is not None]
-        return bool(live) and all(
-            getattr(r, "fusable", False) for r in live)
+        if not live or not all(getattr(r, "fusable", False) for r in live):
+            return False
+        chunks = {r.shard_size for r in live}
+        if len(chunks) != 1:
+            return False
+        (c,) = chunks
+        return shard_len > 0 and c % 4 == 0 and shard_len % c == 0
+
+    def fuse_chunk(self) -> int:
+        return next(r.shard_size for r in self.readers if r is not None)
 
     def read_block(self, shard_offset: int, shard_len: int, raw: bool = False
                    ) -> list[np.ndarray | None]:
@@ -231,65 +238,75 @@ def erasure_decode(erasure: Erasure, writer, readers: list, offset: int,
     start_block = offset // bs
     end_block = (offset + length) // bs
 
-    def emit(fut, block_data_len, boff, blen, retry):
-        res = fut.result()
-        if retry is not None:
-            blocks, corrupt = res
-            if corrupt:
-                # device caught a bitrot mismatch: the rebuilt data is
-                # garbage — drop the corrupt sources and redo this block
-                # through replacement reads (the reference's
-                # readTriggerCh-on-bitrot behavior)
-                preader.drop_corrupt(corrupt)
-                blocks = retry()
-        else:
-            blocks = res
-        block = np.concatenate(blocks[:k]).tobytes()[:block_data_len]
-        writer.write(block[boff: boff + blen])
-        stats.bytes_written += blen
-
     window: deque = deque()
-    for b in range(start_block, end_block + 1):
+
+    def submit(b: int):
+        """Read block b's shards and return a window entry, or None when
+        the block contributes no bytes to the requested range."""
         block_data_len = min(bs, total_length - b * bs)
         if block_data_len <= 0:
-            break
+            return None
         boff = offset % bs if b == start_block else 0
         if b == end_block:
             blen = (offset + length) - b * bs - boff
         else:
             blen = block_data_len - boff
         if blen <= 0:
-            break
+            return None
         shard_len = ceil_div(block_data_len, k)
         shard_offset = b * erasure.shard_size()
         # Degraded data read + device-hash-capable sources -> fused
         # verify+reconstruct: one launch hashes every source shard AND
         # rebuilds the missing ones (BASELINE config 4). Healthy streams
         # keep the CPU per-chunk verify inside read_at (no rebuild launch
-        # to fuse into).
+        # to fuse into). A dead reader among the first k means read_block
+        # fills a replacement index instead, so >=1 data shard is always
+        # missing in the fused case and the rebuild is never wasted.
         degraded = any(preader.readers[i] is None for i in range(k))
         if degraded and preader.fusable(shard_len):
-            # a dead reader among the first k means read_block fills a
-            # replacement index instead, so >=1 data shard is always missing
-            # here and the rebuild launch is never wasted
             shards = preader.read_block(shard_offset, shard_len, raw=True)
             fut = erasure.decode_data_blocks_verified_async(
-                shards, preader.last_digests)
+                shards, preader.last_digests, preader.fuse_chunk())
+            return ["fused", fut, b, block_data_len, boff, blen]
+        shards = preader.read_block(shard_offset, shard_len)
+        return ["plain", erasure.decode_data_blocks_async(shards), b,
+                block_data_len, boff, blen]
 
-            def mk_retry(so=shard_offset, sl=shard_len):
-                def retry():
-                    return erasure.decode_data_blocks(
-                        preader.read_block(so, sl))
-                return retry
-            window.append((fut, block_data_len, boff, blen, mk_retry()))
+    def emit(entry):
+        kind, fut, b, block_data_len, boff, blen = entry
+        res = fut.result()
+        if kind == "fused":
+            blocks, corrupt = res
+            if corrupt:
+                # device caught a bitrot mismatch: the rebuilt data is
+                # garbage — drop the corrupt sources, redo this block via
+                # CPU-verified replacement reads, then RESUBMIT the pending
+                # fused entries (their raw reads also carried the corrupt
+                # shard) so the pipeline recovers in one batch instead of
+                # stalling block by block (the reference's
+                # readTriggerCh-on-bitrot behavior)
+                preader.drop_corrupt(corrupt)
+                blocks = erasure.decode_data_blocks(preader.read_block(
+                    b * erasure.shard_size(), ceil_div(block_data_len, k)))
+                pending = list(window)
+                window.clear()
+                for e in pending:
+                    window.append(e if e[0] == "plain" else submit(e[2]))
         else:
-            shards = preader.read_block(shard_offset, shard_len)
-            window.append((erasure.decode_data_blocks_async(shards),
-                           block_data_len, boff, blen, None))
+            blocks = res
+        block = np.concatenate(blocks[:k]).tobytes()[:block_data_len]
+        writer.write(block[boff: boff + blen])
+        stats.bytes_written += blen
+
+    for b in range(start_block, end_block + 1):
+        entry = submit(b)
+        if entry is None:
+            break
+        window.append(entry)
         if len(window) >= ENCODE_WINDOW:
-            emit(*window.popleft())
+            emit(window.popleft())
     while window:
-        emit(*window.popleft())
+        emit(window.popleft())
     return stats
 
 
@@ -317,13 +334,42 @@ def erasure_heal(erasure: Erasure, writers: list, readers: list,
     preader = _ParallelReader(readers, erasure)
     n_blocks = ceil_div(total_length, bs)
 
-    def emit(fut, retry):
+    window: deque = deque()
+
+    def submit(b: int):
+        block_data_len = min(bs, total_length - b * bs)
+        shard_len = ceil_div(block_data_len, k)
+        shard_offset = b * erasure.shard_size()
+        if preader.fusable(shard_len):
+            # fused verify+rebuild: source digests checked in the same
+            # launch as the reconstruct (BASELINE config 4); a mismatch
+            # falls back to CPU-verified replacement reads for that block
+            shards = preader.read_block(shard_offset, shard_len, raw=True)
+            fut = erasure.rebuild_targets_verified_async(
+                shards, preader.last_digests, targets, preader.fuse_chunk())
+            return ["fused", fut, b]
+        shards = preader.read_block(shard_offset, shard_len)
+        return ["plain", erasure.rebuild_targets_async(shards, targets), b]
+
+    def emit(entry):
+        kind, fut, b = entry
         res = fut.result()
-        if retry is not None:
+        if kind == "fused":
             rebuilt, corrupt = res
             if corrupt:
+                # drop corrupt sources, redo this block via CPU-verified
+                # replacement reads, resubmit the pending fused window
+                # (its raw reads also carried the corrupt shard)
                 preader.drop_corrupt(corrupt)
-                rebuilt = retry()
+                block_data_len = min(bs, total_length - b * bs)
+                rebuilt = erasure.rebuild_targets_async(
+                    preader.read_block(b * erasure.shard_size(),
+                                       ceil_div(block_data_len, k)),
+                    targets).result()
+                pending = list(window)
+                window.clear()
+                for e in pending:
+                    window.append(e if e[0] == "plain" else submit(e[2]))
         else:
             rebuilt = res
         errs: list[BaseException | None] = [None] * len(writers)
@@ -343,33 +389,12 @@ def erasure_heal(erasure: Erasure, writers: list, readers: list,
                 errs, errors.BASE_IGNORED_ERRS, 1)
             raise err if err is not None else errors.ErasureWriteQuorum()
 
-    window: deque = deque()
     for b in range(n_blocks):
-        block_data_len = min(bs, total_length - b * bs)
-        shard_len = ceil_div(block_data_len, k)
-        shard_offset = b * erasure.shard_size()
-        if preader.fusable(shard_len):
-            # fused verify+rebuild: source digests checked in the same
-            # launch as the reconstruct (BASELINE config 4); a mismatch
-            # falls back to CPU-verified replacement reads for that block
-            shards = preader.read_block(shard_offset, shard_len, raw=True)
-            fut = erasure.rebuild_targets_verified_async(
-                shards, preader.last_digests, targets)
-
-            def mk_retry(so=shard_offset, sl=shard_len):
-                def retry():
-                    return erasure.rebuild_targets_async(
-                        preader.read_block(so, sl), targets).result()
-                return retry
-            window.append((fut, mk_retry()))
-        else:
-            shards = preader.read_block(shard_offset, shard_len)
-            window.append(
-                (erasure.rebuild_targets_async(shards, targets), None))
+        window.append(submit(b))
         if len(window) >= ENCODE_WINDOW:
-            emit(*window.popleft())
+            emit(window.popleft())
     while window:
-        emit(*window.popleft())
+        emit(window.popleft())
     for w in writers:
         if w is not None:
             w.close()

@@ -15,7 +15,8 @@ from dataclasses import replace
 
 from ..erasure import (DEFAULT_BITROT_ALGO, Erasure, new_bitrot_reader,
                        new_bitrot_writer)
-from ..erasure.bitrot import BitrotAlgorithm, bitrot_shard_file_size
+from ..erasure.bitrot import (BITROT_CHUNK_KEY, BitrotAlgorithm,
+                              pick_bitrot_chunk)
 from ..erasure.codec import ceil_div
 from ..erasure.streaming import erasure_decode, erasure_encode, erasure_heal
 from ..storage.datatypes import ErasureInfo, FileInfo, ObjectPartInfo
@@ -243,7 +244,7 @@ class ErasureObjects(MultipartMixin, ObjectLayer):
             mod_time=opts.mod_time or FileInfo.now())
         distribution = hash_order(f"{bucket}/{object}", n)
         er = Erasure(data, parity, self.block_size)
-        shard_size = er.shard_size()
+        bitrot_chunk = pick_bitrot_chunk(er.shard_size())
 
         hr = stream if isinstance(stream, HashReader) else \
             HashReader(stream, size)
@@ -258,7 +259,7 @@ class ErasureObjects(MultipartMixin, ObjectLayer):
                 sink = d.create_file_writer(
                     META_TMP, f"{tmp_id}/{fi.data_dir}/part.1")
                 writers.append(new_bitrot_writer(
-                    sink, self.bitrot_algo, shard_size))
+                    sink, self.bitrot_algo, bitrot_chunk))
             except Exception:  # noqa: BLE001
                 writers.append(None)
 
@@ -293,6 +294,7 @@ class ErasureObjects(MultipartMixin, ObjectLayer):
             "content-type": user_defined.pop(
                 "content-type", "application/octet-stream"),
             BITROT_KEY: self.bitrot_algo.value,
+            BITROT_CHUNK_KEY: str(bitrot_chunk),
             **user_defined,
         }
         fi.erasure = ErasureInfo(
@@ -425,7 +427,8 @@ class ErasureObjects(MultipartMixin, ObjectLayer):
                      fi.erasure.block_size)
         algo = BitrotAlgorithm(fi.metadata.get(
             BITROT_KEY, DEFAULT_BITROT_ALGO.value))
-        shard_size = er.shard_size()
+        bitrot_chunk = int(fi.metadata.get(BITROT_CHUNK_KEY,
+                                           str(er.shard_size())))
 
         # disks in shard order via each disk's stored erasure index
         per_shard_disk: list = [None] * len(disks)
@@ -466,7 +469,7 @@ class ErasureObjects(MultipartMixin, ObjectLayer):
                     src = d.read_file_at(
                         bucket, f"{object}/{fi.data_dir}/part.{part.number}")
                     readers.append(new_bitrot_reader(
-                        src, algo, logical, shard_size))
+                        src, algo, logical, bitrot_chunk))
                 except Exception:  # noqa: BLE001
                     readers.append(None)
             try:
@@ -998,7 +1001,8 @@ class ErasureObjects(MultipartMixin, ObjectLayer):
                      fi.erasure.block_size)
         algo = BitrotAlgorithm(fi.metadata.get(
             BITROT_KEY, DEFAULT_BITROT_ALGO.value))
-        shard_size = er.shard_size()
+        bitrot_chunk = int(fi.metadata.get(BITROT_CHUNK_KEY,
+                                           str(er.shard_size())))
 
         # shard-ordered source disks (state OK only) and their FileInfos
         shard_disk: list = [None] * n
@@ -1023,7 +1027,7 @@ class ErasureObjects(MultipartMixin, ObjectLayer):
                     src = d.read_file_at(
                         bucket, f"{object}/{fi.data_dir}/part.{part.number}")
                     readers.append(new_bitrot_reader(
-                        src, algo, logical, shard_size))
+                        src, algo, logical, bitrot_chunk))
                 except Exception:  # noqa: BLE001
                     readers.append(None)
             writers = [None] * n
@@ -1034,7 +1038,7 @@ class ErasureObjects(MultipartMixin, ObjectLayer):
                         META_TMP,
                         f"{tmp_id}/{fi.data_dir}/part.{part.number}")
                     writers[shard_idx - 1] = new_bitrot_writer(
-                        sink, algo, shard_size)
+                        sink, algo, bitrot_chunk)
                 except Exception:  # noqa: BLE001
                     pass
             try:

@@ -43,6 +43,7 @@ class MultipartMixin:
 
     def new_multipart_upload(self, bucket: str, object: str,
                              opts: ObjectOptions = None) -> str:
+        from ..erasure.bitrot import BITROT_CHUNK_KEY, pick_bitrot_chunk
         from .erasure_objects import BITROT_KEY, check_names
         opts = opts or ObjectOptions()
         check_names(bucket, object)
@@ -61,6 +62,8 @@ class MultipartMixin:
             metadata={
                 "x-minio-internal-object": f"{bucket}/{object}",
                 BITROT_KEY: self.bitrot_algo.value,
+                BITROT_CHUNK_KEY: str(pick_bitrot_chunk(
+                    Erasure(data, parity, self.block_size).shard_size())),
                 "content-type": opts.user_defined.get(
                     "content-type", "application/octet-stream"),
                 **{k: v for k, v in opts.user_defined.items()
@@ -127,10 +130,11 @@ class MultipartMixin:
         data, parity = fi.erasure.data_blocks, fi.erasure.parity_blocks
         write_quorum = fi.write_quorum(parity)
         er = Erasure(data, parity, fi.erasure.block_size)
-        shard_size = er.shard_size()
-        from ..erasure.bitrot import BitrotAlgorithm
+        from ..erasure.bitrot import BITROT_CHUNK_KEY, BitrotAlgorithm
         from .erasure_objects import BITROT_KEY
         algo = BitrotAlgorithm(fi.metadata[BITROT_KEY])
+        bitrot_chunk = int(fi.metadata.get(BITROT_CHUNK_KEY,
+                                           str(er.shard_size())))
 
         hr = stream if isinstance(stream, HashReader) else \
             HashReader(stream, size)
@@ -145,7 +149,7 @@ class MultipartMixin:
             try:
                 sink = d.create_file_writer(META_TMP,
                                             f"{tmp_id}/part.{part_id}")
-                writers.append(new_bitrot_writer(sink, algo, shard_size))
+                writers.append(new_bitrot_writer(sink, algo, bitrot_chunk))
             except Exception:  # noqa: BLE001
                 writers.append(None)
         try:

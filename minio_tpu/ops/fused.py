@@ -24,22 +24,34 @@ from . import hh_jax
 
 
 @functools.lru_cache(maxsize=64)
-def _jitted(key_words: tuple[int, ...], nbytes: int, backend_mm):
+def _jitted(key_words: tuple[int, ...], chunk_nbytes: int, backend_mm):
     """Compile cache per (hash key, chunk bytes, matmul kernel)."""
 
     def fused(masks, words, digests):
-        # words [B, k, W] uint32; masks [B, 8, m, k]; digests [B, k, 8]
-        computed = hh_jax.hash256_device_words(key_words, nbytes, words)
-        valid = jnp.all(computed == digests, axis=-1)  # [B, k] bool
-        out = backend_mm(masks, words)                  # [B, m, W]
+        # words [B, k, W] uint32; masks [B, 8, m, k]; digests [B, k, nc*8]
+        B, k, W = words.shape
+        nc = W * 4 // chunk_nbytes
+        chunks = words.reshape(B, k, nc, W // nc)
+        computed = hh_jax.hash256_device_words(
+            key_words, chunk_nbytes, chunks)       # [B, k, nc, 8]
+        valid = jnp.all(computed.reshape(B, k, nc * 8) == digests,
+                        axis=-1)                   # [B, k] bool
+        out = backend_mm(masks, words)             # [B, m, W]
         return out, valid
 
     return jax.jit(fused)
 
 
-def fused_rebuild(key: bytes, masks, words, digests, backend_mm):
-    """words uint32 [B,k,W] + per-element masks [B,8,m,k] + expected digests
-    uint32 [B,k,8] -> (rebuilt [B,m,W], valid bool [B,k]) in one launch."""
+def fused_rebuild(key: bytes, masks, words, digests, backend_mm,
+                  chunk_nbytes: int | None = None):
+    """words uint32 [B,k,W] + per-element masks [B,8,m,k] + expected
+    per-chunk digests uint32 [B,k,nc*8] -> (rebuilt [B,m,W], valid bool
+    [B,k]) in one launch. ``chunk_nbytes`` is the bitrot chunk size the
+    digests were computed over (default: the whole shard)."""
     nbytes = int(words.shape[-1]) * 4
-    fn = _jitted(hh_jax._key_words(key), nbytes, backend_mm)
+    if not chunk_nbytes:
+        chunk_nbytes = nbytes
+    if nbytes % chunk_nbytes:
+        raise ValueError("shard length is not a bitrot-chunk multiple")
+    fn = _jitted(hh_jax._key_words(key), chunk_nbytes, backend_mm)
     return fn(masks, words, digests)

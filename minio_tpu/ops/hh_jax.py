@@ -197,16 +197,31 @@ def _hash256_impl(key_words: tuple[int, ...], nbytes: int,
         pkts = jnp.transpose(
             data32[:, : n_pkts * 8].reshape(N, n_pkts, 8), (1, 2, 0))
 
+        # Unroll several packets per fori_loop iteration: the per-iteration
+        # launch overhead dominates the (tiny) per-packet VPU work, and the
+        # hash chain is sequential so packets can't be parallelized within
+        # a chunk. U=8 measured ~4x faster than U=1 on v5e for 64 KiB
+        # chunks; capped so short chunks keep a >=4-iteration loop.
+        unroll = 1
+        for u in (8, 4, 2):
+            if n_pkts // u >= 4:
+                unroll = u
+                break
+
         def body(i, flat):
             stl = _flat_to_state(flat)
-            w = jax.lax.dynamic_index_in_dim(pkts, i, axis=0,
-                                             keepdims=False)  # [8, N]
-            lanes = [(w[2 * j], w[2 * j + 1]) for j in range(4)]
-            _update(lanes, stl)
+            w = jax.lax.dynamic_slice_in_dim(
+                pkts, i * unroll, unroll, axis=0)  # [unroll, 8, N]
+            for u in range(unroll):
+                lanes = [(w[u, 2 * j], w[u, 2 * j + 1]) for j in range(4)]
+                _update(lanes, stl)
             return _state_to_flat(stl)
 
         st = _flat_to_state(jax.lax.fori_loop(
-            0, n_pkts, body, _state_to_flat(st)))
+            0, n_pkts // unroll, body, _state_to_flat(st)))
+        for p in range(n_pkts - n_pkts % unroll, n_pkts):
+            lanes = [(pkts[p, 2 * j], pkts[p, 2 * j + 1]) for j in range(4)]
+            _update(lanes, st)
 
     rem = nbytes & 31
     if rem:

@@ -49,10 +49,12 @@ class _Pending:
 
 
 class _Bucket:
-    def __init__(self, codec, op: str, hash_key: bytes | None = None):
+    def __init__(self, codec, op: str, hash_key: bytes | None = None,
+                 chunk_size: int = 0):
         self.codec = codec
         self.op = op  # 'encode' | 'masked' | 'fused'
         self.hash_key = hash_key
+        self.chunk_size = chunk_size
         self.items: list[_Pending] = []
 
 
@@ -99,22 +101,26 @@ class DispatchQueue:
         return self._submit(key, codec, "masked", words, masks)
 
     def fused(self, codec, words: np.ndarray, masks: np.ndarray,
-              digests: np.ndarray, hash_key: bytes) -> Future:
+              digests: np.ndarray, hash_key: bytes,
+              chunk_size: int) -> Future:
         """Fused bitrot-verify + rebuild (BASELINE config 4): like masked()
-        but the launch also HighwayHash-verifies each of the k source shards
-        against ``digests`` uint32 [k, 8]. Future resolves to
-        (out_words [o, W], valid bool [k])."""
-        key = ("fused", codec.k, masks.shape[1], words.shape[-1], hash_key)
+        but the launch also HighwayHash-verifies each of the k source
+        shards' ``chunk_size``-byte chunks against ``digests`` uint32
+        [k, nc*8]. Future resolves to (out_words [o, W], valid bool [k])."""
+        key = ("fused", codec.k, masks.shape[1], words.shape[-1], hash_key,
+               chunk_size)
         return self._submit(key, codec, "fused", words, masks,
-                            digests=digests, hash_key=hash_key)
+                            digests=digests, hash_key=hash_key,
+                            chunk_size=chunk_size)
 
     def _submit(self, key, codec, op, words, masks, digests=None,
-                hash_key=None) -> Future:
+                hash_key=None, chunk_size=0) -> Future:
         p = _Pending(words=words, masks=masks, digests=digests)
         with self._cv:
             b = self._buckets.get(key)
             if b is None:
-                b = self._buckets[key] = _Bucket(codec, op, hash_key)
+                b = self._buckets[key] = _Bucket(codec, op, hash_key,
+                                                 chunk_size)
             b.items.append(p)
             self._cv.notify()
         return p.future
@@ -192,7 +198,7 @@ class DispatchQueue:
                             [items[0].digests] * (bsz - n))
             out_dev = fused_rebuild(
                 b.hash_key, jnp.asarray(masks), jnp.asarray(stack),
-                jnp.asarray(digs), b.codec._mm_batch_per)
+                jnp.asarray(digs), b.codec._mm_batch_per, b.chunk_size)
         # hand host readback to a completer so the next batch launches now
         self._completers.submit(self._complete, b.op, out_dev, items)
 

@@ -350,43 +350,48 @@ class XLStorage(StorageAPI):
     def check_parts(self, volume: str, path: str, fi: FileInfo) -> None:
         """Verify all parts exist with the expected shard file size
         (reference CheckParts)."""
-        from ..erasure.bitrot import (BitrotAlgorithm,
+        from ..erasure.bitrot import (BITROT_CHUNK_KEY, BitrotAlgorithm,
                                       bitrot_shard_file_size)
         if fi.data is not None:
             return
         algo = BitrotAlgorithm(fi.metadata.get(
             "x-minio-internal-bitrot", "blake2b256S"))
+        chunk = int(fi.metadata.get(BITROT_CHUNK_KEY,
+                                    str(fi.erasure.shard_size())))
         for part in fi.parts:
             p = f"{path}/{fi.data_dir}/part.{part.number}"
             want = bitrot_shard_file_size(
-                fi.erasure.shard_file_size(part.size), fi.erasure.shard_size(),
-                algo)
+                fi.erasure.shard_file_size(part.size), chunk, algo)
             if self.stat_file_size(volume, p) != want:
                 raise errors.FileCorrupt(p)
 
     def verify_file(self, volume: str, path: str, fi: FileInfo) -> None:
         """Deep bitrot scan of every part on this disk (reference
         VerifyFile / bitrotVerify)."""
-        from ..erasure.bitrot import (BitrotAlgorithm, bitrot_logical_size,
-                                      new_bitrot_reader)
+        from ..erasure.bitrot import (BITROT_CHUNK_KEY, BitrotAlgorithm,
+                                      bitrot_logical_size, new_bitrot_reader)
         if fi.data is not None:
             return
         algo = BitrotAlgorithm(fi.metadata.get(
             "x-minio-internal-bitrot", "blake2b256S"))
-        shard_size = fi.erasure.shard_size()
+        chunk = int(fi.metadata.get(BITROT_CHUNK_KEY,
+                                    str(fi.erasure.shard_size())))
         for part in fi.parts:
             p = f"{path}/{fi.data_dir}/part.{part.number}"
             fsize = self.stat_file_size(volume, p)
-            logical = bitrot_logical_size(fsize, shard_size, algo)
+            logical = bitrot_logical_size(fsize, chunk, algo)
             want = fi.erasure.shard_file_size(part.size)
             if logical != want:
                 raise errors.FileCorrupt(p)
             src = self.read_file_at(volume, p)
             try:
-                r = new_bitrot_reader(src, algo, logical, shard_size)
+                r = new_bitrot_reader(src, algo, logical, chunk)
+                # verify in multi-chunk spans: read_at does one backing
+                # read per call, so bigger spans keep syscall count low
+                span = chunk * max(1, (4 << 20) // chunk)
                 off = 0
                 while off < logical:
-                    n = min(shard_size, logical - off)
+                    n = min(span, logical - off)
                     r.read_at(off, n)
                     off += n
             finally:

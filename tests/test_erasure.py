@@ -302,14 +302,51 @@ def test_fused_heal_roundtrip_and_corruption():
 
 
 def test_raw_read_contract():
-    er, sinks = encode_hh(4, 2, 1 << 20, rng_bytes(1 << 20, seed=14))
-    r = hh_readers(er, sinks, 1 << 20, dead=())[0]
+    # chunk = half the shard so multi-chunk raw reads exist
+    er = Erasure(4, 2, 1 << 20)
+    chunk_size = er.shard_size() // 2
+    data = rng_bytes(1 << 20, seed=14)
+    sinks = [BufferSink() for _ in range(6)]
+    writers = [new_bitrot_writer(s, HH, chunk_size) for s in sinks]
+    erasure_encode(er, io.BytesIO(data), writers, 4)
+    for w in writers:
+        w.close()
+    r = new_bitrot_reader(BufferSource(sinks[0].getvalue()), HH,
+                          er.shard_file_size(len(data)), chunk_size)
     assert r.fusable
-    dig, chunk = r.read_at_raw(0, er.shard_size())
+    dig, chunk = r.read_at_raw(0, r.shard_size)
     h = HH.new()
     h.update(chunk)
     assert h.digest() == dig
+    # multi-chunk raw read returns the concatenated per-chunk digests
+    digs2, payload2 = r.read_at_raw(0, 2 * r.shard_size)
+    assert len(digs2) == 2 * HH.digest_size
+    assert digs2[:HH.digest_size] == dig
+    assert payload2[: r.shard_size] == chunk
+    h = HH.new()
+    h.update(payload2[r.shard_size:])
+    assert digs2[HH.digest_size:] == h.digest()
     with pytest.raises(ValueError):
         r.read_at_raw(1, 8)  # unaligned
-    with pytest.raises(ValueError):
-        r.read_at_raw(0, er.shard_size() + 4)  # spans chunks
+
+
+def test_bitrot_chunk_is_16k_and_recorded(tmp_path):
+    """New objects record the 16 KiB device-friendly bitrot chunk in
+    xl.meta and remain readable/healable (TPU-first chunking choice)."""
+    import io as _io
+    from minio_tpu.erasure.bitrot import (BITROT_CHUNK_KEY,
+                                          DEFAULT_BITROT_CHUNK)
+    from minio_tpu.objectlayer import ErasureObjects
+    from minio_tpu.storage import XLStorage
+    disks = [XLStorage(str(tmp_path / f"d{i}")) for i in range(6)]
+    ol = ErasureObjects(disks, default_parity=2)
+    ol.make_bucket("b")
+    data = rng_bytes((2 << 20) + 999, seed=15)
+    ol.put_object("b", "o", _io.BytesIO(data), len(data))
+    fi = disks[0].read_version("b", "o")
+    assert fi.metadata[BITROT_CHUNK_KEY] == str(DEFAULT_BITROT_CHUNK)
+    assert ol.get_object_bytes("b", "o") == data
+    # degraded read still exact
+    import shutil as _sh
+    _sh.rmtree(str(tmp_path / "d0" / "b" / "o"))
+    assert ol.get_object_bytes("b", "o") == data
