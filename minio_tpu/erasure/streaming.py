@@ -15,7 +15,8 @@ from __future__ import annotations
 import io
 import os
 from collections import deque
-from concurrent.futures import FIRST_COMPLETED, ThreadPoolExecutor, wait
+from concurrent.futures import (FIRST_COMPLETED, Future, ThreadPoolExecutor,
+                                wait)
 from dataclasses import dataclass, field
 
 import numpy as np
@@ -75,6 +76,39 @@ def parallel_write_shards(writers: list, shards: list[np.ndarray],
 ENCODE_WINDOW = int(os.environ.get("MINIO_TPU_ENCODE_WINDOW", "16"))
 
 
+class _OrderedWriter:
+    """Serializes one shard writer's writes while letting different
+    writers (and different blocks) proceed concurrently: each write chains
+    onto the previous one's future, so block N+1's shard write starts the
+    moment block N's finishes on THAT disk — no per-block barrier across
+    disks (the reference gets this from one goroutine per disk,
+    cmd/erasure-encode.go:36-54)."""
+
+    def __init__(self, writer):
+        self.writer = writer
+        self._last: Future | None = None
+
+    def write_async(self, data: bytes) -> Future:
+        out: Future = Future()
+
+        def run():
+            try:
+                out.set_result(self.writer.write(data))
+            except Exception as e:  # noqa: BLE001
+                out.set_exception(e)
+
+        prev, self._last = self._last, out
+        if prev is None:
+            io_pool().submit(run)
+        else:
+            # always hop to the pool: add_done_callback runs inline in the
+            # CALLING thread when prev is already done, which would pull
+            # the blocking write onto the encoder thread and serialize the
+            # whole fan-out
+            prev.add_done_callback(lambda _f: io_pool().submit(run))
+        return out
+
+
 def erasure_encode(erasure: Erasure, stream, writers: list,
                    write_quorum: int) -> int:
     """Read the stream block by block, erasure-encode on device, fan shards
@@ -82,29 +116,73 @@ def erasure_encode(erasure: Erasure, stream, writers: list,
     total bytes consumed (reference Erasure.Encode,
     cmd/erasure-encode.go:73-109).
 
-    Pipelined: up to ENCODE_WINDOW blocks are submitted to the dispatch
-    queue before the first result is awaited, so one stream's blocks batch
-    into few device launches and device work overlaps shard I/O; shard
-    writes stay strictly in block order."""
+    Pipelined twice over: up to ENCODE_WINDOW blocks are in flight through
+    the dispatch queue (so one stream's blocks batch into few device
+    launches), and shard writes ride per-disk ordered chains so disks never
+    barrier on each other between blocks; write-quorum errors are harvested
+    per block as its writes drain."""
     total = 0
-    window: deque = deque()
+    owriters = [None if w is None else _OrderedWriter(w) for w in writers]
+    enc_window: deque = deque()   # Futures of encoded shard lists
+    write_window: deque = deque()  # per-block {writer idx: write Future}
+
+    def start_writes(shards):
+        futs = {}
+        for i, ow in enumerate(owriters):
+            if ow is None or writers[i] is None:
+                continue
+            futs[i] = ow.write_async(shards[i].tobytes())
+        write_window.append(futs)
+
+    def harvest_writes():
+        futs = write_window.popleft()
+        errs: list[BaseException | None] = [None] * len(writers)
+        for i in range(len(writers)):
+            if writers[i] is None:
+                errs[i] = errors.DiskNotFound()
+        for i, f in futs.items():
+            try:
+                f.result()
+            except Exception as e:  # noqa: BLE001 — disk errors become votes
+                errs[i] = e if isinstance(e, errors.StorageError) \
+                    else errors.FaultyDisk(str(e))
+                writers[i] = None
+        err = errors.reduce_write_quorum_errs(
+            errs, errors.BASE_IGNORED_ERRS, write_quorum)
+        if err is not None:
+            raise err
+
     eof = False
-    while not eof or window:
-        while not eof and len(window) < ENCODE_WINDOW:
-            buf = _read_full(stream, erasure.block_size)
-            if not buf:
-                eof = True
-                if total == 0 and not window:
-                    # empty object: single empty block for quorum accounting
-                    window.append(erasure.encode_data_async(b""))
-                break
-            if len(buf) < erasure.block_size:
-                eof = True
-            total += len(buf)
-            window.append(erasure.encode_data_async(buf))
-        if window:
-            shards = window.popleft().result()
-            parallel_write_shards(writers, shards, write_quorum)
+    try:
+        while not eof or enc_window or write_window:
+            while not eof and len(enc_window) < ENCODE_WINDOW:
+                buf = _read_full(stream, erasure.block_size)
+                if not buf:
+                    eof = True
+                    if total == 0 and not enc_window:
+                        # empty object: one empty block for quorum accounting
+                        enc_window.append(erasure.encode_data_async(b""))
+                    break
+                if len(buf) < erasure.block_size:
+                    eof = True
+                total += len(buf)
+                enc_window.append(erasure.encode_data_async(buf))
+            if enc_window:
+                start_writes(enc_window.popleft().result())
+            while len(write_window) > (ENCODE_WINDOW if enc_window or not eof
+                                       else 0):
+                harvest_writes()
+    except BaseException:
+        # quiesce in-flight chained writes before propagating: the caller
+        # will abort/close the writers, and a background write racing an
+        # abort corrupts the writer state
+        for futs in write_window:
+            for f in futs.values():
+                try:
+                    f.result()
+                except Exception:  # noqa: BLE001
+                    pass
+        raise
     return total
 
 

@@ -19,7 +19,16 @@ Mechanics:
   while the previous one's host readback is still in flight (the tunnel
   round-trip overlaps with compute).
 
-Enable/disable with MINIO_TPU_DISPATCH=1/0 (default: on).
+Hybrid routing: each flush is costed against a one-time link profile
+(round-trip latency + host<->device bandwidth, measured lazily) and the
+native AVX2 GF(256) kernel's throughput; the flush runs wherever the model
+predicts it finishes sooner. On a PCIe/DMA-attached TPU that is the device
+for everything beyond a couple of blocks; through a slow tunnel (hundreds
+of ms RT, MB/s bandwidth) single hot PUTs fall back to the same
+CPU-SIMD-per-request behavior as the reference instead of eating a tunnel
+round-trip. Override with MINIO_TPU_DISPATCH_MODE=device|cpu|auto.
+
+Enable/disable batching entirely with MINIO_TPU_DISPATCH=1/0 (default: on).
 """
 from __future__ import annotations
 
@@ -37,6 +46,64 @@ MAX_DELAY_S = float(os.environ.get("MINIO_TPU_DISPATCH_DELAY_MS", "1.0")) / 1e3
 
 def dispatch_enabled() -> bool:
     return os.environ.get("MINIO_TPU_DISPATCH", "1") != "0"
+
+
+class LinkProfile:
+    """One-time measurement of the host<->device link + CPU kernel rate,
+    feeding the device-vs-CPU routing decision."""
+
+    def __init__(self, rt_s: float, up_gibs: float, down_gibs: float,
+                 cpu_gibs: float):
+        self.rt_s = rt_s
+        self.up_gibs = max(up_gibs, 1e-4)
+        self.down_gibs = max(down_gibs, 1e-4)
+        self.cpu_gibs = max(cpu_gibs, 1e-4)
+
+    @classmethod
+    def probe(cls) -> "LinkProfile":
+        import jax
+        import jax.numpy as jnp
+        nbytes = 4 << 20
+        buf = np.zeros(nbytes, np.uint8)
+        # warm the EXACT jitted shapes used below, so no compile lands
+        # inside a timed section
+        warm = jnp.asarray(buf)
+        _ = jax.device_get(jnp.sum(warm[:1]))
+        _ = np.asarray(warm)
+        t0 = time.monotonic()
+        for _ in range(3):
+            _ = jax.device_get(jnp.sum(warm[:1]))
+        rt = (time.monotonic() - t0) / 3
+        t0 = time.monotonic()
+        dev = jnp.asarray(buf)
+        _ = jax.device_get(jnp.sum(dev[:1]))
+        up = nbytes / max(time.monotonic() - t0 - rt, 1e-4) / (1 << 30)
+        t0 = time.monotonic()
+        _ = np.asarray(dev)
+        down = nbytes / max(time.monotonic() - t0, 1e-4) / (1 << 30)
+        # CPU kernel rate: one 16+4 encode of 1 MiB on the native kernel
+        from .. import native
+        from ..ops import gf256
+        pmat = gf256.build_matrix(16, 4)[16:]
+        d = np.zeros((16, 65536), np.uint8)
+        native.cpu_encode(pmat, d, 4)  # warm/build
+        t0 = time.monotonic()
+        for _ in range(8):
+            native.cpu_encode(pmat, d, 4)
+        cpu = 8 * (1 << 20) / max(time.monotonic() - t0, 1e-6) / (1 << 30)
+        prof = cls(rt, up, down, cpu)
+        import sys
+        print(f"minio-tpu dispatch link probe: rt={rt*1e3:.1f}ms "
+              f"up={up:.3f}GiB/s down={down:.3f}GiB/s cpu={cpu:.2f}GiB/s",
+              file=sys.stderr)
+        return prof
+
+    def device_wins(self, bytes_in: int, bytes_out: int,
+                    kernel_s: float = 2e-3) -> bool:
+        t_dev = self.rt_s + bytes_in / self.up_gibs / (1 << 30) \
+            + bytes_out / self.down_gibs / (1 << 30) + kernel_s
+        t_cpu = (bytes_in + bytes_out) / self.cpu_gibs / (1 << 30)
+        return t_dev < t_cpu
 
 
 @dataclass
@@ -76,12 +143,16 @@ class DispatchQueue:
         self._completers = ThreadPoolExecutor(
             max_workers=completers, thread_name_prefix="minio-tpu-complete")
         self._stop = False
+        self._profile: LinkProfile | None = None
+        self._profile_failed = False
+        self._profile_lock = threading.Lock()
         self._thread = threading.Thread(
             target=self._loop, name="minio-tpu-dispatch", daemon=True)
         self._thread.start()
         # telemetry
         self.batches = 0
         self.items = 0
+        self.cpu_batches = 0
 
     # --- submission ---------------------------------------------------------
 
@@ -175,7 +246,85 @@ class DispatchQueue:
             if stopping:
                 return
 
+    # --- device-vs-CPU routing ----------------------------------------------
+
+    def _get_profile(self) -> LinkProfile | None:
+        if self._profile is None and not self._profile_failed:
+            with self._profile_lock:
+                if self._profile is None and not self._profile_failed:
+                    try:
+                        self._profile = LinkProfile.probe()
+                    except Exception:  # noqa: BLE001 — no device: CPU-only
+                        self._profile_failed = True
+        return self._profile
+
+    def _route(self, b: _Bucket, items: list[_Pending]) -> str:
+        mode = os.environ.get("MINIO_TPU_DISPATCH_MODE", "auto")
+        if mode in ("device", "cpu"):
+            return mode
+        prof = self._get_profile()
+        if prof is None:
+            return "cpu"
+        n = len(items)
+        w = items[0].words
+        bytes_in = n * w.nbytes
+        out_rows = b.codec.m
+        if items[0].masks is not None:
+            out_rows = items[0].masks.shape[1]
+            bytes_in += n * items[0].masks.nbytes
+        bytes_out = n * out_rows * w.shape[-1] * 4
+        return "device" if prof.device_wins(bytes_in, bytes_out) else "cpu"
+
+    @staticmethod
+    def _rows_from_masks(masks: np.ndarray) -> np.ndarray:
+        """Invert coeff_masks: uint32 [8, o, k] bit-plane masks -> uint8
+        [o, k] coefficient matrix (masks[b] is all-ones iff bit b set)."""
+        return ((masks & 1).astype(np.uint8)
+                << np.arange(8, dtype=np.uint8)[:, None, None]).sum(
+                    axis=0, dtype=np.uint8)
+
+    def _flush_cpu(self, b: _Bucket, items: list[_Pending]):
+        """Run a flush on the native AVX2 kernel (per item, on completer
+        threads) — the adaptive fallback when the device link would cost
+        more than the math (reference behavior: SIMD per request)."""
+        from .. import native
+        self.batches += 1
+        self.cpu_batches += 1
+        self.items += len(items)
+
+        def one(p: _Pending):
+            try:
+                u8 = np.ascontiguousarray(p.words).view(np.uint8)
+                if b.op == "encode":
+                    rows = b.codec.parity_rows
+                else:
+                    rows = self._rows_from_masks(p.masks)
+                out = native.cpu_encode(rows, u8, rows.shape[0])
+                out_words = np.ascontiguousarray(out).view(np.uint32)
+                if b.op == "fused":
+                    from ..native import highwayhash as hhn
+                    k = u8.shape[0]
+                    chunks = u8.reshape(k, -1, b.chunk_size)
+                    digs = hhn.hash256_batch(
+                        b.hash_key, chunks.reshape(-1, b.chunk_size))
+                    want = np.ascontiguousarray(p.digests).view(np.uint8)
+                    valid = np.array([
+                        digs[i * chunks.shape[1]:(i + 1) * chunks.shape[1]]
+                        .tobytes() == want[i].tobytes() for i in range(k)])
+                    p.future.set_result((out_words, valid))
+                else:
+                    p.future.set_result(out_words)
+            except Exception as e:  # noqa: BLE001
+                if not p.future.done():
+                    p.future.set_exception(e)
+
+        for p in items:
+            self._completers.submit(one, p)
+
     def _flush(self, b: _Bucket, items: list[_Pending]):
+        if self._route(b, items) == "cpu":
+            self._flush_cpu(b, items)
+            return
         import jax.numpy as jnp
         n = len(items)
         bsz = _pad_batch(n)
@@ -227,6 +376,7 @@ class DispatchQueue:
 
     def stats(self) -> dict:
         return {"batches": self.batches, "items": self.items,
+                "cpu_batches": self.cpu_batches,
                 "avg_batch": self.items / self.batches if self.batches else 0}
 
 
