@@ -210,6 +210,17 @@ class StreamingBitrotWriter:
         self.sink.write(h.digest())
         self.sink.write(chunk)
 
+    def write_framed(self, framed) -> None:
+        """Pass pre-framed ``[digest][chunk]`` bytes straight to the sink —
+        the native fused pipeline (native/pipeline.cpp mt_put_block) computes
+        digests and interleaving in the same pass as the erasure encode, so
+        re-hashing here would double the work. Only legal on chunk
+        boundaries (no partial chunk buffered)."""
+        if self._buf:
+            raise ValueError("write_framed with partial chunk buffered")
+        self.sink.write(framed if isinstance(
+            framed, (bytes, bytearray, memoryview)) else memoryview(framed))
+
     def close(self):
         if self._buf:
             self._emit(bytes(self._buf))
@@ -276,6 +287,25 @@ class StreamingBitrotReader:
             pos += h + clen
             left -= clen
         return bytes(digests), bytes(payload)
+
+    def read_framed(self, offset: int, length: int) -> bytes:
+        """Raw physical read covering logical [offset, offset+length) with
+        the digest headers left in place — the native fused read path
+        (native/pipeline.cpp mt_get_block) verifies and strips them in one
+        pass. offset must be chunk-aligned."""
+        if offset % self.shard_size:
+            raise ValueError(f"unaligned bitrot read at {offset}")
+        if offset + length > self.till_offset:
+            raise errors.FileCorrupt(
+                f"bitrot read [{offset}, {offset + length}) past shard end "
+                f"{self.till_offset}")
+        h = self.algo.digest_size
+        n_chunks = -(-length // self.shard_size) if length else 0
+        phys = (offset // self.shard_size) * (self.shard_size + h)
+        blob = self.src.read_at(phys, n_chunks * h + length)
+        if len(blob) < n_chunks * h + length:
+            raise errors.FileCorrupt("short bitrot stream")
+        return blob
 
     def read_at(self, offset: int, length: int) -> bytes:
         if length == 0:

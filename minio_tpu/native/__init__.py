@@ -1,9 +1,18 @@
 """Native (C++) components: build-on-demand via g++, loaded through ctypes.
 
 The reference keeps its hot math in assembly-backed Go modules (SURVEY.md
-§2.10); here the native layer provides the CPU fallback codec and the
-measured AVX2 baseline for the benchmarks, while the TPU path lives in
-minio_tpu.ops.
+§2.10); here one combined libnative.so (pipeline.cpp, which includes
+gf256_simd.cpp + highwayhash.cpp) provides:
+
+- the CPU GF(256) codec (fallback path + the measured AVX2 baseline for
+  bench.py's vs_baseline),
+- AVX2 HighwayHash-256 (bitrot digests),
+- the fused per-block data-plane calls ``mt_put_block`` / ``mt_get_block``
+  (split+encode+hash+frame, verify+assemble) that carry the end-to-end
+  object path on the CPU route.
+
+All entry points release the GIL (plain ctypes CDLL calls), so concurrent
+requests scale across cores where the host has them.
 """
 from __future__ import annotations
 
@@ -12,10 +21,14 @@ import os
 import subprocess
 import threading
 
+import numpy as np
+
 _DIR = os.path.dirname(os.path.abspath(__file__))
 _BUILD = os.path.join(_DIR, "_build")
 _LOCK = threading.Lock()
-_lib = None
+_lib: ctypes.CDLL | None = None
+
+_SOURCES = ("pipeline.cpp", "gf256_simd.cpp", "highwayhash.cpp")
 
 
 def _compile(src: str, out: str) -> None:
@@ -35,36 +48,127 @@ def _compile(src: str, out: str) -> None:
     raise RuntimeError(f"native build failed: {last.stderr.decode()[:500]}")
 
 
-def load_gf256() -> ctypes.CDLL:
-    """Build (once) and load the GF(256) SIMD library."""
+def load_native() -> ctypes.CDLL:
+    """Build (once) and load the combined native library."""
     global _lib
     with _LOCK:
         if _lib is not None:
             return _lib
-        src = os.path.join(_DIR, "gf256_simd.cpp")
-        out = os.path.join(_BUILD, "libgf256.so")
-        if not os.path.exists(out) or os.path.getmtime(out) < os.path.getmtime(src):
-            _compile(src, out)
+        out = os.path.join(_BUILD, "libnative.so")
+        src_mtime = max(os.path.getmtime(os.path.join(_DIR, s))
+                        for s in _SOURCES)
+        if not os.path.exists(out) or os.path.getmtime(out) < src_mtime:
+            _compile(os.path.join(_DIR, "pipeline.cpp"), out)
         lib = ctypes.CDLL(out)
+        c_u8p = ctypes.POINTER(ctypes.c_uint8)
         lib.gf256_encode.argtypes = [
             ctypes.c_char_p, ctypes.c_int, ctypes.c_int,
             ctypes.c_char_p, ctypes.c_char_p, ctypes.c_long]
         lib.gf256_encode.restype = None
         lib.gf256_has_avx2.restype = ctypes.c_int
+        lib.hh256.argtypes = [ctypes.c_char_p, ctypes.c_char_p,
+                              ctypes.c_long, ctypes.c_char_p]
+        lib.hh256.restype = None
+        lib.hh256_batch.argtypes = [ctypes.c_char_p, ctypes.c_char_p,
+                                    ctypes.c_int, ctypes.c_long,
+                                    ctypes.c_long, ctypes.c_char_p]
+        lib.hh256_batch.restype = None
+        lib.hh256_multi.argtypes = [ctypes.c_char_p,
+                                    ctypes.POINTER(ctypes.c_void_p),
+                                    ctypes.POINTER(ctypes.c_long),
+                                    ctypes.c_int, ctypes.c_char_p]
+        lib.hh256_multi.restype = None
+        lib.hh256_ref.argtypes = lib.hh256.argtypes
+        lib.hh256_ref.restype = None
+        lib.hh64.argtypes = [ctypes.c_char_p, ctypes.c_char_p, ctypes.c_long]
+        lib.hh64.restype = ctypes.c_uint64
+        lib.mt_framed_len.argtypes = [ctypes.c_long, ctypes.c_long]
+        lib.mt_framed_len.restype = ctypes.c_long
+        lib.mt_put_block.argtypes = [
+            c_u8p, ctypes.c_long, ctypes.c_char_p, ctypes.c_int,
+            ctypes.c_int, ctypes.c_long, ctypes.c_long, ctypes.c_char_p,
+            c_u8p]
+        lib.mt_put_block.restype = None
+        lib.mt_get_block.argtypes = [
+            ctypes.POINTER(ctypes.c_void_p), ctypes.c_int, ctypes.c_long,
+            ctypes.c_long, ctypes.c_char_p, c_u8p]
+        lib.mt_get_block.restype = ctypes.c_int
+        lib.mt_verify_framed.argtypes = [c_u8p, ctypes.c_long, ctypes.c_long,
+                                         ctypes.c_char_p]
+        lib.mt_verify_framed.restype = ctypes.c_long
         _lib = lib
         return lib
 
 
+def load_gf256() -> ctypes.CDLL:
+    """Back-compat alias: the combined library serves the gf256 symbols."""
+    return load_native()
+
+
+def available() -> bool:
+    try:
+        load_native()
+        return True
+    except Exception:  # noqa: BLE001 — no toolchain: pure-Python fallbacks
+        return False
+
+
 def cpu_encode(matrix, data, rows_out: int):
     """numpy convenience wrapper: matrix [o,i] uint8, data [i,S] uint8 -> [o,S]."""
-    import numpy as np
-    lib = load_gf256()
+    lib = load_native()
     matrix = np.ascontiguousarray(matrix, dtype=np.uint8)
     data = np.ascontiguousarray(data, dtype=np.uint8)
-    o, i = rows_out, data.shape[0]
+    o = rows_out
     out = np.empty((o, data.shape[1]), dtype=np.uint8)
     lib.gf256_encode(
-        matrix.ctypes.data_as(ctypes.c_char_p), o, i,
+        matrix.ctypes.data_as(ctypes.c_char_p), o, data.shape[0],
         data.ctypes.data_as(ctypes.c_char_p),
         out.ctypes.data_as(ctypes.c_char_p), data.shape[1])
     return out
+
+
+def framed_len(shard_len: int, chunk: int) -> int:
+    return load_native().mt_framed_len(shard_len, chunk)
+
+
+_u8p = ctypes.POINTER(ctypes.c_uint8)
+
+
+def put_block(data, data_len: int, pmat: np.ndarray, k: int, m: int,
+              shard_len: int, chunk: int, key: bytes) -> np.ndarray:
+    """Fused split+encode+hash+frame for one erasure block.
+
+    ``data`` is a readable buffer of ``data_len`` bytes; returns a uint8
+    array of (k+m)*framed_len bytes — shard i's framed bytes are
+    ``out[i*framed_len:(i+1)*framed_len]`` (slice views, no copies).
+    """
+    lib = load_native()
+    fl = lib.mt_framed_len(shard_len, chunk)
+    out = np.empty((k + m) * fl, dtype=np.uint8)
+    src = np.frombuffer(data, dtype=np.uint8, count=data_len)
+    pmat = np.ascontiguousarray(pmat, dtype=np.uint8)
+    lib.mt_put_block(
+        src.ctypes.data_as(_u8p), data_len,
+        pmat.ctypes.data_as(ctypes.c_char_p), k, m, shard_len, chunk, key,
+        out.ctypes.data_as(_u8p))
+    return out
+
+
+def get_block(framed: list, k: int, plen: int, chunk: int,
+              key: bytes) -> tuple[np.ndarray, int]:
+    """Fused verify+assemble: k framed shard buffers -> (block uint8
+    [k*plen], bad_shard) where bad_shard is -1 on success."""
+    lib = load_native()
+    arrs = [np.frombuffer(f, dtype=np.uint8) for f in framed]
+    ptrs = (ctypes.c_void_p * k)(*[a.ctypes.data for a in arrs])
+    out = np.empty(k * plen, dtype=np.uint8)
+    bad = lib.mt_get_block(ptrs, k, plen, chunk, key,
+                           out.ctypes.data_as(_u8p))
+    return out, bad
+
+
+def verify_framed(framed, plen: int, chunk: int, key: bytes) -> int:
+    """Verify one framed span; returns -1 ok or the first corrupt chunk."""
+    lib = load_native()
+    arr = np.frombuffer(framed, dtype=np.uint8)
+    return lib.mt_verify_framed(arr.ctypes.data_as(_u8p), plen, chunk, key)

@@ -19,28 +19,9 @@ _lib = None
 
 
 def load() -> ctypes.CDLL:
-    global _lib
-    with _LOCK:
-        if _lib is not None:
-            return _lib
-        from . import _compile, _BUILD
-        src = os.path.join(_DIR, "highwayhash.cpp")
-        out = os.path.join(_BUILD, "libhighwayhash.so")
-        if not os.path.exists(out) or \
-                os.path.getmtime(out) < os.path.getmtime(src):
-            _compile(src, out)
-        lib = ctypes.CDLL(out)
-        lib.hh256.argtypes = [ctypes.c_char_p, ctypes.c_char_p,
-                              ctypes.c_long, ctypes.c_char_p]
-        lib.hh256.restype = None
-        lib.hh256_batch.argtypes = [ctypes.c_char_p, ctypes.c_char_p,
-                                    ctypes.c_int, ctypes.c_long,
-                                    ctypes.c_long, ctypes.c_char_p]
-        lib.hh256_batch.restype = None
-        lib.hh64.argtypes = [ctypes.c_char_p, ctypes.c_char_p, ctypes.c_long]
-        lib.hh64.restype = ctypes.c_uint64
-        _lib = lib
-        return lib
+    """The combined libnative.so serves the hh* symbols."""
+    from . import load_native
+    return load_native()
 
 
 def hash256(key: bytes, data: bytes) -> bytes:

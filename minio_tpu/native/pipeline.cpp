@@ -1,0 +1,184 @@
+// Fused CPU data-plane pipeline: one call per erasure block.
+//
+// The reference's hot write loop does split -> RS encode (SIMD) -> per-shard
+// HighwayHash framing -> disk writes, each stage a separate pass
+// (cmd/erasure-encode.go:73-109, cmd/bitrot-streaming.go:74-89). On a
+// tunnel-attached TPU the CPU route carries single hot PUTs (see
+// minio_tpu/runtime/dispatch.py), and in Python each stage costs a pass over
+// the data plus interpreter overhead per shard. mt_put_block fuses the whole
+// block into one GIL-releasing native call, chunk-major so every byte is
+// touched while still cache-resident:
+//
+//   for each bitrot chunk position:
+//     copy k data-shard chunks into their framed slots  (split)
+//     GF(256)-accumulate m parity chunks into theirs    (encode)
+//     HighwayHash all k+m chunks, interleaved x2        (bitrot digests)
+//
+// mt_get_block is the read-side inverse: verify every chunk digest of the k
+// data shards and scatter the payloads into the caller's contiguous block
+// (replaces cmd/bitrot-streaming.go:115-151 verify + erasure-utils.go
+// writeDataBlocks for the healthy-read path).
+//
+// This TU includes the standalone kernels so one libnative.so serves the
+// gf256, highwayhash, and pipeline entry points.
+#include "gf256_simd.cpp"
+#include "highwayhash.cpp"
+
+#include <cstdint>
+#include <cstring>
+
+namespace {
+
+// dst[0:len] (^)= c * src[0:len] in GF(256); first=true overwrites
+inline void gf_accum(uint8_t c, const uint8_t* src, uint8_t* dst, long len,
+                     bool first) {
+  long p = 0;
+  if (c == 0) {
+    if (first) std::memset(dst, 0, (size_t)len);
+    return;
+  }
+  if (c == 1) {
+    if (first) {
+      std::memcpy(dst, src, (size_t)len);
+    } else {
+      long q = 0;
+#ifdef __AVX2__
+      for (; q + 32 <= len; q += 32) {
+        __m256i v = _mm256_loadu_si256((const __m256i*)(src + q));
+        __m256i a = _mm256_loadu_si256((const __m256i*)(dst + q));
+        _mm256_storeu_si256((__m256i*)(dst + q), _mm256_xor_si256(a, v));
+      }
+#endif
+      for (; q < len; q++) dst[q] ^= src[q];
+    }
+    return;
+  }
+#ifdef __AVX2__
+  const __m256i tlo =
+      _mm256_broadcastsi128_si256(_mm_loadu_si128((const __m128i*)T.lo[c]));
+  const __m256i thi =
+      _mm256_broadcastsi128_si256(_mm_loadu_si128((const __m128i*)T.hi[c]));
+  const __m256i mask = _mm256_set1_epi8(0x0F);
+  for (; p + 32 <= len; p += 32) {
+    __m256i v = _mm256_loadu_si256((const __m256i*)(src + p));
+    __m256i l = _mm256_and_si256(v, mask);
+    __m256i h = _mm256_and_si256(_mm256_srli_epi64(v, 4), mask);
+    __m256i r = _mm256_xor_si256(_mm256_shuffle_epi8(tlo, l),
+                                 _mm256_shuffle_epi8(thi, h));
+    if (!first) r = _mm256_xor_si256(
+        r, _mm256_loadu_si256((const __m256i*)(dst + p)));
+    _mm256_storeu_si256((__m256i*)(dst + p), r);
+  }
+#endif
+  const uint8_t* mrow = T.mul[c];
+  if (first)
+    for (; p < len; p++) dst[p] = mrow[src[p]];
+  else
+    for (; p < len; p++) dst[p] ^= mrow[src[p]];
+}
+
+}  // namespace
+
+extern "C" {
+
+// Framed shard file size for one block: ceil(shard_len/chunk)*32 + shard_len.
+long mt_framed_len(long shard_len, long chunk) {
+  if (shard_len <= 0) return 0;
+  return ((shard_len + chunk - 1) / chunk) * 32 + shard_len;
+}
+
+// One PUT block: split `data` (data_len bytes, zero-padded to k*shard_len)
+// into k shards, compute m parity shards (pmat is the [m,k] parity rows),
+// and emit k+m bitrot-framed shards ([32B digest][chunk] interleaving,
+// chunk size `chunk`) into `out` — (k+m) consecutive spans of
+// mt_framed_len(shard_len, chunk) bytes each.
+void mt_put_block(const uint8_t* data, long data_len, const uint8_t* pmat,
+                  int k, int m, long shard_len, long chunk,
+                  const uint64_t key[4], uint8_t* out) {
+  const long framed_len = mt_framed_len(shard_len, chunk);
+  const long stride = 32 + chunk;  // full-chunk frame stride
+  const uint8_t* hp[256];
+  long hl[256];
+  uint8_t* hd[256];
+  long ci = 0;
+  for (long c0 = 0; c0 < shard_len; c0 += chunk, ci++) {
+    const long clen = (shard_len - c0 < chunk) ? shard_len - c0 : chunk;
+    int nh = 0;
+    // data shards: copy payloads into framed slots (zero-pad past data end)
+    for (int i = 0; i < k; i++) {
+      uint8_t* frame = out + (size_t)i * framed_len + ci * stride;
+      uint8_t* payload = frame + 32;
+      const long spos = (long)i * shard_len + c0;
+      long avail = data_len - spos;
+      if (avail < 0) avail = 0;
+      if (avail > clen) avail = clen;
+      if (avail) std::memcpy(payload, data + spos, (size_t)avail);
+      if (avail < clen) std::memset(payload + avail, 0, (size_t)(clen - avail));
+      hp[nh] = payload;
+      hl[nh] = clen;
+      hd[nh] = frame;  // digest slot
+      nh++;
+    }
+    // parity shards: GF-accumulate from the k payloads still in cache
+    for (int o = 0; o < m; o++) {
+      uint8_t* frame = out + (size_t)(k + o) * framed_len + ci * stride;
+      uint8_t* payload = frame + 32;
+      for (int i = 0; i < k; i++)
+        gf_accum(pmat[o * k + i],
+                 out + (size_t)i * framed_len + ci * stride + 32, payload,
+                 clen, i == 0);
+      hp[nh] = payload;
+      hl[nh] = clen;
+      hd[nh] = frame;
+      nh++;
+    }
+    // digest all k+m chunk payloads (x2-interleaved on AVX2)
+    uint8_t digs[256 * 32];
+    hh256_many(key, hp, hl, nh, digs);
+    for (int i = 0; i < nh; i++) std::memcpy(hd[i], digs + i * 32, 32);
+  }
+}
+
+// One healthy-read block: `framed` points at k framed data-shard spans (each
+// covering `plen` payload bytes chunked at `chunk`); verify every digest and
+// scatter payloads into out[i*plen ...]. Returns -1 on success or the index
+// of the first shard with a digest mismatch.
+int mt_get_block(const uint8_t* const* framed, int k, long plen, long chunk,
+                 const uint64_t key[4], uint8_t* out) {
+  const long stride = 32 + chunk;
+  const uint8_t* hp[256];
+  long hl[256];
+  uint8_t digs[256 * 32];
+  long ci = 0;
+  for (long c0 = 0; c0 < plen; c0 += chunk, ci++) {
+    const long clen = (plen - c0 < chunk) ? plen - c0 : chunk;
+    for (int i = 0; i < k; i++) {
+      hp[i] = framed[i] + ci * stride + 32;
+      hl[i] = clen;
+    }
+    hh256_many(key, hp, hl, k, digs);
+    for (int i = 0; i < k; i++) {
+      if (std::memcmp(digs + i * 32, framed[i] + ci * stride, 32) != 0)
+        return i;
+      std::memcpy(out + (size_t)i * plen + c0, hp[i], (size_t)clen);
+    }
+  }
+  return -1;
+}
+
+// Verify-only over one framed span (deep scan / VerifyFile): returns -1 ok,
+// else the index of the first corrupt chunk.
+long mt_verify_framed(const uint8_t* framed, long plen, long chunk,
+                      const uint64_t key[4]) {
+  const long stride = 32 + chunk;
+  uint8_t dig[32];
+  long ci = 0;
+  for (long c0 = 0; c0 < plen; c0 += chunk, ci++) {
+    const long clen = (plen - c0 < chunk) ? plen - c0 : chunk;
+    hh256(key, framed + ci * stride + 32, clen, dig);
+    if (std::memcmp(dig, framed + ci * stride, 32) != 0) return ci;
+  }
+  return -1;
+}
+
+}  // extern "C"
