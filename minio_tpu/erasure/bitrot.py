@@ -258,24 +258,40 @@ class StreamingBitrotReader:
         only algorithm with a device kernel."""
         return self.algo is BitrotAlgorithm.HIGHWAYHASH256S
 
-    def read_at_raw(self, offset: int, length: int) -> tuple[bytes, bytes]:
-        """Read (digests, payload) without verifying — the fused device path
-        (ops/fused.py) checks the digests in the same launch as the
-        reconstruct. offset must be chunk-aligned; ``digests`` is the
-        concatenation of the per-chunk digests covering the read (all chunks
-        full-size except possibly the last)."""
+    def _read_phys_span(self, offset: int, length: int) -> bytes:
+        """Shared guard + physical-span read for the three read entries:
+        offset must be chunk-aligned, the span must not pass till_offset,
+        and a span ending mid-chunk is only legal at stream end (a short
+        final chunk is only ever stored there — hashing a prefix of a full
+        stored chunk would report spurious corruption). Returns the raw
+        framed blob covering ceil(length/chunk) digests + length payload
+        bytes."""
         if offset % self.shard_size:
             raise ValueError(f"unaligned bitrot read at {offset}")
         if offset + length > self.till_offset:
             raise errors.FileCorrupt(
                 f"bitrot read [{offset}, {offset + length}) past shard end "
                 f"{self.till_offset}")
+        if length % self.shard_size and offset + length != self.till_offset:
+            raise ValueError(
+                f"bitrot read [{offset}, {offset + length}) ends mid-chunk "
+                f"before stream end {self.till_offset}")
         h = self.algo.digest_size
         n_chunks = -(-length // self.shard_size) if length else 0
         phys = (offset // self.shard_size) * (self.shard_size + h)
         blob = self.src.read_at(phys, n_chunks * h + length)
         if len(blob) < n_chunks * h + length:
             raise errors.FileCorrupt("short bitrot stream")
+        return blob
+
+    def read_at_raw(self, offset: int, length: int) -> tuple[bytes, bytes]:
+        """Read (digests, payload) without verifying — the fused device path
+        (ops/fused.py) checks the digests in the same launch as the
+        reconstruct. offset must be chunk-aligned; ``digests`` is the
+        concatenation of the per-chunk digests covering the read (all chunks
+        full-size except possibly the last)."""
+        blob = self._read_phys_span(offset, length)
+        h = self.algo.digest_size
         digests = bytearray()
         payload = bytearray()
         pos = 0
@@ -293,41 +309,19 @@ class StreamingBitrotReader:
         the digest headers left in place — the native fused read path
         (native/pipeline.cpp mt_get_block) verifies and strips them in one
         pass. offset must be chunk-aligned."""
-        if offset % self.shard_size:
-            raise ValueError(f"unaligned bitrot read at {offset}")
-        if offset + length > self.till_offset:
-            raise errors.FileCorrupt(
-                f"bitrot read [{offset}, {offset + length}) past shard end "
-                f"{self.till_offset}")
-        h = self.algo.digest_size
-        n_chunks = -(-length // self.shard_size) if length else 0
-        phys = (offset // self.shard_size) * (self.shard_size + h)
-        blob = self.src.read_at(phys, n_chunks * h + length)
-        if len(blob) < n_chunks * h + length:
-            raise errors.FileCorrupt("short bitrot stream")
-        return blob
+        return self._read_phys_span(offset, length)
 
     def read_at(self, offset: int, length: int) -> bytes:
         if length == 0:
             return b""
-        if offset % self.shard_size:
-            raise ValueError(f"unaligned bitrot read at {offset}")
-        if offset + length > self.till_offset:
-            raise errors.FileCorrupt(
-                f"bitrot read [{offset}, {offset + length}) past shard end "
-                f"{self.till_offset}")
         # ONE backing read for the whole span (a chunk-per-call loop would
         # turn a block read into n_chunks IO round-trips — ruinous when the
         # source is a remote-disk RPC), then verify all full-size chunks
         # with one batched hash call; only a short tail chunk goes through
         # the per-chunk path.
+        blob = self._read_phys_span(offset, length)
         h = self.algo.digest_size
         cs = self.shard_size
-        n_chunks = -(-length // cs)
-        phys = (offset // cs) * (cs + h)
-        blob = self.src.read_at(phys, n_chunks * h + length)
-        if len(blob) < n_chunks * h + length:
-            raise errors.FileCorrupt("short bitrot stream")
         n_full = length // cs
         out = bytearray()
         if n_full:

@@ -37,6 +37,71 @@ def io_pool() -> ThreadPoolExecutor:
     return _io_pool
 
 
+# Pool for the GIL-releasing native per-block calls (mt_put_block /
+# mt_get_block): sized to the host so pipelined blocks from one stream and
+# concurrent streams both scale across cores.
+_encode_pool: ThreadPoolExecutor | None = None
+
+
+def encode_pool() -> ThreadPoolExecutor:
+    global _encode_pool
+    if _encode_pool is None:
+        _encode_pool = ThreadPoolExecutor(
+            max_workers=max(4, os.cpu_count() or 1),
+            thread_name_prefix="minio-tpu-encode")
+    return _encode_pool
+
+
+def _native_put_eligible(erasure: Erasure, writers: list) -> bool:
+    """True when the whole block pipeline (split+encode+hash+frame) can run
+    as one native GIL-releasing call per block (native/pipeline.cpp
+    mt_put_block) with on-disk output bit-identical to the Python path."""
+    if os.environ.get("MINIO_TPU_PUT_PATH", "auto") == "dispatch":
+        return False
+    from .bitrot import BitrotAlgorithm, StreamingBitrotWriter
+    live = [w for w in writers if w is not None]
+    if not live:
+        return False
+    if not all(isinstance(w, StreamingBitrotWriter)
+               and w.algo is BitrotAlgorithm.HIGHWAYHASH256S
+               and not w._buf for w in live):
+        return False
+    chunks = {w.shard_size for w in live}
+    if len(chunks) != 1:
+        return False
+    (chunk,) = chunks
+    # chunk must divide the full-block shard so per-block framing equals
+    # stream framing (pick_bitrot_chunk guarantees this for new objects)
+    if erasure.shard_size() % chunk:
+        return False
+    from .. import native
+    return native.available()
+
+
+def _native_get_eligible(erasure: Erasure, readers: list) -> bool:
+    """True when healthy reads can run the fused native verify+assemble
+    (mt_get_block): all k data-shard readers alive and HighwayHash-framed
+    with one chunk size dividing the shard."""
+    if os.environ.get("MINIO_TPU_GET_PATH", "auto") == "dispatch":
+        return False
+    from .bitrot import BitrotAlgorithm, StreamingBitrotReader
+    k = erasure.data_blocks
+    if len(readers) < k:
+        return False
+    data = readers[:k]
+    if not all(isinstance(r, StreamingBitrotReader)
+               and r.algo is BitrotAlgorithm.HIGHWAYHASH256S for r in data):
+        return False
+    chunks = {r.shard_size for r in data}
+    if len(chunks) != 1:
+        return False
+    (chunk,) = chunks
+    if erasure.shard_size() % chunk:
+        return False
+    from .. import native
+    return native.available()
+
+
 @dataclass
 class DecodeStats:
     """Per-call telemetry: which shard sources failed (for heal-on-read,
@@ -87,14 +152,29 @@ class _OrderedWriter:
     def __init__(self, writer):
         self.writer = writer
         self._last: Future | None = None
+        self._dead: BaseException | None = None
 
     def write_async(self, data: bytes) -> Future:
+        return self._chain(lambda: self.writer.write(data))
+
+    def write_framed_async(self, framed) -> Future:
+        """Chain a pre-framed write (native fast path: digests already
+        interleaved by mt_put_block)."""
+        return self._chain(lambda: self.writer.write_framed(framed))
+
+    def _chain(self, op) -> Future:
         out: Future = Future()
+        if self._dead is not None:
+            # A prior write on this disk already failed; don't keep paying
+            # for up to a window of doomed writes to a known-dead sink.
+            out.set_exception(self._dead)
+            return out
 
         def run():
             try:
-                out.set_result(self.writer.write(data))
+                out.set_result(op())
             except Exception as e:  # noqa: BLE001
+                self._dead = e
                 out.set_exception(e)
 
         prev, self._last = self._last, out
@@ -120,18 +200,56 @@ def erasure_encode(erasure: Erasure, stream, writers: list,
     the dispatch queue (so one stream's blocks batch into few device
     launches), and shard writes ride per-disk ordered chains so disks never
     barrier on each other between blocks; write-quorum errors are harvested
-    per block as its writes drain."""
+    per block as its writes drain.
+
+    When every live writer is HighwayHash-framed and the native library is
+    built, each block instead runs as ONE GIL-releasing mt_put_block call
+    (split+encode+hash+frame fused, native/pipeline.cpp) on encode_pool —
+    block-level pipelining then scales across cores, which the per-stage
+    Python path cannot (the round-2 e2e wall)."""
     total = 0
     owriters = [None if w is None else _OrderedWriter(w) for w in writers]
-    enc_window: deque = deque()   # Futures of encoded shard lists
+    enc_window: deque = deque()   # (kind, Future, shard_len) per block
     write_window: deque = deque()  # per-block {writer idx: write Future}
 
-    def start_writes(shards):
+    native_path = _native_put_eligible(erasure, writers)
+    if native_path:
+        from .. import native
+        from .bitrot import HIGHWAY_KEY
+        k, m = erasure.data_blocks, erasure.parity_blocks
+        pmat = np.ascontiguousarray(erasure.codec.parity_rows)
+        chunk = next(w.shard_size for w in writers if w is not None)
+
+    def encode_block(buf: bytes):
+        if not native_path:
+            return ("py", erasure.encode_data_async(buf), 0)
+        if not buf:
+            return ("nat", None, 0)
+        shard_len = ceil_div(len(buf), k)
+        fut = encode_pool().submit(
+            native.put_block, buf, len(buf), pmat, k, m, shard_len, chunk,
+            HIGHWAY_KEY)
+        return ("nat", fut, shard_len)
+
+    def start_writes(entry):
+        kind, fut, shard_len = entry
         futs = {}
-        for i, ow in enumerate(owriters):
-            if ow is None or writers[i] is None:
-                continue
-            futs[i] = ow.write_async(shards[i].tobytes())
+        if kind == "py":
+            shards = fut.result()
+            for i, ow in enumerate(owriters):
+                if ow is None or writers[i] is None:
+                    continue
+                futs[i] = ow.write_async(shards[i].tobytes())
+        else:
+            framed = fut.result() if fut is not None else None
+            fl = native.framed_len(shard_len, chunk) \
+                if framed is not None else 0
+            for i, ow in enumerate(owriters):
+                if ow is None or writers[i] is None:
+                    continue
+                span = framed[i * fl:(i + 1) * fl] \
+                    if framed is not None else b""
+                futs[i] = ow.write_framed_async(span)
         write_window.append(futs)
 
     def harvest_writes():
@@ -161,14 +279,14 @@ def erasure_encode(erasure: Erasure, stream, writers: list,
                     eof = True
                     if total == 0 and not enc_window:
                         # empty object: one empty block for quorum accounting
-                        enc_window.append(erasure.encode_data_async(b""))
+                        enc_window.append(encode_block(b""))
                     break
                 if len(buf) < erasure.block_size:
                     eof = True
                 total += len(buf)
-                enc_window.append(erasure.encode_data_async(buf))
+                enc_window.append(encode_block(buf))
             if enc_window:
-                start_writes(enc_window.popleft().result())
+                start_writes(enc_window.popleft())
             while len(write_window) > (ENCODE_WINDOW if enc_window or not eof
                                        else 0):
                 harvest_writes()
@@ -316,6 +434,31 @@ def erasure_decode(erasure: Erasure, writer, readers: list, offset: int,
     start_block = offset // bs
     end_block = (offset + length) // bs
 
+    native_get = _native_get_eligible(erasure, readers)
+    if native_get:
+        from .. import native
+        from .bitrot import HIGHWAY_KEY
+        fuse_chunk = readers[0].shard_size
+
+    def read_framed_k(shard_offset: int, shard_len: int):
+        """Concurrently read the k data shards' framed spans; on any read
+        failure mark the reader dead and return None (the caller falls back
+        to the generic replacement-read path for this block)."""
+        futs = {io_pool().submit(preader.readers[i].read_framed,
+                                 shard_offset, shard_len): i
+                for i in range(k)}
+        out: list = [None] * k
+        failed = False
+        for f, i in futs.items():
+            try:
+                out[i] = f.result()
+            except Exception as e:  # noqa: BLE001 — disk errors become votes
+                preader.errs[i] = e if isinstance(e, errors.StorageError) \
+                    else errors.FaultyDisk(str(e))
+                preader.readers[i] = None
+                failed = True
+        return None if failed else out
+
     window: deque = deque()
 
     def submit(b: int):
@@ -333,6 +476,17 @@ def erasure_decode(erasure: Erasure, writer, readers: list, offset: int,
             return None
         shard_len = ceil_div(block_data_len, k)
         shard_offset = b * erasure.shard_size()
+        # Healthy stream + native library -> fused verify+assemble: one
+        # GIL-releasing mt_get_block call checks every chunk digest and
+        # scatters payloads (replaces the numpy per-chunk verify)
+        if native_get and all(preader.readers[i] is not None
+                              for i in range(k)):
+            framed = read_framed_k(shard_offset, shard_len)
+            if framed is not None:
+                fut = encode_pool().submit(
+                    native.get_block, framed, k, shard_len, fuse_chunk,
+                    HIGHWAY_KEY)
+                return ["native", fut, b, block_data_len, boff, blen]
         # Degraded data read + device-hash-capable sources -> fused
         # verify+reconstruct: one launch hashes every source shard AND
         # rebuilds the missing ones (BASELINE config 4). Healthy streams
@@ -353,6 +507,26 @@ def erasure_decode(erasure: Erasure, writer, readers: list, offset: int,
     def emit(entry):
         kind, fut, b, block_data_len, boff, blen = entry
         res = fut.result()
+        if kind == "native":
+            out_arr, bad = res
+            if bad >= 0:
+                # native path caught a bitrot mismatch on shard `bad`: drop
+                # it, redo this block via CPU-verified replacement reads,
+                # and resubmit the pending window (their reads also carried
+                # the corrupt shard)
+                preader.drop_corrupt((bad,))
+                blocks = erasure.decode_data_blocks(preader.read_block(
+                    b * erasure.shard_size(), ceil_div(block_data_len, k)))
+                pending = list(window)
+                window.clear()
+                for e in pending:
+                    window.append(e if e[0] == "plain" else submit(e[2]))
+                block = np.concatenate(blocks[:k]).tobytes()[:block_data_len]
+                writer.write(block[boff: boff + blen])
+            else:
+                writer.write(out_arr[boff: boff + blen].tobytes())
+            stats.bytes_written += blen
+            return
         if kind == "fused":
             blocks, corrupt = res
             if corrupt:

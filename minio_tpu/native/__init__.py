@@ -27,6 +27,7 @@ _DIR = os.path.dirname(os.path.abspath(__file__))
 _BUILD = os.path.join(_DIR, "_build")
 _LOCK = threading.Lock()
 _lib: ctypes.CDLL | None = None
+_load_error: Exception | None = None
 
 _SOURCES = ("pipeline.cpp", "gf256_simd.cpp", "highwayhash.cpp")
 
@@ -49,11 +50,26 @@ def _compile(src: str, out: str) -> None:
 
 
 def load_native() -> ctypes.CDLL:
-    """Build (once) and load the combined native library."""
-    global _lib
+    """Build (once) and load the combined native library. A failure is
+    cached: without this, every request on a host where the build fails
+    would retry full g++ runs serialized under _LOCK instead of falling
+    back to the Python path once."""
+    global _lib, _load_error
     with _LOCK:
         if _lib is not None:
             return _lib
+        if _load_error is not None:
+            raise _load_error
+        try:
+            return _load_native_locked()
+        except Exception as e:  # noqa: BLE001
+            _load_error = e
+            raise
+
+
+def _load_native_locked() -> ctypes.CDLL:
+    global _lib
+    if _lib is None:
         out = os.path.join(_BUILD, "libnative.so")
         src_mtime = max(os.path.getmtime(os.path.join(_DIR, s))
                         for s in _SOURCES)
@@ -97,7 +113,7 @@ def load_native() -> ctypes.CDLL:
                                          ctypes.c_char_p]
         lib.mt_verify_framed.restype = ctypes.c_long
         _lib = lib
-        return lib
+    return _lib
 
 
 def load_gf256() -> ctypes.CDLL:
@@ -143,6 +159,8 @@ def put_block(data, data_len: int, pmat: np.ndarray, k: int, m: int,
     ``out[i*framed_len:(i+1)*framed_len]`` (slice views, no copies).
     """
     lib = load_native()
+    if k + m > 256 or k <= 0 or m < 0 or chunk <= 0:
+        raise ValueError(f"unsupported geometry k={k} m={m} chunk={chunk}")
     fl = lib.mt_framed_len(shard_len, chunk)
     out = np.empty((k + m) * fl, dtype=np.uint8)
     src = np.frombuffer(data, dtype=np.uint8, count=data_len)
@@ -159,6 +177,8 @@ def get_block(framed: list, k: int, plen: int, chunk: int,
     """Fused verify+assemble: k framed shard buffers -> (block uint8
     [k*plen], bad_shard) where bad_shard is -1 on success."""
     lib = load_native()
+    if k <= 0 or k > 256 or chunk <= 0:
+        raise ValueError(f"unsupported geometry k={k} chunk={chunk}")
     arrs = [np.frombuffer(f, dtype=np.uint8) for f in framed]
     ptrs = (ctypes.c_void_p * k)(*[a.ctypes.data for a in arrs])
     out = np.empty(k * plen, dtype=np.uint8)

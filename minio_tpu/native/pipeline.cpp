@@ -95,6 +95,7 @@ long mt_framed_len(long shard_len, long chunk) {
 void mt_put_block(const uint8_t* data, long data_len, const uint8_t* pmat,
                   int k, int m, long shard_len, long chunk,
                   const uint64_t key[4], uint8_t* out) {
+  if (k + m > 256 || k <= 0 || m < 0 || chunk <= 0) return;  // hp/hl/hd bound
   const long framed_len = mt_framed_len(shard_len, chunk);
   const long stride = 32 + chunk;  // full-chunk frame stride
   const uint8_t* hp[256];
@@ -145,6 +146,7 @@ void mt_put_block(const uint8_t* data, long data_len, const uint8_t* pmat,
 // of the first shard with a digest mismatch.
 int mt_get_block(const uint8_t* const* framed, int k, long plen, long chunk,
                  const uint64_t key[4], uint8_t* out) {
+  if (k <= 0 || k > 256 || chunk <= 0) return -2;  // hp/hl/digs bound
   const long stride = 32 + chunk;
   const uint8_t* hp[256];
   long hl[256];

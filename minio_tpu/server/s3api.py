@@ -669,9 +669,12 @@ class _S3Handler(BaseHTTPRequestHandler):
             import base64
             import binascii
             try:
-                md5_hex = base64.b64decode(md5_b64, validate=True).hex()
+                decoded = base64.b64decode(md5_b64, validate=True)
             except (binascii.Error, ValueError) as e:
                 raise dt.InvalidDigest(self.bucket, self.key) from e
+            if len(decoded) != 16:
+                raise dt.InvalidDigest(self.bucket, self.key)
+            md5_hex = decoded.hex()
         return HashReader(self._body_stream(size), size, md5_hex, sha_hex)
 
     def _user_meta(self) -> dict[str, str]:
