@@ -244,9 +244,14 @@ def e2e_put(rng) -> dict:
 
 def heal_latency(rng) -> dict:
     """p50/p99 wall-clock latency of ONE 16+4 heal-shard rebuild (1 MiB
-    block, 2 lost shards) through the dispatch queue, at 1/8/128
-    concurrent requesters — the north-star's latency half."""
+    block, 2 lost shards) through the dispatch queue, at 1/8/128 concurrent
+    requesters — the north-star's latency half. Measured on BOTH routes
+    (MINIO_TPU_DISPATCH_MODE=cpu and =device) so the deployment's actual
+    choice is informed: through the axon tunnel the device route pays the
+    full round-trip per flush; on a PCIe-attached chip it wins."""
     import threading
+
+    import jax
     from minio_tpu.ops import rs_jax
     from minio_tpu.runtime.dispatch import global_queue
     K, M, BLOCK = 16, 4, 1 << 20
@@ -257,51 +262,70 @@ def heal_latency(rng) -> dict:
     masks = codec.target_masks_np(present, (3, 17))
     words = rs_jax.pack_shards(
         rng.integers(0, 256, (K, shard), dtype=np.uint8))
-    # warm every pow2 batch shape the timed runs can hit (a first-time jit
-    # compile inside the timed region would own the p99)
-    for warm_burst in (1, 2, 8, 16, 64, 128, 128):
-        futs = [q.masked(codec, words, masks) for _ in range(warm_burst)]
-        for f in futs:
-            f.result()
+
+    def run_mode(mode: str) -> dict:
+        # warm every pow2 batch shape the timed runs can hit (a first-time
+        # jit compile inside the timed region would own the p99)
+        for warm_burst in (1, 2, 8, 16, 64, 128, 128):
+            futs = [q.masked(codec, words, masks) for _ in range(warm_burst)]
+            for f in futs:
+                f.result()
+        res = {}
+        for conc in (1, 8, 128):
+            n_ops = 40 if conc == 1 else max(conc * 3, 120)
+            lats: list[float] = []
+            lock = threading.Lock()
+
+            def worker(count):
+                for _ in range(count):
+                    t0 = time.perf_counter()
+                    q.masked(codec, words, masks).result()
+                    dt = time.perf_counter() - t0
+                    with lock:
+                        lats.append(dt)
+
+            per_worker = max(1, n_ops // conc)
+            threads = [threading.Thread(target=worker, args=(per_worker,))
+                       for _ in range(conc)]
+            t0 = time.perf_counter()
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            wall = time.perf_counter() - t0
+            arr = np.array(sorted(lats))
+            p50 = float(np.percentile(arr, 50)) * 1e3
+            p99 = float(np.percentile(arr, 99)) * 1e3
+            thr = len(lats) * BLOCK / wall / (1 << 30)
+            log(f"heal-shard latency [{mode}] conc={conc}: p50={p50:.1f}ms "
+                f"p99={p99:.1f}ms agg={thr:.2f} GiB/s ({len(lats)} ops)")
+            res[f"conc{conc}"] = {"p50_ms": round(p50, 1),
+                                  "p99_ms": round(p99, 1),
+                                  "agg_gibs": round(thr, 2)}
+        return res
+
     out = {}
-    for conc in (1, 8, 128):
-        n_ops = 40 if conc == 1 else max(conc * 3, 120)
-        lats: list[float] = []
-        lock = threading.Lock()
-
-        def worker(count):
-            for _ in range(count):
-                t0 = time.perf_counter()
-                q.masked(codec, words, masks).result()
-                dt = time.perf_counter() - t0
-                with lock:
-                    lats.append(dt)
-
-        per_worker = max(1, n_ops // conc)
-        threads = [threading.Thread(target=worker, args=(per_worker,))
-                   for _ in range(conc)]
-        t0 = time.perf_counter()
-        for t in threads:
-            t.start()
-        for t in threads:
-            t.join()
-        wall = time.perf_counter() - t0
-        arr = np.array(sorted(lats))
-        p50 = float(np.percentile(arr, 50)) * 1e3
-        p99 = float(np.percentile(arr, 99)) * 1e3
-        thr = len(lats) * BLOCK / wall / (1 << 30)
-        log(f"heal-shard latency conc={conc}: p50={p50:.1f}ms "
-            f"p99={p99:.1f}ms agg={thr:.2f} GiB/s ({len(lats)} ops)")
-        out[f"conc{conc}"] = {"p50_ms": round(p50, 1),
-                              "p99_ms": round(p99, 1),
-                              "agg_gibs": round(thr, 2)}
+    prev = os.environ.get("MINIO_TPU_DISPATCH_MODE")
+    modes = ["cpu"] + (["device"]
+                       if jax.default_backend() != "cpu" else [])
+    try:
+        for mode in modes:
+            os.environ["MINIO_TPU_DISPATCH_MODE"] = mode
+            out[mode] = run_mode(mode)
+    finally:
+        if prev is None:
+            os.environ.pop("MINIO_TPU_DISPATCH_MODE", None)
+        else:
+            os.environ["MINIO_TPU_DISPATCH_MODE"] = prev
     st = q.stats()
     prof = q._get_profile()
     out["dispatch"] = {
         "batches": st["batches"], "cpu_batches": st["cpu_batches"],
+        "completers": q.completer_count,
         "link_rt_ms": round(prof.rt_s * 1e3, 1) if prof else None,
         "link_up_gibs": round(prof.up_gibs, 3) if prof else None,
         "link_down_gibs": round(prof.down_gibs, 3) if prof else None,
+        "link_cpu_gibs": round(prof.cpu_gibs, 2) if prof else None,
     }
     return out
 

@@ -32,7 +32,14 @@ _io_pool: ThreadPoolExecutor | None = None
 def io_pool() -> ThreadPoolExecutor:
     global _io_pool
     if _io_pool is None:
-        _io_pool = ThreadPoolExecutor(max_workers=64,
+        # scale with the host: local-disk "IO" on tmpfs/page-cache is
+        # really CPU (memcpy), so a 64-thread pool on a small host only
+        # buys GIL churn; remote-RPC deployments can raise the floor via
+        # MINIO_TPU_IO_THREADS
+        workers = int(os.environ.get(
+            "MINIO_TPU_IO_THREADS",
+            str(min(64, max(8, 4 * (os.cpu_count() or 1))))))
+        _io_pool = ThreadPoolExecutor(max_workers=workers,
                                       thread_name_prefix="minio-tpu-io")
     return _io_pool
 
@@ -139,6 +146,12 @@ def parallel_write_shards(writers: list, shards: list[np.ndarray],
 #: single hot PUT, shallow enough to bound buffering (window * block_size
 #: bytes live at once).
 ENCODE_WINDOW = int(os.environ.get("MINIO_TPU_ENCODE_WINDOW", "16"))
+
+#: The native per-block path doesn't batch into device launches, so its
+#: window only needs to cover pipeline overlap (encode pool + write chains).
+#: A deep window on a small host is pure thread churn — measured 4.5x worse
+#: 8-way-parallel PUT at window 16 vs 4 on one core.
+NATIVE_WINDOW = min(ENCODE_WINDOW, max(4, 2 * (os.cpu_count() or 1)))
 
 
 class _OrderedWriter:
@@ -270,10 +283,11 @@ def erasure_encode(erasure: Erasure, stream, writers: list,
         if err is not None:
             raise err
 
+    win = NATIVE_WINDOW if native_path else ENCODE_WINDOW
     eof = False
     try:
         while not eof or enc_window or write_window:
-            while not eof and len(enc_window) < ENCODE_WINDOW:
+            while not eof and len(enc_window) < win:
                 buf = _read_full(stream, erasure.block_size)
                 if not buf:
                     eof = True
@@ -287,7 +301,7 @@ def erasure_encode(erasure: Erasure, stream, writers: list,
                 enc_window.append(encode_block(buf))
             if enc_window:
                 start_writes(enc_window.popleft())
-            while len(write_window) > (ENCODE_WINDOW if enc_window or not eof
+            while len(write_window) > (win if enc_window or not eof
                                        else 0):
                 harvest_writes()
     except BaseException:
@@ -504,58 +518,51 @@ def erasure_decode(erasure: Erasure, writer, readers: list, offset: int,
         return ["plain", erasure.decode_data_blocks_async(shards), b,
                 block_data_len, boff, blen]
 
+    def recover_block(corrupt: tuple[int, ...], b: int,
+                      block_data_len: int) -> list:
+        """Shared bitrot-mismatch recovery for the device-verified paths
+        (native and fused): the rebuilt/assembled data is garbage — drop
+        the corrupt sources, redo this block via CPU-verified replacement
+        reads, then RESUBMIT the pending window entries (their reads also
+        carried the corrupt shard) so the pipeline recovers in one batch
+        instead of stalling block by block (the reference's
+        readTriggerCh-on-bitrot behavior)."""
+        preader.drop_corrupt(corrupt)
+        blocks = erasure.decode_data_blocks(preader.read_block(
+            b * erasure.shard_size(), ceil_div(block_data_len, k)))
+        pending = list(window)
+        window.clear()
+        for e in pending:
+            window.append(e if e[0] == "plain" else submit(e[2]))
+        return blocks
+
     def emit(entry):
         kind, fut, b, block_data_len, boff, blen = entry
         res = fut.result()
         if kind == "native":
             out_arr, bad = res
-            if bad >= 0:
-                # native path caught a bitrot mismatch on shard `bad`: drop
-                # it, redo this block via CPU-verified replacement reads,
-                # and resubmit the pending window (their reads also carried
-                # the corrupt shard)
-                preader.drop_corrupt((bad,))
-                blocks = erasure.decode_data_blocks(preader.read_block(
-                    b * erasure.shard_size(), ceil_div(block_data_len, k)))
-                pending = list(window)
-                window.clear()
-                for e in pending:
-                    window.append(e if e[0] == "plain" else submit(e[2]))
-                block = np.concatenate(blocks[:k]).tobytes()[:block_data_len]
-                writer.write(block[boff: boff + blen])
-            else:
+            if bad < 0:
                 writer.write(out_arr[boff: boff + blen].tobytes())
-            stats.bytes_written += blen
-            return
-        if kind == "fused":
+                stats.bytes_written += blen
+                return
+            blocks = recover_block((bad,), b, block_data_len)
+        elif kind == "fused":
             blocks, corrupt = res
             if corrupt:
-                # device caught a bitrot mismatch: the rebuilt data is
-                # garbage — drop the corrupt sources, redo this block via
-                # CPU-verified replacement reads, then RESUBMIT the pending
-                # fused entries (their raw reads also carried the corrupt
-                # shard) so the pipeline recovers in one batch instead of
-                # stalling block by block (the reference's
-                # readTriggerCh-on-bitrot behavior)
-                preader.drop_corrupt(corrupt)
-                blocks = erasure.decode_data_blocks(preader.read_block(
-                    b * erasure.shard_size(), ceil_div(block_data_len, k)))
-                pending = list(window)
-                window.clear()
-                for e in pending:
-                    window.append(e if e[0] == "plain" else submit(e[2]))
+                blocks = recover_block(corrupt, b, block_data_len)
         else:
             blocks = res
         block = np.concatenate(blocks[:k]).tobytes()[:block_data_len]
         writer.write(block[boff: boff + blen])
         stats.bytes_written += blen
 
+    win = NATIVE_WINDOW if native_get else ENCODE_WINDOW
     for b in range(start_block, end_block + 1):
         entry = submit(b)
         if entry is None:
             break
         window.append(entry)
-        if len(window) >= ENCODE_WINDOW:
+        if len(window) >= win:
             emit(window.popleft())
     while window:
         emit(window.popleft())

@@ -32,6 +32,7 @@ Enable/disable batching entirely with MINIO_TPU_DISPATCH=1/0 (default: on).
 """
 from __future__ import annotations
 
+import logging
 import os
 import threading
 import time
@@ -40,8 +41,18 @@ from dataclasses import dataclass, field
 
 import numpy as np
 
+log = logging.getLogger("minio_tpu.dispatch")
+
 MAX_BATCH = int(os.environ.get("MINIO_TPU_DISPATCH_BATCH", "128"))
 MAX_DELAY_S = float(os.environ.get("MINIO_TPU_DISPATCH_DELAY_MS", "1.0")) / 1e3
+#: Link profile age after which a background re-probe is kicked (a one-shot
+#: probe would pin the device/CPU routing decision to one possibly-transient
+#: measurement forever).
+PROBE_TTL_S = float(os.environ.get("MINIO_TPU_PROBE_TTL_S", "60"))
+#: CPU-route completer threads; sized to the host so the CPU fallback's
+#: aggregate is not capped below the per-core kernel rate.
+COMPLETERS = int(os.environ.get(
+    "MINIO_TPU_COMPLETERS", str(max(4, os.cpu_count() or 4))))
 
 
 def dispatch_enabled() -> bool:
@@ -49,8 +60,10 @@ def dispatch_enabled() -> bool:
 
 
 class LinkProfile:
-    """One-time measurement of the host<->device link + CPU kernel rate,
-    feeding the device-vs-CPU routing decision."""
+    """Measurement of the host<->device link + CPU kernel rate, feeding the
+    device-vs-CPU routing decision. Re-measured every PROBE_TTL_S in the
+    background (see DispatchQueue._get_profile) so one transient slow probe
+    can't pin the route forever."""
 
     def __init__(self, rt_s: float, up_gibs: float, down_gibs: float,
                  cpu_gibs: float):
@@ -58,6 +71,7 @@ class LinkProfile:
         self.up_gibs = max(up_gibs, 1e-4)
         self.down_gibs = max(down_gibs, 1e-4)
         self.cpu_gibs = max(cpu_gibs, 1e-4)
+        self.measured_at = time.monotonic()
 
     @classmethod
     def probe(cls) -> "LinkProfile":
@@ -92,17 +106,22 @@ class LinkProfile:
             native.cpu_encode(pmat, d, 4)
         cpu = 8 * (1 << 20) / max(time.monotonic() - t0, 1e-6) / (1 << 30)
         prof = cls(rt, up, down, cpu)
-        import sys
-        print(f"minio-tpu dispatch link probe: rt={rt*1e3:.1f}ms "
-              f"up={up:.3f}GiB/s down={down:.3f}GiB/s cpu={cpu:.2f}GiB/s",
-              file=sys.stderr)
+        log.info("dispatch link probe: rt=%.1fms up=%.3fGiB/s "
+                 "down=%.3fGiB/s cpu=%.2fGiB/s",
+                 rt * 1e3, up, down, cpu)
         return prof
 
-    def device_wins(self, bytes_in: int, bytes_out: int,
+    def device_wins(self, bytes_in: int, bytes_out: int, n_items: int = 1,
+                    cpu_workers: int = COMPLETERS,
                     kernel_s: float = 2e-3) -> bool:
+        """Predicted device time vs CPU time for one flush. The CPU route
+        runs per-item on ``cpu_workers`` completer threads (the native
+        kernel releases the GIL), so its wall time divides by the effective
+        parallelism — the model must agree with the executor it models."""
         t_dev = self.rt_s + bytes_in / self.up_gibs / (1 << 30) \
             + bytes_out / self.down_gibs / (1 << 30) + kernel_s
-        t_cpu = (bytes_in + bytes_out) / self.cpu_gibs / (1 << 30)
+        par = max(1, min(n_items, cpu_workers))
+        t_cpu = (bytes_in + bytes_out) / self.cpu_gibs / (1 << 30) / par
         return t_dev < t_cpu
 
 
@@ -134,9 +153,11 @@ def _pad_batch(n: int) -> int:
 
 class DispatchQueue:
     def __init__(self, max_batch: int = MAX_BATCH,
-                 max_delay: float = MAX_DELAY_S, completers: int = 4):
+                 max_delay: float = MAX_DELAY_S,
+                 completers: int = COMPLETERS):
         self.max_batch = max_batch
         self.max_delay = max_delay
+        self.completer_count = completers
         self._lock = threading.Lock()
         self._cv = threading.Condition(self._lock)
         self._buckets: dict[tuple, _Bucket] = {}
@@ -145,6 +166,8 @@ class DispatchQueue:
         self._stop = False
         self._profile: LinkProfile | None = None
         self._profile_failed = False
+        self._probe_failed_at = 0.0
+        self._probe_running = False
         self._profile_lock = threading.Lock()
         self._thread = threading.Thread(
             target=self._loop, name="minio-tpu-dispatch", daemon=True)
@@ -153,6 +176,12 @@ class DispatchQueue:
         self.batches = 0
         self.items = 0
         self.cpu_batches = 0
+        # warm the profile off the request path: in auto mode the first
+        # flush would otherwise absorb the full probe cost (device
+        # transfers + 8 CPU encodes) inside its latency
+        if dispatch_enabled() and os.environ.get(
+                "MINIO_TPU_DISPATCH_MODE", "auto") == "auto":
+            self._kick_probe()
 
     # --- submission ---------------------------------------------------------
 
@@ -248,15 +277,48 @@ class DispatchQueue:
 
     # --- device-vs-CPU routing ----------------------------------------------
 
+    def _kick_probe(self):
+        """Run (or refresh) the link probe on a background thread; callers
+        keep using the previous profile (or the static default route) until
+        the new measurement lands."""
+        with self._profile_lock:
+            if self._probe_running:
+                return
+            self._probe_running = True
+
+        def run():
+            try:
+                prof = LinkProfile.probe()
+                with self._profile_lock:
+                    self._profile = prof
+                    self._profile_failed = False
+            except Exception:  # noqa: BLE001 — no device: CPU-only
+                with self._profile_lock:
+                    self._profile_failed = True
+                    self._probe_failed_at = time.monotonic()
+            finally:
+                with self._profile_lock:
+                    self._probe_running = False
+
+        threading.Thread(target=run, name="minio-tpu-probe",
+                         daemon=True).start()
+
     def _get_profile(self) -> LinkProfile | None:
-        if self._profile is None and not self._profile_failed:
-            with self._profile_lock:
-                if self._profile is None and not self._profile_failed:
-                    try:
-                        self._profile = LinkProfile.probe()
-                    except Exception:  # noqa: BLE001 — no device: CPU-only
-                        self._profile_failed = True
-        return self._profile
+        """Current link profile; stale or missing profiles trigger a
+        background re-probe without blocking the caller. Failed probes back
+        off for a full TTL — without that, a device that dies after a good
+        first probe would trigger back-to-back probe attempts (device
+        transfers + CPU encodes each) on every flush, forever."""
+        prof = self._profile
+        backoff = self._profile_failed and \
+            time.monotonic() - self._probe_failed_at < PROBE_TTL_S
+        if prof is None:
+            if not backoff:
+                self._kick_probe()
+        elif time.monotonic() - prof.measured_at > PROBE_TTL_S \
+                and not backoff:
+            self._kick_probe()
+        return prof
 
     def _route(self, b: _Bucket, items: list[_Pending]) -> str:
         mode = os.environ.get("MINIO_TPU_DISPATCH_MODE", "auto")
@@ -264,6 +326,8 @@ class DispatchQueue:
             return mode
         prof = self._get_profile()
         if prof is None:
+            # probe still in flight (or failed): CPU is the safe default —
+            # it always works and single-flush latency never eats a probe
             return "cpu"
         n = len(items)
         w = items[0].words
@@ -273,7 +337,8 @@ class DispatchQueue:
             out_rows = items[0].masks.shape[1]
             bytes_in += n * items[0].masks.nbytes
         bytes_out = n * out_rows * w.shape[-1] * 4
-        return "device" if prof.device_wins(bytes_in, bytes_out) else "cpu"
+        return "device" if prof.device_wins(
+            bytes_in, bytes_out, n, self.completer_count) else "cpu"
 
     @staticmethod
     def _rows_from_masks(masks: np.ndarray) -> np.ndarray:
@@ -325,13 +390,31 @@ class DispatchQueue:
         if self._route(b, items) == "cpu":
             self._flush_cpu(b, items)
             return
+        try:
+            self._flush_device(b, items)
+        except Exception:  # noqa: BLE001 — dead/hung device: degrade
+            log.warning("device flush failed; falling back to CPU route",
+                        exc_info=True)
+            self._mark_device_failed()
+            self.batches -= 1  # _flush_cpu re-counts this flush
+            self.items -= len(items)
+            self._flush_cpu(b, items)
+
+    def _mark_device_failed(self):
+        with self._profile_lock:
+            self._profile = None
+            self._profile_failed = True
+            self._probe_failed_at = time.monotonic()
+
+    def _flush_device(self, b: _Bucket, items: list[_Pending]):
         import jax.numpy as jnp
         n = len(items)
         bsz = _pad_batch(n)
-        stack = np.stack([p.words for p in items] +
-                         [items[0].words] * (bsz - n))
+        # count first so the fallback's decrement is always balanced
         self.batches += 1
         self.items += n
+        stack = np.stack([p.words for p in items] +
+                         [items[0].words] * (bsz - n))
         if b.op == "encode":
             out_dev = b.codec._mm_batch(b.codec._enc_masks, jnp.asarray(stack))
         elif b.op == "masked":
@@ -349,12 +432,11 @@ class DispatchQueue:
                 b.hash_key, jnp.asarray(masks), jnp.asarray(stack),
                 jnp.asarray(digs), b.codec._mm_batch_per, b.chunk_size)
         # hand host readback to a completer so the next batch launches now
-        self._completers.submit(self._complete, b.op, out_dev, items)
+        self._completers.submit(self._complete, b, out_dev, items)
 
-    @staticmethod
-    def _complete(op: str, out_dev, items: list[_Pending]):
+    def _complete(self, b: _Bucket, out_dev, items: list[_Pending]):
         try:
-            if op == "fused":
+            if b.op == "fused":
                 out = np.asarray(out_dev[0])
                 valid = np.asarray(out_dev[1])
                 for i, p in enumerate(items):
@@ -363,10 +445,15 @@ class DispatchQueue:
                 out = np.asarray(out_dev)
                 for i, p in enumerate(items):
                     p.future.set_result(out[i])
-        except Exception as e:  # noqa: BLE001
-            for p in items:
-                if not p.future.done():
-                    p.future.set_exception(e)
+        except Exception:  # noqa: BLE001 — readback died: CPU salvages
+            log.warning("device readback failed; salvaging flush on CPU",
+                        exc_info=True)
+            self._mark_device_failed()
+            pending = [p for p in items if not p.future.done()]
+            if pending:
+                self.batches -= 1
+                self.items -= len(pending)
+                self._flush_cpu(b, pending)
 
     def stop(self):
         with self._cv:

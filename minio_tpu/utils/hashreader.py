@@ -82,10 +82,12 @@ class HashReader:
         self._read = 0
         self._eof = False
         self._async: _AsyncDigest | None = None
-        if size < 0 or size >= ASYNC_DIGEST_MIN:
-            hashes = [self._md5] + (
-                [self._sha256] if self._sha256 is not None else [])
-            self._async = _AsyncDigest(hashes)
+        if size >= ASYNC_DIGEST_MIN:
+            self._async = _AsyncDigest(self._hashes())
+
+    def _hashes(self) -> list:
+        return [self._md5] + (
+            [self._sha256] if self._sha256 is not None else [])
 
     def read(self, n: int = -1) -> bytes:
         if self._eof:
@@ -106,6 +108,12 @@ class HashReader:
             self._finish()
             return b""
         self._read += len(b)
+        if self._async is None and self.size < 0 and \
+                self._read >= ASYNC_DIGEST_MIN:
+            # unknown-size body that turned out large: move the digest
+            # chain to a worker from here on (hash state carries over, so
+            # inline-hashed bytes so far stay counted)
+            self._async = _AsyncDigest(self._hashes())
         if self._async is not None:
             self._async.update(b)
         else:
