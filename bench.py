@@ -189,6 +189,48 @@ def bench_dir() -> str | None:
     return None
 
 
+def host_profile(rng) -> dict:
+    """Primitive single-thread rates that bound the e2e configs on this
+    host: the serial PUT chain is read + MD5(ETag) + fused encode+hash +
+    framed file write, so on an N-core host the achievable ceiling is
+    roughly min(stage rates) (pipelined) or 1/sum(1/rates) on one core.
+    Recorded so the e2e numbers are interpretable against the hardware."""
+    import tempfile as tf
+    import time as tm
+    out = {"cpus": os.cpu_count()}
+    buf = rng.integers(0, 256, 32 << 20, dtype=np.uint8).tobytes()
+    import hashlib
+    h = hashlib.md5()
+    t0 = tm.perf_counter()
+    h.update(buf)
+    out["md5_gibs"] = round(len(buf) / (tm.perf_counter() - t0) / (1 << 30), 2)
+    d = tf.mkdtemp(dir=bench_dir())
+    try:
+        t0 = tm.perf_counter()
+        with open(os.path.join(d, "f"), "wb") as f:
+            f.write(buf)
+        out["file_write_gibs"] = round(
+            len(buf) / (tm.perf_counter() - t0) / (1 << 30), 2)
+    finally:
+        shutil.rmtree(d, ignore_errors=True)
+    try:
+        from minio_tpu import native
+        from minio_tpu.ops import gf256
+        pmat = gf256.build_matrix(4, 2)[4:]
+        native.put_block(buf[:1 << 20], 1 << 20, pmat, 4, 2, 1 << 18,
+                         16384, b"\x00" * 32)
+        t0 = tm.perf_counter()
+        for i in range(16):
+            native.put_block(buf[i << 20:(i + 1) << 20], 1 << 20, pmat,
+                             4, 2, 1 << 18, 16384, b"\x00" * 32)
+        out["native_put_block_gibs"] = round(
+            16 * (1 << 20) / (tm.perf_counter() - t0) / (1 << 30), 2)
+    except Exception:  # noqa: BLE001 — no native build
+        pass
+    log(f"host: {out}")
+    return out
+
+
 def e2e_put(rng) -> dict:
     """Config 1: end-to-end PutObject through object layer -> erasure ->
     bitrot writers -> local disks, 4+2 and 16+4, serial and 8-way
@@ -333,6 +375,7 @@ def heal_latency(rng) -> dict:
 def main() -> None:
     rng = np.random.default_rng(0)
     cpu_gibs = cpu_baseline(rng)
+    host = host_profile(rng)
     dev = device_configs(rng)
     put = e2e_put(rng)
     lat = heal_latency(rng)
@@ -345,6 +388,7 @@ def main() -> None:
         "vs_baseline": round(enc / cpu_gibs, 2),
         "extra": {
             "cpu_avx2_encode_gibs": round(cpu_gibs, 2),
+            "host": host,
             "e2e_put_gibs": put,                      # config 1
             "encode_sweep_8p4_gibs": dev["encode_sweep_8p4"],  # config 2
             "reconstruct_2loss_gibs": round(

@@ -164,6 +164,33 @@ class StorageRESTClient(StorageAPI):
                                       "recursive": int(recursive)})
         yield from msgpack.unpackb(blob, raw=False)
 
+    #: Page size for the remote metadata walk: bounds per-RPC payload while
+    #: keeping round-trips ~1 per listing page.
+    WALK_PAGE = 1000
+
+    def walk_versions(self, volume: str, prefix: str = "", marker: str = "",
+                      limit: int = -1):
+        """Paged remote walk: each RPC returns up to WALK_PAGE sorted
+        (name, xl.meta) pairs after the rolling marker, so the remote disk
+        does O(page) work per call no matter the namespace size."""
+        got = 0
+        cur = marker
+        while True:
+            page = self.WALK_PAGE if limit < 0 else min(
+                self.WALK_PAGE, limit - got)
+            if page <= 0:
+                return
+            blob = self._call("walkversions", {
+                "volume": volume, "prefix": prefix, "marker": cur,
+                "limit": page})
+            entries = msgpack.unpackb(blob, raw=False)
+            for name, raw in entries:
+                got += 1
+                cur = name
+                yield name, raw
+            if len(entries) < page:
+                return
+
 
 class _RemoteFileWriter:
     """Streams shard blocks to the remote disk: first write truncates
@@ -350,4 +377,10 @@ class StorageRESTService:
     def _h_walkdir(self, d, p, b):
         entries = list(d.walk_dir(p["volume"], p.get("dir", ""),
                                   bool(int(p.get("recursive", "1")))))
+        return msgpack.packb(entries, use_bin_type=True)
+
+    def _h_walkversions(self, d, p, b):
+        entries = list(d.walk_versions(
+            p["volume"], p.get("prefix", ""), p.get("marker", ""),
+            int(p.get("limit", "-1"))))
         return msgpack.packb(entries, use_bin_type=True)

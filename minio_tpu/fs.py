@@ -227,10 +227,6 @@ class FSObjects(ObjectLayer):
             self, bucket, prefix, marker, version_marker, delimiter,
             max_keys)
 
-    def _walk_merged(self, bucket, prefix=""):
-        from .objectlayer.erasure_objects import ErasureObjects
-        return ErasureObjects._walk_merged(self, bucket, prefix)
-
     @property
     def disks(self):
         return [self.disk]

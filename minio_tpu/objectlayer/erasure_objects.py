@@ -587,71 +587,87 @@ class ErasureObjects(MultipartMixin, ObjectLayer):
 
     # --- list --------------------------------------------------------------
 
-    def _walk_merged(self, bucket: str, prefix: str = "") -> list[str]:
-        """Merged sorted object names across disks (quorum-free union —
-        listing consistency matches the reference's 'listing is advisory'
-        stance)."""
-        names: set[str] = set()
-        found_any_disk = False
-        for d in self.disks:
-            if d is None:
+    def _iter_resolved(self, bucket: str, prefix: str = "",
+                       marker: str = ""):
+        """Stream (name, XLMeta) pairs from the metacache merge — O(page)
+        metadata touched per page consumed (replaces the full-namespace
+        _walk_merged + per-key quorum fan-out the round-2 review flagged).
+        """
+        from .metacache import merged_entries
+        for entry in merged_entries(self.disks, bucket, prefix, marker):
+            meta = entry.resolve()
+            if meta is None or not meta.versions:
                 continue
+            yield entry.name, meta
+
+    def iter_objects(self, bucket: str, prefix: str = "") -> "Iterator":
+        """Streaming iterator of latest-version ObjectInfo for background
+        services (scanner, global heal): one pass, no paging restarts,
+        delete markers skipped."""
+        for name, meta in self._iter_resolved(bucket, prefix):
             try:
-                dir_path = prefix if prefix.endswith("/") else \
-                    ("/".join(prefix.split("/")[:-1]) if "/" in prefix else "")
-                names.update(d.walk_dir(bucket, dir_path.rstrip("/")))
-                found_any_disk = True
-            except errors.VolumeNotFound:
-                raise
+                fi = meta.to_fileinfo(bucket, name)
             except errors.StorageError:
                 continue
-        if not found_any_disk:
-            raise errors.ErasureReadQuorum()
-        return sorted(n for n in names if n.startswith(prefix))
+            if fi.deleted:
+                continue
+            yield ObjectInfo.from_file_info(fi, bucket, name,
+                                            bool(fi.version_id))
 
     def list_objects(self, bucket: str, prefix: str = "", marker: str = "",
                      delimiter: str = "", max_keys: int = 1000
                      ) -> ListObjectsInfo:
         check_names(bucket)
         self.get_bucket_info(bucket)
-        try:
-            names = self._walk_merged(bucket, prefix)
-        except errors.VolumeNotFound:
-            raise dt.BucketNotFound(bucket) from None
         out = ListObjectsInfo()
         seen_prefixes: set[str] = set()
         count = 0
         last_emitted = ""  # S3 marker semantics: the LAST key returned
-        for name in names:
-            if marker and name <= marker:
-                continue
-            if delimiter:
-                rest = name[len(prefix):]
-                if delimiter in rest:
-                    cp = prefix + rest.split(delimiter)[0] + delimiter
-                    if marker and cp <= marker:
-                        continue  # whole prefix listed on a previous page
-                    if cp not in seen_prefixes:
-                        if count >= max_keys:
-                            out.is_truncated = True
-                            out.next_marker = last_emitted
-                            break
-                        seen_prefixes.add(cp)
-                        out.prefixes.append(cp)
-                        last_emitted = cp
-                        count += 1
-                    continue
-            if count >= max_keys:
-                out.is_truncated = True
-                out.next_marker = last_emitted
-                break
-            try:
-                oi = self.get_object_info(bucket, name)
-            except (dt.ObjectNotFound, dt.InsufficientReadQuorum):
-                continue  # latest is a delete marker or unhealthy
-            out.objects.append(oi)
-            last_emitted = name
-            count += 1
+        # past-subtree sentinel: restarting the walk at cp+HIGH skips every
+        # key under a collapsed common prefix without reading its metadata
+        # (the reference forwards the metacache stream the same way) — a
+        # delimiter page stays O(page), not O(largest subtree)
+        high = "\U0010ffff"
+        walk_from = marker
+        try:
+            done = False
+            while not done:
+                done = True
+                for name, meta in self._iter_resolved(bucket, prefix,
+                                                      walk_from):
+                    if delimiter:
+                        rest = name[len(prefix):]
+                        if delimiter in rest:
+                            cp = prefix + rest.split(delimiter)[0] + delimiter
+                            if cp not in seen_prefixes and \
+                                    not (marker and cp <= marker):
+                                if count >= max_keys:
+                                    out.is_truncated = True
+                                    out.next_marker = last_emitted
+                                    return out
+                                seen_prefixes.add(cp)
+                                out.prefixes.append(cp)
+                                last_emitted = cp
+                                count += 1
+                            walk_from = cp + high
+                            done = False
+                            break  # restart the merge past this subtree
+                    try:
+                        fi = meta.to_fileinfo(bucket, name)
+                    except errors.StorageError:
+                        continue
+                    if fi.deleted:
+                        continue  # latest is a delete marker
+                    if count >= max_keys:
+                        out.is_truncated = True
+                        out.next_marker = last_emitted
+                        return out
+                    out.objects.append(ObjectInfo.from_file_info(
+                        fi, bucket, name, bool(fi.version_id)))
+                    last_emitted = name
+                    count += 1
+        except errors.VolumeNotFound:
+            raise dt.BucketNotFound(bucket) from None
         return out
 
     def list_object_versions(self, bucket: str, prefix: str = "",
@@ -660,11 +676,15 @@ class ErasureObjects(MultipartMixin, ObjectLayer):
                              ) -> ListObjectVersionsInfo:
         check_names(bucket)
         self.get_bucket_info(bucket)
-        names = self._walk_merged(bucket, prefix)
         out = ListObjectVersionsInfo()
         count = 0
         seen_prefixes: set[str] = set()
-        for name in names:
+        # resume at the marker key itself when a version_marker continues
+        # inside it (walk markers are exclusive, so back off by one key)
+        walk_marker = ""
+        if marker:
+            walk_marker = marker[:-1] if version_marker else marker
+        for name, meta in self._iter_resolved(bucket, prefix, walk_marker):
             if marker and name < marker:
                 continue
             if marker and name == marker and not version_marker:
@@ -677,17 +697,7 @@ class ErasureObjects(MultipartMixin, ObjectLayer):
                         seen_prefixes.add(cp)
                         out.prefixes.append(cp)
                     continue
-            vers = None
-            for d in self.disks:
-                if d is None:
-                    continue
-                try:
-                    vers = d.list_versions(bucket, name)
-                    break
-                except errors.StorageError:
-                    continue
-            if vers is None:
-                continue
+            vers = meta.list_versions(bucket, name)
             # resume inside the marker key: versions are mod_time-ordered,
             # so skip until the marker version id is passed (identity match,
             # not lexicographic — uuids don't sort by recency)

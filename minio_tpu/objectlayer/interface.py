@@ -55,6 +55,19 @@ class ObjectLayer(abc.ABC):
                      delimiter: str = "", max_keys: int = 1000
                      ) -> ListObjectsInfo: ...
 
+    def iter_objects(self, bucket: str, prefix: str = ""):
+        """Streaming iterator over latest-version objects for background
+        services (scanner, global heal). Default: marker paging over
+        list_objects; erasure layers override with a single metacache
+        walk."""
+        marker = ""
+        while True:
+            r = self.list_objects(bucket, prefix, marker, max_keys=1000)
+            yield from r.objects
+            if not r.is_truncated or not r.next_marker:
+                return
+            marker = r.next_marker
+
     @abc.abstractmethod
     def list_object_versions(self, bucket: str, prefix: str = "",
                              marker: str = "", version_marker: str = "",

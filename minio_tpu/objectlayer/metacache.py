@@ -1,0 +1,113 @@
+"""Metacache-style listing: per-disk sorted metadata walks merged with
+version-quorum resolution (reference cmd/metacache-server-pool.go:59,
+cmd/metacache-walk.go, cmd/metacache-entries.go).
+
+The reference streams each disk's WalkDir (sorted names + inline xl.meta),
+merges the streams, quorum-resolves each name's version journal, and
+persists 5000-entry blocks for reuse. The TPU build keeps the same shape
+minus persistence: every StorageAPI exposes ``walk_versions`` (marker and
+prefix pushed down into the directory descent — O(page) touched per page),
+``merged_entries`` lazily k-way-merges the streams with ``heapq.merge``,
+and resolution picks the journal a write-quorum majority agrees on.
+
+Emission rule (cmd/metacache-entries.go resolve analogue): a committed
+write lands its journal on >= n//2+1 disks (write quorum), and a committed
+delete removes it from >= n//2+1, so an entry is emitted iff found on
+``min(n//2+1, live_disks)`` walked disks — stale ghosts (<= parity copies)
+are dropped without any per-key RPC fan-out."""
+from __future__ import annotations
+
+import heapq
+from dataclasses import dataclass, field
+from typing import Iterator
+
+from ..storage.xlmeta import XLMeta
+from ..utils import errors
+
+
+@dataclass
+class MetaCacheEntry:
+    """One merged namespace entry: the object name plus every walked
+    disk's raw xl.meta bytes."""
+    name: str
+    raws: list[bytes] = field(default_factory=list)
+
+    _meta: XLMeta | None = None
+
+    def resolve(self) -> XLMeta | None:
+        """The agreed version journal: byte-identical fast path first
+        (no parse per replica), else parse all and take the journal with
+        the newest latest-version mod_time (any disk that accepted the
+        last committed write has it; stale disks lose the comparison).
+        Returns None when no replica parses."""
+        if self._meta is not None:
+            return self._meta
+        first = self.raws[0]
+        if all(r == first for r in self.raws[1:]):
+            try:
+                self._meta = XLMeta.load(first)
+            except errors.FileCorrupt:
+                self._meta = None
+            return self._meta
+        best: XLMeta | None = None
+        best_t = -1.0
+        for raw in self.raws:
+            try:
+                m = XLMeta.load(raw)
+            except errors.FileCorrupt:
+                continue
+            t = m.latest_mod_time()
+            if t > best_t or (t == best_t and best is not None
+                              and len(m.versions) > len(best.versions)):
+                best, best_t = m, t
+        self._meta = best
+        return best
+
+
+def merged_entries(disks: list, bucket: str, prefix: str = "",
+                   marker: str = "") -> Iterator[MetaCacheEntry]:
+    """Lazily merge every online disk's sorted walk_versions stream and
+    group by name, applying the write-quorum emission rule. Raises
+    ErasureReadQuorum when no disk can walk at all; VolumeNotFound
+    propagates (bucket existence is a harder error than a sick disk)."""
+    streams = []
+    vol_missing = 0
+    total = len(disks)
+    for d in disks:
+        if d is None:
+            continue
+        try:
+            it = iter(d.walk_versions(bucket, prefix, marker))
+            first = next(it, None)
+        except errors.VolumeNotFound:
+            vol_missing += 1
+            continue
+        except errors.StorageError:
+            continue
+
+        def stream(first_item, rest):
+            if first_item is not None:
+                yield first_item
+            try:
+                yield from rest
+            except errors.StorageError:
+                return  # disk died mid-walk: its remaining votes vanish
+
+        streams.append(stream(first, it))
+    if not streams:
+        if vol_missing:
+            raise errors.VolumeNotFound(bucket)
+        raise errors.ErasureReadQuorum()
+    need = min(total // 2 + 1, len(streams))
+    merged = heapq.merge(*streams, key=lambda t: t[0])
+    cur: MetaCacheEntry | None = None
+    for name, raw in merged:
+        if cur is not None and name != cur.name:
+            if len(cur.raws) >= need:
+                yield cur
+            cur = None
+        if cur is None:
+            cur = MetaCacheEntry(name=name)
+        cur.raws.append(raw)
+    if cur is not None and len(cur.raws) >= need:
+        yield cur

@@ -146,6 +146,24 @@ class ServerPools(ObjectLayer):
                                    max_keys) for p in self.pools]
         return _merge_list_results(per_pool, max_keys)
 
+    def iter_objects(self, bucket, prefix=""):
+        """Streaming merge across pools; an object that exists in several
+        pools (mid-expansion) is emitted once, newest mod_time wins."""
+        import heapq
+        pending = None
+        for oi in heapq.merge(*(p.iter_objects(bucket, prefix)
+                                for p in self.pools),
+                              key=lambda o: o.name):
+            if pending is not None and oi.name == pending.name:
+                if oi.mod_time > pending.mod_time:
+                    pending = oi
+                continue
+            if pending is not None:
+                yield pending
+            pending = oi
+        if pending is not None:
+            yield pending
+
     def list_object_versions(self, bucket, prefix="", marker="",
                              version_marker="", delimiter="", max_keys=1000):
         out = None

@@ -123,6 +123,14 @@ class ErasureSets(ObjectLayer):
                                   max_keys) for s in self.sets]
         return _merge_list_results(per_set, max_keys)
 
+    def iter_objects(self, bucket, prefix=""):
+        """Streaming merge of every set's metacache walk (names don't
+        collide across sets — placement is by name hash)."""
+        import heapq
+        yield from heapq.merge(*(s.iter_objects(bucket, prefix)
+                                 for s in self.sets),
+                               key=lambda oi: oi.name)
+
     def list_object_versions(self, bucket, prefix="", marker="",
                              version_marker="", delimiter="", max_keys=1000
                              ) -> ListObjectVersionsInfo:

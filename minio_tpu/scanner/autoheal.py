@@ -68,18 +68,14 @@ class GlobalHealer:
             for b in self.obj.list_buckets():
                 self.obj.heal_bucket(b.name)
                 results["buckets"] += 1
-                marker = ""
-                while True:
-                    r = self.obj.list_objects(b.name, marker=marker,
-                                              max_keys=1000)
-                    for oi in r.objects:
-                        futs.append(pool.submit(
-                            self._heal_one, b.name, oi.name, scan_mode))
-                        if len(futs) >= max_inflight:
-                            reap(futs.popleft())
-                    if not r.is_truncated or not r.next_marker:
-                        break
-                    marker = r.next_marker
+                # streaming metacache pass: O(concurrency) memory and no
+                # per-page namespace restarts (cmd/global-heal.go:123 walks
+                # the erasure set's disks the same way)
+                for oi in self.obj.iter_objects(b.name):
+                    futs.append(pool.submit(
+                        self._heal_one, b.name, oi.name, scan_mode))
+                    if len(futs) >= max_inflight:
+                        reap(futs.popleft())
             while futs:
                 reap(futs.popleft())
         finally:

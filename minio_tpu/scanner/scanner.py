@@ -48,22 +48,17 @@ class DataScanner:
         total_objects = total_size = 0
         for b in self.obj.list_buckets():
             count = size = versions = 0
-            marker = ""
-            while True:
-                r = self.obj.list_objects(b.name, marker=marker,
-                                          max_keys=1000)
-                for oi in r.objects:
-                    if self._stop.is_set():
-                        return self.last_usage
-                    count += 1
-                    size += oi.size
-                    versions += max(1, oi.num_versions)
-                    self._check_object(b.name, oi, deep)
-                    if self.sleep_per_object:
-                        time.sleep(self.sleep_per_object)
-                if not r.is_truncated or not r.next_marker:
-                    break
-                marker = r.next_marker
+            # one streaming metacache pass per bucket — no paging restarts
+            # (cmd/data-scanner.go crawls the disks directly the same way)
+            for oi in self.obj.iter_objects(b.name):
+                if self._stop.is_set():
+                    return self.last_usage
+                count += 1
+                size += oi.size
+                versions += max(1, oi.num_versions)
+                self._check_object(b.name, oi, deep)
+                if self.sleep_per_object:
+                    time.sleep(self.sleep_per_object)
             buckets[b.name] = {"objects": count, "size": size,
                                "versions": versions}
             total_objects += count

@@ -142,3 +142,29 @@ class StorageAPI(abc.ABC):
         """Yield sorted object paths (entries owning an xl.meta) under
         dir_path (reference WalkDir, cmd/metacache-walk.go)."""
         ...
+
+    def walk_versions(self, volume: str, prefix: str = "", marker: str = "",
+                      limit: int = -1) -> Iterator[tuple[str, bytes]]:
+        """Stream (object_name, raw xl.meta bytes) in sorted key order,
+        names strictly after ``marker``, matching ``prefix`` — the
+        metadata-carrying walk the metacache listing merges
+        (cmd/metacache-walk.go sends metadata inline the same way).
+
+        Default: derive from walk_dir + read_all (correct but O(namespace)
+        per call); real backends override with marker push-down. walk_dir's
+        filesystem descent order differs from S3 key order around the "/"
+        separator ("a!b" < "a/c" as keys, but dir "a" walks before "a!b"),
+        so the names are collected and sorted here — the merge machinery
+        depends on strict key order."""
+        emitted = 0
+        for name in sorted(self.walk_dir(volume, "")):
+            if not name.startswith(prefix) or name <= marker:
+                continue
+            if limit >= 0 and emitted >= limit:
+                return
+            try:
+                blob = self.read_all(volume, f"{name}/xl.meta")
+            except Exception:  # noqa: BLE001 — raced with delete
+                continue
+            emitted += 1
+            yield name, blob

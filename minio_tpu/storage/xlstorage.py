@@ -426,3 +426,67 @@ class XLStorage(StorageAPI):
                         yield child + "/"
 
         yield from walk(root, dir_path)
+
+    def walk_versions(self, volume: str, prefix: str = "", marker: str = "",
+                      limit: int = -1) -> Iterator[tuple[str, bytes]]:
+        """Stream (object_name, raw xl.meta bytes) in S3 lexicographic key
+        order, names strictly after ``marker`` and matching ``prefix`` —
+        the per-disk sorted metadata stream the metacache merge consumes
+        (reference WalkDir, cmd/metacache-walk.go).
+
+        Marker and prefix push down into the directory descent, so a page
+        read touches O(page) of the namespace, not all of it. Sort order
+        treats non-leaf directories as ``name + "/"`` (the reference's
+        trailing-slash convention) because a subtree's keys all carry the
+        separator, which sorts differently from the bare dir name."""
+        base = self._abs(volume)
+        if not os.path.isdir(base):
+            raise errors.VolumeNotFound(volume)
+        high = "\U0010ffff"
+        emitted = 0
+
+        def walk(d: str, rel: str) -> Iterator[tuple[str, bytes]]:
+            nonlocal emitted
+            try:
+                names = os.listdir(d)
+            except (FileNotFoundError, NotADirectoryError):
+                return
+            ents = []
+            for n in names:
+                sub = os.path.join(d, n)
+                if not os.path.isdir(sub):
+                    continue
+                leaf = os.path.isfile(os.path.join(sub, XL_META_FILE))
+                ents.append((n if leaf else n + "/", n, leaf, sub))
+            for sort_key, n, leaf, sub in sorted(ents):
+                if limit >= 0 and emitted >= limit:
+                    return
+                child = f"{rel}/{n}" if rel else n
+                cmp_key = child if leaf else child + "/"
+                # sorted order: once past the prefix range, nothing later
+                # can match
+                if prefix and cmp_key > prefix and \
+                        not cmp_key.startswith(prefix) and \
+                        not prefix.startswith(cmp_key):
+                    return
+                if leaf:
+                    if child > marker and child.startswith(prefix):
+                        try:
+                            with open(os.path.join(sub, XL_META_FILE),
+                                      "rb") as f:
+                                blob = f.read()
+                        except OSError:
+                            continue  # raced with delete
+                        emitted += 1
+                        yield child, blob
+                else:
+                    cslash = child + "/"
+                    if prefix and not (cslash.startswith(prefix)
+                                       or prefix.startswith(cslash)):
+                        continue
+                    # skip subtrees entirely <= marker
+                    if marker and marker >= cslash + high:
+                        continue
+                    yield from walk(sub, child)
+
+        yield from walk(base, "")
