@@ -408,29 +408,58 @@ class DispatchQueue:
 
     def _flush_device(self, b: _Bucket, items: list[_Pending]):
         import jax.numpy as jnp
+        from .mesh import cached_replicated, object_mesh, sharded_batched
         n = len(items)
         bsz = _pad_batch(n)
+        # multi-chip: shard the batch (objects) axis across the local mesh
+        # via shard_map — EC math has no cross-object reduction, so this is
+        # one SPMD launch with zero collectives, each chip taking bsz/n_dev
+        # blocks (and pallas kernels run per-device, which bare sharded
+        # inputs could not express)
+        mesh = object_mesh()
+        if mesh is not None and bsz % mesh.devices.size:
+            bsz += -bsz % mesh.devices.size
         # count first so the fallback's decrement is always balanced
         self.batches += 1
         self.items += n
         stack = np.stack([p.words for p in items] +
                          [items[0].words] * (bsz - n))
         if b.op == "encode":
-            out_dev = b.codec._mm_batch(b.codec._enc_masks, jnp.asarray(stack))
+            if mesh is None:
+                out_dev = b.codec._mm_batch(b.codec._enc_masks,
+                                            jnp.asarray(stack))
+            else:
+                fn = sharded_batched(b.codec._mm_batch, mesh, (False, True))
+                out_dev = fn(cached_replicated(
+                    id(b.codec), b.codec._enc_masks, mesh), stack)
         elif b.op == "masked":
             masks = np.stack([p.masks for p in items] +
                              [items[0].masks] * (bsz - n))
-            out_dev = b.codec._mm_batch_per(jnp.asarray(masks),
-                                            jnp.asarray(stack))
+            if mesh is None:
+                out_dev = b.codec._mm_batch_per(jnp.asarray(masks),
+                                                jnp.asarray(stack))
+            else:
+                fn = sharded_batched(b.codec._mm_batch_per, mesh,
+                                     (True, True))
+                out_dev = fn(masks, stack)
         else:  # 'fused': verify source digests + rebuild in one launch
-            from ..ops.fused import fused_rebuild
+            from ..ops import hh_jax
+            from ..ops.fused import _jitted, fused_rebuild
             masks = np.stack([p.masks for p in items] +
                              [items[0].masks] * (bsz - n))
             digs = np.stack([p.digests for p in items] +
                             [items[0].digests] * (bsz - n))
-            out_dev = fused_rebuild(
-                b.hash_key, jnp.asarray(masks), jnp.asarray(stack),
-                jnp.asarray(digs), b.codec._mm_batch_per, b.chunk_size)
+            if mesh is None:
+                out_dev = fused_rebuild(
+                    b.hash_key, jnp.asarray(masks), jnp.asarray(stack),
+                    jnp.asarray(digs), b.codec._mm_batch_per, b.chunk_size)
+            else:
+                inner = _jitted(hh_jax._key_words(b.hash_key),
+                                b.chunk_size or stack.shape[-1] * 4,
+                                b.codec._mm_batch_per)
+                fn = sharded_batched(inner, mesh, (True, True, True),
+                                     out_batch=2)
+                out_dev = fn(masks, stack, digs)
         # hand host readback to a completer so the next batch launches now
         self._completers.submit(self._complete, b, out_dev, items)
 
