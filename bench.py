@@ -135,30 +135,38 @@ def device_configs(rng) -> dict:
             timed84, masks84, ws), 2)
     out["encode_sweep_8p4"] = sweep
 
-    # config 4: fused HighwayHash verify + 2-loss reconstruct, 16 KiB chunks
+    # config 4: fused bitrot verify + 2-loss reconstruct, 16 KiB chunks —
+    # measured with BOTH device hashes: MUR3X256 (u32-native, the
+    # framework default) and HighwayHash (u64-emulated, reference-parity)
     from minio_tpu.erasure.bitrot import HIGHWAY_KEY
-    from minio_tpu.ops import hh_jax
+    from minio_tpu.native import mur3py
+    from minio_tpu.ops import hh_jax, mur3_jax
     C = 16384
     nc = shard // C
-    digs_np = np.stack([
-        hhn.hash256_batch(HIGHWAY_KEY,
-                          data[b].reshape(K * nc, C)).reshape(K, nc * 32)
-        for b in range(B)])
-    digs = jnp.asarray(digs_np.view(np.uint32).reshape(B, K, nc * 8))
     rec_masks_b = jnp.asarray(np.broadcast_to(
         codec.target_masks_np(present, (2, 9)),
         (B, 8, M, K)))
-    fused_fn = fused_mod._jitted(hh_jax._key_words(HIGHWAY_KEY), C,
-                                 mm_batch_per)
+    for algo_name, algo_id, batch_hash, key_fn in (
+            ("mur3", 1, mur3py.hash256_batch, mur3_jax._key_words),
+            ("hh", 0, hhn.hash256_batch, hh_jax._key_words)):
+        digs_np = np.stack([
+            batch_hash(HIGHWAY_KEY,
+                       data[b].reshape(K * nc, C)).reshape(K, nc * 32)
+            for b in range(B)])
+        digs = jnp.asarray(digs_np.view(np.uint32).reshape(B, K, nc * 8))
+        fused_fn = fused_mod._jitted(key_fn(HIGHWAY_KEY), C,
+                                     mm_batch_per, algo_id)
 
-    def timed_fused(ms, xs, dg):
-        o, v = fused_fn(ms, xs, dg)
-        return o[..., :2].sum() + v.sum()
+        def timed_fused(ms, xs, dg, fused_fn=fused_fn):
+            o, v = fused_fn(ms, xs, dg)
+            return o[..., :2].sum() + v.sum()
 
-    timed_fused_j = jax.jit(timed_fused)
-    out["fused_verify_reconstruct_16p4_b128"] = bench_op(
-        f"tpu FUSED hh-verify+reconstruct 16+4 x{B}", B * BLOCK,
-        timed_fused_j, rec_masks_b, w, digs)
+        timed_fused_j = jax.jit(timed_fused)
+        out[f"fused_verify_reconstruct_16p4_b128_{algo_name}"] = bench_op(
+            f"tpu FUSED {algo_name}-verify+reconstruct 16+4 x{B}",
+            B * BLOCK, timed_fused_j, rec_masks_b, w, digs)
+    out["fused_verify_reconstruct_16p4_b128"] = \
+        out["fused_verify_reconstruct_16p4_b128_mur3"]
 
     # config 5: batched heal rebuild — per-element masks, mixed loss
     heal_masks = np.stack([
@@ -395,6 +403,8 @@ def main() -> None:
                 dev["reconstruct_2loss_16p4_b128"], 2),        # config 3
             "fused_verify_reconstruct_gibs": round(
                 dev["fused_verify_reconstruct_16p4_b128"], 2),  # config 4
+            "fused_verify_reconstruct_hh_gibs": round(
+                dev["fused_verify_reconstruct_16p4_b128_hh"], 2),
             "batched_heal_rebuild_gibs": round(
                 dev["batched_heal_rebuild_b128"], 2),           # config 5
             "heal_shard_latency": lat,                # north-star p99 half

@@ -70,12 +70,18 @@ class BitrotAlgorithm(enum.Enum):
     BLAKE2B512 = "blake2b"
     HIGHWAYHASH256 = "highwayhash256"
     HIGHWAYHASH256S = "highwayhash256S"
-    BLAKE2B256S = "blake2b256S"  # TPU-build streaming default (32-byte blake2b)
+    BLAKE2B256S = "blake2b256S"  # no-native streaming fallback (blake2b-256)
+    #: TPU-native streaming default: two-seed MurmurHash3_x86_128 — pure
+    #: u32 ops, so the fused device verify runs at VPU rate (~4x the
+    #: u64-emulated HighwayHash kernel). The reference picked HighwayHash
+    #: for AVX2 for the same hardware-fit reason (cmd/bitrot.go:51).
+    MUR3X256S = "mur3x256S"
 
     @property
     def streaming(self) -> bool:
         return self in (BitrotAlgorithm.HIGHWAYHASH256S,
-                        BitrotAlgorithm.BLAKE2B256S)
+                        BitrotAlgorithm.BLAKE2B256S,
+                        BitrotAlgorithm.MUR3X256S)
 
     @property
     def digest_size(self) -> int:
@@ -96,11 +102,16 @@ class BitrotAlgorithm(enum.Enum):
 def _batch_digests(algo: BitrotAlgorithm, blob: bytes, n: int,
                    chunk_size: int) -> "np.ndarray":
     """Digests of n equal chunks as uint8 [n, digest_size]; HighwayHash
-    goes through the native batch entry (one ctypes call)."""
+    and MUR3X256 go through the native batch entries (one ctypes call)."""
     if algo in (BitrotAlgorithm.HIGHWAYHASH256,
                 BitrotAlgorithm.HIGHWAYHASH256S):
         from ..native import highwayhash as hhn
         return hhn.hash256_batch(
+            HIGHWAY_KEY,
+            np.frombuffer(blob, dtype=np.uint8).reshape(n, chunk_size))
+    if algo is BitrotAlgorithm.MUR3X256S:
+        from ..native import mur3py
+        return mur3py.hash256_batch(
             HIGHWAY_KEY,
             np.frombuffer(blob, dtype=np.uint8).reshape(n, chunk_size))
     out = np.empty((n, algo.digest_size), dtype=np.uint8)
@@ -124,19 +135,56 @@ def _highwayhash256():
     return highwayhash.HighwayHash256(HIGHWAY_KEY)
 
 
+def _mur3x256():
+    from ..native import mur3py
+    return mur3py.Mur3x256(HIGHWAY_KEY)
+
+
 _ALGOS = {
     BitrotAlgorithm.SHA256: hashlib.sha256,
     BitrotAlgorithm.BLAKE2B512: _blake2b512,
     BitrotAlgorithm.HIGHWAYHASH256: _highwayhash256,
     BitrotAlgorithm.HIGHWAYHASH256S: _highwayhash256,
     BitrotAlgorithm.BLAKE2B256S: _blake2b256,
+    BitrotAlgorithm.MUR3X256S: _mur3x256,
 }
+
+#: Streaming algorithms with both a native CPU engine and a device kernel
+#: (the fused verify+reconstruct set), with their native/pipeline.cpp ids.
+def native_algo_id(algo: BitrotAlgorithm) -> int | None:
+    from .. import native
+    return {BitrotAlgorithm.HIGHWAYHASH256S: native.ALGO_HIGHWAY,
+            BitrotAlgorithm.MUR3X256S: native.ALGO_MUR3}.get(algo)
+
+
+def native_batch_hasher(algo_id: int):
+    """CPU batch-hash entry for a native ALGO_* id — the ONE place the
+    id -> hasher table lives for CPU-side verification (codec fallback,
+    dispatch CPU route)."""
+    from .. import native
+    if algo_id == native.ALGO_MUR3:
+        from ..native import mur3py
+        return mur3py.hash256_batch
+    from ..native import highwayhash
+    return highwayhash.hash256_batch
 
 
 def default_bitrot_algo() -> BitrotAlgorithm:
-    """Streaming HighwayHash if the native library is built, else blake2b."""
-    a = BitrotAlgorithm.HIGHWAYHASH256S
-    return a if a.available else BitrotAlgorithm.BLAKE2B256S
+    """Streaming MUR3X256 (u32-native, full device rate) when the native
+    library is built, else blake2b. Override with MINIO_TPU_BITROT_ALGO
+    (e.g. highwayhash256S for reference-parity digests)."""
+    env = os.environ.get("MINIO_TPU_BITROT_ALGO", "")
+    if env:
+        try:
+            a = BitrotAlgorithm(env)
+            if a.streaming and a.available:
+                return a
+        except ValueError:
+            pass
+    from .. import native
+    if native.available():
+        return BitrotAlgorithm.MUR3X256S
+    return BitrotAlgorithm.BLAKE2B256S
 
 
 DEFAULT_BITROT_ALGO = default_bitrot_algo()
@@ -254,9 +302,13 @@ class StreamingBitrotReader:
     @property
     def fusable(self) -> bool:
         """True when chunk digests can be verified on device in the fused
-        verify+reconstruct launch (minio_tpu.ops.fused): HighwayHash is the
-        only algorithm with a device kernel."""
-        return self.algo is BitrotAlgorithm.HIGHWAYHASH256S
+        verify+reconstruct launch (minio_tpu.ops.fused): HighwayHash and
+        MUR3X256 have device kernels (MUR3X256 additionally needs 16-byte
+        packets)."""
+        if self.algo is BitrotAlgorithm.HIGHWAYHASH256S:
+            return True
+        return self.algo is BitrotAlgorithm.MUR3X256S \
+            and self.shard_size % 16 == 0
 
     def _read_phys_span(self, offset: int, length: int) -> bytes:
         """Shared guard + physical-span read for the three read entries:

@@ -191,7 +191,7 @@ class Erasure:
             self, shards: list[np.ndarray | None],
             digests: list[bytes | None],
             targets: tuple[int, ...],
-            chunk_size: int) -> Future:
+            chunk_size: int, algo: int = 0) -> Future:
         """Fused bitrot-verify + rebuild (BASELINE config 4, the one-launch
         replacement for cmd/bitrot-streaming.go verify-then-reconstruct):
         like rebuild_targets_async, but each chosen source shard's
@@ -222,12 +222,13 @@ class Erasure:
                 f"cannot rebuild: {len(present)} shards present, "
                 f"need {self.data_blocks}")
         if not dispatch_enabled():
-            # MINIO_TPU_DISPATCH=0: verify on the CPU (native HighwayHash)
-            # and rebuild through the non-queued codec path
-            from ..native import highwayhash as hhn
+            # MINIO_TPU_DISPATCH=0: verify on the CPU (native hash) and
+            # rebuild through the non-queued codec path
+            from ..erasure.bitrot import native_batch_hasher
+            batch_hash = native_batch_hasher(algo)
             corrupt = tuple(
                 i for i in present
-                if hhn.hash256_batch(
+                if batch_hash(
                     HIGHWAY_KEY,
                     np.asarray(shards[i]).reshape(-1, chunk_size)
                 ).tobytes() != digests[i])
@@ -242,7 +243,7 @@ class Erasure:
         masks = self.codec.target_masks_np(present, tuple(targets))
         fut = global_queue().fused(
             self.codec, pack_shards(gathered), masks, digs, HIGHWAY_KEY,
-            chunk_size)
+            chunk_size, algo)
 
         def finish(res):
             out_words, valid = res
@@ -271,7 +272,8 @@ class Erasure:
 
     def decode_data_blocks_verified_async(
             self, shards: list[np.ndarray | None],
-            digests: list[bytes | None], chunk_size: int) -> Future:
+            digests: list[bytes | None], chunk_size: int,
+            algo: int = 0) -> Future:
         """Fused DecodeDataBlocks for degraded reads: missing data shards are
         rebuilt AND every source shard's digest is verified in the same
         launch. Future -> (shard list with data filled, corrupt indices)."""
@@ -280,7 +282,7 @@ class Erasure:
         if not missing:
             raise ValueError("verified decode is for degraded reads only")
         fut = self.rebuild_targets_verified_async(shards, digests, missing,
-                                                  chunk_size)
+                                                  chunk_size, algo)
 
         def finish(res):
             rebuilt, corrupt = res

@@ -65,12 +65,12 @@ def _native_put_eligible(erasure: Erasure, writers: list) -> bool:
     mt_put_block) with on-disk output bit-identical to the Python path."""
     if os.environ.get("MINIO_TPU_PUT_PATH", "auto") == "dispatch":
         return False
-    from .bitrot import BitrotAlgorithm, StreamingBitrotWriter
+    from .bitrot import StreamingBitrotWriter, native_algo_id
     live = [w for w in writers if w is not None]
     if not live:
         return False
     if not all(isinstance(w, StreamingBitrotWriter)
-               and w.algo is BitrotAlgorithm.HIGHWAYHASH256S
+               and native_algo_id(w.algo) is not None
                and not w._buf for w in live):
         return False
     chunks = {w.shard_size for w in live}
@@ -91,13 +91,15 @@ def _native_get_eligible(erasure: Erasure, readers: list) -> bool:
     with one chunk size dividing the shard."""
     if os.environ.get("MINIO_TPU_GET_PATH", "auto") == "dispatch":
         return False
-    from .bitrot import BitrotAlgorithm, StreamingBitrotReader
+    from .bitrot import StreamingBitrotReader, native_algo_id
     k = erasure.data_blocks
     if len(readers) < k:
         return False
     data = readers[:k]
     if not all(isinstance(r, StreamingBitrotReader)
-               and r.algo is BitrotAlgorithm.HIGHWAYHASH256S for r in data):
+               and native_algo_id(r.algo) is not None for r in data):
+        return False
+    if len({r.algo for r in data}) != 1:
         return False
     chunks = {r.shard_size for r in data}
     if len(chunks) != 1:
@@ -228,10 +230,12 @@ def erasure_encode(erasure: Erasure, stream, writers: list,
     native_path = _native_put_eligible(erasure, writers)
     if native_path:
         from .. import native
-        from .bitrot import HIGHWAY_KEY
+        from .bitrot import HIGHWAY_KEY, native_algo_id
         k, m = erasure.data_blocks, erasure.parity_blocks
         pmat = np.ascontiguousarray(erasure.codec.parity_rows)
-        chunk = next(w.shard_size for w in writers if w is not None)
+        live0 = next(w for w in writers if w is not None)
+        chunk = live0.shard_size
+        algo_id = native_algo_id(live0.algo)
 
     def encode_block(buf: bytes):
         if not native_path:
@@ -241,7 +245,7 @@ def erasure_encode(erasure: Erasure, stream, writers: list,
         shard_len = ceil_div(len(buf), k)
         fut = encode_pool().submit(
             native.put_block, buf, len(buf), pmat, k, m, shard_len, chunk,
-            HIGHWAY_KEY)
+            HIGHWAY_KEY, algo_id)
         return ("nat", fut, shard_len)
 
     def start_writes(entry):
@@ -355,13 +359,21 @@ class _ParallelReader:
         if not live or not all(getattr(r, "fusable", False) for r in live):
             return False
         chunks = {r.shard_size for r in live}
-        if len(chunks) != 1:
+        if len(chunks) != 1 or len({r.algo for r in live}) != 1:
             return False
         (c,) = chunks
         return shard_len > 0 and c % 4 == 0 and shard_len % c == 0
 
     def fuse_chunk(self) -> int:
         return next(r.shard_size for r in self.readers if r is not None)
+
+    def fuse_algo(self) -> int:
+        """Native ALGO_* id of the live readers' bitrot algorithm (the
+        fusable gate guarantees one exists)."""
+        from .bitrot import native_algo_id
+        a = native_algo_id(
+            next(r.algo for r in self.readers if r is not None))
+        return 0 if a is None else a
 
     def read_block(self, shard_offset: int, shard_len: int, raw: bool = False
                    ) -> list[np.ndarray | None]:
@@ -451,8 +463,9 @@ def erasure_decode(erasure: Erasure, writer, readers: list, offset: int,
     native_get = _native_get_eligible(erasure, readers)
     if native_get:
         from .. import native
-        from .bitrot import HIGHWAY_KEY
+        from .bitrot import HIGHWAY_KEY, native_algo_id
         fuse_chunk = readers[0].shard_size
+        get_algo_id = native_algo_id(readers[0].algo)
 
     def read_framed_k(shard_offset: int, shard_len: int):
         """Concurrently read the k data shards' framed spans; on any read
@@ -499,7 +512,7 @@ def erasure_decode(erasure: Erasure, writer, readers: list, offset: int,
             if framed is not None:
                 fut = encode_pool().submit(
                     native.get_block, framed, k, shard_len, fuse_chunk,
-                    HIGHWAY_KEY)
+                    HIGHWAY_KEY, get_algo_id)
                 return ["native", fut, b, block_data_len, boff, blen]
         # Degraded data read + device-hash-capable sources -> fused
         # verify+reconstruct: one launch hashes every source shard AND
@@ -512,7 +525,8 @@ def erasure_decode(erasure: Erasure, writer, readers: list, offset: int,
         if degraded and preader.fusable(shard_len):
             shards = preader.read_block(shard_offset, shard_len, raw=True)
             fut = erasure.decode_data_blocks_verified_async(
-                shards, preader.last_digests, preader.fuse_chunk())
+                shards, preader.last_digests, preader.fuse_chunk(),
+                preader.fuse_algo())
             return ["fused", fut, b, block_data_len, boff, blen]
         shards = preader.read_block(shard_offset, shard_len)
         return ["plain", erasure.decode_data_blocks_async(shards), b,
@@ -605,7 +619,8 @@ def erasure_heal(erasure: Erasure, writers: list, readers: list,
             # falls back to CPU-verified replacement reads for that block
             shards = preader.read_block(shard_offset, shard_len, raw=True)
             fut = erasure.rebuild_targets_verified_async(
-                shards, preader.last_digests, targets, preader.fuse_chunk())
+                shards, preader.last_digests, targets, preader.fuse_chunk(),
+                preader.fuse_algo())
             return ["fused", fut, b]
         shards = preader.read_block(shard_offset, shard_len)
         return ["plain", erasure.rebuild_targets_async(shards, targets), b]

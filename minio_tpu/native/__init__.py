@@ -29,7 +29,11 @@ _LOCK = threading.Lock()
 _lib: ctypes.CDLL | None = None
 _load_error: Exception | None = None
 
-_SOURCES = ("pipeline.cpp", "gf256_simd.cpp", "highwayhash.cpp")
+_SOURCES = ("pipeline.cpp", "gf256_simd.cpp", "highwayhash.cpp", "mur3.cpp")
+
+#: Bitrot algorithm ids shared with native/pipeline.cpp hash_many().
+ALGO_HIGHWAY = 0
+ALGO_MUR3 = 1
 
 
 def _compile(src: str, out: str) -> None:
@@ -103,15 +107,27 @@ def _load_native_locked() -> ctypes.CDLL:
         lib.mt_put_block.argtypes = [
             c_u8p, ctypes.c_long, ctypes.c_char_p, ctypes.c_int,
             ctypes.c_int, ctypes.c_long, ctypes.c_long, ctypes.c_char_p,
-            c_u8p]
+            c_u8p, ctypes.c_int]
         lib.mt_put_block.restype = None
         lib.mt_get_block.argtypes = [
             ctypes.POINTER(ctypes.c_void_p), ctypes.c_int, ctypes.c_long,
-            ctypes.c_long, ctypes.c_char_p, c_u8p]
+            ctypes.c_long, ctypes.c_char_p, c_u8p, ctypes.c_int]
         lib.mt_get_block.restype = ctypes.c_int
         lib.mt_verify_framed.argtypes = [c_u8p, ctypes.c_long, ctypes.c_long,
-                                         ctypes.c_char_p]
+                                         ctypes.c_char_p, ctypes.c_int]
         lib.mt_verify_framed.restype = ctypes.c_long
+        lib.mur3x256.argtypes = [ctypes.c_char_p, ctypes.c_char_p,
+                                 ctypes.c_long, ctypes.c_char_p]
+        lib.mur3x256.restype = None
+        lib.mur3x256_batch.argtypes = [ctypes.c_char_p, ctypes.c_char_p,
+                                       ctypes.c_int, ctypes.c_long,
+                                       ctypes.c_long, ctypes.c_char_p]
+        lib.mur3x256_batch.restype = None
+        lib.mur3x256_many.argtypes = [ctypes.c_char_p,
+                                      ctypes.POINTER(ctypes.c_void_p),
+                                      ctypes.POINTER(ctypes.c_long),
+                                      ctypes.c_int, ctypes.c_char_p]
+        lib.mur3x256_many.restype = None
         _lib = lib
     return _lib
 
@@ -151,7 +167,8 @@ _u8p = ctypes.POINTER(ctypes.c_uint8)
 
 
 def put_block(data, data_len: int, pmat: np.ndarray, k: int, m: int,
-              shard_len: int, chunk: int, key: bytes) -> np.ndarray:
+              shard_len: int, chunk: int, key: bytes,
+              algo: int = ALGO_HIGHWAY) -> np.ndarray:
     """Fused split+encode+hash+frame for one erasure block.
 
     ``data`` is a readable buffer of ``data_len`` bytes; returns a uint8
@@ -168,12 +185,12 @@ def put_block(data, data_len: int, pmat: np.ndarray, k: int, m: int,
     lib.mt_put_block(
         src.ctypes.data_as(_u8p), data_len,
         pmat.ctypes.data_as(ctypes.c_char_p), k, m, shard_len, chunk, key,
-        out.ctypes.data_as(_u8p))
+        out.ctypes.data_as(_u8p), algo)
     return out
 
 
-def get_block(framed: list, k: int, plen: int, chunk: int,
-              key: bytes) -> tuple[np.ndarray, int]:
+def get_block(framed: list, k: int, plen: int, chunk: int, key: bytes,
+              algo: int = ALGO_HIGHWAY) -> tuple[np.ndarray, int]:
     """Fused verify+assemble: k framed shard buffers -> (block uint8
     [k*plen], bad_shard) where bad_shard is -1 on success."""
     lib = load_native()
@@ -183,12 +200,14 @@ def get_block(framed: list, k: int, plen: int, chunk: int,
     ptrs = (ctypes.c_void_p * k)(*[a.ctypes.data for a in arrs])
     out = np.empty(k * plen, dtype=np.uint8)
     bad = lib.mt_get_block(ptrs, k, plen, chunk, key,
-                           out.ctypes.data_as(_u8p))
+                           out.ctypes.data_as(_u8p), algo)
     return out, bad
 
 
-def verify_framed(framed, plen: int, chunk: int, key: bytes) -> int:
+def verify_framed(framed, plen: int, chunk: int, key: bytes,
+                  algo: int = ALGO_HIGHWAY) -> int:
     """Verify one framed span; returns -1 ok or the first corrupt chunk."""
     lib = load_native()
     arr = np.frombuffer(framed, dtype=np.uint8)
-    return lib.mt_verify_framed(arr.ctypes.data_as(_u8p), plen, chunk, key)
+    return lib.mt_verify_framed(arr.ctypes.data_as(_u8p), plen, chunk, key,
+                                algo)

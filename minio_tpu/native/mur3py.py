@@ -1,0 +1,157 @@
+"""MUR3X256 Python-side entry points: ctypes wrappers over the native
+implementation (mur3.cpp) plus an independent pure-Python fallback used
+when the toolchain is absent — and as a cross-implementation pin in tests
+(three independent implementations must agree byte-for-byte: C++, device
+kernel ops/mur3_jax.py, and this one)."""
+from __future__ import annotations
+
+import ctypes
+import struct
+
+import numpy as np
+
+_C1, _C2, _C3, _C4 = 0x239B961B, 0xAB0E9789, 0x38B34AE5, 0xA1E38B93
+_M = 0xFFFFFFFF
+
+
+def _rotl(x: int, r: int) -> int:
+    return ((x << r) | (x >> (32 - r))) & _M
+
+
+def _fmix(h: int) -> int:
+    h ^= h >> 16
+    h = (h * 0x85EBCA6B) & _M
+    h ^= h >> 13
+    h = (h * 0xC2B2AE35) & _M
+    h ^= h >> 16
+    return h
+
+
+def _x86_128(seed: int, data: bytes) -> bytes:
+    """MurmurHash3_x86_128 (public-domain algorithm), pure Python."""
+    h1 = h2 = h3 = h4 = seed
+    length = len(data)
+    nblocks = length // 16
+    for i in range(nblocks):
+        k1, k2, k3, k4 = struct.unpack_from("<4I", data, i * 16)
+        k1 = (k1 * _C1) & _M
+        k1 = (_rotl(k1, 15) * _C2) & _M
+        h1 ^= k1
+        h1 = (_rotl(h1, 19) + h2) & _M
+        h1 = (h1 * 5 + 0x561CCD1B) & _M
+        k2 = (k2 * _C2) & _M
+        k2 = (_rotl(k2, 16) * _C3) & _M
+        h2 ^= k2
+        h2 = (_rotl(h2, 17) + h3) & _M
+        h2 = (h2 * 5 + 0x0BCAA747) & _M
+        k3 = (k3 * _C3) & _M
+        k3 = (_rotl(k3, 17) * _C4) & _M
+        h3 ^= k3
+        h3 = (_rotl(h3, 15) + h4) & _M
+        h3 = (h3 * 5 + 0x96CD1C35) & _M
+        k4 = (k4 * _C4) & _M
+        k4 = (_rotl(k4, 18) * _C1) & _M
+        h4 ^= k4
+        h4 = (_rotl(h4, 13) + h1) & _M
+        h4 = (h4 * 5 + 0x32AC3B17) & _M
+    tail = data[nblocks * 16:]
+    k1 = k2 = k3 = k4 = 0
+    t = len(tail)
+    if t >= 13:
+        for j in range(t - 1, 11, -1):
+            k4 = (k4 << 8) | tail[j]
+        k4 = (k4 * _C4) & _M
+        k4 = (_rotl(k4, 18) * _C1) & _M
+        h4 ^= k4
+    if t >= 9:
+        for j in range(min(t, 12) - 1, 7, -1):
+            k3 = (k3 << 8) | tail[j]
+        k3 = (k3 * _C3) & _M
+        k3 = (_rotl(k3, 17) * _C4) & _M
+        h3 ^= k3
+    if t >= 5:
+        for j in range(min(t, 8) - 1, 3, -1):
+            k2 = (k2 << 8) | tail[j]
+        k2 = (k2 * _C2) & _M
+        k2 = (_rotl(k2, 16) * _C3) & _M
+        h2 ^= k2
+    if t >= 1:
+        for j in range(min(t, 4) - 1, -1, -1):
+            k1 = (k1 << 8) | tail[j]
+        k1 = (k1 * _C1) & _M
+        k1 = (_rotl(k1, 15) * _C2) & _M
+        h1 ^= k1
+    h1 ^= length
+    h2 ^= length
+    h3 ^= length
+    h4 ^= length
+    h1 = (h1 + h2 + h3 + h4) & _M
+    h2 = (h2 + h1) & _M
+    h3 = (h3 + h1) & _M
+    h4 = (h4 + h1) & _M
+    h1, h2, h3, h4 = _fmix(h1), _fmix(h2), _fmix(h3), _fmix(h4)
+    h1 = (h1 + h2 + h3 + h4) & _M
+    h2 = (h2 + h1) & _M
+    h3 = (h3 + h1) & _M
+    h4 = (h4 + h1) & _M
+    return struct.pack("<4I", h1, h2, h3, h4)
+
+
+def seeds_from_key(key: bytes) -> tuple[int, int]:
+    """seed1 = LE u32 word 0, seed2 = LE u32 word 4 ^ golden ratio (the
+    second instance must differ even under an all-equal-words key)."""
+    s1 = struct.unpack_from("<I", key, 0)[0]
+    s2 = struct.unpack_from("<I", key, 16)[0] ^ 0x9E3779B9
+    return s1, s2
+
+
+def digest256_py(key: bytes, data: bytes) -> bytes:
+    s1, s2 = seeds_from_key(key)
+    return _x86_128(s1, data) + _x86_128(s2, data)
+
+
+def _native():
+    from . import available, load_native
+    return load_native() if available() else None
+
+
+def digest256(key: bytes, data: bytes) -> bytes:
+    lib = _native()
+    if lib is None:
+        return digest256_py(key, data)
+    out = ctypes.create_string_buffer(32)
+    lib.mur3x256(key, bytes(data), len(data), out)
+    return out.raw
+
+
+def hash256_batch(key: bytes, chunks: np.ndarray) -> np.ndarray:
+    """Digest every row of a uint8 [n, L] array -> uint8 [n, 32]."""
+    chunks = np.ascontiguousarray(chunks, dtype=np.uint8)
+    n, L = chunks.shape
+    lib = _native()
+    out = np.empty((n, 32), dtype=np.uint8)
+    if lib is None:
+        for i in range(n):
+            out[i] = np.frombuffer(
+                digest256_py(key, chunks[i].tobytes()), dtype=np.uint8)
+        return out
+    lib.mur3x256_batch(key, chunks.ctypes.data_as(ctypes.c_char_p), n, L, L,
+                       out.ctypes.data_as(ctypes.c_char_p))
+    return out
+
+
+class Mur3x256:
+    """hashlib-shaped buffering wrapper (the bitrot writer hashes one chunk
+    per digest, so buffering — not incremental state — is sufficient)."""
+
+    digest_size = 32
+
+    def __init__(self, key: bytes):
+        self.key = key
+        self._buf = bytearray()
+
+    def update(self, b: bytes):
+        self._buf += b
+
+    def digest(self) -> bytes:
+        return digest256(self.key, bytes(self._buf))

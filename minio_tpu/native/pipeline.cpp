@@ -23,11 +23,24 @@
 // gf256, highwayhash, and pipeline entry points.
 #include "gf256_simd.cpp"
 #include "highwayhash.cpp"
+#include "mur3.cpp"
 
 #include <cstdint>
 #include <cstring>
 
 namespace {
+
+// bitrot algorithm ids shared with minio_tpu.native (ALGO_* constants)
+enum { kAlgoHighway = 0, kAlgoMur3 = 1 };
+
+inline void hash_many(int algo, const uint64_t key[4],
+                      const uint8_t* const* hp, const long* hl, int n,
+                      uint8_t* digs) {
+  if (algo == kAlgoMur3)
+    mur3x256_many((const uint8_t*)key, hp, hl, n, digs);
+  else
+    hh256_many(key, hp, hl, n, digs);
+}
 
 // dst[0:len] (^)= c * src[0:len] in GF(256); first=true overwrites
 inline void gf_accum(uint8_t c, const uint8_t* src, uint8_t* dst, long len,
@@ -94,7 +107,7 @@ long mt_framed_len(long shard_len, long chunk) {
 // mt_framed_len(shard_len, chunk) bytes each.
 void mt_put_block(const uint8_t* data, long data_len, const uint8_t* pmat,
                   int k, int m, long shard_len, long chunk,
-                  const uint64_t key[4], uint8_t* out) {
+                  const uint64_t key[4], uint8_t* out, int algo) {
   if (k + m > 256 || k <= 0 || m < 0 || chunk <= 0) return;  // hp/hl/hd bound
   const long framed_len = mt_framed_len(shard_len, chunk);
   const long stride = 32 + chunk;  // full-chunk frame stride
@@ -135,7 +148,7 @@ void mt_put_block(const uint8_t* data, long data_len, const uint8_t* pmat,
     }
     // digest all k+m chunk payloads (x2-interleaved on AVX2)
     uint8_t digs[256 * 32];
-    hh256_many(key, hp, hl, nh, digs);
+    hash_many(algo, key, hp, hl, nh, digs);
     for (int i = 0; i < nh; i++) std::memcpy(hd[i], digs + i * 32, 32);
   }
 }
@@ -145,7 +158,7 @@ void mt_put_block(const uint8_t* data, long data_len, const uint8_t* pmat,
 // scatter payloads into out[i*plen ...]. Returns -1 on success or the index
 // of the first shard with a digest mismatch.
 int mt_get_block(const uint8_t* const* framed, int k, long plen, long chunk,
-                 const uint64_t key[4], uint8_t* out) {
+                 const uint64_t key[4], uint8_t* out, int algo) {
   if (k <= 0 || k > 256 || chunk <= 0) return -2;  // hp/hl/digs bound
   const long stride = 32 + chunk;
   const uint8_t* hp[256];
@@ -158,7 +171,7 @@ int mt_get_block(const uint8_t* const* framed, int k, long plen, long chunk,
       hp[i] = framed[i] + ci * stride + 32;
       hl[i] = clen;
     }
-    hh256_many(key, hp, hl, k, digs);
+    hash_many(algo, key, hp, hl, k, digs);
     for (int i = 0; i < k; i++) {
       if (std::memcmp(digs + i * 32, framed[i] + ci * stride, 32) != 0)
         return i;
@@ -171,13 +184,14 @@ int mt_get_block(const uint8_t* const* framed, int k, long plen, long chunk,
 // Verify-only over one framed span (deep scan / VerifyFile): returns -1 ok,
 // else the index of the first corrupt chunk.
 long mt_verify_framed(const uint8_t* framed, long plen, long chunk,
-                      const uint64_t key[4]) {
+                      const uint64_t key[4], int algo) {
   const long stride = 32 + chunk;
   uint8_t dig[32];
   long ci = 0;
   for (long c0 = 0; c0 < plen; c0 += chunk, ci++) {
     const long clen = (plen - c0 < chunk) ? plen - c0 : chunk;
-    hh256(key, framed + ci * stride + 32, clen, dig);
+    const uint8_t* payload = framed + ci * stride + 32;
+    hash_many(algo, key, &payload, &clen, 1, dig);
     if (std::memcmp(dig, framed + ci * stride, 32) != 0) return ci;
   }
   return -1;

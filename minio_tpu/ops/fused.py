@@ -20,19 +20,29 @@ import functools
 import jax
 import jax.numpy as jnp
 
-from . import hh_jax
+from . import hh_jax, mur3_jax
+
+#: Device hash kernels by wire id (matches minio_tpu.native ALGO_*):
+#: 0 = HighwayHash-256 (u64-emulated — reference-compatible), 1 = MUR3X256
+#: (u32-native — the TPU-first default, ~4x the fused rate).
+_DEVICE_HASHES = {
+    0: (hh_jax._key_words, hh_jax.hash256_device_words),
+    1: (mur3_jax._key_words, mur3_jax.hash256_device_words),
+}
 
 
 @functools.lru_cache(maxsize=64)
-def _jitted(key_words: tuple[int, ...], chunk_nbytes: int, backend_mm):
-    """Compile cache per (hash key, chunk bytes, matmul kernel)."""
+def _jitted(key_words: tuple[int, ...], chunk_nbytes: int, backend_mm,
+            algo: int = 0):
+    """Compile cache per (hash key, chunk bytes, matmul kernel, algo)."""
+    hash_fn = _DEVICE_HASHES[algo][1]
 
     def fused(masks, words, digests):
         # words [B, k, W] uint32; masks [B, 8, m, k]; digests [B, k, nc*8]
         B, k, W = words.shape
         nc = W * 4 // chunk_nbytes
         chunks = words.reshape(B, k, nc, W // nc)
-        computed = hh_jax.hash256_device_words(
+        computed = hash_fn(
             key_words, chunk_nbytes, chunks)       # [B, k, nc, 8]
         valid = jnp.all(computed.reshape(B, k, nc * 8) == digests,
                         axis=-1)                   # [B, k] bool
@@ -42,16 +52,26 @@ def _jitted(key_words: tuple[int, ...], chunk_nbytes: int, backend_mm):
     return jax.jit(fused)
 
 
+def fused_fn_for(key: bytes, shard_nbytes: int, backend_mm,
+                 chunk_nbytes: int | None = None, algo: int = 0):
+    """Validated + cached fused kernel for one (key, shard, chunk, algo):
+    the single entry both the plain and mesh-sharded dispatch flushes go
+    through, so the chunk-divisibility guard can't be bypassed."""
+    if not chunk_nbytes:
+        chunk_nbytes = shard_nbytes
+    if shard_nbytes % chunk_nbytes:
+        raise ValueError("shard length is not a bitrot-chunk multiple")
+    key_fn = _DEVICE_HASHES[algo][0]
+    return _jitted(key_fn(key), chunk_nbytes, backend_mm, algo)
+
+
 def fused_rebuild(key: bytes, masks, words, digests, backend_mm,
-                  chunk_nbytes: int | None = None):
+                  chunk_nbytes: int | None = None, algo: int = 0):
     """words uint32 [B,k,W] + per-element masks [B,8,m,k] + expected
     per-chunk digests uint32 [B,k,nc*8] -> (rebuilt [B,m,W], valid bool
     [B,k]) in one launch. ``chunk_nbytes`` is the bitrot chunk size the
-    digests were computed over (default: the whole shard)."""
-    nbytes = int(words.shape[-1]) * 4
-    if not chunk_nbytes:
-        chunk_nbytes = nbytes
-    if nbytes % chunk_nbytes:
-        raise ValueError("shard length is not a bitrot-chunk multiple")
-    fn = _jitted(hh_jax._key_words(key), chunk_nbytes, backend_mm)
+    digests were computed over (default: the whole shard); ``algo`` picks
+    the device hash (native ALGO_* id)."""
+    fn = fused_fn_for(key, int(words.shape[-1]) * 4, backend_mm,
+                      chunk_nbytes, algo)
     return fn(masks, words, digests)

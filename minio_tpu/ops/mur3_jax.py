@@ -1,0 +1,109 @@
+"""MUR3X256 on device (jax.numpy), batched over chunks — the TPU-native
+bitrot hash for the fused verify+reconstruct launch (BASELINE config 4).
+
+Why a second device hash: HighwayHash (hh_jax) needs u64 emulation — every
+64-bit op becomes (lo, hi) uint32 pairs with 16-bit-limb multiplies, which
+costs ~8x the GF math it fuses with. MurmurHash3_x86_128 (the public-domain
+algorithm this 2x-seeded 256-bit construction is built from) uses ONLY u32
+multiply/rotate/add/xor — the VPU's native ops — so the per-packet body is
+~10x cheaper. The block loop is a lax.scan over 16-byte packets, vectorized
+across all chunks of the batch (B x k x nc lanes wide).
+
+Bit-identical to the native C++ (minio_tpu/native/mur3.cpp) and the pure-
+Python fallback (minio_tpu/native/mur3py.py); pinned in tests.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_C1 = np.uint32(0x239B961B)
+_C2 = np.uint32(0xAB0E9789)
+_C3 = np.uint32(0x38B34AE5)
+_C4 = np.uint32(0xA1E38B93)
+_F1 = np.uint32(0x85EBCA6B)
+_F2 = np.uint32(0xC2B2AE35)
+_FIVE = np.uint32(5)
+
+
+def _rotl(x, r: int):
+    return (x << np.uint32(r)) | (x >> np.uint32(32 - r))
+
+
+def _fmix(h):
+    h = h ^ (h >> np.uint32(16))
+    h = h * _F1
+    h = h ^ (h >> np.uint32(13))
+    h = h * _F2
+    return h ^ (h >> np.uint32(16))
+
+
+@functools.lru_cache(maxsize=64)
+def _jitted_impl(seeds: tuple[int, int], nbytes: int):
+    if nbytes % 16:
+        raise ValueError("device MUR3X256 needs 16-byte-multiple chunks")
+    nblocks = nbytes // 16
+    seed_vec = np.array(seeds, dtype=np.uint32)[:, None]  # [2, 1]
+
+    def impl(flat):  # [N, W] uint32 (LE words), W = nbytes // 4
+        n = flat.shape[0]
+        # Layout is everything here (v5e-1, 128 MiB batch): feeding the
+        # scan [nblocks, N, 4] slabs costs a relayout XLA lowers badly
+        # (5.9 GiB/s); strided per-position lane arrays k_i = flat[:,i::4].T
+        # ([nblocks, N], lanes minor) passed as a TUPLE of scan inputs
+        # measure 41 GiB/s from the same object-shaped input.
+        ks = tuple(flat[:, i::4].T for i in range(4))
+        init = tuple(jnp.broadcast_to(seed_vec, (2, n)) for _ in range(4))
+
+        def body(carry, blk):
+            h1, h2, h3, h4 = carry
+            k1, k2, k3, k4 = (b[None] for b in blk)
+            k1 = _rotl(k1 * _C1, 15) * _C2
+            h1 = h1 ^ k1
+            h1 = (_rotl(h1, 19) + h2) * _FIVE + np.uint32(0x561CCD1B)
+            k2 = _rotl(k2 * _C2, 16) * _C3
+            h2 = h2 ^ k2
+            h2 = (_rotl(h2, 17) + h3) * _FIVE + np.uint32(0x0BCAA747)
+            k3 = _rotl(k3 * _C3, 17) * _C4
+            h3 = h3 ^ k3
+            h3 = (_rotl(h3, 15) + h4) * _FIVE + np.uint32(0x96CD1C35)
+            k4 = _rotl(k4 * _C4, 18) * _C1
+            h4 = h4 ^ k4
+            h4 = (_rotl(h4, 13) + h1) * _FIVE + np.uint32(0x32AC3B17)
+            return (h1, h2, h3, h4), None
+
+        # unroll: the per-packet body is ~26 cheap u32 ops, so bare scan
+        # iterations are overhead-dominated
+        (h1, h2, h3, h4), _ = jax.lax.scan(body, init, ks,
+                                           unroll=min(32, nblocks))
+        ln = np.uint32(nbytes)
+        h1, h2, h3, h4 = h1 ^ ln, h2 ^ ln, h3 ^ ln, h4 ^ ln
+        h1 = h1 + h2 + h3 + h4
+        h2, h3, h4 = h2 + h1, h3 + h1, h4 + h1
+        h1, h2, h3, h4 = _fmix(h1), _fmix(h2), _fmix(h3), _fmix(h4)
+        h1 = h1 + h2 + h3 + h4
+        h2, h3, h4 = h2 + h1, h3 + h1, h4 + h1
+        # [2, 4, N] -> [N, 8]: instance 0's h1..h4 then instance 1's
+        dig = jnp.stack([h1, h2, h3, h4], axis=1)
+        return dig.reshape(8, -1).T
+
+    return jax.jit(impl)
+
+
+def _key_words(key: bytes) -> tuple[int, int]:
+    """The two instance seeds (must match native/mur3.cpp digest256 and
+    mur3py.seeds_from_key)."""
+    from ..native.mur3py import seeds_from_key
+    return seeds_from_key(key)
+
+
+def hash256_device_words(key_words: tuple[int, int], nbytes: int, data32):
+    """Digest chunks of ``nbytes`` bytes given as uint32 LE words
+    [..., nbytes//4] -> uint32 digests [..., 8] (same contract as
+    hh_jax.hash256_device_words)."""
+    flat = data32.reshape(-1, data32.shape[-1])
+    dig = _jitted_impl(tuple(key_words), nbytes)(flat)
+    return dig.reshape(data32.shape[:-1] + (8,))

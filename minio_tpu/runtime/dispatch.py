@@ -136,11 +136,12 @@ class _Pending:
 
 class _Bucket:
     def __init__(self, codec, op: str, hash_key: bytes | None = None,
-                 chunk_size: int = 0):
+                 chunk_size: int = 0, hash_algo: int = 0):
         self.codec = codec
         self.op = op  # 'encode' | 'masked' | 'fused'
         self.hash_key = hash_key
         self.chunk_size = chunk_size
+        self.hash_algo = hash_algo  # native ALGO_* id for 'fused'
         self.items: list[_Pending] = []
 
 
@@ -202,25 +203,26 @@ class DispatchQueue:
 
     def fused(self, codec, words: np.ndarray, masks: np.ndarray,
               digests: np.ndarray, hash_key: bytes,
-              chunk_size: int) -> Future:
+              chunk_size: int, hash_algo: int = 0) -> Future:
         """Fused bitrot-verify + rebuild (BASELINE config 4): like masked()
-        but the launch also HighwayHash-verifies each of the k source
-        shards' ``chunk_size``-byte chunks against ``digests`` uint32
-        [k, nc*8]. Future resolves to (out_words [o, W], valid bool [k])."""
+        but the launch also hash-verifies each of the k source shards'
+        ``chunk_size``-byte chunks against ``digests`` uint32 [k, nc*8]
+        with the device kernel for ``hash_algo`` (native ALGO_* id).
+        Future resolves to (out_words [o, W], valid bool [k])."""
         key = ("fused", codec.k, masks.shape[1], words.shape[-1], hash_key,
-               chunk_size)
+               chunk_size, hash_algo)
         return self._submit(key, codec, "fused", words, masks,
                             digests=digests, hash_key=hash_key,
-                            chunk_size=chunk_size)
+                            chunk_size=chunk_size, hash_algo=hash_algo)
 
     def _submit(self, key, codec, op, words, masks, digests=None,
-                hash_key=None, chunk_size=0) -> Future:
+                hash_key=None, chunk_size=0, hash_algo=0) -> Future:
         p = _Pending(words=words, masks=masks, digests=digests)
         with self._cv:
             b = self._buckets.get(key)
             if b is None:
                 b = self._buckets[key] = _Bucket(codec, op, hash_key,
-                                                 chunk_size)
+                                                 chunk_size, hash_algo)
             b.items.append(p)
             self._cv.notify()
         return p.future
@@ -367,10 +369,11 @@ class DispatchQueue:
                 out = native.cpu_encode(rows, u8, rows.shape[0])
                 out_words = np.ascontiguousarray(out).view(np.uint32)
                 if b.op == "fused":
-                    from ..native import highwayhash as hhn
+                    from ..erasure.bitrot import native_batch_hasher
+                    batch_hash = native_batch_hasher(b.hash_algo)
                     k = u8.shape[0]
                     chunks = u8.reshape(k, -1, b.chunk_size)
-                    digs = hhn.hash256_batch(
+                    digs = batch_hash(
                         b.hash_key, chunks.reshape(-1, b.chunk_size))
                     want = np.ascontiguousarray(p.digests).view(np.uint8)
                     valid = np.array([
@@ -408,7 +411,7 @@ class DispatchQueue:
 
     def _flush_device(self, b: _Bucket, items: list[_Pending]):
         import jax.numpy as jnp
-        from .mesh import cached_replicated, object_mesh, sharded_batched
+        from .mesh import object_mesh, replicated_for, sharded_batched
         n = len(items)
         bsz = _pad_batch(n)
         # multi-chip: shard the batch (objects) axis across the local mesh
@@ -430,8 +433,9 @@ class DispatchQueue:
                                             jnp.asarray(stack))
             else:
                 fn = sharded_batched(b.codec._mm_batch, mesh, (False, True))
-                out_dev = fn(cached_replicated(
-                    id(b.codec), b.codec._enc_masks, mesh), stack)
+                out_dev = fn(replicated_for(
+                    b.codec, "_mesh_enc_masks", b.codec._enc_masks, mesh),
+                    stack)
         elif b.op == "masked":
             masks = np.stack([p.masks for p in items] +
                              [items[0].masks] * (bsz - n))
@@ -443,20 +447,18 @@ class DispatchQueue:
                                      (True, True))
                 out_dev = fn(masks, stack)
         else:  # 'fused': verify source digests + rebuild in one launch
-            from ..ops import hh_jax
-            from ..ops.fused import _jitted, fused_rebuild
+            from ..ops.fused import fused_fn_for
             masks = np.stack([p.masks for p in items] +
                              [items[0].masks] * (bsz - n))
             digs = np.stack([p.digests for p in items] +
                             [items[0].digests] * (bsz - n))
+            inner = fused_fn_for(b.hash_key, stack.shape[-1] * 4,
+                                 b.codec._mm_batch_per, b.chunk_size,
+                                 b.hash_algo)
             if mesh is None:
-                out_dev = fused_rebuild(
-                    b.hash_key, jnp.asarray(masks), jnp.asarray(stack),
-                    jnp.asarray(digs), b.codec._mm_batch_per, b.chunk_size)
+                out_dev = inner(jnp.asarray(masks), jnp.asarray(stack),
+                                jnp.asarray(digs))
             else:
-                inner = _jitted(hh_jax._key_words(b.hash_key),
-                                b.chunk_size or stack.shape[-1] * 4,
-                                b.codec._mm_batch_per)
                 fn = sharded_batched(inner, mesh, (True, True, True),
                                      out_batch=2)
                 out_dev = fn(masks, stack, digs)

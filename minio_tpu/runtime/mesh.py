@@ -8,8 +8,8 @@ Two first-class axes:
   dimension). EC math has no cross-object reduction, so sharding the batch
   axis over all local chips is embarrassingly parallel: XLA compiles one
   SPMD program with zero collectives and each chip encodes B/n blocks.
-  This is the production path — ``DispatchQueue`` routes every device
-  flush through :func:`put_sharded` when more than one device is visible.
+  This is the production path — ``DispatchQueue`` wraps every device
+  flush in :func:`sharded_batched` when more than one device is visible.
 - **shards** — the k data shards of one object split across devices, with
   the GF(256) XOR-accumulation completed by an ``all_gather`` + combine
   over ICI (tensor-parallel analogue). Used by :func:`build_sharded_step`,
@@ -59,33 +59,23 @@ def mesh_size() -> int:
     return int(m.devices.size) if m is not None else 1
 
 
-def put_sharded(arr, mesh):
-    """device_put along the leading (objects/batch) axis; the batch size
-    must divide by the mesh size (the dispatch queue pads to it)."""
-    import jax
-    from jax.sharding import NamedSharding, PartitionSpec
-    spec = PartitionSpec("objects", *([None] * (arr.ndim - 1)))
-    return jax.device_put(arr, NamedSharding(mesh, spec))
-
-
 def put_replicated(arr, mesh):
     import jax
     from jax.sharding import NamedSharding, PartitionSpec
     return jax.device_put(arr, NamedSharding(mesh, PartitionSpec()))
 
 
-_repl_cache: dict = {}
-
-
-def cached_replicated(tag, arr, mesh):
-    """Replicate a per-codec constant (e.g. encode masks) onto the mesh
-    once and reuse it — re-broadcasting on every flush would add a
-    transfer per launch for data that never changes."""
-    key = (tag, mesh)
-    v = _repl_cache.get(key)
-    if v is None:
-        v = _repl_cache[key] = put_replicated(arr, mesh)
-    return v
+def replicated_for(obj, attr: str, arr, mesh):
+    """Replicate a per-object constant (e.g. a codec's encode masks) onto
+    the mesh once and cache it ON the owning object — re-broadcasting
+    every flush would add a transfer per launch, and a global cache keyed
+    by id() would serve stale data after id reuse and pin device memory
+    past the owner's lifetime."""
+    cached = getattr(obj, attr, None)
+    if cached is None or cached[0] is not mesh:
+        cached = (mesh, put_replicated(arr, mesh))
+        setattr(obj, attr, cached)
+    return cached[1]
 
 
 _shard_cache: dict = {}

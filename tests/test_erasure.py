@@ -232,9 +232,15 @@ def test_highwayhash_test_vectors():
                               hhn.hash256_batch(hhn.TEST_KEY, chunks))
 
 
-def test_highwayhash_is_default_and_streaming():
-    from minio_tpu.erasure.bitrot import DEFAULT_BITROT_ALGO
-    assert DEFAULT_BITROT_ALGO is HH
+def test_default_algo_is_streaming_mur3():
+    from minio_tpu.erasure.bitrot import DEFAULT_BITROT_ALGO, BitrotAlgorithm
+    from minio_tpu import native
+    if native.available():
+        assert DEFAULT_BITROT_ALGO is BitrotAlgorithm.MUR3X256S
+    assert DEFAULT_BITROT_ALGO.streaming
+    assert DEFAULT_BITROT_ALGO.available
+    assert DEFAULT_BITROT_ALGO.digest_size == 32
+    # HighwayHash stays available for objects written with it
     assert HH.streaming and HH.available and HH.digest_size == 32
 
 
