@@ -1,0 +1,18 @@
+"""Server-side encryption (SSE-C / SSE-S3) — reference cmd/crypto/ +
+cmd/encryption-v1.go, redesigned small: envelope encryption with a random
+per-object key (OEK) sealed by the request key (SSE-C) or a KMS data key
+(SSE-S3), and an AES-256-GCM package stream (64 KiB packages, sequence
+numbers bound into nonce+AAD) that supports ranged reads by package
+alignment."""
+from .kms import LocalKMS, get_kms
+from .sse import (META_SCHEME, PKG_SIZE, DecryptWriter, EncryptReader,
+                  SSEInfo, decrypt_range_bounds, enc_size,
+                  parse_sse_headers, plain_size_of, seal_object_key,
+                  unseal_object_key)
+
+__all__ = [
+    "LocalKMS", "get_kms", "META_SCHEME", "PKG_SIZE", "DecryptWriter",
+    "EncryptReader", "SSEInfo", "decrypt_range_bounds", "enc_size",
+    "parse_sse_headers", "plain_size_of", "seal_object_key",
+    "unseal_object_key",
+]

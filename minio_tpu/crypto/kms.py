@@ -1,0 +1,54 @@
+"""Local KMS — the SSE-S3 master-key service (reference cmd/crypto/kms.go:
+a KES/Vault client in production; here a single master key held by the
+process, the same role as the reference's masterKeyKMS dev fallback).
+
+GenerateKey returns (plaintext data key, sealed blob); the sealed blob is
+stored in object metadata and unsealed on read. Context binds the blob to
+its object so blobs can't be replayed across objects."""
+from __future__ import annotations
+
+import hashlib
+import os
+import secrets
+
+from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+
+
+class LocalKMS:
+    def __init__(self, master_key: bytes, key_id: str = "minio-tpu-default"):
+        if len(master_key) != 32:
+            raise ValueError("KMS master key must be 32 bytes")
+        self.key_id = key_id
+        self._aead = AESGCM(master_key)
+
+    def generate_key(self, context: str) -> tuple[bytes, bytes]:
+        """(plaintext 32-byte data key, sealed blob)."""
+        key = secrets.token_bytes(32)
+        nonce = secrets.token_bytes(12)
+        blob = nonce + self._aead.encrypt(nonce, key, context.encode())
+        return key, blob
+
+    def unseal(self, blob: bytes, context: str) -> bytes:
+        nonce, ct = blob[:12], blob[12:]
+        return self._aead.decrypt(nonce, ct, context.encode())
+
+
+_kms: LocalKMS | None = None
+
+
+def get_kms() -> LocalKMS:
+    """Process KMS: master key from MINIO_TPU_KMS_MASTER_KEY (hex), else a
+    deterministic dev key derived from the credentials env — fine for tests
+    and dev, NOT for production (matching the reference's refusal to ship a
+    default production master key)."""
+    global _kms
+    if _kms is None:
+        hexkey = os.environ.get("MINIO_TPU_KMS_MASTER_KEY", "")
+        if hexkey:
+            master = bytes.fromhex(hexkey)
+        else:
+            seed = os.environ.get("MINIO_TPU_SECRET_KEY", "minio-tpu-dev")
+            master = hashlib.sha256(
+                b"minio-tpu-kms-dev:" + seed.encode()).digest()
+        _kms = LocalKMS(master)
+    return _kms

@@ -149,6 +149,37 @@ class NotImplemented(ObjectAPIError):
     http_status = 501
 
 
+class InvalidEncryptionAlgo(ObjectAPIError):
+    code = "InvalidEncryptionAlgorithmError"
+    http_status = 400
+
+
+class InvalidSSEKey(ObjectAPIError):
+    code = "InvalidArgument"
+    http_status = 400
+
+
+class SSEKeyMD5Mismatch(ObjectAPIError):
+    code = "XMinioSSECustomerKeyMD5Mismatch"
+    http_status = 400
+
+
+class SSEKeyMismatch(ObjectAPIError):
+    code = "AccessDenied"
+    http_status = 403
+
+
+class SSEEncryptedObject(ObjectAPIError):
+    """GET/HEAD of an SSE-C object without the customer key headers."""
+    code = "InvalidRequest"
+    http_status = 400
+
+
+class SSEDecryptError(ObjectAPIError):
+    code = "XMinioSSEDecryptFailure"
+    http_status = 400
+
+
 api_errors = {
     c.code: c for c in [
         BucketNotFound, BucketExists, BucketNotEmpty, BucketNameInvalid,
@@ -157,6 +188,8 @@ api_errors = {
         EntityTooLarge, EntityTooSmall, NoSuchUpload, InvalidPart,
         InvalidPartOrder, PreconditionFailed, InsufficientReadQuorum,
         InsufficientWriteQuorum, StorageFull, NotImplemented,
+        InvalidEncryptionAlgo, InvalidSSEKey, SSEKeyMD5Mismatch,
+        SSEKeyMismatch, SSEEncryptedObject, SSEDecryptError,
     ]
 }
 
@@ -202,6 +235,9 @@ class ObjectInfo:
     etag: str = ""
     content_type: str = ""
     user_defined: dict[str, str] = field(default_factory=dict)
+    #: server-internal metadata (x-minio-internal-*): never exposed in
+    #: responses, consumed by handler-layer subsystems (SSE, compression)
+    internal: dict[str, str] = field(default_factory=dict)
     parts: list[ObjectPartInfo] = field(default_factory=list)
     storage_class: str = "STANDARD"
     actual_size: int = -1
@@ -224,6 +260,8 @@ class ObjectInfo:
                    content_type=content_type,
                    user_defined={k: v for k, v in meta.items()
                                  if not k.startswith("x-minio-internal-")},
+                   internal={k: v for k, v in meta.items()
+                             if k.startswith("x-minio-internal-")},
                    parts=list(fi.parts), actual_size=actual,
                    num_versions=fi.num_versions)
 

@@ -480,6 +480,17 @@ class _S3Handler(BaseHTTPRequestHandler):
         self.s3.bucket_meta.remove(self.bucket)
         self._send(204)
 
+    @staticmethod
+    def _display_sizes(r):
+        """Listings must report the same size GET/HEAD do: for encrypted
+        objects that is the plaintext size, not the stored package-stream
+        length."""
+        from ..crypto import META_SCHEME, plain_size_of
+        for oi in r.objects:
+            if oi.internal.get(META_SCHEME):
+                oi.size = plain_size_of(oi.internal, oi.size)
+        return r
+
     def list_objects(self, ak):
         self._authorize(ak, "s3:ListBucket")
         prefix = self.q("prefix")
@@ -487,14 +498,14 @@ class _S3Handler(BaseHTTPRequestHandler):
         max_keys = min(int(self.q("max-keys", "1000") or "1000"), 10_000)
         if self.q("list-type") == "2":
             marker = self.q("continuation-token") or self.q("start-after")
-            r = self.s3.obj.list_objects(self.bucket, prefix, marker,
-                                         delimiter, max_keys)
+            r = self._display_sizes(self.s3.obj.list_objects(
+                self.bucket, prefix, marker, delimiter, max_keys))
             return self._send(200, xu.list_objects_v2_xml(
                 self.bucket, prefix, delimiter, max_keys, r,
                 continuation_token=self.q("continuation-token")))
         marker = self.q("marker")
-        r = self.s3.obj.list_objects(self.bucket, prefix, marker, delimiter,
-                                     max_keys)
+        r = self._display_sizes(self.s3.obj.list_objects(
+            self.bucket, prefix, marker, delimiter, max_keys))
         self._send(200, xu.list_objects_v1_xml(
             self.bucket, prefix, delimiter, marker, max_keys, r))
 
@@ -503,9 +514,9 @@ class _S3Handler(BaseHTTPRequestHandler):
         prefix = self.q("prefix")
         delimiter = self.q("delimiter")
         max_keys = min(int(self.q("max-keys", "1000") or "1000"), 10_000)
-        r = self.s3.obj.list_object_versions(
+        r = self._display_sizes(self.s3.obj.list_object_versions(
             self.bucket, prefix, self.q("key-marker"),
-            self.q("version-id-marker"), delimiter, max_keys)
+            self.q("version-id-marker"), delimiter, max_keys))
         self._send(200, xu.list_versions_xml(
             self.bucket, prefix, delimiter, max_keys, r))
 
@@ -648,13 +659,95 @@ class _S3Handler(BaseHTTPRequestHandler):
             raise dt.EntityTooLarge(self.bucket, self.key)
         user_defined = self._user_meta()
         hr = self._hash_reader(size)
+        from ..crypto import parse_sse_headers
+        sse = parse_sse_headers(self.hdr, self.bucket, self.key)
+        stream, put_size = hr, size
+        sse_resp = {}
+        if sse is not None:
+            stream, put_size, sse_resp = self._encrypt_setup(
+                sse, hr, size, user_defined)
         opts = self._opts()
         opts.user_defined = user_defined
-        oi = self.s3.obj.put_object(self.bucket, self.key, hr, size, opts)
+        oi = self.s3.obj.put_object(self.bucket, self.key, stream, put_size,
+                                    opts)
         self._send(200, headers={
             "ETag": f'"{oi.etag}"',
-            "x-amz-version-id": oi.version_id or None})
+            "x-amz-version-id": oi.version_id or None,
+            **sse_resp})
         self._notify("s3:ObjectCreated:Put", oi)
+
+    def _encrypt_setup(self, sse, hr, size: int, user_defined: dict):
+        """Envelope setup for a PUT (cmd/encryption-v1.go EncryptRequest):
+        random OEK sealed under the request key (SSE-C) or a KMS data key
+        (SSE-S3); internal metadata records everything a reader needs
+        except the secret itself. Returns (cipher stream, encrypted size,
+        response headers)."""
+        import base64
+        import secrets
+
+        from ..crypto import (EncryptReader, enc_size, get_kms,
+                              seal_object_key)
+        from ..crypto.sse import (META_IV, META_KEY_MD5, META_KMS_BLOB,
+                                  META_PLAIN_SIZE, META_SCHEME, META_SEALED)
+        oek = secrets.token_bytes(32)
+        base_iv = secrets.token_bytes(12)
+        user_defined[META_SCHEME] = sse.scheme
+        user_defined[META_IV] = base64.b64encode(base_iv).decode()
+        user_defined[META_PLAIN_SIZE] = str(size)
+        if sse.scheme == "C":
+            sealed = seal_object_key(oek, sse.key, self.bucket, self.key)
+            user_defined[META_KEY_MD5] = sse.key_md5
+            resp = {
+                "x-amz-server-side-encryption-customer-algorithm": "AES256",
+                "x-amz-server-side-encryption-customer-key-MD5":
+                    sse.key_md5}
+        else:
+            kms = get_kms()
+            dk, blob = kms.generate_key(f"{self.bucket}/{self.key}")
+            sealed = seal_object_key(oek, dk, self.bucket, self.key)
+            user_defined[META_KMS_BLOB] = base64.b64encode(blob).decode()
+            resp = {"x-amz-server-side-encryption": "AES256"}
+        user_defined[META_SEALED] = base64.b64encode(sealed).decode()
+        return EncryptReader(hr, oek, base_iv), enc_size(size), resp
+
+    def _sse_read_ctx(self, oi):
+        """For an encrypted object: unseal the OEK using this request's
+        credentials and return (oek, base_iv, plain_size, response
+        headers); None for plaintext objects. SSE-C requires the customer
+        key headers on GET/HEAD (matching fingerprint), SSE-S3 unseals via
+        the KMS (cmd/encryption-v1.go DecryptRequest)."""
+        import base64
+
+        from ..crypto import get_kms, parse_sse_headers, unseal_object_key
+        from ..crypto.sse import (META_IV, META_KEY_MD5, META_KMS_BLOB,
+                                  META_PLAIN_SIZE, META_SCHEME, META_SEALED)
+        from ..crypto import plain_size_of
+        scheme = oi.internal.get(META_SCHEME, "")
+        if not scheme:
+            return None
+        sealed = base64.b64decode(oi.internal.get(META_SEALED, ""))
+        base_iv = base64.b64decode(oi.internal.get(META_IV, ""))
+        plain_size = plain_size_of(oi.internal, oi.size)
+        if scheme == "C":
+            req = parse_sse_headers(self.hdr, self.bucket, self.key)
+            if req is None or req.scheme != "C":
+                raise dt.SSEEncryptedObject(self.bucket, self.key)
+            if req.key_md5 != oi.internal.get(META_KEY_MD5, ""):
+                raise dt.SSEKeyMismatch(self.bucket, self.key)
+            oek = unseal_object_key(sealed, req.key, self.bucket, self.key)
+            resp = {
+                "x-amz-server-side-encryption-customer-algorithm": "AES256",
+                "x-amz-server-side-encryption-customer-key-MD5":
+                    req.key_md5}
+        else:
+            blob = base64.b64decode(oi.internal.get(META_KMS_BLOB, ""))
+            try:
+                dk = get_kms().unseal(blob, f"{self.bucket}/{self.key}")
+            except Exception:  # noqa: BLE001 — rotated/wrong master key
+                raise dt.SSEKeyMismatch(self.bucket, self.key) from None
+            oek = unseal_object_key(sealed, dk, self.bucket, self.key)
+            resp = {"x-amz-server-side-encryption": "AES256"}
+        return oek, base_iv, plain_size, resp
 
     def _hash_reader(self, size: int) -> HashReader:
         """Body reader verifying Content-MD5 / x-amz-content-sha256 on the
@@ -736,16 +829,20 @@ class _S3Handler(BaseHTTPRequestHandler):
         opts = self._opts()
         oi = self.s3.obj.get_object_info(self.bucket, self.key, opts)
         self._check_preconditions(oi)
-        rng = self._parse_range(oi.size) if oi.size > 0 else None
+        sse = self._sse_read_ctx(oi)
+        logical_size = sse[2] if sse else oi.size
+        rng = self._parse_range(logical_size) if logical_size > 0 else None
         headers = self._obj_headers(oi)
+        if sse:
+            headers.update(sse[3])
         if rng is None:
-            offset, length = 0, oi.size
+            offset, length = 0, logical_size
             status = 200
         else:
             offset, length = rng[0], rng[1] - rng[0] + 1
             status = 206
             headers["Content-Range"] = \
-                f"bytes {rng[0]}-{rng[1]}/{oi.size}"
+                f"bytes {rng[0]}-{rng[1]}/{logical_size}"
         self.send_response(status)
         for k, v in headers.items():
             if v:
@@ -753,16 +850,33 @@ class _S3Handler(BaseHTTPRequestHandler):
         self.send_header("Content-Length", str(length))
         self.end_headers()
         if length > 0:
-            self.s3.obj.get_object(self.bucket, self.key, self.wfile,
-                                   offset, length, opts)
+            if sse:
+                from ..crypto import DecryptWriter, decrypt_range_bounds
+                oek, base_iv, plain_size, _ = sse
+                enc_off, enc_len, seq0, skip = decrypt_range_bounds(
+                    offset, length, plain_size)
+                dw = DecryptWriter(self.wfile, oek, base_iv, seq0, skip,
+                                   length, self.bucket, self.key)
+                if enc_len > 0:
+                    self.s3.obj.get_object(self.bucket, self.key, dw,
+                                           enc_off, enc_len, opts)
+                dw.finish()
+            else:
+                self.s3.obj.get_object(self.bucket, self.key, self.wfile,
+                                       offset, length, opts)
         self._notify("s3:ObjectAccessed:Get", oi)
 
     def head_object(self, ak):
         self._authorize(ak, "s3:GetObject")
         oi = self.s3.obj.get_object_info(self.bucket, self.key, self._opts())
         self._check_preconditions(oi)
+        sse = self._sse_read_ctx(oi)
         h = self._obj_headers(oi)
-        h["Content-Length"] = str(oi.size)
+        if sse:
+            h.update(sse[3])
+            h["Content-Length"] = str(sse[2])
+        else:
+            h["Content-Length"] = str(oi.size)
         self.send_response(200)
         for k, v in h.items():
             if v:
@@ -795,16 +909,24 @@ class _S3Handler(BaseHTTPRequestHandler):
         src = src.lstrip("/")
         src_bucket, _, src_key = src.partition("/")
         src_opts = ObjectOptions(version_id=src_vid)
+        # SSE copy (decrypt source / re-encrypt destination) is not wired
+        # yet; refuse clearly instead of copying ciphertext as plaintext
+        from ..crypto.sse import META_SCHEME
+        si_probe = self.s3.obj.get_object_info(src_bucket, src_key, src_opts)
+        if si_probe.internal.get(META_SCHEME) or \
+                self.hdr.get("x-amz-server-side-encryption") or \
+                self.hdr.get(
+                    "x-amz-server-side-encryption-customer-algorithm"):
+            raise dt.NotImplemented(self.bucket, self.key)
         dst_opts = self._opts()
         directive = self.hdr.get("x-amz-metadata-directive", "COPY")
         if directive == "REPLACE":
             dst_opts.user_defined = self._user_meta()
             dst_opts.metadata_replace = True
         else:
-            si = self.s3.obj.get_object_info(src_bucket, src_key, src_opts)
-            dst_opts.user_defined = dict(si.user_defined)
-            if si.content_type:
-                dst_opts.user_defined["content-type"] = si.content_type
+            dst_opts.user_defined = dict(si_probe.user_defined)
+            if si_probe.content_type:
+                dst_opts.user_defined["content-type"] = si_probe.content_type
         oi = self.s3.obj.copy_object(src_bucket, src_key, self.bucket,
                                      self.key, None, src_opts, dst_opts)
         self._send(200, xu.copy_object_xml(oi.etag, oi.mod_time),
@@ -836,6 +958,11 @@ class _S3Handler(BaseHTTPRequestHandler):
 
     def initiate_upload(self, ak):
         self._authorize(ak, "s3:PutObject")
+        if self.hdr.get("x-amz-server-side-encryption") or self.hdr.get(
+                "x-amz-server-side-encryption-customer-algorithm"):
+            # multipart SSE (per-part cipher streams) is not wired yet;
+            # refuse instead of storing parts unencrypted
+            raise dt.NotImplemented(self.bucket, self.key)
         opts = self._opts()
         opts.user_defined = self._user_meta()
         uid = self.s3.obj.new_multipart_upload(self.bucket, self.key, opts)
