@@ -34,20 +34,36 @@ class LocalKMS:
 
 
 _kms: LocalKMS | None = None
+_seed_secret = ""
+
+
+def configure(seed_secret: str):
+    """Give the KMS a deployment-specific seed (the server's root secret)
+    for the derived-key fallback. Called by S3Server at construction."""
+    global _seed_secret
+    _seed_secret = seed_secret
 
 
 def get_kms() -> LocalKMS:
-    """Process KMS: master key from MINIO_TPU_KMS_MASTER_KEY (hex), else a
-    deterministic dev key derived from the credentials env — fine for tests
-    and dev, NOT for production (matching the reference's refusal to ship a
-    default production master key)."""
+    """Process KMS: master key from MINIO_TPU_KMS_MASTER_KEY (hex). With
+    no explicit master key, a key derived from the deployment's root
+    secret is used and a warning is logged — the sealed blobs are then
+    only as strong as the root credential, so production deployments must
+    set a real master key (the reference refuses SSE-S3 without a KMS for
+    the same reason)."""
     global _kms
     if _kms is None:
         hexkey = os.environ.get("MINIO_TPU_KMS_MASTER_KEY", "")
         if hexkey:
             master = bytes.fromhex(hexkey)
         else:
-            seed = os.environ.get("MINIO_TPU_SECRET_KEY", "minio-tpu-dev")
+            import logging
+            logging.getLogger("minio_tpu.crypto").warning(
+                "no MINIO_TPU_KMS_MASTER_KEY configured: SSE-S3 keys are "
+                "sealed under a key derived from the root secret — set a "
+                "dedicated master key for production")
+            seed = _seed_secret or os.environ.get(
+                "MINIO_TPU_SECRET_KEY", "minio-tpu-dev")
             master = hashlib.sha256(
                 b"minio-tpu-kms-dev:" + seed.encode()).digest()
         _kms = LocalKMS(master)

@@ -97,8 +97,24 @@ class Node:
         server.bucket_meta = BucketMetadataSys(self.obj)
         self.bucket_meta = server.bucket_meta
         server.bucket_meta.on_update = self._broadcast_bucket_update
+        # IAM with cross-node propagation: a user created on this node can
+        # authenticate on every peer immediately (reference
+        # peer-rest-common.go:33-44 LoadUser et al.); mutations serialize
+        # under a cluster lock so concurrent admin calls on different
+        # nodes can't clobber the shared state document
+        server.enable_iam()
+        server.iam.on_change = self._broadcast_iam_update
+        server.iam.dist_lock = lambda: self.ns_lock.new_lock(
+            ".minio.sys", "config/iam/state.json")
         self.bootstrap_verify()
         return server
+
+    def _broadcast_iam_update(self):
+        for p in self.peers:
+            try:
+                p.load_iam()
+            except Exception:  # noqa: BLE001 — peer down: it reloads on boot
+                pass
 
     def wait_format(self, timeout: float):
         """waitForFormatErasure (cmd/prepare-storage.go:331): retry until

@@ -39,19 +39,43 @@ class PeerRESTClient:
     def signal_service(self, sig: str) -> None:
         self.rpc.call("signalservice", {"signal": sig})
 
+    # --- IAM sync (reference peer-rest-common.go:33-44) ---------------------
+
+    def load_iam(self, entity: str = "", name: str = "") -> None:
+        """Tell the peer to reload IAM state; entity/name narrow the
+        reload for the reference's method parity (LoadUser, LoadPolicy,
+        LoadGroup, LoadServiceAccount) — the state is one shared document
+        so the peer reloads it whole either way."""
+        self.rpc.call("loadiam", {"entity": entity, "name": name})
+
+    def load_user(self, access_key: str) -> None:
+        self.load_iam("user", access_key)
+
+    def load_policy(self, name: str) -> None:
+        self.load_iam("policy", name)
+
+    def load_group(self, name: str) -> None:
+        self.load_iam("group", name)
+
+    def load_service_account(self, access_key: str) -> None:
+        self.load_iam("service-account", access_key)
+
 
 class PeerRESTService:
     def __init__(self, node):
         self.node = node  # dist.node.Node
 
     def handle(self, method: str, params: dict, body: bytes) -> bytes:
-        if method == "loadbucketmetadata":
+        if method in ("loadbucketmetadata", "deletebucketmetadata"):
+            bucket = params.get("bucket", "")
             if self.node.bucket_meta is not None:
-                self.node.bucket_meta.invalidate(params.get("bucket", ""))
-            return b""
-        if method == "deletebucketmetadata":
-            if self.node.bucket_meta is not None:
-                self.node.bucket_meta.invalidate(params.get("bucket", ""))
+                self.node.bucket_meta.invalidate(bucket)
+            notifier = getattr(getattr(self.node, "server", None),
+                               "_notifier", None)
+            if notifier is not None:
+                # notification rules are derived from bucket metadata;
+                # drop this node's cached routing too
+                notifier.invalidate(bucket)
             return b""
         if method == "serverinfo":
             return json.dumps({
@@ -72,6 +96,11 @@ class PeerRESTService:
             return b"ok" if mine == theirs else \
                 json.dumps(mine).encode()
         if method == "signalservice":
+            return b""
+        if method == "loadiam":
+            srv = getattr(self.node, "server", None)
+            if srv is not None and getattr(srv, "iam", None) is not None:
+                srv.iam.load()
             return b""
         from ..utils import errors
         raise errors.MethodNotSupported(method)

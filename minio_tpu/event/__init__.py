@@ -1,0 +1,14 @@
+"""Bucket event notification (reference pkg/event, 8k LoC: 11 target
+types + persistent queue store + ARN routing; here the load-bearing core:
+S3-shaped event records, notification-rule matching, a webhook target, and
+a crash-safe on-disk delivery queue with retry)."""
+from .notifier import EventNotifier, targets_from_env
+from .queuestore import QueueStore
+from .record import new_event_record
+from .rules import NotificationRules, parse_notification_xml
+from .targets import WebhookTarget
+
+__all__ = [
+    "EventNotifier", "targets_from_env", "QueueStore", "new_event_record",
+    "NotificationRules", "parse_notification_xml", "WebhookTarget",
+]

@@ -1,0 +1,98 @@
+"""EventNotifier — ties the pieces together (reference cmd/notification.go
++ cmd/event-notification.go): per-bucket rules cached from bucket
+metadata, ARN routing, and one persistent queue+sender per target. The
+object handlers call it through the existing ``s3.notify`` hook."""
+from __future__ import annotations
+
+import logging
+import os
+import threading
+
+from .queuestore import QueueStore
+from .record import new_event_record
+from .rules import NotificationRules, parse_notification_xml
+from .targets import WebhookTarget
+
+log = logging.getLogger("minio_tpu.event")
+
+
+def targets_from_env(region: str = "us-east-1") -> list[WebhookTarget]:
+    """Webhook targets from MINIO_TPU_NOTIFY_WEBHOOK_ENDPOINT_<ID> (+
+    optional _AUTH_TOKEN_<ID>) — the reference's
+    MINIO_NOTIFY_WEBHOOK_ENABLE_* env scheme."""
+    out = []
+    prefix = "MINIO_TPU_NOTIFY_WEBHOOK_ENDPOINT_"
+    for k, v in os.environ.items():
+        if not k.startswith(prefix) or not v:
+            continue
+        tid = k[len(prefix):].lower()
+        token = os.environ.get(
+            f"MINIO_TPU_NOTIFY_WEBHOOK_AUTH_TOKEN_{tid.upper()}", "")
+        out.append(WebhookTarget(tid, v, token, region=region))
+    return out
+
+
+class EventNotifier:
+    def __init__(self, bucket_meta, targets: list, queue_root: str,
+                 region: str = "us-east-1", queue_limit: int = 10000):
+        self.bucket_meta = bucket_meta
+        self.region = region
+        self._rules: dict[str, NotificationRules] = {}
+        self._rules_lock = threading.Lock()
+        self.stores: dict[str, QueueStore] = {}
+        self.targets: dict[str, object] = {}
+        for t in targets:
+            self.targets[t.arn] = t
+            self.stores[t.arn] = QueueStore(
+                os.path.join(queue_root, t.KIND, t.id), t.send,
+                limit=queue_limit).start()
+
+    # -- config ---------------------------------------------------------------
+
+    def rules_for(self, bucket: str) -> NotificationRules:
+        with self._rules_lock:
+            cached = self._rules.get(bucket)
+        if cached is not None:
+            return cached
+        xml = b""
+        if self.bucket_meta is not None:
+            meta = self.bucket_meta.get(bucket)
+            xml = getattr(meta, "notification_xml", b"") or b""
+        try:
+            rules = parse_notification_xml(xml)
+        except Exception:  # noqa: BLE001 — bad stored config: no routing
+            log.warning("bad notification config for %s", bucket,
+                        exc_info=True)
+            rules = NotificationRules()
+        with self._rules_lock:
+            self._rules[bucket] = rules
+        return rules
+
+    def invalidate(self, bucket: str):
+        with self._rules_lock:
+            self._rules.pop(bucket, None)
+
+    def unknown_arns(self, rules: NotificationRules) -> list[str]:
+        """ARNs in a candidate config with no registered target (the
+        reference rejects SetBucketNotification for these)."""
+        return sorted(a for a in rules.arns() if a not in self.targets)
+
+    # -- the s3.notify hook ---------------------------------------------------
+
+    def __call__(self, event_name: str, bucket: str, oi,
+                 request_params: dict | None = None):
+        rules = self.rules_for(bucket)
+        key = getattr(oi, "name", "")
+        arns = rules.route(event_name, key)
+        if not arns:
+            return
+        record = new_event_record(event_name, bucket, oi, self.region,
+                                  request_params)
+        for arn in arns:
+            store = self.stores.get(arn)
+            if store is not None and not store.put(record):
+                log.warning("event queue full for %s; dropping event", arn)
+
+    def stop(self):
+        for s in self.stores.values():
+            s.stop()

@@ -1,0 +1,38 @@
+"""Delivery targets. Webhook is the reference's most-deployed target
+(pkg/event/target/webhook.go): POST the event envelope as JSON, success =
+2xx."""
+from __future__ import annotations
+
+import json
+import urllib.request
+
+
+class WebhookTarget:
+    KIND = "webhook"
+
+    def __init__(self, target_id: str, endpoint: str, auth_token: str = "",
+                 timeout_s: float = 5.0, region: str = "us-east-1"):
+        self.id = target_id
+        self.endpoint = endpoint
+        self.auth_token = auth_token
+        self.timeout = timeout_s
+        self.arn = f"arn:minio:sqs:{region}:{target_id}:webhook"
+
+    def send(self, record: dict) -> None:
+        """Deliver one event envelope; raises on any failure (the queue
+        store retries)."""
+        body = json.dumps(
+            {"EventName": "s3:" + record.get("eventName", ""),
+             "Key": f"{record['s3']['bucket']['name']}/"
+                    f"{record['s3']['object']['key']}",
+             "Records": [record]},
+            separators=(",", ":")).encode()
+        req = urllib.request.Request(
+            self.endpoint, data=body, method="POST",
+            headers={"Content-Type": "application/json",
+                     "User-Agent": "minio-tpu-event"})
+        if self.auth_token:
+            req.add_header("Authorization", f"Bearer {self.auth_token}")
+        with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+            if not (200 <= resp.status < 300):
+                raise RuntimeError(f"webhook status {resp.status}")

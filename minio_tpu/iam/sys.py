@@ -12,6 +12,7 @@ import json
 import secrets
 import threading
 import time
+from contextlib import contextmanager
 from dataclasses import dataclass, field
 
 from ..utils import errors
@@ -63,6 +64,15 @@ class IAMSys:
         self.users: dict[str, UserIdentity] = {}
         self.groups: dict[str, dict] = {}   # name -> {members, policies}
         self.policies: dict[str, pol.Policy] = dict(pol.CANNED)
+        #: cross-node sync hook (reference peer-rest-common.go:33-44
+        #: LoadUser/LoadPolicy/...): called after every persisted mutation
+        #: so peers reload — set by dist.node.Node
+        self.on_change = None
+        #: cluster mutation lock factory (() -> DRWMutex-like with
+        #: get_lock/unlock) — set by dist.node.Node. IAM state is one
+        #: read-modify-write document; without cluster serialization two
+        #: nodes mutating concurrently would clobber each other's writes.
+        self.dist_lock = None
         self.load()
 
     # --- persistence --------------------------------------------------------
@@ -77,22 +87,49 @@ class IAMSys:
         }
         self.obj.put_config(f"{IAM_PREFIX}/state.json",
                             json.dumps(doc).encode())
+        if self.on_change is not None:
+            # async: a slow/dead peer must not stall the admin API call
+            threading.Thread(target=self.on_change, daemon=True,
+                             name="iam-sync").start()
 
     def load(self):
+        with self._lock:
+            self._load_locked()
+
+    def _load_locked(self):
         try:
             doc = json.loads(self.obj.get_config(f"{IAM_PREFIX}/state.json"))
         except (errors.StorageError, ValueError, NotImplementedError):
             return
-        with self._lock:
-            self.users = {k: UserIdentity.from_dict(u)
-                          for k, u in doc.get("users", {}).items()}
-            self.groups = doc.get("groups", {})
-            self.policies = dict(pol.CANNED)
-            for name, blob in doc.get("policies", {}).items():
-                try:
-                    self.policies[name] = pol.Policy.parse(blob, name)
-                except ValueError:
-                    continue
+        self.users = {k: UserIdentity.from_dict(u)
+                      for k, u in doc.get("users", {}).items()}
+        self.groups = doc.get("groups", {})
+        self.policies = dict(pol.CANNED)
+        for name, blob in doc.get("policies", {}).items():
+            try:
+                self.policies[name] = pol.Policy.parse(blob, name)
+            except ValueError:
+                continue
+
+    @contextmanager
+    def _mutating(self):
+        """Serialize a read-modify-write of the IAM document: cluster lock
+        (when distributed) + refresh from the store + local lock, so
+        concurrent mutations on different nodes can't clobber each other
+        (a lost add_user would mean an admin call that 'succeeded' but
+        whose user can't authenticate anywhere)."""
+        mtx = self.dist_lock() if self.dist_lock is not None else None
+        if mtx is not None and not mtx.get_lock(timeout=10.0):
+            raise errors.LockTimeout("iam state lock")
+        try:
+            with self._lock:
+                if mtx is not None:
+                    self._load_locked()  # refresh under the cluster lock
+                yield
+                self._save()
+        finally:
+            if mtx is not None:
+                mtx.unlock()
 
     # --- credential lookup (the auth layer's hook) --------------------------
 
@@ -114,72 +151,63 @@ class IAMSys:
             raise ValueError("access key must be at least 3 characters")
         if len(secret_key) < 8:
             raise ValueError("secret key must be at least 8 characters")
-        with self._lock:
+        with self._mutating():
             self.users[access_key] = UserIdentity(
                 access_key=access_key, secret_key=secret_key,
                 policies=policies or [])
-            self._save()
 
     def remove_user(self, access_key: str):
-        with self._lock:
+        with self._mutating():
             self.users.pop(access_key, None)
             # cascade: drop service accounts / STS creds owned by the user
             for k in [k for k, u in self.users.items()
                       if u.parent == access_key]:
                 del self.users[k]
-            self._save()
 
     def set_user_status(self, access_key: str, status: str):
-        with self._lock:
+        with self._mutating():
             u = self.users[access_key]
             u.status = status
-            self._save()
 
     def set_user_policy(self, access_key: str, policy_names: list[str]):
-        with self._lock:
+        with self._mutating():
             self.users[access_key].policies = policy_names
-            self._save()
 
     # --- groups -------------------------------------------------------------
 
     def add_group(self, name: str, members: list[str]):
-        with self._lock:
+        with self._mutating():
             g = self.groups.setdefault(name,
                                        {"members": [], "policies": []})
             g["members"] = sorted(set(g["members"]) | set(members))
             for m in members:
                 if m in self.users and name not in self.users[m].groups:
                     self.users[m].groups.append(name)
-            self._save()
 
     def set_group_policy(self, name: str, policy_names: list[str]):
-        with self._lock:
+        with self._mutating():
             self.groups.setdefault(name, {"members": []})[
                 "policies"] = policy_names
-            self._save()
 
     def remove_group(self, name: str):
-        with self._lock:
+        with self._mutating():
             self.groups.pop(name, None)
             for u in self.users.values():
                 if name in u.groups:
                     u.groups.remove(name)
-            self._save()
 
     # --- policies -----------------------------------------------------------
 
     def set_policy(self, name: str, doc: bytes):
         p = pol.Policy.parse(doc, name)
-        with self._lock:
+        with self._mutating():
             self.policies[name] = p
-            self._save()
 
     def delete_policy(self, name: str):
         if name in pol.CANNED:
             raise ValueError(f"cannot delete canned policy {name}")
-        with self._lock:
+        with self._mutating():
             self.policies.pop(name, None)
-            self._save()
 
     # --- service accounts / STS ---------------------------------------------
 
@@ -189,9 +217,8 @@ class IAMSys:
         sk = secrets.token_urlsafe(30)
         u = UserIdentity(access_key=ak, secret_key=sk, parent=parent,
                          session_policy=session_policy)
-        with self._lock:
+        with self._mutating():
             self.users[ak] = u
-            self._save()
         return u
 
     def assume_role(self, access_key: str, duration_s: int = 3600,
@@ -205,10 +232,9 @@ class IAMSys:
         u = UserIdentity(access_key=ak, secret_key=sk, parent=access_key,
                          expiration=time.time() + duration_s,
                          session_policy=session_policy)
-        with self._lock:
+        with self._mutating():
             self._purge_expired_locked()
             self.users[ak] = u
-            self._save()
         return u
 
     def _purge_expired_locked(self):

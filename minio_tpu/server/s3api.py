@@ -50,10 +50,13 @@ class S3Server:
         self.iam = None
         #: optional event notifier: fn(event_name, bucket, object_info)
         self.notify = None
+        self._notifier = None
         self.verifier = SigV4Verifier(lambda ak: self.lookup_secret(ak),
                                       region)
         self.address = address
         self.port = port
+        from ..crypto import kms as _kms_mod
+        _kms_mod.configure(self.secret_key)
         self._sem = threading.BoundedSemaphore(max_requests)
         self._httpd: ThreadingHTTPServer | None = None
         #: internal RPC services mounted under /minio/<name>/v1/<method>
@@ -68,6 +71,25 @@ class S3Server:
         self.lookup_secret = self.iam.lookup_secret
         self.authorize = self._iam_authorize
         return self.iam
+
+    def enable_events(self, targets: list | None = None,
+                      queue_root: str = ""):
+        """Attach the event-notification subsystem: persistent per-target
+        delivery queues + ARN routing from bucket notification configs.
+        Targets default to the env-configured webhooks
+        (MINIO_TPU_NOTIFY_WEBHOOK_ENDPOINT_<ID>); the queue root defaults
+        to MINIO_TPU_NOTIFY_QUEUE_DIR or .events under the cwd."""
+        from ..event import EventNotifier, targets_from_env
+        if targets is None:
+            targets = targets_from_env(self.region)
+        if not queue_root:
+            queue_root = os.environ.get(
+                "MINIO_TPU_NOTIFY_QUEUE_DIR",
+                os.path.join(os.getcwd(), ".minio-tpu-events"))
+        self._notifier = EventNotifier(self.bucket_meta, targets,
+                                       queue_root, self.region)
+        self.notify = self._notifier
+        return self._notifier
 
     def _iam_authorize(self, access_key: str, action: str, bucket: str,
                        object: str) -> bool:
@@ -205,13 +227,16 @@ class _S3Handler(BaseHTTPRequestHandler):
         return self.s3.verifier.verify(
             self.command, self.url_path, self.query, headers)
 
-    def _authorize(self, access_key: str, action: str):
+    def _authorize(self, access_key: str, action: str,
+                   bucket: str | None = None, key: str | None = None):
         gate = self.s3.authorize
         if gate is None:
             if access_key == "":
                 raise AuthError("AccessDenied", "anonymous access denied")
             return
-        if not gate(access_key, action, self.bucket, self.key):
+        bucket = self.bucket if bucket is None else bucket
+        key = self.key if key is None else key
+        if not gate(access_key, action, bucket, key):
             raise AuthError("AccessDenied", f"not allowed to {action}")
 
     def _sts(self, body: bytes):
@@ -478,6 +503,9 @@ class _S3Handler(BaseHTTPRequestHandler):
         force = self.hdr.get("x-minio-force-delete", "") == "true"
         self.s3.obj.delete_bucket(self.bucket, force=force)
         self.s3.bucket_meta.remove(self.bucket)
+        if self.s3._notifier is not None:
+            # a recreated bucket must not inherit the old routing rules
+            self.s3._notifier.invalidate(self.bucket)
         self._send(204)
 
     @staticmethod
@@ -580,8 +608,23 @@ class _S3Handler(BaseHTTPRequestHandler):
     def put_bucket_notification(self, ak):
         self._authorize(ak, "s3:PutBucketNotification")
         self.s3.obj.get_bucket_info(self.bucket)
-        self.s3.bucket_meta.update(self.bucket,
-                                   notification_xml=self._read_body())
+        body = self._read_body()
+        from ..event import parse_notification_xml
+        try:
+            parsed = parse_notification_xml(body)
+        except Exception:  # noqa: BLE001 — malformed XML
+            return self._error("MalformedXML",
+                               "invalid notification configuration", 400)
+        if self.s3._notifier is not None:
+            unknown = self.s3._notifier.unknown_arns(parsed)
+            if unknown:
+                return self._error(
+                    "InvalidArgument",
+                    f"unknown notification target ARN(s): "
+                    f"{', '.join(unknown)}", 400)
+        self.s3.bucket_meta.update(self.bucket, notification_xml=body)
+        if self.s3._notifier is not None:
+            self.s3._notifier.invalidate(self.bucket)
         self._send(200)
 
     def get_bucket_notification(self, ak):
@@ -670,6 +713,10 @@ class _S3Handler(BaseHTTPRequestHandler):
         opts.user_defined = user_defined
         oi = self.s3.obj.put_object(self.bucket, self.key, stream, put_size,
                                     opts)
+        if sse is not None:
+            # everything downstream (response, event records) speaks
+            # plaintext sizes; the ciphertext length is an internal detail
+            oi.size = size
         self._send(200, headers={
             "ETag": f'"{oi.etag}"',
             "x-amz-version-id": oi.version_id or None,
@@ -908,6 +955,9 @@ class _S3Handler(BaseHTTPRequestHandler):
             src, _, src_vid = src.partition("?versionId=")
         src = src.lstrip("/")
         src_bucket, _, src_key = src.partition("/")
+        # the caller must be allowed to READ the source, not just write the
+        # destination (otherwise copy exfiltrates unreadable objects)
+        self._authorize(ak, "s3:GetObject", src_bucket, src_key)
         src_opts = ObjectOptions(version_id=src_vid)
         # SSE copy (decrypt source / re-encrypt destination) is not wired
         # yet; refuse clearly instead of copying ciphertext as plaintext

@@ -98,6 +98,11 @@ class MoreData(StorageError):
     """Stream carried more bytes than declared (errMoreData)."""
 
 
+class LockTimeout(StorageError):
+    """A distributed lock could not be acquired within the deadline
+    (reference OperationTimedOut)."""
+
+
 class RPCError(StorageError):
     """Remote call transport failure — marks the remote disk offline."""
 

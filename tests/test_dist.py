@@ -239,6 +239,35 @@ def cluster(tmp_path):
         n.shutdown()
 
 
+def test_iam_sync_across_nodes(cluster):
+    """Create a user on node A -> it can authenticate (and is authorized)
+    on node B without restart (reference peer IAM sync,
+    cmd/peer-rest-common.go:33-44)."""
+    n0, n1 = cluster
+    c_root = S3Client(f"http://127.0.0.1:{n0.server.port}", AK, SK)
+    assert c_root.request("PUT", "/iamsync").status_code == 200
+    n0.server.iam.add_user("synceduser", "syncedsecret99",
+                           policies=["readwrite"])
+    c_new = S3Client(f"http://127.0.0.1:{n1.server.port}",
+                     "synceduser", "syncedsecret99")
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        r = c_new.request("GET", "/iamsync")
+        if r.status_code == 200:
+            break
+        time.sleep(0.1)
+    assert r.status_code == 200, r.text
+    # removal propagates too
+    n0.server.iam.remove_user("synceduser")
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        r = c_new.request("GET", "/iamsync")
+        if r.status_code == 403:
+            break
+        time.sleep(0.1)
+    assert r.status_code == 403, r.status_code
+
+
 def test_two_node_cluster_put_get(cluster):
     n0, n1 = cluster
     c0 = S3Client(f"http://127.0.0.1:{n0.server.port}", AK, SK)

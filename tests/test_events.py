@@ -1,0 +1,187 @@
+"""Event notification: ARN routing, webhook delivery to a live HTTP
+target, and crash-safe retry from the on-disk queue store (reference
+pkg/event/target/webhook.go + queuestore.go)."""
+import json
+import os
+import sys
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(__file__))
+from s3client import S3Client  # noqa: E402
+
+from minio_tpu.event import (EventNotifier, QueueStore, WebhookTarget,
+                             parse_notification_xml)  # noqa: E402
+from minio_tpu.objectlayer import ErasureObjects  # noqa: E402
+from minio_tpu.server import S3Server  # noqa: E402
+from minio_tpu.storage import XLStorage  # noqa: E402
+
+AK, SK = "evak", "evsk"
+
+
+class _Sink(BaseHTTPRequestHandler):
+    received: list = []
+    fail = False
+
+    def do_POST(self):  # noqa: N802
+        body = self.rfile.read(int(self.headers.get("Content-Length", 0)))
+        if type(self).fail:
+            self.send_response(503)
+            self.end_headers()
+            return
+        type(self).received.append(
+            (self.headers.get("Authorization", ""), json.loads(body)))
+        self.send_response(200)
+        self.end_headers()
+
+    def log_message(self, *a):  # silence
+        pass
+
+
+@pytest.fixture
+def sink():
+    class Snk(_Sink):
+        received = []
+        fail = False
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), Snk)
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    yield Snk, f"http://127.0.0.1:{httpd.server_address[1]}/hook"
+    httpd.shutdown()
+
+
+NOTIF_XML = """<NotificationConfiguration>
+  <QueueConfiguration>
+    <Id>1</Id>
+    <Queue>arn:minio:sqs:us-east-1:t1:webhook</Queue>
+    <Event>s3:ObjectCreated:*</Event>
+    <Filter><S3Key>
+      <FilterRule><Name>prefix</Name><Value>docs/</Value></FilterRule>
+      <FilterRule><Name>suffix</Name><Value>.txt</Value></FilterRule>
+    </S3Key></Filter>
+  </QueueConfiguration>
+  <QueueConfiguration>
+    <Id>2</Id>
+    <Queue>arn:minio:sqs:us-east-1:t1:webhook</Queue>
+    <Event>s3:ObjectRemoved:*</Event>
+  </QueueConfiguration>
+</NotificationConfiguration>"""
+
+
+def test_rule_parsing_and_routing():
+    rules = parse_notification_xml(NOTIF_XML.encode())
+    assert len(rules.rules) == 2
+    assert rules.route("s3:ObjectCreated:Put", "docs/a.txt") == \
+        ["arn:minio:sqs:us-east-1:t1:webhook"]
+    assert rules.route("s3:ObjectCreated:Put", "docs/a.pdf") == []
+    assert rules.route("s3:ObjectCreated:Put", "other/a.txt") == []
+    assert rules.route("s3:ObjectRemoved:Delete", "anything") == \
+        ["arn:minio:sqs:us-east-1:t1:webhook"]
+
+
+def _server(tmp_path, sink_url):
+    obj = ErasureObjects([XLStorage(str(tmp_path / f"d{i}"))
+                          for i in range(4)], default_parity=2)
+    srv = S3Server(obj, "127.0.0.1", 0, access_key=AK, secret_key=SK)
+    target = WebhookTarget("t1", sink_url, auth_token="sekrit")
+    srv.enable_events([target], queue_root=str(tmp_path / "queue"))
+    srv.start_background()
+    return srv
+
+
+def _wait(cond, timeout=10.0):
+    t0 = time.time()
+    while time.time() - t0 < timeout:
+        if cond():
+            return True
+        time.sleep(0.05)
+    return False
+
+
+def test_put_delivers_s3_shaped_event(tmp_path, sink):
+    Snk, url = sink
+    srv = _server(tmp_path, url)
+    try:
+        c = S3Client(srv.endpoint(), AK, SK)
+        assert c.request("PUT", "/evb").status_code == 200
+        r = c.request("PUT", "/evb", query={"notification": ""},
+                      body=NOTIF_XML.encode())
+        assert r.status_code == 200, r.text
+        c.request("PUT", "/evb/docs/hello.txt", body=b"hi there")
+        c.request("PUT", "/evb/docs/skip.pdf", body=b"nope")
+        assert _wait(lambda: len(Snk.received) >= 1)
+        auth, env = Snk.received[0]
+        assert auth == "Bearer sekrit"
+        assert env["EventName"] == "s3:ObjectCreated:Put"
+        rec = env["Records"][0]
+        assert rec["eventVersion"] == "2.0"
+        assert rec["s3"]["bucket"]["name"] == "evb"
+        assert rec["s3"]["object"]["key"] == "docs/hello.txt"
+        assert rec["s3"]["object"]["size"] == 8
+        # the .pdf must NOT arrive
+        time.sleep(0.3)
+        keys = [e["Records"][0]["s3"]["object"]["key"]
+                for _, e in Snk.received]
+        assert "docs/skip.pdf" not in keys
+        # delete event (rule 2: no filter)
+        c.request("DELETE", "/evb/docs/hello.txt")
+        assert _wait(lambda: any(
+            e["EventName"].startswith("s3:ObjectRemoved")
+            for _, e in Snk.received))
+    finally:
+        srv.shutdown()
+
+
+def test_unknown_arn_rejected(tmp_path, sink):
+    Snk, url = sink
+    srv = _server(tmp_path, url)
+    try:
+        c = S3Client(srv.endpoint(), AK, SK)
+        c.request("PUT", "/evb2")
+        bad = NOTIF_XML.replace("t1:webhook", "nope:webhook")
+        r = c.request("PUT", "/evb2", query={"notification": ""},
+                      body=bad.encode())
+        assert r.status_code == 400
+        assert "unknown notification target" in r.text
+    finally:
+        srv.shutdown()
+
+
+def test_queue_survives_restart(tmp_path):
+    """Events enqueued while the target is down are delivered by a NEW
+    store instance pointed at the same directory (restart semantics)."""
+    calls = []
+    fails = {"n": 3}
+
+    def flaky(record):
+        if fails["n"] > 0:
+            fails["n"] -= 1
+            raise RuntimeError("target down")
+        calls.append(record)
+
+    qdir = str(tmp_path / "q")
+    store = QueueStore(qdir, lambda r: (_ for _ in ()).throw(
+        RuntimeError("always down")), retry_base_s=0.05)
+    store.start()
+    for i in range(5):
+        assert store.put({"i": i})
+    time.sleep(0.3)
+    store.stop()
+    assert calls == []
+    assert len(os.listdir(qdir)) == 5  # persisted, undelivered
+    # "restart": new store over the same dir with a working sender
+    store2 = QueueStore(qdir, flaky, retry_base_s=0.05).start()
+    assert _wait(lambda: len(calls) == 5)
+    assert [r["i"] for r in calls] == [0, 1, 2, 3, 4]  # oldest first
+    store2.stop()
+    assert os.listdir(qdir) == []
+
+
+def test_queue_limit(tmp_path):
+    store = QueueStore(str(tmp_path / "q"), lambda r: None, limit=3)
+    assert all(store.put({"i": i}) for i in range(3))
+    assert not store.put({"i": 99})
+    assert store.failed_puts == 1
