@@ -27,6 +27,7 @@ class BucketMetadata:
     sse_xml: bytes = b""
     quota: int = 0
     object_lock_enabled: bool = False
+    object_lock_xml: bytes = b""
     replication_xml: bytes = b""
 
     def dump(self) -> bytes:
@@ -39,6 +40,7 @@ class BucketMetadata:
             "notification": self.notification_xml,
             "sse": self.sse_xml, "quota": self.quota,
             "lock": self.object_lock_enabled,
+            "lock_cfg": self.object_lock_xml,
             "replication": self.replication_xml,
         }, use_bin_type=True)
 
@@ -54,6 +56,7 @@ class BucketMetadata:
                    notification_xml=d.get("notification", b""),
                    sse_xml=d.get("sse", b""), quota=d.get("quota", 0),
                    object_lock_enabled=d.get("lock", False),
+                   object_lock_xml=d.get("lock_cfg", b""),
                    replication_xml=d.get("replication", b""))
 
 

@@ -1,0 +1,201 @@
+"""Config KVS registry — one place where every tunable lives (reference
+cmd/config/config.go: SubSystems set :103-130, Config map :303,
+RegisterDefaultKVS :179): per-subsystem key/value tables with the
+reference's precedence **env > stored > default**, persisted through the
+object layer, and dynamic-apply callbacks for subsystems that take effect
+without restart.
+
+The framework's historical MINIO_TPU_* env knobs are registered here with
+their original names, so the registry is the single inventory of them."""
+from __future__ import annotations
+
+import json
+import threading
+from dataclasses import dataclass, field
+
+from ..utils import errors
+
+CONFIG_PATH = "config/config.json"
+
+
+@dataclass
+class KV:
+    default: str
+    env: str = ""          # env var honored for this key
+    help: str = ""
+
+
+#: SubSystems registry (cmd/config/config.go:103-130 analogue). Keys are
+#: the knobs; defaults double as documentation.
+SUB_SYSTEMS: dict[str, dict[str, KV]] = {
+    "api": {
+        "requests_max": KV("256", help="max in-flight API requests"),
+        "region": KV("us-east-1"),
+    },
+    "storage_class": {
+        "standard_parity": KV("", help="EC:<m> parity for STANDARD"),
+        "rrs_parity": KV("", help="EC:<m> parity for REDUCED_REDUNDANCY"),
+    },
+    "erasure": {
+        "encode_window": KV("16", env="MINIO_TPU_ENCODE_WINDOW",
+                            help="blocks in flight per stream"),
+        "put_path": KV("auto", env="MINIO_TPU_PUT_PATH",
+                       help="auto|dispatch native PUT pipeline gate"),
+        "get_path": KV("auto", env="MINIO_TPU_GET_PATH"),
+        "io_threads": KV("", env="MINIO_TPU_IO_THREADS"),
+    },
+    "bitrot": {
+        "algo": KV("mur3x256S", env="MINIO_TPU_BITROT_ALGO",
+                   help="streaming bitrot algorithm for new objects"),
+        "chunk": KV("16384", env="MINIO_TPU_BITROT_CHUNK",
+                    help="streaming bitrot chunk bytes"),
+    },
+    "dispatch": {
+        "enable": KV("1", env="MINIO_TPU_DISPATCH"),
+        "mode": KV("auto", env="MINIO_TPU_DISPATCH_MODE",
+                   help="auto|device|cpu flush routing"),
+        "batch": KV("128", env="MINIO_TPU_DISPATCH_BATCH"),
+        "delay_ms": KV("1.0", env="MINIO_TPU_DISPATCH_DELAY_MS"),
+        "completers": KV("", env="MINIO_TPU_COMPLETERS"),
+        "probe_ttl_s": KV("60", env="MINIO_TPU_PROBE_TTL_S"),
+    },
+    "scanner": {
+        "interval_s": KV("60"),
+        "sleep_per_object_ms": KV("1"),
+        "deep_every": KV("16"),
+    },
+    "heal": {
+        "concurrency": KV("128"),
+    },
+    "kms": {
+        "master_key": KV("", env="MINIO_TPU_KMS_MASTER_KEY",
+                         help="hex 32-byte SSE-S3 master key"),
+    },
+    "notify_webhook": {
+        "endpoint": KV("", help="per-target: endpoint_<id> via env"),
+        "queue_dir": KV("", env="MINIO_TPU_NOTIFY_QUEUE_DIR"),
+        "queue_limit": KV("10000"),
+    },
+}
+
+#: Subsystems whose set() takes effect without restart (SubSystemsDynamic,
+#: config.go:132) — consumers read the registry at call time or register
+#: an apply callback.
+DYNAMIC = {"api", "scanner", "heal", "dispatch", "bitrot"}
+
+
+class ConfigSys:
+    def __init__(self, objlayer=None):
+        self.obj = objlayer
+        self._stored: dict[str, dict[str, str]] = {}
+        self._lock = threading.Lock()
+        self._apply: dict[str, list] = {}
+        if objlayer is not None:
+            self.load()
+
+    # -- persistence ----------------------------------------------------------
+
+    def load(self):
+        try:
+            doc = json.loads(self.obj.get_config(CONFIG_PATH))
+        except (errors.StorageError, ValueError, NotImplementedError,
+                AttributeError):
+            return
+        with self._lock:
+            self._stored = {k: dict(v) for k, v in doc.items()}
+
+    def _persist(self):
+        if self.obj is None:
+            return
+        self.obj.put_config(CONFIG_PATH,
+                            json.dumps(self._stored).encode())
+
+    # -- resolution (env > stored > default) ----------------------------------
+
+    def get(self, subsys: str, key: str) -> str:
+        import os
+        kv = SUB_SYSTEMS.get(subsys, {}).get(key)
+        if kv is None:
+            raise KeyError(f"unknown config key {subsys}.{key}")
+        if kv.env:
+            env = os.environ.get(kv.env)
+            if env is not None:
+                return env
+        with self._lock:
+            stored = self._stored.get(subsys, {}).get(key)
+        return kv.default if stored is None else stored
+
+    def get_int(self, subsys: str, key: str, fallback: int = 0) -> int:
+        try:
+            return int(self.get(subsys, key))
+        except (KeyError, ValueError):
+            return fallback
+
+    def set(self, subsys: str, key: str, value: str):
+        if key not in SUB_SYSTEMS.get(subsys, {}):
+            raise KeyError(f"unknown config key {subsys}.{key}")
+        with self._lock:
+            self._stored.setdefault(subsys, {})[key] = value
+            self._persist()
+        self._fire(subsys)
+
+    def delete(self, subsys: str, key: str):
+        with self._lock:
+            self._stored.get(subsys, {}).pop(key, None)
+            self._persist()
+        self._fire(subsys)
+
+    def dump(self) -> dict:
+        """Effective config: every registered key with its resolved value
+        and source (env/stored/default) — the admin get-config payload."""
+        import os
+        out: dict = {}
+        for subsys, keys in SUB_SYSTEMS.items():
+            sub: dict = {}
+            for key, kv in keys.items():
+                source = "default"
+                value = kv.default
+                with self._lock:
+                    if key in self._stored.get(subsys, {}):
+                        value = self._stored[subsys][key]
+                        source = "stored"
+                if kv.env and os.environ.get(kv.env) is not None:
+                    value = os.environ[kv.env]
+                    source = "env"
+                sub[key] = {"value": value, "source": source,
+                            "env": kv.env, "help": kv.help}
+            out[subsys] = sub
+        return out
+
+    # -- dynamic apply ----------------------------------------------------------
+
+    def on_apply(self, subsys: str, fn):
+        """Register a callback fired when ``subsys`` changes (dynamic
+        subsystems only)."""
+        self._apply.setdefault(subsys, []).append(fn)
+
+    def _fire(self, subsys: str):
+        if subsys not in DYNAMIC:
+            return
+        for fn in self._apply.get(subsys, []):
+            try:
+                fn(self)
+            except Exception:  # noqa: BLE001 — apply must not break set()
+                pass
+
+
+_global: ConfigSys | None = None
+_global_lock = threading.Lock()
+
+
+def get_config_sys(objlayer=None) -> ConfigSys:
+    """Process config registry; first caller with an object layer attaches
+    persistence."""
+    global _global
+    with _global_lock:
+        if _global is None:
+            _global = ConfigSys(objlayer)
+        elif objlayer is not None and _global.obj is None:
+            _global.obj = objlayer
+            _global.load()
+        return _global

@@ -93,6 +93,8 @@ class Node:
         self.wait_format(wait_format_timeout)
         self._build_object_layer()
         server.obj = self.obj
+        from ..config import get_config_sys
+        get_config_sys(self.obj)  # attach stored-config persistence
         from ..bucket import BucketMetadataSys
         server.bucket_meta = BucketMetadataSys(self.obj)
         self.bucket_meta = server.bucket_meta

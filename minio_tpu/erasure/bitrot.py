@@ -52,8 +52,14 @@ _CONFIGURED_CHUNK = _env_chunk()
 def pick_bitrot_chunk(shard_size: int) -> int:
     """Streaming chunk size for a new object with the given erasure shard
     size: the configured default when it divides the shard (so block reads
-    stay chunk-aligned), else the shard size itself."""
-    c = _CONFIGURED_CHUNK
+    stay chunk-aligned), else the shard size itself. Resolved through the
+    config KVS (bitrot.chunk: env > stored > default), so admin set-config
+    applies to new objects without restart."""
+    try:
+        from ..config import get_config_sys
+        c = get_config_sys().get_int("bitrot", "chunk", _CONFIGURED_CHUNK)
+    except Exception:  # noqa: BLE001 — registry unavailable: env/default
+        c = _CONFIGURED_CHUNK
     if c > 0 and shard_size % c == 0:
         return c
     return shard_size

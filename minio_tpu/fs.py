@@ -384,6 +384,17 @@ class FSObjects(ObjectLayer):
         return self._fi(bucket, object, opts).metadata.get(
             "x-minio-internal-tags", "")
 
+    def update_object_meta(self, bucket, object, updates, opts=None):
+        fi = self._fi(bucket, object, opts)
+        meta = dict(fi.metadata)
+        for k, v in updates.items():
+            if v is None:
+                meta.pop(k, None)
+            else:
+                meta[k] = v
+        fi.metadata = meta
+        self.disk.update_metadata(bucket, object, fi)
+
     # --- heal (no-ops in FS mode, reference fs-v1 has none) -----------------
 
     def heal_object(self, bucket, object, version_id="", dry_run=False,

@@ -180,6 +180,23 @@ class SSEDecryptError(ObjectAPIError):
     http_status = 400
 
 
+class InvalidRequest(ObjectAPIError):
+    code = "InvalidRequest"
+    http_status = 400
+
+
+class ObjectLocked(ObjectAPIError):
+    """WORM: retention or legal hold forbids the operation
+    (cmd/bucket-object-lock.go)."""
+    code = "AccessDenied"
+    http_status = 403
+
+
+class QuotaExceeded(ObjectAPIError):
+    code = "XMinioAdminBucketQuotaExceeded"
+    http_status = 409
+
+
 api_errors = {
     c.code: c for c in [
         BucketNotFound, BucketExists, BucketNotEmpty, BucketNameInvalid,
@@ -190,6 +207,7 @@ api_errors = {
         InsufficientWriteQuorum, StorageFull, NotImplemented,
         InvalidEncryptionAlgo, InvalidSSEKey, SSEKeyMD5Mismatch,
         SSEKeyMismatch, SSEEncryptedObject, SSEDecryptError,
+        InvalidRequest, ObjectLocked, QuotaExceeded,
     ]
 }
 

@@ -792,6 +792,23 @@ class ErasureObjects(MultipartMixin, ObjectLayer):
                     pass
             return fi
 
+    def update_object_meta(self, bucket: str, object: str, updates: dict,
+                           opts: ObjectOptions = None) -> None:
+        """Merge metadata keys into a version's xl.meta in place (None
+        values delete keys) — object-lock retention/legal-hold writes ride
+        this (reference updates xl.meta the same way)."""
+        opts = opts or ObjectOptions()
+
+        def mutate(fi, meta):
+            for k, v in updates.items():
+                if v is None:
+                    meta.pop(k, None)
+                else:
+                    meta[k] = v
+            return meta
+
+        self._rewrite_metadata(bucket, object, opts.version_id, mutate)
+
     def put_object_tags(self, bucket: str, object: str, tags_enc: str,
                         opts: ObjectOptions = None) -> None:
         """Set (or clear, with "") the object's encoded tag set by updating

@@ -226,6 +226,10 @@ class ServerPools(ObjectLayer):
 
     # --- object tags --------------------------------------------------------
 
+    def update_object_meta(self, bucket, object, updates, opts=None):
+        self._route(bucket, object, opts).update_object_meta(
+            bucket, object, updates, opts)
+
     def put_object_tags(self, bucket, object, tags_enc, opts=None):
         self._route(bucket, object, opts).put_object_tags(
             bucket, object, tags_enc, opts)

@@ -190,6 +190,10 @@ class ErasureSets(ObjectLayer):
 
     # --- object tags --------------------------------------------------------
 
+    def update_object_meta(self, bucket, object, updates, opts=None):
+        self.get_hashed_set(object).update_object_meta(bucket, object,
+                                                       updates, opts)
+
     def put_object_tags(self, bucket, object, tags_enc, opts=None):
         self.get_hashed_set(object).put_object_tags(bucket, object,
                                                     tags_enc, opts)

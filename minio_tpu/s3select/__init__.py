@@ -1,0 +1,11 @@
+"""S3 Select (reference pkg/s3select, 30k LoC: SQL parser + evaluator,
+CSV/JSON/Parquet readers, AWS event-stream framing; here the load-bearing
+core: SELECT/WHERE/LIMIT with projections, aggregates and scalar
+functions over CSV and JSON(+LINES) inputs, gzip decompression, and the
+binary event-stream response)."""
+from .message import encode_end, encode_records, encode_stats
+from .select import S3SelectRequest, run_select
+from .sql import parse_select
+
+__all__ = ["S3SelectRequest", "run_select", "parse_select",
+           "encode_records", "encode_stats", "encode_end"]

@@ -48,6 +48,39 @@ def _dispatch_admin(h, op: str) -> None:
     if op.startswith("service"):
         # restart/stop accepted; process supervisor owns actual signals
         return h._send(200, b"{}", "application/json")
+    if op == "set-bucket-quota":
+        q = {k: v[0] for k, v in h.query.items()}
+        body = json.loads(h._read_body() or b"{}")
+        h.s3.obj.get_bucket_info(q["bucket"])
+        h.s3.bucket_meta.update(q["bucket"],
+                                quota=int(body.get("quota", 0)))
+        return h._send(200, b"{}", "application/json")
+    if op == "get-bucket-quota":
+        q = {k: v[0] for k, v in h.query.items()}
+        meta = h.s3.bucket_meta.get(q["bucket"])
+        return h._send(200, json.dumps(
+            {"quota": meta.quota, "quotatype": "hard"}).encode(),
+            "application/json")
+    if op == "get-config":
+        from ..config import get_config_sys
+        cfg = get_config_sys(h.s3.obj)
+        return h._send(200, json.dumps(cfg.dump()).encode(),
+                       "application/json")
+    if op == "set-config-kv":
+        from ..config import get_config_sys
+        cfg = get_config_sys(h.s3.obj)
+        q = {k: v[0] for k, v in h.query.items()}
+        try:
+            cfg.set(q["subsys"], q["key"], q.get("value", ""))
+        except KeyError as e:
+            return h._error("InvalidArgument", str(e), 400)
+        return h._send(200, b"{}", "application/json")
+    if op == "del-config-kv":
+        from ..config import get_config_sys
+        cfg = get_config_sys(h.s3.obj)
+        q = {k: v[0] for k, v in h.query.items()}
+        cfg.delete(q.get("subsys", ""), q.get("key", ""))
+        return h._send(200, b"{}", "application/json")
     if _iam_op(h, op):
         return
     h._error("NotImplemented", f"admin op {op}", 501)

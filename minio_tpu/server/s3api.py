@@ -12,6 +12,7 @@ import hashlib
 import os
 import threading
 import urllib.parse
+import xml.etree.ElementTree as ET
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from ..bucket import BucketMetadataSys
@@ -57,6 +58,11 @@ class S3Server:
         self.port = port
         from ..crypto import kms as _kms_mod
         _kms_mod.configure(self.secret_key)
+        if objlayer is not None:
+            # attach the config KVS to its persistence backend so stored
+            # settings survive restarts (env > stored > default)
+            from ..config import get_config_sys
+            get_config_sys(objlayer)
         self._sem = threading.BoundedSemaphore(max_requests)
         self._httpd: ThreadingHTTPServer | None = None
         #: internal RPC services mounted under /minio/<name>/v1/<method>
@@ -139,6 +145,22 @@ class S3Server:
 
     def endpoint(self) -> str:
         return f"http://127.0.0.1:{self.port}"
+
+
+class _ChunkedWriter:
+    """HTTP/1.1 chunked transfer encoding over a raw socket file — lets
+    event-stream responses (S3 Select) stream frames without knowing the
+    total length up front."""
+
+    def __init__(self, wfile):
+        self.wfile = wfile
+
+    def write(self, b: bytes):
+        if b:
+            self.wfile.write(f"{len(b):x}\r\n".encode() + b + b"\r\n")
+
+    def close(self):
+        self.wfile.write(b"0\r\n\r\n")
 
 
 class _S3Handler(BaseHTTPRequestHandler):
@@ -381,6 +403,8 @@ class _S3Handler(BaseHTTPRequestHandler):
                 return s.put_bucket_notification(ak)
             if s.has_q("lifecycle"):
                 return s.put_bucket_lifecycle(ak)
+            if s.has_q("object-lock"):
+                return s.put_object_lock_config(ak)
             return s.put_bucket(ak)
         if m in ("GET", "HEAD"):
             if s.has_q("location"):
@@ -395,6 +419,8 @@ class _S3Handler(BaseHTTPRequestHandler):
                 return s.get_bucket_notification(ak)
             if s.has_q("lifecycle"):
                 return s.get_bucket_lifecycle(ak)
+            if s.has_q("object-lock"):
+                return s.get_object_lock_config(ak)
             if s.has_q("uploads"):
                 return s.list_uploads(ak)
             if s.has_q("versions"):
@@ -422,6 +448,10 @@ class _S3Handler(BaseHTTPRequestHandler):
                 return s.put_part(ak)
             if s.has_q("tagging"):
                 return s.put_object_tagging(ak)
+            if s.has_q("retention"):
+                return s.put_object_retention(ak)
+            if s.has_q("legal-hold"):
+                return s.put_object_legal_hold(ak)
             if "x-amz-copy-source" in s.hdr:
                 return s.copy_object(ak)
             return s.put_object(ak)
@@ -430,6 +460,10 @@ class _S3Handler(BaseHTTPRequestHandler):
                 return s.list_parts(ak)
             if s.has_q("tagging"):
                 return s.get_object_tagging(ak)
+            if s.has_q("retention"):
+                return s.get_object_retention(ak)
+            if s.has_q("legal-hold"):
+                return s.get_object_legal_hold(ak)
             return s.get_object(ak)
         if m == "HEAD":
             return s.head_object(ak)
@@ -444,9 +478,59 @@ class _S3Handler(BaseHTTPRequestHandler):
                 return s.initiate_upload(ak)
             if s.has_q("uploadId"):
                 return s.complete_upload(ak)
+            if s.has_q("select") or s.q("select-type"):
+                return s.select_object_content(ak)
             if s.has_q("restore"):
                 return s._send(202)
         return s._error("MethodNotAllowed", f"bad object op {m}", 405)
+
+    def select_object_content(self, ak):
+        """SelectObjectContent (reference cmd/object-handlers.go:96 ->
+        pkg/s3select): run the SQL over the object and stream event-stream
+        frames. Encrypted objects are decrypted first (the reference does
+        the same through GetObjectNInfo's decrypting reader)."""
+        self._authorize(ak, "s3:GetObject")
+        from ..s3select import S3SelectRequest, run_select
+        from ..s3select.sql import SQLError
+        body = self._read_body()
+        try:
+            req = S3SelectRequest.parse(body)
+        except (ET.ParseError, SQLError) as e:
+            return self._error("InvalidRequest", str(e), 400)
+        opts = self._opts()
+        oi = self.s3.obj.get_object_info(self.bucket, self.key, opts)
+        sse = self._sse_read_ctx(oi)
+        import io as iomod
+        sink = iomod.BytesIO()
+        if sse:
+            from ..crypto import DecryptWriter
+            oek, base_iv, plain_size, _ = sse
+            dw = DecryptWriter(sink, oek, base_iv, 0, 0, plain_size,
+                               self.bucket, self.key)
+            self.s3.obj.get_object(self.bucket, self.key, dw, 0, -1, opts)
+            dw.finish()
+        else:
+            self.s3.obj.get_object(self.bucket, self.key, sink, 0, -1, opts)
+        raw = sink.getvalue()
+        # validate the SQL before committing to a 200 (frames stream
+        # chunked after this, so late errors can only abort mid-stream)
+        from ..s3select import parse_select
+        try:
+            parse_select(req.expression)
+        except SQLError as e:
+            return self._error("InvalidRequest", str(e), 400)
+        self.send_response(200)
+        self.send_header("Content-Type",
+                         "application/vnd.amazon.eventstream")
+        self.send_header("Transfer-Encoding", "chunked")
+        self.end_headers()
+        out = _ChunkedWriter(self.wfile)
+        try:
+            run_select(req, raw, out)
+        except Exception:  # noqa: BLE001 — mid-stream failure: cut the
+            self.close_connection = True  # connection, the client sees EOF
+            return
+        out.close()
 
     # --- HTTP verbs ---------------------------------------------------------
 
@@ -554,6 +638,13 @@ class _S3Handler(BaseHTTPRequestHandler):
         body = self._read_body()
         enabled = xu.parse_versioning(body)
         was = self.s3.bucket_meta.get(self.bucket)
+        if was.object_lock_enabled and not enabled:
+            # suspending versioning would let WORM-retained versions be
+            # hard-deleted via versionless deletes (AWS forbids changing
+            # versioning state on object-lock buckets)
+            raise dt.InvalidRequest(
+                self.bucket, "",
+                "cannot suspend versioning on an object-lock bucket")
         self.s3.bucket_meta.update(
             self.bucket, versioning_enabled=enabled,
             versioning_suspended=not enabled and
@@ -658,10 +749,36 @@ class _S3Handler(BaseHTTPRequestHandler):
 
     def delete_multiple(self, ak):
         self._authorize(ak, "s3:DeleteObject")
+        self._last_ak = ak
         objs, quiet = xu.parse_delete_objects(self._read_body())
         versioned = self.s3.bucket_meta.versioning_enabled(self.bucket)
+        # WORM: version deletes under retention/legal hold are refused
+        # per key, not whole-request (reference DeleteObjects behavior)
+        meta = self.s3.bucket_meta.get(self.bucket)
+        locked_errs: list[tuple[int, str, str, BaseException]] = []
+        if meta.object_lock_enabled:
+            allowed = []
+            for idx, obj in enumerate(objs):
+                vid = "" if isinstance(obj, str) else obj.get(
+                    "version_id", "")
+                name = obj if isinstance(obj, str) else obj["object"]
+                if vid:
+                    try:
+                        self._check_delete_lock(ObjectOptions(
+                            version_id=vid, versioned=versioned), key=name)
+                    except dt.ObjectAPIError as e:
+                        # keep key+version so the <Error> entry names what
+                        # was refused
+                        locked_errs.append((idx, name, vid, e))
+                        continue
+                allowed.append(obj)
+            objs = allowed
         deleted, errs = self.s3.obj.delete_objects(
             self.bucket, objs, ObjectOptions(versioned=versioned))
+        for idx, name, vid, e in locked_errs:
+            deleted.insert(idx, dt.DeletedObject(object_name=name,
+                                                 version_id=vid))
+            errs.insert(idx, e)
         ok_deleted = [d for d, e in zip(deleted, errs) if e is None]
         if quiet:
             # quiet mode reports only failures
@@ -701,6 +818,14 @@ class _S3Handler(BaseHTTPRequestHandler):
         if size > MAX_PUT_SIZE:
             raise dt.EntityTooLarge(self.bucket, self.key)
         user_defined = self._user_meta()
+        # object lock: validate headers / apply the bucket default
+        from ..bucket import objectlock as olock
+        lock_enabled, lock_default = self._lock_ctx()
+        user_defined.update(olock.check_put_headers(
+            self.hdr, self.bucket, self.key, lock_enabled, lock_default))
+        # quota (reference cmd/bucket-quota.go: enforced from the data
+        # usage snapshot, so it trails the scanner like the reference)
+        self._check_quota(size)
         hr = self._hash_reader(size)
         from ..crypto import parse_sse_headers
         sse = parse_sse_headers(self.hdr, self.bucket, self.key)
@@ -844,7 +969,8 @@ class _S3Handler(BaseHTTPRequestHandler):
             "x-amz-version-id": oi.version_id or None,
         }
         for k, v in oi.user_defined.items():
-            if k.startswith("x-amz-meta-") or k in (
+            if k.startswith("x-amz-meta-") or \
+                    k.startswith("x-amz-object-lock-") or k in (
                     "cache-control", "content-disposition",
                     "content-encoding", "content-language", "expires"):
                 h[k] = v
@@ -938,9 +1064,183 @@ class _S3Handler(BaseHTTPRequestHandler):
         if im and im.strip('"') != oi.etag:
             raise dt.PreconditionFailed(self.bucket, self.key)
 
+    # --- object lock / retention / legal hold -------------------------------
+
+    def put_object_lock_config(self, ak):
+        self._authorize(ak, "s3:PutBucketObjectLockConfiguration")
+        from ..bucket import objectlock as ol
+        meta = self.s3.bucket_meta.get(self.bucket)
+        if not meta.object_lock_enabled:
+            raise dt.InvalidRequest(
+                self.bucket, "",
+                "object lock is not enabled on this bucket")
+        body = self._read_body()
+        try:
+            ol.parse_lock_config(body)
+        except (ET.ParseError, ValueError) as e:
+            return self._error("MalformedXML", str(e), 400)
+        self.s3.bucket_meta.update(self.bucket, object_lock_xml=body)
+        self._send(200)
+
+    def get_object_lock_config(self, ak):
+        self._authorize(ak, "s3:GetBucketObjectLockConfiguration")
+        from ..bucket import objectlock as ol
+        meta = self.s3.bucket_meta.get(self.bucket)
+        if not meta.object_lock_enabled:
+            return self._error("ObjectLockConfigurationNotFoundError",
+                               "object lock is not enabled", 404)
+        dr = ol.DefaultRetention()
+        if meta.object_lock_xml:
+            dr = ol.parse_lock_config(meta.object_lock_xml)
+        self._send(200, ol.lock_config_xml(True, dr))
+
+    def _lock_ctx(self):
+        from ..bucket import objectlock as ol
+        meta = self.s3.bucket_meta.get(self.bucket)
+        default = ol.DefaultRetention()
+        if meta.object_lock_enabled and meta.object_lock_xml:
+            try:
+                default = ol.parse_lock_config(meta.object_lock_xml)
+            except ValueError:
+                pass
+        return meta.object_lock_enabled, default
+
+    def put_object_retention(self, ak):
+        self._authorize(ak, "s3:PutObjectRetention")
+        from ..bucket import objectlock as ol
+        enabled, _ = self._lock_ctx()
+        if not enabled:
+            raise dt.InvalidRequest(self.bucket, self.key,
+                                    "bucket has no object lock")
+        try:
+            root = ET.fromstring(self._read_body())
+        except ET.ParseError as e:
+            return self._error("MalformedXML", str(e), 400)
+        mode = ol.findtext(root, "Mode").upper()
+        until = ol.findtext(root, "RetainUntilDate")
+        if mode not in (ol.GOVERNANCE, ol.COMPLIANCE) or not until:
+            raise dt.InvalidRequest(self.bucket, self.key,
+                                    "invalid retention")
+        try:
+            until_t = ol.parse_iso8601(until)
+        except ValueError:
+            raise dt.InvalidRequest(self.bucket, self.key,
+                                    "invalid retain-until date") from None
+        opts = self._opts()
+        oi = self.s3.obj.get_object_info(self.bucket, self.key, opts)
+        cur = ol.retention_of({**oi.user_defined})
+        bypass = self.hdr.get(
+            "x-amz-bypass-governance-retention", "") == "true"
+        if bypass:
+            # weakening GOVERNANCE retention needs its own permission,
+            # same as the delete path
+            self._authorize(ak, "s3:BypassGovernanceRetention")
+        cur_t = 0.0
+        if cur.active:
+            try:
+                cur_t = ol.parse_iso8601(cur.retain_until)
+            except ValueError:
+                cur_t = 0.0
+        if cur.active and cur.mode == ol.COMPLIANCE:
+            # COMPLIANCE can only be extended, never weakened
+            if mode != ol.COMPLIANCE or until_t < cur_t:
+                raise dt.ObjectLocked(self.bucket, self.key,
+                                      "COMPLIANCE retention active")
+        elif cur.active and cur.mode == ol.GOVERNANCE and not bypass:
+            if until_t < cur_t:
+                raise dt.ObjectLocked(self.bucket, self.key,
+                                      "GOVERNANCE retention active")
+        self._mutate_lock_meta(opts, {ol.META_MODE: mode,
+                                      ol.META_RETAIN_UNTIL: until})
+        self._send(200)
+
+    def get_object_retention(self, ak):
+        self._authorize(ak, "s3:GetObjectRetention")
+        from ..bucket import objectlock as ol
+        oi = self.s3.obj.get_object_info(self.bucket, self.key, self._opts())
+        ret = ol.retention_of(oi.user_defined)
+        if not ret.mode:
+            return self._error("NoSuchObjectLockConfiguration",
+                               "no retention on this object", 404)
+        self._send(200, (f"<Retention><Mode>{ret.mode}</Mode>"
+                         f"<RetainUntilDate>{ret.retain_until}"
+                         f"</RetainUntilDate></Retention>").encode())
+
+    def put_object_legal_hold(self, ak):
+        self._authorize(ak, "s3:PutObjectLegalHold")
+        from ..bucket import objectlock as ol
+        enabled, _ = self._lock_ctx()
+        if not enabled:
+            raise dt.InvalidRequest(self.bucket, self.key,
+                                    "bucket has no object lock")
+        try:
+            root = ET.fromstring(self._read_body())
+        except ET.ParseError as e:
+            return self._error("MalformedXML", str(e), 400)
+        status = ol.findtext(root, "Status").upper()
+        if status not in ("ON", "OFF"):
+            raise dt.InvalidRequest(self.bucket, self.key,
+                                    "invalid legal hold status")
+        self._mutate_lock_meta(self._opts(), {ol.META_LEGAL_HOLD: status})
+        self._send(200)
+
+    def get_object_legal_hold(self, ak):
+        self._authorize(ak, "s3:GetObjectLegalHold")
+        from ..bucket import objectlock as ol
+        oi = self.s3.obj.get_object_info(self.bucket, self.key, self._opts())
+        status = ol.legal_hold_of(oi.user_defined)
+        self._send(200,
+                   f"<LegalHold><Status>{status}</Status></LegalHold>"
+                   .encode())
+
+    def _mutate_lock_meta(self, opts, updates: dict):
+        """Merge object-lock keys into the version's metadata in place
+        (the reference rewrites xl.meta the same way for retention)."""
+        self.s3.obj.update_object_meta(self.bucket, self.key, updates, opts)
+
+    def _check_quota(self, incoming: int):
+        """Hard bucket quota from the data-usage snapshot
+        (cmd/bucket-quota.go enforceBucketQuotaHard): best-effort like the
+        reference — usage trails the scanner's last sweep."""
+        meta = self.s3.bucket_meta.get(self.bucket)
+        if meta.quota <= 0:
+            return
+        from ..scanner import usage as usage_mod
+        usage = usage_mod.load_usage(self.s3.obj)
+        used = usage.get("buckets", {}).get(self.bucket, {}).get("size", 0)
+        if used + max(incoming, 0) > meta.quota:
+            raise dt.QuotaExceeded(
+                self.bucket, self.key,
+                f"quota {meta.quota} would be exceeded")
+
+    def _check_delete_lock(self, opts, key: str | None = None):
+        """WORM enforcement for version deletes (a versionless delete only
+        writes a delete marker, which object lock permits)."""
+        if not opts.version_id:
+            return
+        from ..bucket import objectlock as ol
+        meta = self.s3.bucket_meta.get(self.bucket)
+        if not meta.object_lock_enabled:
+            return
+        key = self.key if key is None else key
+        try:
+            oi = self.s3.obj.get_object_info(self.bucket, key, opts)
+        except dt.ObjectAPIError:
+            return  # nothing to protect
+        bypass = self.hdr.get(
+            "x-amz-bypass-governance-retention", "") == "true"
+        if bypass:
+            # bypass needs its own permission
+            self._authorize(self._last_ak,
+                            "s3:BypassGovernanceRetention", self.bucket,
+                            key)
+        ol.check_delete_allowed(oi.user_defined, self.bucket, key, bypass)
+
     def delete_object(self, ak):
         self._authorize(ak, "s3:DeleteObject")
+        self._last_ak = ak
         opts = self._opts()
+        self._check_delete_lock(opts)
         oi = self.s3.obj.delete_object(self.bucket, self.key, opts)
         self._send(204, headers={
             "x-amz-version-id": oi.version_id or None,
@@ -968,6 +1268,7 @@ class _S3Handler(BaseHTTPRequestHandler):
                 self.hdr.get(
                     "x-amz-server-side-encryption-customer-algorithm"):
             raise dt.NotImplemented(self.bucket, self.key)
+        self._check_quota(si_probe.size)  # destination bucket quota
         dst_opts = self._opts()
         directive = self.hdr.get("x-amz-metadata-directive", "COPY")
         if directive == "REPLACE":
@@ -1029,6 +1330,7 @@ class _S3Handler(BaseHTTPRequestHandler):
         if size < 0:
             return self._error("MissingContentLength",
                                "Content-Length required", 411)
+        self._check_quota(size)  # quota applies to multipart traffic too
         # Verify Content-MD5 / x-amz-content-sha256 on part bodies exactly
         # like PutObject — otherwise corrupted parts are accepted and only
         # surface as a confusing InvalidPart at complete time.

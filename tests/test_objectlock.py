@@ -1,0 +1,187 @@
+"""Object lock / retention / legal hold + bucket quota + config KVS
+(reference cmd/bucket-object-lock.go, cmd/bucket-quota.go,
+cmd/config/config.go)."""
+import io
+import json
+import os
+import sys
+import time
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(__file__))
+from s3client import S3Client  # noqa: E402
+
+from minio_tpu.bucket import objectlock as ol  # noqa: E402
+from minio_tpu.objectlayer import ErasureObjects  # noqa: E402
+from minio_tpu.server import S3Server  # noqa: E402
+from minio_tpu.storage import XLStorage  # noqa: E402
+
+AK, SK = "olak", "olsecret1"
+
+
+@pytest.fixture
+def srv(tmp_path):
+    obj = ErasureObjects([XLStorage(str(tmp_path / f"d{i}"))
+                          for i in range(4)], default_parity=2)
+    server = S3Server(obj, "127.0.0.1", 0, access_key=AK, secret_key=SK)
+    server.start_background()
+    yield server
+    server.shutdown()
+
+
+@pytest.fixture
+def c(srv):
+    return S3Client(srv.endpoint(), AK, SK)
+
+
+def _mk_locked_bucket(c, name="lk"):
+    r = c.request("PUT", f"/{name}",
+                  headers={"x-amz-bucket-object-lock-enabled": "true"})
+    assert r.status_code == 200
+    return name
+
+
+def _future(days=1):
+    return ol.iso8601(time.time() + days * 86400)
+
+
+def test_governance_retention_blocks_version_delete(c):
+    b = _mk_locked_bucket(c)
+    r = c.request("PUT", f"/{b}/doc", body=b"hello", headers={
+        "x-amz-object-lock-mode": "GOVERNANCE",
+        "x-amz-object-lock-retain-until-date": _future()})
+    assert r.status_code == 200, r.text
+    vid = r.headers["x-amz-version-id"]
+    # versioned delete refused
+    r = c.request("DELETE", f"/{b}/doc", query={"versionId": vid})
+    assert r.status_code == 403
+    # versionless delete just writes a marker — allowed
+    r = c.request("DELETE", f"/{b}/doc")
+    assert r.status_code == 204
+    # bypass header allows governance delete (root has all permissions)
+    r = c.request("DELETE", f"/{b}/doc", query={"versionId": vid},
+                  headers={"x-amz-bypass-governance-retention": "true"})
+    assert r.status_code == 204
+
+
+def test_compliance_retention_cannot_be_bypassed(c):
+    b = _mk_locked_bucket(c, "lkc")
+    r = c.request("PUT", f"/{b}/doc", body=b"x", headers={
+        "x-amz-object-lock-mode": "COMPLIANCE",
+        "x-amz-object-lock-retain-until-date": _future()})
+    vid = r.headers["x-amz-version-id"]
+    r = c.request("DELETE", f"/{b}/doc", query={"versionId": vid},
+                  headers={"x-amz-bypass-governance-retention": "true"})
+    assert r.status_code == 403
+
+
+def test_legal_hold_blocks_delete_until_released(c):
+    b = _mk_locked_bucket(c, "lkh")
+    r = c.request("PUT", f"/{b}/h", body=b"x",
+                  headers={"x-amz-object-lock-legal-hold": "ON"})
+    vid = r.headers["x-amz-version-id"]
+    r = c.request("GET", f"/{b}/h", query={"legal-hold": ""})
+    assert r.status_code == 200 and "<Status>ON</Status>" in r.text
+    assert c.request("DELETE", f"/{b}/h", query={"versionId": vid}
+                     ).status_code == 403
+    r = c.request("PUT", f"/{b}/h", query={"legal-hold": ""},
+                  body=b"<LegalHold><Status>OFF</Status></LegalHold>")
+    assert r.status_code == 200
+    assert c.request("DELETE", f"/{b}/h", query={"versionId": vid}
+                     ).status_code == 204
+
+
+def test_default_retention_from_bucket_config(c):
+    b = _mk_locked_bucket(c, "lkd")
+    cfg = (b"<ObjectLockConfiguration>"
+           b"<ObjectLockEnabled>Enabled</ObjectLockEnabled>"
+           b"<Rule><DefaultRetention><Mode>GOVERNANCE</Mode>"
+           b"<Days>1</Days></DefaultRetention></Rule>"
+           b"</ObjectLockConfiguration>")
+    assert c.request("PUT", f"/{b}", query={"object-lock": ""},
+                     body=cfg).status_code == 200
+    r = c.request("GET", f"/{b}", query={"object-lock": ""})
+    assert "<Days>1</Days>" in r.text
+    # a plain PUT inherits the default retention
+    r = c.request("PUT", f"/{b}/auto", body=b"x")
+    assert r.headers.get("x-amz-object-lock-mode") is None  # PUT response
+    r = c.request("GET", f"/{b}/auto", query={"retention": ""})
+    assert r.status_code == 200 and "GOVERNANCE" in r.text
+    vid_r = c.request("HEAD", f"/{b}/auto")
+    assert vid_r.headers.get("x-amz-object-lock-mode") == "GOVERNANCE"
+
+
+def test_lock_headers_on_unlocked_bucket_rejected(c):
+    assert c.request("PUT", "/plain").status_code == 200
+    r = c.request("PUT", "/plain/x", body=b"x", headers={
+        "x-amz-object-lock-mode": "GOVERNANCE",
+        "x-amz-object-lock-retain-until-date": _future()})
+    assert r.status_code == 400
+
+
+def test_retention_api_roundtrip_and_tighten_only(c):
+    b = _mk_locked_bucket(c, "lkr")
+    r = c.request("PUT", f"/{b}/r", body=b"x", headers={
+        "x-amz-object-lock-mode": "COMPLIANCE",
+        "x-amz-object-lock-retain-until-date": _future(1)})
+    assert r.status_code == 200
+    # extending COMPLIANCE is fine
+    r = c.request("PUT", f"/{b}/r", query={"retention": ""},
+                  body=(f"<Retention><Mode>COMPLIANCE</Mode>"
+                        f"<RetainUntilDate>{_future(2)}</RetainUntilDate>"
+                        f"</Retention>").encode())
+    assert r.status_code == 200
+    # weakening to GOVERNANCE is refused
+    r = c.request("PUT", f"/{b}/r", query={"retention": ""},
+                  body=(f"<Retention><Mode>GOVERNANCE</Mode>"
+                        f"<RetainUntilDate>{_future(3)}</RetainUntilDate>"
+                        f"</Retention>").encode())
+    assert r.status_code == 403
+
+
+def test_bucket_quota_enforced(c, srv):
+    assert c.request("PUT", "/qb").status_code == 200
+    r = c.request("PUT", "/minio/admin/v3/set-bucket-quota",
+                  query={"bucket": "qb"},
+                  body=json.dumps({"quota": 1000}).encode())
+    assert r.status_code == 200, r.text
+    r = c.request("GET", "/minio/admin/v3/get-bucket-quota",
+                  query={"bucket": "qb"})
+    assert json.loads(r.text)["quota"] == 1000
+    # usage snapshot says the bucket holds 900 bytes
+    from minio_tpu.scanner import usage as usage_mod
+    usage_mod.save_usage(srv.obj, {
+        "last_update": time.time(), "objects_total": 1, "size_total": 900,
+        "buckets": {"qb": {"objects": 1, "size": 900}}})
+    r = c.request("PUT", "/qb/big", body=b"x" * 500)
+    assert r.status_code == 409
+    assert "Quota" in r.text
+    r = c.request("PUT", "/qb/small", body=b"x" * 50)
+    assert r.status_code == 200
+
+
+def test_config_kvs(c, srv):
+    from minio_tpu.config import get_config_sys
+    cfg = get_config_sys(srv.obj)
+    # precedence: default
+    assert cfg.get("dispatch", "batch") == \
+        os.environ.get("MINIO_TPU_DISPATCH_BATCH", "128")
+    # admin set + get
+    r = c.request("PUT", "/minio/admin/v3/set-config-kv",
+                  query={"subsys": "bitrot", "key": "chunk",
+                         "value": "32768"})
+    assert r.status_code == 200, r.text
+    r = c.request("GET", "/minio/admin/v3/get-config")
+    doc = json.loads(r.text)
+    assert doc["bitrot"]["chunk"]["value"] == "32768"
+    assert doc["bitrot"]["chunk"]["source"] == "stored"
+    # dynamic apply: new objects pick up the stored chunk
+    from minio_tpu.erasure.bitrot import pick_bitrot_chunk
+    if "MINIO_TPU_BITROT_CHUNK" not in os.environ:
+        assert pick_bitrot_chunk(1 << 18) == 32768
+    # unknown key rejected
+    r = c.request("PUT", "/minio/admin/v3/set-config-kv",
+                  query={"subsys": "nope", "key": "x", "value": "1"})
+    assert r.status_code == 400
+    cfg.delete("bitrot", "chunk")
