@@ -79,6 +79,14 @@ class LocalLocker:
             return not any(e["uid"] == uid
                            for e in self._table.get(resource, []))
 
+    def dump(self) -> list[dict]:
+        """Current lock table, oldest first (admin top-locks,
+        cmd/admin-handlers.go TopLocksHandler)."""
+        with self._lock:
+            out = [{"resource": r, **e}
+                   for r, entries in self._table.items() for e in entries]
+        return sorted(out, key=lambda e: e["ts"])
+
     def force_unlock(self, resource: str) -> bool:
         with self._lock:
             return self._table.pop(resource, None) is not None

@@ -108,6 +108,9 @@ class Node:
         server.iam.on_change = self._broadcast_iam_update
         server.iam.dist_lock = lambda: self.ns_lock.new_lock(
             ".minio.sys", "config/iam/state.json")
+        # observability hooks for the admin plane (trace fan-out, top locks)
+        server.peers = lambda: self.peers
+        server.local_locker = self.local_locker
         self.bootstrap_verify()
         return server
 

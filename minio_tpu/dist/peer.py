@@ -60,6 +60,12 @@ class PeerRESTClient:
     def load_service_account(self, access_key: str) -> None:
         self.load_iam("service-account", access_key)
 
+    def trace_recent(self, n: int = 256) -> list[dict]:
+        """The peer's recent trace ring (one-shot fan-out for admin trace,
+        reference peerRESTMethodTrace streaming)."""
+        import json as _json
+        return _json.loads(self.rpc.call("tracerecent", {"n": str(n)}))
+
 
 class PeerRESTService:
     def __init__(self, node):
@@ -102,5 +108,10 @@ class PeerRESTService:
             if srv is not None and getattr(srv, "iam", None) is not None:
                 srv.iam.load()
             return b""
+        if method == "tracerecent":
+            from ..obs.trace import recent
+            n = int(params.get("n", "256"))
+            return json.dumps(
+                [t.to_dict() for t in recent(n)]).encode()
         from ..utils import errors
         raise errors.MethodNotSupported(method)

@@ -64,6 +64,8 @@ class FSObjects(ObjectLayer):
         opts = opts or ObjectOptions()
         check_names(bucket, object)
         self.get_bucket_info(bucket)
+        from .scanner.tracker import global_tracker
+        global_tracker().mark(bucket, object)
         hr = stream if isinstance(stream, HashReader) else \
             HashReader(stream, size)
         data_dir = str(uuid.uuid4())
@@ -97,7 +99,10 @@ class FSObjects(ObjectLayer):
                 writer.abort()
             raise dt.IncompleteBody(bucket, object)
         user_defined = dict(opts.user_defined)
-        etag = user_defined.pop("etag", "") or hr.etag()
+        etag = user_defined.pop("etag", "")
+        if not etag and getattr(opts, "etag_source", None) is not None:
+            etag = opts.etag_source.etag()
+        etag = etag or hr.etag()
         fi = FileInfo(
             volume=bucket, name=object,
             version_id=FileInfo.new_version_id() if opts.versioned else "",
@@ -173,6 +178,8 @@ class FSObjects(ObjectLayer):
         opts = opts or ObjectOptions()
         check_names(bucket, object)
         self.get_bucket_info(bucket)
+        from .scanner.tracker import global_tracker
+        global_tracker().mark(bucket, object)
         vid = "" if opts.version_id in ("", "null") else opts.version_id
         if opts.versioned and not opts.version_id:
             fi = FileInfo(volume=bucket, name=object,

@@ -231,6 +231,10 @@ class ObjectOptions:
     # replaces the stored user metadata instead of merging over it.
     metadata_replace: bool = False
     no_lock: bool = False
+    # ETag source override: a HashReader whose digest is the object's ETag
+    # even though the stored stream differs (transparent compression
+    # hashes the plaintext while storing the compressed bytes).
+    etag_source: object = None
 
 
 @dataclass

@@ -284,7 +284,10 @@ class ErasureObjects(MultipartMixin, ObjectLayer):
             raise dt.IncompleteBody(bucket, object)
 
         user_defined = dict(opts.user_defined)  # never mutate caller's opts
-        etag = user_defined.pop("etag", "") or hr.etag()
+        etag = user_defined.pop("etag", "")
+        if not etag and opts.etag_source is not None:
+            etag = opts.etag_source.etag()
+        etag = etag or hr.etag()
         fi.size = total
         fi.parts = [ObjectPartInfo(number=1, etag=etag, size=total,
                                    actual_size=hr.actual_size
@@ -345,6 +348,8 @@ class ErasureObjects(MultipartMixin, ObjectLayer):
         if any(e is not None for e in errs):
             self._cleanup_tmp(tmp_id)  # reclaim tmp on the failed minority
             self._notify_partial(bucket, object, fi.version_id)
+        from ..scanner.tracker import global_tracker
+        global_tracker().mark(bucket, object)
         oi = ObjectInfo.from_file_info(fi, bucket, object, opts.versioned)
         return oi
 
@@ -511,6 +516,8 @@ class ErasureObjects(MultipartMixin, ObjectLayer):
         opts = opts or ObjectOptions()
         check_names(bucket, object)
         self.get_bucket_info(bucket)
+        from ..scanner.tracker import global_tracker
+        global_tracker().mark(bucket, object)
         disks = self.disks
         write_quorum = len(disks) // 2 + 1
 
@@ -925,6 +932,9 @@ class ErasureObjects(MultipartMixin, ObjectLayer):
         """Heal one object version (reference healObject,
         cmd/erasure-healing.go:233): classify per-disk state, rebuild missing
         /corrupt shards via decode→encode, rewrite xl.meta on healed disks."""
+        from ..obs import metrics as mx
+        mx.inc("minio_tpu_heal_objects_total",
+               mode=scan_mode, dry=str(dry_run).lower())
         disks = self.disks
         n = len(disks)
         vid = "" if version_id in ("", "null") else version_id

@@ -364,6 +364,8 @@ class MultipartMixin:
                 d.delete_path(META_MULTIPART, upath, recursive=True)
             except errors.StorageError:
                 pass
+        from ..scanner.tracker import global_tracker
+        global_tracker().mark(bucket, object)
         return ObjectInfo.from_file_info(fi, bucket, object, opts.versioned)
 
     def _commit_one_disk(self, d, upath: str, tmp_id: str, fi: FileInfo,

@@ -1,0 +1,127 @@
+"""Structured logging + audit plane (reference cmd/logger/: console and
+HTTP webhook targets, audit-webhook, logOnce dedup). Rides Python's
+logging for the console path; webhook targets get JSON lines through a
+bounded background sender so a dead endpoint never blocks a request."""
+from __future__ import annotations
+
+import json
+import logging
+import os
+import queue
+import threading
+import time
+import urllib.request
+
+_console = logging.getLogger("minio_tpu")
+
+
+class HTTPLogTarget:
+    """POST one JSON document per entry to an endpoint (reference
+    cmd/logger/target/http): bounded queue, background sender, drops on
+    overflow (the reference drops too — logging must not backpressure)."""
+
+    def __init__(self, endpoint: str, auth_token: str = "",
+                 maxsize: int = 4096):
+        self.endpoint = endpoint
+        self.auth_token = auth_token
+        self.q: queue.Queue = queue.Queue(maxsize=maxsize)
+        self.dropped = 0
+        self.sent = 0
+        self._stop = threading.Event()
+        self._t = threading.Thread(target=self._loop, daemon=True,
+                                   name="minio-tpu-log-sender")
+        self._t.start()
+
+    def enqueue(self, entry: dict) -> None:
+        try:
+            self.q.put_nowait(entry)
+        except queue.Full:
+            self.dropped += 1
+
+    def _loop(self):
+        while not self._stop.is_set():
+            try:
+                entry = self.q.get(timeout=0.5)
+            except queue.Empty:
+                continue
+            try:
+                req = urllib.request.Request(
+                    self.endpoint,
+                    data=json.dumps(entry).encode(),
+                    headers={"Content-Type": "application/json"},
+                    method="POST")
+                if self.auth_token:
+                    req.add_header("Authorization",
+                                   f"Bearer {self.auth_token}")
+                with urllib.request.urlopen(req, timeout=5):
+                    self.sent += 1
+            except Exception:  # noqa: BLE001 — endpoint down: drop
+                self.dropped += 1
+
+    def stop(self):
+        self._stop.set()
+        self._t.join(timeout=2)
+
+
+class LogSys:
+    """Process log/audit fan-out. Targets from env:
+    MINIO_TPU_LOGGER_WEBHOOK_ENDPOINT (error/info log entries),
+    MINIO_TPU_AUDIT_WEBHOOK_ENDPOINT (one entry per API request)."""
+
+    def __init__(self):
+        self.log_target: HTTPLogTarget | None = None
+        self.audit_target: HTTPLogTarget | None = None
+        self._once: set[str] = set()
+        ep = os.environ.get("MINIO_TPU_LOGGER_WEBHOOK_ENDPOINT", "")
+        if ep:
+            self.log_target = HTTPLogTarget(
+                ep, os.environ.get(
+                    "MINIO_TPU_LOGGER_WEBHOOK_AUTH_TOKEN", ""))
+        ep = os.environ.get("MINIO_TPU_AUDIT_WEBHOOK_ENDPOINT", "")
+        if ep:
+            self.audit_target = HTTPLogTarget(
+                ep, os.environ.get(
+                    "MINIO_TPU_AUDIT_WEBHOOK_AUTH_TOKEN", ""))
+
+    def event(self, level: str, subsystem: str, message: str, **fields):
+        rec = {"level": level, "subsystem": subsystem, "message": message,
+               "time": time.time(), **fields}
+        getattr(_console, level if level != "fatal" else "critical",
+                _console.info)("%s: %s", subsystem, message)
+        if self.log_target is not None:
+            self.log_target.enqueue(rec)
+
+    def log_once(self, key: str, level: str, subsystem: str, message: str):
+        """Dedup noisy repeated errors (reference logger/logonce.go)."""
+        if key in self._once:
+            return
+        self._once.add(key)
+        if len(self._once) > 4096:
+            self._once.clear()
+        self.event(level, subsystem, message)
+
+    def audit(self, entry: dict):
+        """One entry per completed API request (reference audit-webhook;
+        entry shape mirrors the trace record plus identity)."""
+        if self.audit_target is not None:
+            self.audit_target.enqueue(
+                {"version": "1", "deploymentid": "minio-tpu",
+                 "time": time.time(), **entry})
+
+    def stop(self):
+        for t in (self.log_target, self.audit_target):
+            if t is not None:
+                t.stop()
+
+
+_sys: LogSys | None = None
+_sys_lock = threading.Lock()
+
+
+def log_sys() -> LogSys:
+    global _sys
+    if _sys is None:
+        with _sys_lock:
+            if _sys is None:
+                _sys = LogSys()
+    return _sys

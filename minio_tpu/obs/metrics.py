@@ -36,7 +36,9 @@ def _key(name: str, labels: dict) -> str:
 
 
 def render_prometheus(server) -> bytes:
-    """One pass over counters + gauges; server gives cluster state."""
+    """One pass over counters + gauges; server gives cluster state
+    (reference cmd/metrics-v2.go MetricsGroup generators: capacity,
+    request histograms, heal, usage, dispatch)."""
     lines = [
         "# HELP minio_tpu_uptime_seconds Server uptime",
         "# TYPE minio_tpu_uptime_seconds gauge",
@@ -50,6 +52,24 @@ def render_prometheus(server) -> bytes:
             "# TYPE minio_tpu_disks_offline gauge",
             f"minio_tpu_disks_offline {info.get('disks_offline', 0)}",
         ]
+    except Exception:  # noqa: BLE001
+        pass
+    try:  # usage group (from the scanner's last sweep)
+        from ..scanner.usage import load_usage
+        usage = load_usage(server.obj)
+        lines += [
+            "# TYPE minio_tpu_usage_objects_total gauge",
+            f"minio_tpu_usage_objects_total {usage.get('objects_total', 0)}",
+            "# TYPE minio_tpu_usage_bytes_total gauge",
+            f"minio_tpu_usage_bytes_total {usage.get('size_total', 0)}",
+        ]
+        for b, st in sorted(usage.get("buckets", {}).items()):
+            lines.append(
+                f'minio_tpu_bucket_usage_bytes{{bucket="{b}"}} '
+                f'{st.get("size", 0)}')
+            lines.append(
+                f'minio_tpu_bucket_usage_objects{{bucket="{b}"}} '
+                f'{st.get("objects", 0)}')
     except Exception:  # noqa: BLE001
         pass
     try:

@@ -1,0 +1,41 @@
+"""Tiny in-process pub/sub (reference pkg/pubsub/pubsub.go:40-55): non-
+blocking publish, per-subscriber bounded queues (slow subscribers drop,
+the hot path never waits)."""
+from __future__ import annotations
+
+import queue
+import threading
+
+
+class PubSub:
+    def __init__(self, maxsize: int = 1024):
+        self._subs: list[queue.Queue] = []
+        self._lock = threading.Lock()
+        self.maxsize = maxsize
+
+    def subscribe(self) -> queue.Queue:
+        q: queue.Queue = queue.Queue(maxsize=self.maxsize)
+        with self._lock:
+            self._subs.append(q)
+        return q
+
+    def unsubscribe(self, q: queue.Queue):
+        with self._lock:
+            try:
+                self._subs.remove(q)
+            except ValueError:
+                pass
+
+    def publish(self, item) -> None:
+        with self._lock:
+            subs = list(self._subs)
+        for q in subs:
+            try:
+                q.put_nowait(item)
+            except queue.Full:
+                pass  # slow subscriber: drop, never block the hot path
+
+    @property
+    def num_subscribers(self) -> int:
+        with self._lock:
+            return len(self._subs)

@@ -1,0 +1,49 @@
+"""Per-request tracing (reference cmd/http-tracer.go:164 +
+pkg/trace/trace.go:26-40): every API call publishes a TraceInfo to the
+global pubsub and into a ring buffer; `mc admin trace` style consumers
+subscribe (live) or fetch the ring (peers, one-shot)."""
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from dataclasses import asdict, dataclass, field
+
+from .pubsub import PubSub
+
+
+@dataclass
+class TraceInfo:
+    node: str = ""
+    func: str = ""              # api name, e.g. s3.PutObject
+    method: str = ""
+    path: str = ""
+    query: str = ""
+    status: int = 0
+    time: float = field(default_factory=time.time)
+    duration_s: float = 0.0
+    ttfb_s: float = 0.0
+    input_bytes: int = 0
+    output_bytes: int = 0
+    remote: str = ""
+    error: str = ""
+
+    def to_dict(self) -> dict:
+        return asdict(self)
+
+
+trace_pubsub = PubSub()
+_ring: deque = deque(maxlen=256)
+_ring_lock = threading.Lock()
+
+
+def publish(info: TraceInfo) -> None:
+    with _ring_lock:
+        _ring.append(info)
+    trace_pubsub.publish(info)
+
+
+def recent(n: int = 256) -> list[TraceInfo]:
+    with _ring_lock:
+        items = list(_ring)
+    return items[-n:]

@@ -288,10 +288,18 @@ class Evaluator:
             if n is not None:
                 st.sum += n
             cmp = n if n is not None else str(v)
-            if st.seen == 0 or cmp < st.min:
-                st.min = cmp
-            if st.seen == 0 or cmp > st.max:
-                st.max = cmp
+            try:
+                if st.seen == 0 or cmp < st.min:
+                    st.min = cmp
+                if st.seen == 0 or cmp > st.max:
+                    st.max = cmp
+            except TypeError:
+                # mixed numeric/string column: compare in string space
+                # (SQL engines coerce; crashing mid-stream is worse)
+                if str(cmp) < str(st.min):
+                    st.min = cmp
+                if str(cmp) > str(st.max):
+                    st.max = cmp
             st.seen += 1
             return aid
         for attr in ("operand", "left", "right", "pattern", "lo", "hi"):

@@ -169,12 +169,13 @@ def _item_names(sel: Select) -> list[str]:
 
 
 def run_select(req: S3SelectRequest, raw: bytes, writer,
-               flush_every: int = 128 << 10) -> dict:
+               flush_every: int = 128 << 10, parsed: Select | None = None
+               ) -> dict:
     """Execute the select over the full object bytes, writing event-stream
     frames to ``writer``. Returns stats. Payload batches up to
     ``flush_every`` bytes per Records frame (the reference uses
     maxRecordSize batches the same way)."""
-    sel = parse_select(req.expression)
+    sel = parsed if parsed is not None else parse_select(req.expression)
     alias = sel.alias or ""
     ev = Evaluator()
     agg = has_aggregates(sel)
@@ -196,23 +197,18 @@ def run_select(req: S3SelectRequest, raw: bytes, writer,
         if agg:
             ev.accumulate(sel.items, rec)
             continue
+        if sel.limit >= 0 and matched >= sel.limit:
+            break  # checked BEFORE emitting so LIMIT 0 returns nothing
         matched += 1
         if sel.items:
             fields = [ev.eval(item.expr, rec) for item in sel.items]
+            buf.extend(_serialize(req, fields, names).encode())
         else:
             fields = rec.all_columns()
             names_row = [f"_{i + 1}" for i in range(len(fields))]
             buf.extend(_serialize(req, fields, names_row).encode())
-            if len(buf) >= flush_every:
-                flush()
-            if sel.limit >= 0 and matched >= sel.limit:
-                break
-            continue
-        buf.extend(_serialize(req, fields, names).encode())
         if len(buf) >= flush_every:
             flush()
-        if sel.limit >= 0 and matched >= sel.limit:
-            break
     if agg:
         fields = ev.finish(sel.items)
         buf.extend(_serialize(req, fields, names).encode())

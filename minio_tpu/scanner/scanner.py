@@ -41,13 +41,30 @@ class DataScanner:
                 pass
 
     def scan_cycle(self) -> dict:
-        """One full crawl; returns the usage snapshot (also persisted)."""
+        """One crawl; returns the usage snapshot (also persisted). Buckets
+        untouched since the last sweep (per the update tracker) reuse their
+        previous stats instead of re-walking — the bloom-filter skip of
+        cmd/data-update-tracker.go. Deep-scan cycles always walk."""
+        from .tracker import global_tracker
         self.cycle += 1
         deep = (self.cycle % DEEP_SCAN_EVERY == 0)
+        tracker = global_tracker()
+        gen = tracker.begin_cycle()
+        prev_buckets = self.last_usage.get("buckets", {}) \
+            if self.last_usage else usage_mod.load_usage(
+                self.obj).get("buckets", {})
         buckets = {}
         total_objects = total_size = 0
         for b in self.obj.list_buckets():
+            prev = prev_buckets.get(b.name)
+            if prev is not None and not deep and \
+                    not tracker.bucket_dirty(b.name):
+                buckets[b.name] = prev
+                total_objects += prev.get("objects", 0)
+                total_size += prev.get("size", 0)
+                continue
             count = size = versions = 0
+            prefixes: dict[str, dict] = {}
             # one streaming metacache pass per bucket — no paging restarts
             # (cmd/data-scanner.go crawls the disks directly the same way)
             for oi in self.obj.iter_objects(b.name):
@@ -56,13 +73,21 @@ class DataScanner:
                 count += 1
                 size += oi.size
                 versions += max(1, oi.num_versions)
+                # hierarchical breakdown: one level of prefixes
+                # (cmd/data-usage-cache.go's tree, depth-limited)
+                top = oi.name.split("/", 1)[0] if "/" in oi.name else ""
+                p = prefixes.setdefault(top or "/",
+                                        {"objects": 0, "size": 0})
+                p["objects"] += 1
+                p["size"] += oi.size
                 self._check_object(b.name, oi, deep)
                 if self.sleep_per_object:
                     time.sleep(self.sleep_per_object)
             buckets[b.name] = {"objects": count, "size": size,
-                               "versions": versions}
+                               "versions": versions, "prefixes": prefixes}
             total_objects += count
             total_size += size
+        tracker.end_cycle(gen)
         snapshot = {"last_update": time.time(),
                     "objects_total": total_objects,
                     "size_total": total_size, "buckets": buckets,

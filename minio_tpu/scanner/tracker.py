@@ -1,0 +1,71 @@
+"""Data update tracker (reference cmd/data-update-tracker.go:39-104): the
+write path marks touched (bucket, top-level prefix) pairs; the scanner
+skips subtrees that saw no writes since its last sweep instead of
+re-walking the whole namespace every cycle. The reference uses rotating
+bloom filters; a bounded exact set serves the same contract here (false
+positives only — overflow degrades to 'everything dirty', never to a
+missed update)."""
+from __future__ import annotations
+
+import threading
+
+MAX_ENTRIES = 100_000
+
+
+class UpdateTracker:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._dirty: set[tuple[str, str]] = set()
+        self._overflow = False
+        self.generation = 0
+
+    @staticmethod
+    def _key(bucket: str, object: str) -> tuple[str, str]:
+        top = object.split("/", 1)[0] if object else ""
+        return (bucket, top)
+
+    def mark(self, bucket: str, object: str = "") -> None:
+        with self._lock:
+            if self._overflow:
+                return
+            if len(self._dirty) >= MAX_ENTRIES:
+                self._overflow = True
+                return
+            self._dirty.add(self._key(bucket, object))
+
+    def bucket_dirty(self, bucket: str) -> bool:
+        with self._lock:
+            if self._overflow:
+                return True
+            return any(b == bucket for b, _ in self._dirty)
+
+    def dirty_prefixes(self, bucket: str) -> set[str]:
+        with self._lock:
+            if self._overflow:
+                return {"*"}
+            return {p for b, p in self._dirty if b == bucket}
+
+    def begin_cycle(self) -> int:
+        """Snapshot the current generation; end_cycle clears only what was
+        dirty when the sweep started (marks landing mid-sweep survive)."""
+        with self._lock:
+            self.generation += 1
+            self._snapshot = set(self._dirty)
+            snap_overflow = self._overflow
+        return self.generation if not snap_overflow else -1
+
+    def end_cycle(self, gen: int) -> None:
+        with self._lock:
+            if gen == -1:
+                self._overflow = False
+                self._dirty.clear()
+                return
+            self._dirty -= getattr(self, "_snapshot", set())
+            self._snapshot = set()
+
+
+_global = UpdateTracker()
+
+
+def global_tracker() -> UpdateTracker:
+    return _global

@@ -61,6 +61,15 @@ def _dispatch_admin(h, op: str) -> None:
         return h._send(200, json.dumps(
             {"quota": meta.quota, "quotatype": "hard"}).encode(),
             "application/json")
+    if op == "trace":
+        return _trace(h)
+    if op == "top/locks":
+        return _top_locks(h)
+    if op == "logs":
+        from ..obs.trace import recent
+        n = int({k: v[0] for k, v in h.query.items()}.get("n", "100"))
+        return h._send(200, json.dumps(
+            [t.to_dict() for t in recent(n)]).encode(), "application/json")
     if op == "get-config":
         from ..config import get_config_sys
         cfg = get_config_sys(h.s3.obj)
@@ -146,6 +155,64 @@ def _iam_op(h, op: str) -> bool:
     else:
         return False
     return True
+
+
+def _trace(h) -> None:
+    """`mc admin trace` analogue (reference peerRESTMethodTrace fan-out):
+    streams JSON-line trace events. ?peers=1 first dumps every peer's
+    recent ring buffer (one-shot over RPC), then follows live local
+    events; bounded by ?count / ?timeout so clients and tests terminate.
+    """
+    import queue as qmod
+
+    from ..obs.trace import recent, trace_pubsub
+    q = {k: v[0] for k, v in h.query.items()}
+    count = int(q.get("count", "50"))
+    timeout = float(q.get("timeout", "10"))
+    h.send_response(200)
+    h.send_header("Content-Type", "application/x-ndjson")
+    h.send_header("Transfer-Encoding", "chunked")
+    h.end_headers()
+    from .s3api import _ChunkedWriter
+    out = _ChunkedWriter(h.wfile)
+    sent = 0
+    if q.get("peers") == "1":
+        for peer in getattr(h.s3, "peers", lambda: [])():
+            try:
+                for t in peer.trace_recent():
+                    out.write((json.dumps(t) + "\n").encode())
+                    sent += 1
+            except Exception:  # noqa: BLE001 — peer down: skip
+                continue
+    for t in recent(count):
+        out.write((json.dumps(t.to_dict()) + "\n").encode())
+        sent += 1
+    sub = trace_pubsub.subscribe()
+    import time as _t
+    deadline = _t.monotonic() + timeout
+    try:
+        while sent < count and _t.monotonic() < deadline:
+            try:
+                info = sub.get(timeout=min(0.5, max(
+                    0.0, deadline - _t.monotonic())))
+            except qmod.Empty:
+                continue
+            out.write((json.dumps(info.to_dict()) + "\n").encode())
+            sent += 1
+    finally:
+        trace_pubsub.unsubscribe(sub)
+    out.close()
+
+
+def _top_locks(h) -> None:
+    """`mc admin top locks` analogue: the node's lock table
+    (cmd/admin-handlers.go TopLocksHandler)."""
+    locker = getattr(h.s3, "local_locker", None)
+    entries = []
+    if locker is not None:
+        entries = locker.dump()
+    h._send(200, json.dumps({"locks": entries}).encode(),
+            "application/json")
 
 
 def _heal(h, op: str) -> None:

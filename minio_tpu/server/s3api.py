@@ -175,6 +175,7 @@ class _S3Handler(BaseHTTPRequestHandler):
 
     def _parse(self):
         split = urllib.parse.urlsplit(self.path)
+        self.raw_query = split.query
         self.url_path = urllib.parse.unquote(split.path)
         self.query = urllib.parse.parse_qs(split.query,
                                            keep_blank_values=True)
@@ -490,16 +491,21 @@ class _S3Handler(BaseHTTPRequestHandler):
         frames. Encrypted objects are decrypted first (the reference does
         the same through GetObjectNInfo's decrypting reader)."""
         self._authorize(ak, "s3:GetObject")
-        from ..s3select import S3SelectRequest, run_select
+        from ..s3select import S3SelectRequest, parse_select, run_select
         from ..s3select.sql import SQLError
         body = self._read_body()
         try:
             req = S3SelectRequest.parse(body)
+            # validate the SQL BEFORE reading the object (a bad expression
+            # must 400 without paying the read; frames stream chunked
+            # after the 200, so late errors can only abort mid-stream)
+            parsed = parse_select(req.expression)
         except (ET.ParseError, SQLError) as e:
             return self._error("InvalidRequest", str(e), 400)
         opts = self._opts()
         oi = self.s3.obj.get_object_info(self.bucket, self.key, opts)
         sse = self._sse_read_ctx(oi)
+        from ..utils import compress as cz
         import io as iomod
         sink = iomod.BytesIO()
         if sse:
@@ -509,16 +515,14 @@ class _S3Handler(BaseHTTPRequestHandler):
                                self.bucket, self.key)
             self.s3.obj.get_object(self.bucket, self.key, dw, 0, -1, opts)
             dw.finish()
+        elif oi.internal.get(cz.META_COMPRESSION):
+            # stored bytes are deflate: the SQL engine needs plaintext
+            dz = cz.DecompressWriter(sink)
+            self.s3.obj.get_object(self.bucket, self.key, dz, 0, -1, opts)
+            dz.finish()
         else:
             self.s3.obj.get_object(self.bucket, self.key, sink, 0, -1, opts)
         raw = sink.getvalue()
-        # validate the SQL before committing to a 200 (frames stream
-        # chunked after this, so late errors can only abort mid-stream)
-        from ..s3select import parse_select
-        try:
-            parse_select(req.expression)
-        except SQLError as e:
-            return self._error("InvalidRequest", str(e), 400)
         self.send_response(200)
         self.send_header("Content-Type",
                          "application/vnd.amazon.eventstream")
@@ -526,7 +530,7 @@ class _S3Handler(BaseHTTPRequestHandler):
         self.end_headers()
         out = _ChunkedWriter(self.wfile)
         try:
-            run_select(req, raw, out)
+            run_select(req, raw, out, parsed=parsed)
         except Exception:  # noqa: BLE001 — mid-stream failure: cut the
             self.close_connection = True  # connection, the client sees EOF
             return
@@ -534,7 +538,21 @@ class _S3Handler(BaseHTTPRequestHandler):
 
     # --- HTTP verbs ---------------------------------------------------------
 
+    def send_response(self, code, message=None):  # noqa: N802
+        self._last_status = code
+        super().send_response(code, message)
+
     def _handle(self):
+        """Route one request wrapped in the observability plane
+        (cmd/http-tracer.go httpTraceAll + cmd/http-stats.go): timing,
+        metrics, trace pubsub, audit entry."""
+        import time as _time
+
+        from ..obs import metrics as mx
+        from ..obs import trace as trc
+        from ..obs.logger import log_sys
+        self._last_status = 0
+        t0 = _time.perf_counter()
         try:
             self._route()
         finally:
@@ -542,6 +560,32 @@ class _S3Handler(BaseHTTPRequestHandler):
                 self._drain_body()
             except Exception:  # noqa: BLE001
                 self.close_connection = True
+            dur = _time.perf_counter() - t0
+            try:
+                status = getattr(self, "_last_status", 0)
+                path = getattr(self, "url_path", self.path)
+                api = f"s3.{self.command}"
+                if path.startswith("/minio/admin/"):
+                    api = "admin"
+                elif path.startswith("/minio/"):
+                    api = "internal"
+                mx.inc("minio_tpu_requests_total", api=api,
+                       code=str(status))
+                mx.observe("minio_tpu_request_duration_seconds", dur,
+                           api=api)
+                if api != "internal":
+                    info = trc.TraceInfo(
+                        node=f"{self.s3.address}:{self.s3.port}",
+                        func=api, method=self.command,
+                        path=path, query=getattr(self, "raw_query", ""),
+                        status=status, duration_s=dur,
+                        input_bytes=int(getattr(self, "hdr", {}).get(
+                            "content-length", "0") or 0),
+                        remote=self.client_address[0])
+                    trc.publish(info)
+                    log_sys().audit(info.to_dict())
+            except Exception:  # noqa: BLE001 — obs must never break serving
+                pass
 
     def do_GET(self):  # noqa: N802
         self._handle()
@@ -585,6 +629,13 @@ class _S3Handler(BaseHTTPRequestHandler):
     def delete_bucket(self, ak):
         self._authorize(ak, "s3:DeleteBucket")
         force = self.hdr.get("x-minio-force-delete", "") == "true"
+        if force and self.s3.bucket_meta.get(
+                self.bucket).object_lock_enabled:
+            # force delete would bypass WORM retention (the reference
+            # refuses force-delete on lock buckets the same way)
+            raise dt.InvalidRequest(
+                self.bucket, "",
+                "force delete not allowed on object-lock buckets")
         self.s3.obj.delete_bucket(self.bucket, force=force)
         self.s3.bucket_meta.remove(self.bucket)
         if self.s3._notifier is not None:
@@ -595,12 +646,15 @@ class _S3Handler(BaseHTTPRequestHandler):
     @staticmethod
     def _display_sizes(r):
         """Listings must report the same size GET/HEAD do: for encrypted
-        objects that is the plaintext size, not the stored package-stream
-        length."""
+        or compressed objects that is the plaintext size, not the stored
+        stream length."""
         from ..crypto import META_SCHEME, plain_size_of
+        from ..utils import compress as cz
         for oi in r.objects:
             if oi.internal.get(META_SCHEME):
                 oi.size = plain_size_of(oi.internal, oi.size)
+            elif oi.internal.get(cz.META_COMPRESSION):
+                oi.size = oi.actual_size
         return r
 
     def list_objects(self, ak):
@@ -831,16 +885,28 @@ class _S3Handler(BaseHTTPRequestHandler):
         sse = parse_sse_headers(self.hdr, self.bucket, self.key)
         stream, put_size = hr, size
         sse_resp = {}
+        opts = self._opts()
         if sse is not None:
             stream, put_size, sse_resp = self._encrypt_setup(
                 sse, hr, size, user_defined)
-        opts = self._opts()
+        else:
+            from ..utils import compress as cz
+            if cz.should_compress(self.key,
+                                  user_defined.get("content-type", "")):
+                # compressed length is unknown up front: the object layer
+                # streams to EOF (size=-1) and records the stored length;
+                # ETag stays the PLAINTEXT md5 via etag_source
+                user_defined[cz.META_COMPRESSION] = cz.ALGO
+                user_defined[cz.META_ACTUAL_SIZE] = str(size)
+                stream, put_size = cz.CompressReader(hr), -1
+                opts.etag_source = hr
         opts.user_defined = user_defined
         oi = self.s3.obj.put_object(self.bucket, self.key, stream, put_size,
                                     opts)
-        if sse is not None:
+        if stream is not hr:
             # everything downstream (response, event records) speaks
-            # plaintext sizes; the ciphertext length is an internal detail
+            # plaintext sizes; the stored (encrypted/compressed) length is
+            # an internal detail
             oi.size = size
         self._send(200, headers={
             "ETag": f'"{oi.etag}"',
@@ -1003,7 +1069,10 @@ class _S3Handler(BaseHTTPRequestHandler):
         oi = self.s3.obj.get_object_info(self.bucket, self.key, opts)
         self._check_preconditions(oi)
         sse = self._sse_read_ctx(oi)
-        logical_size = sse[2] if sse else oi.size
+        from ..utils import compress as cz
+        compressed = oi.internal.get(cz.META_COMPRESSION, "")
+        logical_size = sse[2] if sse else (
+            oi.actual_size if compressed else oi.size)
         rng = self._parse_range(logical_size) if logical_size > 0 else None
         headers = self._obj_headers(oi)
         if sse:
@@ -1034,6 +1103,14 @@ class _S3Handler(BaseHTTPRequestHandler):
                     self.s3.obj.get_object(self.bucket, self.key, dw,
                                            enc_off, enc_len, opts)
                 dw.finish()
+            elif compressed:
+                # inflate the whole stored stream, trim to the requested
+                # plaintext range (reference compressed-range behavior)
+                dz = cz.DecompressWriter(self.wfile, skip=offset,
+                                         limit=length)
+                self.s3.obj.get_object(self.bucket, self.key, dz, 0, -1,
+                                       opts)
+                dz.finish()
             else:
                 self.s3.obj.get_object(self.bucket, self.key, self.wfile,
                                        offset, length, opts)
@@ -1049,7 +1126,10 @@ class _S3Handler(BaseHTTPRequestHandler):
             h.update(sse[3])
             h["Content-Length"] = str(sse[2])
         else:
-            h["Content-Length"] = str(oi.size)
+            from ..utils import compress as cz
+            h["Content-Length"] = str(
+                oi.actual_size if oi.internal.get(cz.META_COMPRESSION)
+                else oi.size)
         self.send_response(200)
         for k, v in h.items():
             if v:
@@ -1201,12 +1281,19 @@ class _S3Handler(BaseHTTPRequestHandler):
     def _check_quota(self, incoming: int):
         """Hard bucket quota from the data-usage snapshot
         (cmd/bucket-quota.go enforceBucketQuotaHard): best-effort like the
-        reference — usage trails the scanner's last sweep."""
+        reference — usage trails the scanner's last sweep. The snapshot is
+        cached on the server with a short TTL so the hot write path
+        doesn't re-read+parse the usage blob per request."""
+        import time as _t
         meta = self.s3.bucket_meta.get(self.bucket)
         if meta.quota <= 0:
             return
-        from ..scanner import usage as usage_mod
-        usage = usage_mod.load_usage(self.s3.obj)
+        cached = getattr(self.s3, "_usage_cache", None)
+        if cached is None or _t.monotonic() - cached[0] > 10.0:
+            from ..scanner import usage as usage_mod
+            cached = (_t.monotonic(), usage_mod.load_usage(self.s3.obj))
+            self.s3._usage_cache = cached
+        usage = cached[1]
         used = usage.get("buckets", {}).get(self.bucket, {}).get("size", 0)
         if used + max(incoming, 0) > meta.quota:
             raise dt.QuotaExceeded(
@@ -1270,6 +1357,12 @@ class _S3Handler(BaseHTTPRequestHandler):
             raise dt.NotImplemented(self.bucket, self.key)
         self._check_quota(si_probe.size)  # destination bucket quota
         dst_opts = self._opts()
+        # object lock applies to the new version exactly like a PUT:
+        # request headers validated, else the bucket default
+        from ..bucket import objectlock as olock
+        lock_enabled, lock_default = self._lock_ctx()
+        lock_meta = olock.check_put_headers(
+            self.hdr, self.bucket, self.key, lock_enabled, lock_default)
         directive = self.hdr.get("x-amz-metadata-directive", "COPY")
         if directive == "REPLACE":
             dst_opts.user_defined = self._user_meta()
@@ -1278,6 +1371,13 @@ class _S3Handler(BaseHTTPRequestHandler):
             dst_opts.user_defined = dict(si_probe.user_defined)
             if si_probe.content_type:
                 dst_opts.user_defined["content-type"] = si_probe.content_type
+        # the copy moves the STORED bytes, so the compression markers must
+        # travel with them or the destination would serve raw deflate
+        from ..utils import compress as cz
+        for k in (cz.META_COMPRESSION, cz.META_ACTUAL_SIZE):
+            if k in si_probe.internal:
+                dst_opts.user_defined[k] = si_probe.internal[k]
+        dst_opts.user_defined.update(lock_meta)
         oi = self.s3.obj.copy_object(src_bucket, src_key, self.bucket,
                                      self.key, None, src_opts, dst_opts)
         self._send(200, xu.copy_object_xml(oi.etag, oi.mod_time),

@@ -1,0 +1,104 @@
+"""Transparent object compression (reference cmd/object-api-utils.go:920
+newS2CompressReader + compression config): opt-in via config/env, applied
+on PUT for compressible content (extension/MIME filters), recorded in
+internal metadata, undone on GET. The reference streams snappy/S2; zlib
+level 1 plays the same role here (pure-Python deployment, off by default
+exactly like the reference)."""
+from __future__ import annotations
+
+import os
+import zlib
+
+META_COMPRESSION = "x-minio-internal-compression"
+META_ACTUAL_SIZE = "x-minio-internal-actual-size"
+ALGO = "zlib/1"
+
+DEFAULT_EXTENSIONS = (".txt", ".log", ".csv", ".json", ".tar", ".xml",
+                      ".bin")
+DEFAULT_MIME = ("text/", "application/json", "application/xml",
+                "application/x-ndjson")
+
+
+def enabled() -> bool:
+    return os.environ.get("MINIO_TPU_COMPRESSION", "") in ("1", "on",
+                                                           "true")
+
+
+def should_compress(key: str, content_type: str) -> bool:
+    if not enabled():
+        return False
+    ext_env = os.environ.get("MINIO_TPU_COMPRESSION_EXTENSIONS", "")
+    exts = tuple(e.strip() for e in ext_env.split(",") if e.strip()) \
+        or DEFAULT_EXTENSIONS
+    mime_env = os.environ.get("MINIO_TPU_COMPRESSION_MIME", "")
+    mimes = tuple(m.strip() for m in mime_env.split(",") if m.strip()) \
+        or DEFAULT_MIME
+    if any(key.lower().endswith(e) for e in exts):
+        return True
+    return any((content_type or "").lower().startswith(m) for m in mimes)
+
+
+class CompressReader:
+    """Wraps a plaintext stream, yields the raw-deflate stream."""
+
+    def __init__(self, stream, level: int = 1):
+        self.stream = stream
+        self._c = zlib.compressobj(level)
+        self._buf = bytearray()
+        self._eof = False
+
+    def read(self, n: int = -1) -> bytes:
+        if n < 0:
+            out = bytearray()
+            while True:
+                b = self.read(1 << 20)
+                if not b:
+                    return bytes(out)
+                out += b
+        while not self._eof and len(self._buf) < n:
+            chunk = self.stream.read(1 << 20)
+            if not chunk:
+                self._eof = True
+                self._buf += self._c.flush()
+                break
+            self._buf += self._c.compress(chunk)
+        out = bytes(self._buf[:n])
+        del self._buf[:n]
+        return out
+
+
+class DecompressWriter:
+    """Writer wrapper inflating the stored stream and emitting the
+    plaintext sub-range [skip, skip+limit) — ranged GETs decompress from
+    the start and trim (the reference does the same for compressed
+    ranges)."""
+
+    def __init__(self, writer, skip: int = 0, limit: int = -1):
+        self.writer = writer
+        self._d = zlib.decompressobj()
+        self._skip = skip
+        self._left = limit
+
+    def write(self, b: bytes):
+        self._emit(self._d.decompress(b))
+
+    def _emit(self, plain: bytes):
+        if not plain:
+            return
+        if self._skip:
+            drop = min(self._skip, len(plain))
+            plain = plain[drop:]
+            self._skip -= drop
+        if self._left >= 0:
+            plain = plain[:self._left]
+            self._left -= len(plain)
+        if plain:
+            self.writer.write(plain)
+
+    def finish(self):
+        self._emit(self._d.flush())
+
+    def close(self):
+        self.finish()
+        if hasattr(self.writer, "close"):
+            self.writer.close()

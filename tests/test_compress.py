@@ -1,0 +1,102 @@
+"""Transparent compression (reference cmd/object-api-utils.go:920 S2
+compression): opt-in, filtered by extension/MIME, plaintext ETag, ranged
+GETs, copies keep markers, listings report plaintext sizes."""
+import hashlib
+import os
+import re
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(__file__))
+from s3client import S3Client  # noqa: E402
+
+from minio_tpu.objectlayer import ErasureObjects  # noqa: E402
+from minio_tpu.server import S3Server  # noqa: E402
+from minio_tpu.storage import XLStorage  # noqa: E402
+
+AK, SK = "czak", "czsecret1"
+BODY = (b"compressible line of text\n" * 8000)  # ~200 KB, very redundant
+
+
+@pytest.fixture
+def srv(tmp_path, monkeypatch):
+    monkeypatch.setenv("MINIO_TPU_COMPRESSION", "on")
+    obj = ErasureObjects([XLStorage(str(tmp_path / f"d{i}"))
+                          for i in range(4)], default_parity=2)
+    server = S3Server(obj, "127.0.0.1", 0, access_key=AK, secret_key=SK)
+    server.start_background()
+    yield server
+    server.shutdown()
+
+
+@pytest.fixture
+def c(srv):
+    client = S3Client(srv.endpoint(), AK, SK)
+    assert client.request("PUT", "/cz").status_code == 200
+    return client
+
+
+def test_roundtrip_etag_and_stored_size(c, srv):
+    r = c.request("PUT", "/cz/log.txt", body=BODY)
+    assert r.status_code == 200
+    # ETag is the PLAINTEXT md5
+    assert r.headers["ETag"] == f'"{hashlib.md5(BODY).hexdigest()}"'
+    r = c.request("GET", "/cz/log.txt")
+    assert r.content == BODY
+    assert int(r.headers["Content-Length"]) == len(BODY)
+    # the stored stream really is compressed (much smaller)
+    stored = srv.obj.get_object_bytes("cz", "log.txt")
+    assert len(stored) < len(BODY) // 4
+    # HEAD reports plaintext size
+    r = c.request("HEAD", "/cz/log.txt")
+    assert int(r.headers["Content-Length"]) == len(BODY)
+
+
+def test_ranged_get_on_compressed(c):
+    c.request("PUT", "/cz/r.txt", body=BODY)
+    r = c.request("GET", "/cz/r.txt",
+                  headers={"Range": "bytes=100000-100999"})
+    assert r.status_code == 206
+    assert r.content == BODY[100000:101000]
+    r = c.request("GET", "/cz/r.txt", headers={"Range": "bytes=-50"})
+    assert r.content == BODY[-50:]
+
+
+def test_incompressible_extension_skipped(c, srv):
+    r = c.request("PUT", "/cz/photo.jpg", body=BODY)
+    assert r.status_code == 200
+    stored = srv.obj.get_object_bytes("cz", "photo.jpg")
+    assert stored == BODY  # no compression applied
+
+
+def test_listing_reports_plain_size(c):
+    c.request("PUT", "/cz/list.txt", body=BODY)
+    r = c.request("GET", "/cz", query={"prefix": "list.txt"})
+    m = re.search(r"<Key>list.txt</Key>.*?<Size>(\d+)</Size>", r.text,
+                  re.DOTALL)
+    assert m and int(m.group(1)) == len(BODY)
+
+
+def test_copy_preserves_compression(c):
+    c.request("PUT", "/cz/src.txt", body=BODY)
+    r = c.request("PUT", "/cz/dst.txt",
+                  headers={"x-amz-copy-source": "/cz/src.txt"})
+    assert r.status_code == 200, r.text
+    r = c.request("GET", "/cz/dst.txt")
+    assert r.content == BODY
+
+
+def test_off_by_default(tmp_path):
+    os.environ.pop("MINIO_TPU_COMPRESSION", None)
+    obj = ErasureObjects([XLStorage(str(tmp_path / f"d{i}"))
+                          for i in range(4)], default_parity=2)
+    server = S3Server(obj, "127.0.0.1", 0, access_key=AK, secret_key=SK)
+    server.start_background()
+    try:
+        c2 = S3Client(server.endpoint(), AK, SK)
+        c2.request("PUT", "/czoff")
+        c2.request("PUT", "/czoff/a.txt", body=BODY)
+        assert obj.get_object_bytes("czoff", "a.txt") == BODY
+    finally:
+        server.shutdown()

@@ -1,0 +1,141 @@
+"""Observability plane: request metrics, trace pubsub + ring, admin trace
+streaming, top-locks, audit/log webhook targets (reference cmd/logger/,
+cmd/http-tracer.go, cmd/metrics-v2.go)."""
+import json
+import os
+import sys
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(__file__))
+from s3client import S3Client  # noqa: E402
+
+from minio_tpu.objectlayer import ErasureObjects  # noqa: E402
+from minio_tpu.server import S3Server  # noqa: E402
+from minio_tpu.storage import XLStorage  # noqa: E402
+
+AK, SK = "obak", "obsecret1"
+
+
+@pytest.fixture
+def srv(tmp_path):
+    obj = ErasureObjects([XLStorage(str(tmp_path / f"d{i}"))
+                          for i in range(4)], default_parity=2)
+    server = S3Server(obj, "127.0.0.1", 0, access_key=AK, secret_key=SK)
+    server.start_background()
+    yield server
+    server.shutdown()
+
+
+@pytest.fixture
+def c(srv):
+    return S3Client(srv.endpoint(), AK, SK)
+
+
+def test_requests_metrics_and_usage(c, srv):
+    c.request("PUT", "/mb")
+    c.request("PUT", "/mb/o", body=b"x" * 100)
+    c.request("GET", "/mb/o")
+    r = c.http.get(srv.endpoint() + "/minio/v2/metrics/cluster")
+    text = r.text
+    assert "minio_tpu_requests_total" in text
+    assert 'api="s3.PUT"' in text
+    assert "minio_tpu_request_duration_seconds_bucket" in text
+    assert "minio_tpu_uptime_seconds" in text
+
+
+def test_trace_ring_and_admin_trace(c, srv):
+    from minio_tpu.obs.trace import recent
+    c.request("PUT", "/tb")
+    c.request("PUT", "/tb/k", body=b"y")
+    # the trace publishes after the response flushes — poll briefly
+    deadline = time.time() + 5
+    while time.time() < deadline:
+        if any(t.path == "/tb/k" and t.method == "PUT" and t.status == 200
+               for t in recent()):
+            break
+        time.sleep(0.05)
+    assert any(t.path == "/tb/k" and t.method == "PUT" and t.status == 200
+               for t in recent())
+    # admin trace endpoint streams ndjson (bounded by count/timeout)
+    r = c.request("GET", "/minio/admin/v3/trace",
+                  query={"count": "5", "timeout": "1"})
+    assert r.status_code == 200
+    lines = [json.loads(ln) for ln in r.text.splitlines() if ln.strip()]
+    assert lines and all("path" in e and "status" in e for e in lines)
+
+
+def test_top_locks_endpoint(c, srv):
+    # standalone server has no locker attached -> empty table, not an error
+    r = c.request("GET", "/minio/admin/v3/top/locks")
+    assert r.status_code == 200
+    assert json.loads(r.text) == {"locks": []}
+
+
+def test_locker_dump():
+    from minio_tpu.dist.dsync import LocalLocker
+    lk = LocalLocker()
+    lk.lock("b/o1", "u1", "owner1")
+    lk.rlock("b/o2", "u2", "owner2")
+    d = lk.dump()
+    assert [e["resource"] for e in d] == ["b/o1", "b/o2"]
+    assert d[0]["writer"] and not d[1]["writer"]
+
+
+class _Hook(BaseHTTPRequestHandler):
+    got: list = []
+
+    def do_POST(self):  # noqa: N802
+        body = self.rfile.read(int(self.headers.get("Content-Length", 0)))
+        type(self).got.append(json.loads(body))
+        self.send_response(200)
+        self.end_headers()
+
+    def log_message(self, *a):
+        pass
+
+
+def test_audit_webhook(tmp_path, monkeypatch):
+    class Hk(_Hook):
+        got = []
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), Hk)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    monkeypatch.setenv("MINIO_TPU_AUDIT_WEBHOOK_ENDPOINT",
+                       f"http://127.0.0.1:{httpd.server_address[1]}/a")
+    import minio_tpu.obs.logger as lg
+    monkeypatch.setattr(lg, "_sys", None)  # rebuild with the env target
+    obj = ErasureObjects([XLStorage(str(tmp_path / f"d{i}"))
+                          for i in range(4)], default_parity=2)
+    server = S3Server(obj, "127.0.0.1", 0, access_key=AK, secret_key=SK)
+    server.start_background()
+    try:
+        c2 = S3Client(server.endpoint(), AK, SK)
+        c2.request("PUT", "/ab")
+        c2.request("PUT", "/ab/doc", body=b"z")
+        t0 = time.time()
+        while time.time() - t0 < 10:
+            if any(e.get("path") == "/ab/doc" for e in Hk.got):
+                break
+            time.sleep(0.05)
+        assert any(e.get("path") == "/ab/doc" and e.get("method") == "PUT"
+                   for e in Hk.got)
+    finally:
+        server.shutdown()
+        httpd.shutdown()
+        lg._sys = None
+
+
+def test_log_once_dedup():
+    from minio_tpu.obs.logger import LogSys
+    ls = LogSys()
+    sent = []
+    class T:
+        def enqueue(self, e):
+            sent.append(e)
+    ls.log_target = T()
+    for _ in range(5):
+        ls.log_once("disk-d0-offline", "error", "storage", "disk offline")
+    assert len(sent) == 1
