@@ -22,6 +22,8 @@ class Rule:
     expiration_date: float = 0.0
     expire_delete_marker: bool = False
     noncurrent_days: int = 0
+    transition_days: int = 0
+    transition_tier: str = ""   # <StorageClass> = admin-configured tier
 
     @property
     def enabled(self) -> bool:
@@ -63,6 +65,10 @@ def parse_lifecycle(xml_blob: bytes) -> list[Rule]:
         if nexp is not None:
             rule.noncurrent_days = int(
                 nexp.findtext("NoncurrentDays", "0") or "0")
+        tr = r.find("Transition")
+        if tr is not None:
+            rule.transition_days = int(tr.findtext("Days", "0") or "0")
+            rule.transition_tier = tr.findtext("StorageClass", "")
         rules.append(rule)
     return rules
 
@@ -71,9 +77,12 @@ class LifecycleSys:
     """Evaluates rules during scanner cycles (reference applies them in the
     scanner's scanFolder — cmd/data-scanner.go)."""
 
-    def __init__(self, objlayer, bucket_meta):
+    def __init__(self, objlayer, bucket_meta, transition_sys=None):
         self.obj = objlayer
         self.bucket_meta = bucket_meta
+        #: optional TransitionSys (bucket.transition) enabling the
+        #: Transition action; None = transition rules are inert
+        self.transition_sys = transition_sys
         self.expired = 0
         #: bucket -> (xml blob, parsed rules) — re-parse only on change
         self._cache: dict[str, tuple[bytes, list[Rule]]] = {}
@@ -133,9 +142,28 @@ class LifecycleSys:
                 # object expires, regardless of creation time
                 expired = True
             if expired and not oi.delete_marker:
+                if self.transition_sys is not None:
+                    from .transition import is_transitioned
+                    if is_transitioned(oi):
+                        # the tier key lives only in this stub: reclaim
+                        # the tier copy before the stub disappears
+                        self.transition_sys.delete_remote(oi)
                 versioned = self.bucket_meta.versioning_enabled(bucket)
                 self.obj.delete_object(bucket, oi.name,
                                        ObjectOptions(versioned=versioned))
                 self.expired += 1
                 return True
+            # transition to tier (cmd/bucket-lifecycle.go:365)
+            if self.transition_sys is not None:
+                from .transition import is_transitioned
+                if self.transition_sys.maybe_restub(bucket, oi):
+                    return False  # restored window lapsed: stubbed again
+                if r.transition_days and r.transition_tier and \
+                        not is_transitioned(oi) and \
+                        now - oi.mod_time >= r.transition_days * 86400:
+                    try:
+                        self.transition_sys.transition(
+                            bucket, oi, r.transition_tier)
+                    except Exception:  # noqa: BLE001 — tier down: retry
+                        pass           # next cycle
         return False

@@ -45,6 +45,9 @@ class S3Target:
     def put(self, key: str, body: bytes, headers: dict | None = None):
         return self._req("PUT", key, body, headers)
 
+    def get(self, key: str):
+        return self._req("GET", key)
+
     def delete(self, key: str):
         return self._req("DELETE", key)
 
@@ -132,11 +135,15 @@ class ReplicationPool:
         for k, v in oi.user_defined.items():
             if k.startswith("x-amz-meta-"):
                 headers[k] = v
-        if oi.size <= self.SPOOL_THRESHOLD:
+        from ..utils.compress import META_COMPRESSION, logical_bytes
+        compressed = bool(oi.internal.get(META_COMPRESSION))
+        if compressed or oi.size <= self.SPOOL_THRESHOLD:
             from ..erasure.streaming import BufferSink
             sink = BufferSink()
             self.obj.get_object(bucket, key, sink)
-            r = tgt.put(key, sink.getvalue(), headers)
+            # the replica must hold PLAINTEXT — the target doesn't know
+            # this deployment's transparent-compression markers
+            r = tgt.put(key, logical_bytes(oi, sink.getvalue()), headers)
         else:
             # spool to disk so multi-GB objects never sit in RAM; requests
             # streams a file body with a correct Content-Length
@@ -146,6 +153,40 @@ class ReplicationPool:
                 r = tgt.put(key, spool, headers)
         if r.status_code != 200:
             raise RuntimeError(f"replication target: {r.status_code}")
+
+    def resync(self, bucket: str) -> int:
+        """Re-schedule every object for replication (reference
+        cmd/bucket-replication.go resyncBucket: recover a target that was
+        down or newly attached). Returns the number scheduled."""
+        if bucket not in self.targets:
+            return 0
+        count = 0
+        for oi in self.obj.iter_objects(bucket):
+            self.schedule(bucket, oi.name, "put")
+            count += 1
+        return count
+
+    def proxy_get(self, bucket: str, key: str, range_header: str = ""):
+        """GET proxy-to-target on local miss (reference
+        ObjectOptions.ProxyRequest, cmd/object-api-interface.go:55): an
+        object not yet replicated back can still be served. The client's
+        Range header is forwarded so ranged requests stay ranged (and a
+        miss on a huge object doesn't pull the whole body). Returns
+        (status, bytes, headers dict) or None."""
+        tgt = self.targets.get(bucket)
+        if tgt is None:
+            return None
+        try:
+            hdrs = {"range": range_header} if range_header else None
+            r = tgt._req("GET", key, headers=hdrs)
+        except Exception:  # noqa: BLE001 — target down
+            return None
+        if r.status_code not in (200, 206):
+            return None
+        keep = {k: v for k, v in r.headers.items()
+                if k.lower() in ("content-type", "content-range", "etag",
+                                 "last-modified")}
+        return r.status_code, r.content, keep
 
     def drain(self, timeout: float = 30.0):
         """Block until the queue is empty AND no worker is mid-replication."""

@@ -1,0 +1,150 @@
+"""AdminClient — Python SDK for the admin API (reference pkg/madmin, the
+Go client `mc admin` drives; same role here: a typed programmatic surface
+over /minio/admin/v3/...). Uses only the standard library."""
+from __future__ import annotations
+
+import hashlib
+import json
+import urllib.parse
+import urllib.request
+
+
+class AdminError(Exception):
+    def __init__(self, status: int, body: str):
+        self.status = status
+        self.body = body
+        super().__init__(f"admin API {status}: {body[:200]}")
+
+
+class AdminClient:
+    def __init__(self, endpoint: str, access_key: str, secret_key: str,
+                 region: str = "us-east-1"):
+        self.endpoint = endpoint.rstrip("/")
+        self.ak = access_key
+        self.sk = secret_key
+        self.region = region
+
+    # -- transport ------------------------------------------------------------
+
+    def _request(self, method: str, op: str,
+                 query: dict[str, str] | None = None,
+                 body: bytes = b"") -> bytes:
+        from .server.auth import SigV4Verifier
+        path = f"/minio/admin/v3/{op}"
+        q = {k: [v] for k, v in (query or {}).items()}
+        host = self.endpoint.split("//", 1)[1]
+        headers = {"host": host}
+        payload_hash = hashlib.sha256(body).hexdigest()
+        signer = SigV4Verifier(lambda a: None, self.region)
+        headers["authorization"] = signer.sign_request(
+            self.ak, self.sk, method, path, q, headers, payload_hash)
+        qs = urllib.parse.urlencode({k: v for k, v in (query or {}).items()})
+        url = self.endpoint + path + (f"?{qs}" if qs else "")
+        req = urllib.request.Request(url, data=body or None, method=method,
+                                     headers=headers)
+        try:
+            with urllib.request.urlopen(req, timeout=30) as resp:
+                return resp.read()
+        except urllib.error.HTTPError as e:
+            raise AdminError(e.code, e.read().decode("utf-8", "replace")) \
+                from None
+
+    def _json(self, method: str, op: str, query=None, body: bytes = b""):
+        out = self._request(method, op, query, body)
+        return json.loads(out) if out.strip() else {}
+
+    # -- info / health --------------------------------------------------------
+
+    def server_info(self) -> dict:
+        return self._json("GET", "info")
+
+    def storage_info(self) -> dict:
+        return self._json("GET", "storageinfo")
+
+    def data_usage_info(self) -> dict:
+        return self._json("GET", "datausageinfo")
+
+    # -- heal -----------------------------------------------------------------
+
+    def heal(self, bucket: str = "", prefix: str = "",
+             dry_run: bool = False) -> dict:
+        op = "heal" + (f"/{bucket}" if bucket else "") + \
+            (f"/{prefix}" if prefix else "")
+        return self._json("POST", op,
+                          {"dryRun": "true"} if dry_run else None)
+
+    # -- IAM ------------------------------------------------------------------
+
+    def add_user(self, access_key: str, secret_key: str,
+                 policies: list[str] | None = None) -> None:
+        self._json("PUT", "add-user", {"accessKey": access_key},
+                   json.dumps({"secretKey": secret_key,
+                               "policies": policies or []}).encode())
+
+    def remove_user(self, access_key: str) -> None:
+        self._json("DELETE", "remove-user", {"accessKey": access_key})
+
+    def list_users(self) -> dict:
+        return self._json("GET", "list-users")
+
+    def set_user_status(self, access_key: str, status: str) -> None:
+        self._json("PUT", "set-user-status",
+                   {"accessKey": access_key, "status": status})
+
+    def add_canned_policy(self, name: str, policy_json: bytes) -> None:
+        self._json("PUT", "add-canned-policy", {"name": name}, policy_json)
+
+    def list_canned_policies(self) -> dict:
+        return self._json("GET", "list-canned-policies")
+
+    def set_policy(self, user_or_group: str, policy_names: list[str],
+                   group: bool = False) -> None:
+        self._json("PUT", "set-user-or-group-policy",
+                   {"userOrGroup": user_or_group,
+                    "policyName": ",".join(policy_names),
+                    "isGroup": "true" if group else "false"})
+
+    def add_service_account(self, parent: str = "",
+                            policy: str = "") -> dict:
+        return self._json("PUT", "add-service-account", None,
+                          json.dumps({"parent": parent,
+                                      "policy": policy}).encode())
+
+    # -- quota / config / tiers ----------------------------------------------
+
+    def set_bucket_quota(self, bucket: str, quota_bytes: int) -> None:
+        self._json("PUT", "set-bucket-quota", {"bucket": bucket},
+                   json.dumps({"quota": quota_bytes}).encode())
+
+    def get_bucket_quota(self, bucket: str) -> dict:
+        return self._json("GET", "get-bucket-quota", {"bucket": bucket})
+
+    def get_config(self) -> dict:
+        return self._json("GET", "get-config")
+
+    def set_config_kv(self, subsys: str, key: str, value: str) -> None:
+        self._json("PUT", "set-config-kv",
+                   {"subsys": subsys, "key": key, "value": value})
+
+    def del_config_kv(self, subsys: str, key: str) -> None:
+        self._json("DELETE", "del-config-kv",
+                   {"subsys": subsys, "key": key})
+
+    def add_tier(self, spec: dict) -> None:
+        self._json("PUT", "tier", None, json.dumps(spec).encode())
+
+    def list_tiers(self) -> list:
+        return self._json("GET", "tier")
+
+    def remove_tier(self, name: str) -> None:
+        self._json("DELETE", "tier", {"name": name})
+
+    # -- observability --------------------------------------------------------
+
+    def top_locks(self) -> dict:
+        return self._json("GET", "top/locks")
+
+    def trace(self, count: int = 50, timeout: float = 5.0) -> list[dict]:
+        raw = self._request("GET", "trace", {"count": str(count),
+                                             "timeout": str(timeout)})
+        return [json.loads(ln) for ln in raw.splitlines() if ln.strip()]

@@ -57,7 +57,12 @@ class DataScanner:
         total_objects = total_size = 0
         for b in self.obj.list_buckets():
             prev = prev_buckets.get(b.name)
-            if prev is not None and not deep and \
+            # the skip is only legal when no time-based actions are
+            # configured — lifecycle rules must evaluate every cycle even
+            # with zero writes (expiry/transition trigger on age)
+            has_lifecycle = self.lifecycle is not None and \
+                bool(self.lifecycle.rules_for(b.name))
+            if prev is not None and not deep and not has_lifecycle and \
                     not tracker.bucket_dirty(b.name):
                 buckets[b.name] = prev
                 total_objects += prev.get("objects", 0)

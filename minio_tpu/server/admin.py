@@ -65,11 +65,32 @@ def _dispatch_admin(h, op: str) -> None:
         return _trace(h)
     if op == "top/locks":
         return _top_locks(h)
-    if op == "logs":
-        from ..obs.trace import recent
-        n = int({k: v[0] for k, v in h.query.items()}.get("n", "100"))
-        return h._send(200, json.dumps(
-            [t.to_dict() for t in recent(n)]).encode(), "application/json")
+    if op == "tier":
+        q = {k: v[0] for k, v in h.query.items()}
+        if h.command == "GET":
+            return h._send(200, json.dumps(h.s3.tiers.list()).encode(),
+                           "application/json")
+        if h.command == "DELETE":
+            h.s3.tiers.remove(q.get("name", ""))
+            return h._send(200, b"{}", "application/json")
+        body = json.loads(h._read_body() or b"{}")
+        from ..bucket.tiers import TierFS, TierS3
+        try:
+            if body.get("kind") == "fs":
+                tier = TierFS(body["name"], body["dir"])
+            elif body.get("kind") == "s3":
+                tier = TierS3(body["name"], body["endpoint"],
+                              body["bucket"], body["access_key"],
+                              body["secret_key"], body.get("prefix", ""),
+                              body.get("region", "us-east-1"))
+            else:
+                return h._error("InvalidArgument",
+                                f"unknown tier kind {body.get('kind')!r}",
+                                400)
+            h.s3.tiers.add(tier)
+        except (KeyError, ValueError) as e:
+            return h._error("InvalidArgument", str(e), 400)
+        return h._send(200, b"{}", "application/json")
     if op == "get-config":
         from ..config import get_config_sys
         cfg = get_config_sys(h.s3.obj)

@@ -78,6 +78,21 @@ class S3Server:
         self.authorize = self._iam_authorize
         return self.iam
 
+    def enable_replication(self, pool):
+        """Attach a ReplicationPool: object events feed it (chained with
+        any existing notifier) and GETs of locally-missing objects proxy
+        to the bucket's target (reference proxy-to-target on GET miss)."""
+        self.replication = pool
+        prev = self.notify
+
+        def chained(event, bucket, oi, *a):
+            pool.on_event(event, bucket, oi)
+            if prev is not None:
+                prev(event, bucket, oi, *a)
+
+        self.notify = chained
+        return pool
+
     def enable_events(self, targets: list | None = None,
                       queue_root: str = ""):
         """Attach the event-notification subsystem: persistent per-target
@@ -145,6 +160,22 @@ class S3Server:
 
     def endpoint(self) -> str:
         return f"http://127.0.0.1:{self.port}"
+
+    @property
+    def tiers(self):
+        """Lazy tier registry (reference globalTierConfigMgr)."""
+        if getattr(self, "_tiers", None) is None:
+            from ..bucket.tiers import TierRegistry
+            self._tiers = TierRegistry(self.obj)
+        return self._tiers
+
+    @property
+    def transition(self):
+        if getattr(self, "_transition", None) is None:
+            from ..bucket.transition import TransitionSys
+            self._transition = TransitionSys(self.obj, self.tiers,
+                                             self.bucket_meta)
+        return self._transition
 
 
 class _ChunkedWriter:
@@ -482,7 +513,7 @@ class _S3Handler(BaseHTTPRequestHandler):
             if s.has_q("select") or s.q("select-type"):
                 return s.select_object_content(ak)
             if s.has_q("restore"):
-                return s._send(202)
+                return s.restore_object(ak)
         return s._error("MethodNotAllowed", f"bad object op {m}", 405)
 
     def select_object_content(self, ak):
@@ -648,6 +679,7 @@ class _S3Handler(BaseHTTPRequestHandler):
         """Listings must report the same size GET/HEAD do: for encrypted
         or compressed objects that is the plaintext size, not the stored
         stream length."""
+        from ..bucket import transition as tx
         from ..crypto import META_SCHEME, plain_size_of
         from ..utils import compress as cz
         for oi in r.objects:
@@ -655,6 +687,8 @@ class _S3Handler(BaseHTTPRequestHandler):
                 oi.size = plain_size_of(oi.internal, oi.size)
             elif oi.internal.get(cz.META_COMPRESSION):
                 oi.size = oi.actual_size
+            elif tx.is_transitioned(oi) and oi.size == 0:
+                oi.size = tx.transitioned_size(oi)
         return r
 
     def list_objects(self, ak):
@@ -1066,8 +1100,32 @@ class _S3Handler(BaseHTTPRequestHandler):
     def get_object(self, ak):
         self._authorize(ak, "s3:GetObject")
         opts = self._opts()
-        oi = self.s3.obj.get_object_info(self.bucket, self.key, opts)
+        try:
+            oi = self.s3.obj.get_object_info(self.bucket, self.key, opts)
+        except dt.ObjectNotFound:
+            # replication proxy: serve from the bucket's remote target
+            # when the object hasn't replicated back yet
+            pool = getattr(self.s3, "replication", None)
+            res = pool.proxy_get(self.bucket, self.key,
+                                 self.hdr.get("range", "")) \
+                if pool is not None else None
+            if res is None:
+                raise
+            status, body, hdrs = res
+            self.send_response(status)
+            for k, v in hdrs.items():
+                self.send_header(k, v)
+            self.send_header("Content-Length", str(len(body)))
+            self.send_header("x-minio-proxied-from-target", "true")
+            self.end_headers()
+            self.wfile.write(body)
+            return
         self._check_preconditions(oi)
+        from ..bucket import transition as tx
+        if tx.is_transitioned(oi) and oi.size == 0:
+            # stub: read through from the tier (cmd/bucket-lifecycle.go
+            # getTransitionedObjectReader)
+            return self._get_transitioned(oi)
         sse = self._sse_read_ctx(oi)
         from ..utils import compress as cz
         compressed = oi.internal.get(cz.META_COMPRESSION, "")
@@ -1116,10 +1174,75 @@ class _S3Handler(BaseHTTPRequestHandler):
                                        offset, length, opts)
         self._notify("s3:ObjectAccessed:Get", oi)
 
+    def _get_transitioned(self, oi):
+        from ..bucket import transition as tx
+        try:
+            data = self.s3.transition.read(oi)
+        except Exception:  # noqa: BLE001 — tier unreachable
+            return self._error("InvalidObjectState",
+                               "transitioned object's tier unavailable",
+                               403)
+        rng = self._parse_range(len(data)) if data else None
+        headers = self._obj_headers(oi)
+        headers["x-amz-storage-class"] = oi.internal.get(tx.META_TIER, "")
+        if rng is None:
+            body, status = data, 200
+        else:
+            body, status = data[rng[0]:rng[1] + 1], 206
+            headers["Content-Range"] = \
+                f"bytes {rng[0]}-{rng[1]}/{len(data)}"
+        self.send_response(status)
+        for k, v in headers.items():
+            if v:
+                self.send_header(k, v)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+        self._notify("s3:ObjectAccessed:Get", oi)
+
+    def restore_object(self, ak):
+        """POST ?restore (reference PostRestoreObjectHandler): bring a
+        transitioned object's bytes back locally for Days days."""
+        self._authorize(ak, "s3:RestoreObject")
+        days = 1
+        body = self._read_body()
+        if body.strip():
+            try:
+                root = ET.fromstring(body)
+                from ..bucket.objectlock import findtext
+                days = int(findtext(root, "Days") or "1")
+            except ET.ParseError as e:
+                return self._error("MalformedXML", str(e), 400)
+        oi = self.s3.obj.get_object_info(self.bucket, self.key,
+                                         self._opts())
+        from ..bucket import transition as tx
+        if not tx.is_transitioned(oi):
+            return self._error("InvalidObjectState",
+                               "object is not archived", 403)
+        if oi.size > 0 and tx.is_restored(oi):
+            # already restored: just extend the expiry, no tier fetch
+            self.s3.transition.extend_restore(self.bucket, oi, days)
+        else:
+            self.s3.transition.restore(self.bucket, oi, days)
+        self._send(202)
+
     def head_object(self, ak):
         self._authorize(ak, "s3:GetObject")
         oi = self.s3.obj.get_object_info(self.bucket, self.key, self._opts())
         self._check_preconditions(oi)
+        from ..bucket import transition as tx
+        if tx.is_transitioned(oi):
+            h = self._obj_headers(oi)
+            h["Content-Length"] = str(tx.transitioned_size(oi))
+            h["x-amz-storage-class"] = oi.internal.get(tx.META_TIER, "")
+            if oi.size > 0 and tx.is_restored(oi):
+                h["x-amz-restore"] = 'ongoing-request="false"'
+            self.send_response(200)
+            for k, v in h.items():
+                if v:
+                    self.send_header(k, v)
+            self.end_headers()
+            return
         sse = self._sse_read_ctx(oi)
         h = self._obj_headers(oi)
         if sse:

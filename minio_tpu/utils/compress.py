@@ -38,6 +38,16 @@ def should_compress(key: str, content_type: str) -> bool:
     return any((content_type or "").lower().startswith(m) for m in mimes)
 
 
+def logical_bytes(oi, stored: bytes) -> bytes:
+    """The object's plaintext given its STORED bytes: inflate when the
+    compression marker is present. Subsystems that move object data out
+    of this deployment (replication, tiering) must ship plaintext — the
+    destination doesn't know our markers."""
+    if getattr(oi, "internal", {}).get(META_COMPRESSION):
+        return zlib.decompress(stored)
+    return stored
+
+
 class CompressReader:
     """Wraps a plaintext stream, yields the raw-deflate stream."""
 

@@ -212,3 +212,47 @@ def test_replication(tmp_path):
         pool.stop()
     finally:
         dst_srv.shutdown()
+
+
+def test_replication_resync_and_proxy(tmp_path):
+    """Resync re-replicates the whole bucket; a GET miss on the source
+    server proxies to the target (reference resyncBucket +
+    ObjectOptions.ProxyRequest)."""
+    from minio_tpu.server import S3Server
+    from s3client import S3Client
+    src_obj, _ = mk_obj(tmp_path, prefix="psrc")
+    dst_obj, _ = mk_obj(tmp_path, prefix="pdst")
+    dst_srv = S3Server(dst_obj, "127.0.0.1", 0, access_key="repl",
+                       secret_key="replsecret1")
+    dst_srv.start_background()
+    src_srv = S3Server(src_obj, "127.0.0.1", 0, access_key="src",
+                       secret_key="srcsecret1")
+    src_srv.start_background()
+    try:
+        src_obj.make_bucket("rb")
+        # objects written BEFORE the target existed
+        for i in range(5):
+            d = rng_bytes(64 << 10, seed=40 + i)
+            src_obj.put_object("rb", f"pre{i}", io.BytesIO(d), len(d))
+        pool = ReplicationPool(src_obj, workers=2).start()
+        pool.set_target("rb", S3Target(
+            dst_srv.endpoint(), "repl", "replsecret1", "rb"))
+        src_srv.enable_replication(pool)
+        assert pool.resync("rb") == 5
+        pool.drain()
+        time.sleep(0.5)
+        c_dst = S3Client(dst_srv.endpoint(), "repl", "replsecret1")
+        assert c_dst.get_object("rb", "pre3").status_code == 200
+        # proxy: an object that exists ONLY on the target serves via the
+        # source server's GET
+        c_dst.request("PUT", "/rb/remote-only", body=b"target data")
+        c_src = S3Client(src_srv.endpoint(), "src", "srcsecret1")
+        r = c_src.get_object("rb", "remote-only")
+        assert r.status_code == 200 and r.content == b"target data"
+        assert r.headers.get("x-minio-proxied-from-target") == "true"
+        # a genuinely missing object still 404s
+        assert c_src.get_object("rb", "nowhere").status_code == 404
+        pool.stop()
+    finally:
+        src_srv.shutdown()
+        dst_srv.shutdown()

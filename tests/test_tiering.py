@@ -1,0 +1,135 @@
+"""ILM transition/tiering + restore + the madmin AdminClient SDK
+(reference cmd/bucket-lifecycle.go, cmd/tier.go, pkg/madmin)."""
+import io
+import json
+import os
+import sys
+import time
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(__file__))
+from s3client import S3Client  # noqa: E402
+
+from minio_tpu.bucket import transition as tx  # noqa: E402
+from minio_tpu.bucket.lifecycle import LifecycleSys  # noqa: E402
+from minio_tpu.madmin import AdminClient, AdminError  # noqa: E402
+from minio_tpu.objectlayer import ErasureObjects  # noqa: E402
+from minio_tpu.server import S3Server  # noqa: E402
+from minio_tpu.storage import XLStorage  # noqa: E402
+
+AK, SK = "trak", "trsecret1"
+BODY = b"cold data " * 5000
+
+
+@pytest.fixture
+def srv(tmp_path):
+    obj = ErasureObjects([XLStorage(str(tmp_path / f"d{i}"))
+                          for i in range(4)], default_parity=2)
+    server = S3Server(obj, "127.0.0.1", 0, access_key=AK, secret_key=SK)
+    server.start_background()
+    yield server
+    server.shutdown()
+
+
+@pytest.fixture
+def c(srv):
+    return S3Client(srv.endpoint(), AK, SK)
+
+
+@pytest.fixture
+def adm(srv):
+    return AdminClient(srv.endpoint(), AK, SK)
+
+
+def _transition_now(srv, bucket, name, tier):
+    oi = srv.obj.get_object_info(bucket, name)
+    assert srv.transition.transition(bucket, oi, tier)
+
+
+def test_transition_readthrough_restore(c, srv, adm, tmp_path):
+    adm.add_tier({"kind": "fs", "name": "COLD",
+                  "dir": str(tmp_path / "cold")})
+    assert [t["name"] for t in adm.list_tiers()] == ["COLD"]
+    c.request("PUT", "/tb")
+    c.request("PUT", "/tb/archive.bin", body=BODY)
+    _transition_now(srv, "tb", "archive.bin", "COLD")
+    # stub on local disks, bytes in the tier
+    oi = srv.obj.get_object_info("tb", "archive.bin")
+    assert oi.size == 0 and tx.is_transitioned(oi)
+    # HEAD reports original size + storage class
+    r = c.request("HEAD", "/tb/archive.bin")
+    assert int(r.headers["Content-Length"]) == len(BODY)
+    assert r.headers["x-amz-storage-class"] == "COLD"
+    # GET reads through from the tier
+    r = c.request("GET", "/tb/archive.bin")
+    assert r.content == BODY
+    r = c.request("GET", "/tb/archive.bin",
+                  headers={"Range": "bytes=100-199"})
+    assert r.status_code == 206 and r.content == BODY[100:200]
+    # restore brings bytes back locally
+    r = c.request("POST", "/tb/archive.bin", query={"restore": ""},
+                  body=b"<RestoreRequest><Days>2</Days></RestoreRequest>")
+    assert r.status_code == 202, r.text
+    oi = srv.obj.get_object_info("tb", "archive.bin")
+    assert oi.size == len(BODY) and tx.is_restored(oi)
+    r = c.request("HEAD", "/tb/archive.bin")
+    assert "x-amz-restore" in r.headers
+    # listing shows original size for stubs
+    c.request("PUT", "/tb/stub2.bin", body=BODY)
+    _transition_now(srv, "tb", "stub2.bin", "COLD")
+    r = c.request("GET", "/tb", query={"prefix": "stub2"})
+    import re
+    m = re.search(r"<Size>(\d+)</Size>", r.text)
+    assert m and int(m.group(1)) == len(BODY)
+
+
+def test_lifecycle_rule_drives_transition(srv, c, adm, tmp_path):
+    adm.add_tier({"kind": "fs", "name": "ICE",
+                  "dir": str(tmp_path / "ice")})
+    c.request("PUT", "/lcb")
+    c.request("PUT", "/lcb/old.bin", body=BODY)
+    # backdate the object so the 1-day transition rule matches
+    srv.obj.update_object_meta  # sanity: method exists
+    lc_xml = (b"<LifecycleConfiguration><Rule><ID>t</ID>"
+              b"<Status>Enabled</Status><Filter><Prefix></Prefix></Filter>"
+              b"<Transition><Days>1</Days><StorageClass>ICE</StorageClass>"
+              b"</Transition></Rule></LifecycleConfiguration>")
+    assert c.request("PUT", "/lcb", query={"lifecycle": ""},
+                     body=lc_xml).status_code == 200
+    lcs = LifecycleSys(srv.obj, srv.bucket_meta, srv.transition)
+    oi = srv.obj.get_object_info("lcb", "old.bin")
+    oi.mod_time -= 2 * 86400  # pretend it is 2 days old
+    lcs.apply("lcb", oi)
+    oi = srv.obj.get_object_info("lcb", "old.bin")
+    assert tx.is_transitioned(oi) and oi.size == 0
+    # restub after restore window lapses
+    srv.transition.restore("lcb", oi, days=1)
+    oi = srv.obj.get_object_info("lcb", "old.bin")
+    assert oi.size == len(BODY)
+    oi.internal[tx.META_RESTORE] = str(time.time() - 1)  # expired window
+    srv.obj.update_object_meta("lcb", "old.bin",
+                               {tx.META_RESTORE: str(time.time() - 1)})
+    oi = srv.obj.get_object_info("lcb", "old.bin")
+    assert lcs.transition_sys.maybe_restub("lcb", oi)
+    oi = srv.obj.get_object_info("lcb", "old.bin")
+    assert oi.size == 0 and tx.is_transitioned(oi)
+
+
+def test_madmin_client_surface(adm, c, srv):
+    info = adm.server_info()
+    assert info.get("mode") == "online"
+    srv.enable_iam()
+    adm.add_user("sdkuser", "sdksecret1", ["readonly"])
+    assert "sdkuser" in adm.list_users()
+    adm.set_bucket_quota_bucket = None  # attr poke guard (no-op)
+    c.request("PUT", "/mab")
+    adm.set_bucket_quota("mab", 12345)
+    assert adm.get_bucket_quota("mab")["quota"] == 12345
+    cfg = adm.get_config()
+    assert "dispatch" in cfg
+    locks = adm.top_locks()
+    assert "locks" in locks
+    adm.remove_user("sdkuser")
+    with pytest.raises(AdminError):
+        adm.add_tier({"kind": "bogus", "name": "x"})
