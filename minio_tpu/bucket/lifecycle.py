@@ -162,8 +162,14 @@ class LifecycleSys:
                         not is_transitioned(oi) and \
                         now - oi.mod_time >= r.transition_days * 86400:
                     try:
-                        self.transition_sys.transition(
+                        moved = self.transition_sys.transition(
                             bucket, oi, r.transition_tier)
                     except Exception:  # noqa: BLE001 — tier down: retry
-                        pass           # next cycle
+                        moved = False  # next cycle
+                    if moved:
+                        # the in-memory oi is now stale (object became a
+                        # stub); stop evaluating further rules against it
+                        # or a second Transition clause would archive the
+                        # empty stub over the real pointer
+                        return False
         return False

@@ -26,7 +26,8 @@ class S3Target:
         self.http = requests.Session()
 
     def _req(self, method: str, key: str, body: bytes = b"",
-             headers: dict | None = None, query: dict | None = None):
+             headers: dict | None = None, query: dict | None = None,
+             stream: bool = False):
         path = f"/{self.bucket}/{key}" if key else f"/{self.bucket}"
         q = {k: [v] for k, v in (query or {}).items()}
         host = self.endpoint.split("//", 1)[1]
@@ -40,7 +41,7 @@ class S3Target:
         url = f"{self.endpoint}{urllib.parse.quote(path)}" + \
             (f"?{qs}" if qs else "")
         return self.http.request(method, url, data=body, headers=h,
-                                 timeout=30)
+                                 timeout=30, stream=stream)
 
     def put(self, key: str, body: bytes, headers: dict | None = None):
         return self._req("PUT", key, body, headers)
@@ -135,20 +136,24 @@ class ReplicationPool:
         for k, v in oi.user_defined.items():
             if k.startswith("x-amz-meta-"):
                 headers[k] = v
-        from ..utils.compress import META_COMPRESSION, logical_bytes
+        from ..utils.compress import META_COMPRESSION, DecompressWriter
         compressed = bool(oi.internal.get(META_COMPRESSION))
-        if compressed or oi.size <= self.SPOOL_THRESHOLD:
+        if not compressed and oi.size <= self.SPOOL_THRESHOLD:
             from ..erasure.streaming import BufferSink
             sink = BufferSink()
             self.obj.get_object(bucket, key, sink)
-            # the replica must hold PLAINTEXT — the target doesn't know
-            # this deployment's transparent-compression markers
-            r = tgt.put(key, logical_bytes(oi, sink.getvalue()), headers)
+            r = tgt.put(key, sink.getvalue(), headers)
         else:
-            # spool to disk so multi-GB objects never sit in RAM; requests
-            # streams a file body with a correct Content-Length
+            # spool to disk so multi-GB objects never sit in RAM; the
+            # replica must hold PLAINTEXT, so compressed objects stream
+            # through the inflater on the way to the spool
             with tempfile.TemporaryFile() as spool:
-                self.obj.get_object(bucket, key, spool)
+                if compressed:
+                    dz = DecompressWriter(spool)
+                    self.obj.get_object(bucket, key, dz)
+                    dz.finish()
+                else:
+                    self.obj.get_object(bucket, key, spool)
                 spool.seek(0)
                 r = tgt.put(key, spool, headers)
         if r.status_code != 200:
@@ -170,23 +175,24 @@ class ReplicationPool:
         """GET proxy-to-target on local miss (reference
         ObjectOptions.ProxyRequest, cmd/object-api-interface.go:55): an
         object not yet replicated back can still be served. The client's
-        Range header is forwarded so ranged requests stay ranged (and a
-        miss on a huge object doesn't pull the whole body). Returns
-        (status, bytes, headers dict) or None."""
+        Range header is forwarded so ranged requests stay ranged, and the
+        body STREAMS (never fully resident). Returns (status, body
+        iterator, headers dict incl. Content-Length) or None."""
         tgt = self.targets.get(bucket)
         if tgt is None:
             return None
         try:
             hdrs = {"range": range_header} if range_header else None
-            r = tgt._req("GET", key, headers=hdrs)
+            r = tgt._req("GET", key, headers=hdrs, stream=True)
         except Exception:  # noqa: BLE001 — target down
             return None
         if r.status_code not in (200, 206):
+            r.close()
             return None
         keep = {k: v for k, v in r.headers.items()
                 if k.lower() in ("content-type", "content-range", "etag",
-                                 "last-modified")}
-        return r.status_code, r.content, keep
+                                 "last-modified", "content-length")}
+        return r.status_code, r.iter_content(1 << 20), keep
 
     def drain(self, timeout: float = 30.0):
         """Block until the queue is empty AND no worker is mid-replication."""

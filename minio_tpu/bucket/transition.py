@@ -60,6 +60,12 @@ class TransitionSys:
         with a stub carrying the pointer. Returns True when moved."""
         if is_transitioned(oi) or self._versioned(bucket):
             return False
+        if oi.internal.get("x-minio-internal-sse-scheme"):
+            # SSE objects: the stored bytes are ciphertext and the server
+            # may not even hold the key (SSE-C) — archiving them would
+            # orphan the crypto metadata. The reference transitions
+            # ciphertext+metadata together; until that is wired, skip.
+            return False
         tier = self.tiers.get(tier_name)
         if tier is None:
             return False

@@ -21,6 +21,34 @@ from . import policy as pol
 IAM_PREFIX = "iam"
 
 
+def _b64url_decode(s: str) -> bytes:
+    pad = "=" * (-len(s) % 4)
+    return base64.urlsafe_b64decode(s + pad)
+
+
+def _verify_jwt_hs256(token: str, secret: str) -> dict:
+    """Minimal JWT validation: HS256 signature + exp check. Raises
+    ValueError on any problem."""
+    try:
+        header_b64, payload_b64, sig_b64 = token.split(".")
+        header = json.loads(_b64url_decode(header_b64))
+        payload = json.loads(_b64url_decode(payload_b64))
+        sig = _b64url_decode(sig_b64)
+    except (ValueError, json.JSONDecodeError):
+        raise ValueError("malformed JWT") from None
+    if header.get("alg") != "HS256":
+        raise ValueError(f"unsupported JWT alg {header.get('alg')!r}")
+    want = hmac.new(secret.encode(),
+                    f"{header_b64}.{payload_b64}".encode(),
+                    hashlib.sha256).digest()
+    if not hmac.compare_digest(want, sig):
+        raise ValueError("JWT signature mismatch")
+    exp = payload.get("exp")
+    if isinstance(exp, (int, float)) and exp < time.time():
+        raise ValueError("JWT expired")
+    return payload
+
+
 @dataclass
 class UserIdentity:
     access_key: str
@@ -231,6 +259,46 @@ class IAMSys:
         sk = secrets.token_urlsafe(30)
         u = UserIdentity(access_key=ak, secret_key=sk, parent=access_key,
                          expiration=time.time() + duration_s,
+                         session_policy=session_policy)
+        with self._mutating():
+            self._purge_expired_locked()
+            self.users[ak] = u
+        return u
+
+    def assume_role_with_web_identity(self, token: str,
+                                      duration_s: int = 3600,
+                                      session_policy: bytes = b""
+                                      ) -> UserIdentity:
+        """STS AssumeRoleWithWebIdentity (reference
+        cmd/sts-handlers.go:43-93 + cmd/config/identity/openid): validate
+        the IdP's JWT and mint temporary credentials for its subject.
+
+        Token validation here covers HS256 with the shared secret from
+        MINIO_TPU_OPENID_HMAC_SECRET (the dev/test IdP shape); RS256/JWKS
+        discovery against a real OpenID provider is not wired. Claims:
+        ``sub`` (required), ``policy`` (comma-separated policy names
+        applied to the temporary identity), ``exp`` honored as an upper
+        bound."""
+        import os
+        secret = os.environ.get("MINIO_TPU_OPENID_HMAC_SECRET", "")
+        if not secret:
+            raise ValueError("no OpenID provider configured")
+        claims = _verify_jwt_hs256(token, secret)
+        sub = claims.get("sub", "")
+        if not sub:
+            raise ValueError("token has no sub claim")
+        duration_s = max(900, min(duration_s, 7 * 24 * 3600))
+        expiry = time.time() + duration_s
+        if isinstance(claims.get("exp"), (int, float)):
+            expiry = min(expiry, float(claims["exp"]))
+        policies = [p for p in str(claims.get("policy", "")).split(",")
+                    if p]
+        ak = "STSWI" + secrets.token_hex(8).upper()
+        sk = secrets.token_urlsafe(30)
+        u = UserIdentity(access_key=ak, secret_key=sk,
+                         parent=f"web-identity:{sub}",
+                         policies=policies,
+                         expiration=expiry,
                          session_policy=session_policy)
         with self._mutating():
             self._purge_expired_locked()

@@ -148,3 +148,7 @@ class AdminClient:
         raw = self._request("GET", "trace", {"count": str(count),
                                              "timeout": str(timeout)})
         return [json.loads(ln) for ln in raw.splitlines() if ln.strip()]
+
+    def recent_logs(self, n: int = 100) -> list[dict]:
+        """Recent structured log entries (console-log history analogue)."""
+        return self._json("GET", "logs", {"n": str(n)})

@@ -66,11 +66,15 @@ class HTTPLogTarget:
 class LogSys:
     """Process log/audit fan-out. Targets from env:
     MINIO_TPU_LOGGER_WEBHOOK_ENDPOINT (error/info log entries),
-    MINIO_TPU_AUDIT_WEBHOOK_ENDPOINT (one entry per API request)."""
+    MINIO_TPU_AUDIT_WEBHOOK_ENDPOINT (one entry per API request). A ring
+    of recent entries backs the admin logs endpoint (the reference's
+    console-log history, cmd/consolelogger.go)."""
 
     def __init__(self):
+        from collections import deque
         self.log_target: HTTPLogTarget | None = None
         self.audit_target: HTTPLogTarget | None = None
+        self.ring: deque = deque(maxlen=512)
         self._once: set[str] = set()
         ep = os.environ.get("MINIO_TPU_LOGGER_WEBHOOK_ENDPOINT", "")
         if ep:
@@ -86,6 +90,7 @@ class LogSys:
     def event(self, level: str, subsystem: str, message: str, **fields):
         rec = {"level": level, "subsystem": subsystem, "message": message,
                "time": time.time(), **fields}
+        self.ring.append(rec)
         getattr(_console, level if level != "fatal" else "critical",
                 _console.info)("%s: %s", subsystem, message)
         if self.log_target is not None:

@@ -65,6 +65,12 @@ def _dispatch_admin(h, op: str) -> None:
         return _trace(h)
     if op == "top/locks":
         return _top_locks(h)
+    if op == "logs":
+        # recent structured log entries (reference console-log history)
+        from ..obs.logger import log_sys
+        n = int({k: v[0] for k, v in h.query.items()}.get("n", "100"))
+        return h._send(200, json.dumps(
+            list(log_sys().ring)[-n:]).encode(), "application/json")
     if op == "tier":
         q = {k: v[0] for k, v in h.query.items()}
         if h.command == "GET":

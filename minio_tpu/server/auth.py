@@ -6,6 +6,7 @@ from __future__ import annotations
 import datetime
 import hashlib
 import hmac
+import time
 import urllib.parse
 from dataclasses import dataclass
 
@@ -130,11 +131,94 @@ class SigV4Verifier:
                headers: dict[str, str]) -> str:
         """Verify; returns the authenticated access key. Raises AuthError."""
         auth = headers.get("authorization", "")
+        if auth.startswith("AWS "):
+            return self._verify_v2(method, path, query, headers, auth)
         if auth:
             return self._verify_header(method, path, query, headers, auth)
-        if "X-Amz-Signature" in dict_ci(query):
+        ci = dict_ci(query)
+        if "X-Amz-Signature" in ci:
             return self._verify_presigned(method, path, query, headers)
+        if "Signature" in ci and "AWSAccessKeyId" in ci:
+            return self._verify_presigned_v2(method, path, query)
         raise AuthError("AccessDenied", "no authentication provided")
+
+    # --- AWS Signature Version 2 (reference cmd/signature-v2.go) ------------
+
+    _V2_SUBRESOURCES = (
+        "acl", "delete", "lifecycle", "location", "logging", "notification",
+        "partNumber", "policy", "requestPayment", "response-cache-control",
+        "response-content-disposition", "response-content-encoding",
+        "response-content-language", "response-content-type",
+        "response-expires", "restore", "tagging", "torrent", "uploadId",
+        "uploads", "versionId", "versioning", "versions", "website",
+        "select", "select-type", "object-lock", "retention", "legal-hold",
+    )
+
+    def _v2_string_to_sign(self, method: str, path: str,
+                           query: dict[str, list[str]],
+                           headers: dict[str, str], expires: str = "") -> str:
+        amz = sorted((k.lower().strip(), ",".join(v if isinstance(v, list)
+                                                  else [v]))
+                     for k, v in headers.items()
+                     if k.lower().startswith("x-amz-"))
+        canon_amz = "".join(f"{k}:{vs.strip()}\n" for k, vs in amz)
+        sub = sorted(k for k in query if k in self._V2_SUBRESOURCES)
+        resource = path
+        if sub:
+            parts = []
+            for k in sub:
+                v = query[k][0] if query[k] and query[k][0] else ""
+                parts.append(f"{k}={v}" if v else k)
+            resource += "?" + "&".join(parts)
+        date = expires or headers.get("date", "") or \
+            headers.get("x-amz-date", "")
+        return "\n".join([
+            method,
+            headers.get("content-md5", ""),
+            headers.get("content-type", ""),
+            date,
+            canon_amz + resource,
+        ])
+
+    def _v2_signature(self, secret: str, sts: str) -> str:
+        import base64
+        return base64.b64encode(
+            hmac.new(secret.encode(), sts.encode(),
+                     hashlib.sha1).digest()).decode()
+
+    def _verify_v2(self, method, path, query, headers, auth) -> str:
+        try:
+            ak, want = auth[len("AWS "):].split(":", 1)
+        except ValueError:
+            raise AuthError("InvalidArgument",
+                            "malformed v2 authorization") from None
+        secret = self.lookup(ak)
+        if secret is None:
+            raise AuthError("InvalidAccessKeyId", "access key not found")
+        sts = self._v2_string_to_sign(method, path, query, headers)
+        if not hmac.compare_digest(self._v2_signature(secret, sts), want):
+            raise AuthError("SignatureDoesNotMatch", "v2 signature mismatch")
+        return ak
+
+    def _verify_presigned_v2(self, method, path, query) -> str:
+        ci = dict_ci(query)
+        ak = first(ci, "AWSAccessKeyId")
+        want = first(ci, "Signature")
+        expires = first(ci, "Expires")
+        secret = self.lookup(ak)
+        if secret is None:
+            raise AuthError("InvalidAccessKeyId", "access key not found")
+        try:
+            if float(expires) < time.time():
+                raise AuthError("AccessDenied", "presigned URL expired")
+        except ValueError:
+            raise AuthError("InvalidArgument", "bad Expires") from None
+        q = {k: v for k, v in query.items()
+             if k not in ("Signature", "AWSAccessKeyId", "Expires")}
+        sts = self._v2_string_to_sign(method, path, q, {}, expires=expires)
+        if not hmac.compare_digest(self._v2_signature(secret, sts), want):
+            raise AuthError("SignatureDoesNotMatch", "v2 signature mismatch")
+        return ak
 
     def _verify_header(self, method, path, query, headers, auth) -> str:
         sig = parse_auth_header(auth)

@@ -294,30 +294,47 @@ class _S3Handler(BaseHTTPRequestHandler):
             raise AuthError("AccessDenied", f"not allowed to {action}")
 
     def _sts(self, body: bytes):
-        """AssumeRole: temporary credentials for the signing identity
-        (reference cmd/sts-handlers.go:43)."""
-        import datetime
-        try:
-            ak = self._authenticate()
-        except AuthError as e:
-            return self._error(e.code, e.message, e.status)
+        """STS: AssumeRole (signed caller) and AssumeRoleWithWebIdentity
+        (OIDC JWT) — reference cmd/sts-handlers.go:43-93."""
         form = dict(urllib.parse.parse_qsl(body.decode("utf-8", "replace")))
-        duration = int(form.get("DurationSeconds", "3600") or "3600")
+        action = form.get("Action", "AssumeRole")
+        try:
+            duration = int(form.get("DurationSeconds", "3600") or "3600")
+        except ValueError:
+            return self._error("InvalidParameterValue",
+                               "DurationSeconds must be an integer", 400)
         session_policy = form.get("Policy", "").encode()
-        cred = self.s3.iam.assume_role(ak, duration, session_policy)
+        if action == "AssumeRoleWithWebIdentity":
+            token = form.get("WebIdentityToken", "")
+            try:
+                cred = self.s3.iam.assume_role_with_web_identity(
+                    token, duration, session_policy)
+            except ValueError as e:
+                return self._error("InvalidParameterValue", str(e), 400)
+        elif action == "AssumeRole":
+            try:
+                ak = self._authenticate()
+            except AuthError as e:
+                return self._error(e.code, e.message, e.status)
+            cred = self.s3.iam.assume_role(ak, duration, session_policy)
+        else:
+            return self._error("InvalidAction",
+                               f"unsupported STS action {action}", 400)
+        import datetime
         exp = datetime.datetime.fromtimestamp(
             cred.expiration, tz=datetime.timezone.utc
         ).strftime("%Y-%m-%dT%H:%M:%SZ")
+        result = f"{action}Result"
         xml = (
             '<?xml version="1.0" encoding="UTF-8"?>'
-            '<AssumeRoleResponse xmlns='
+            f'<{action}Response xmlns='
             '"https://sts.amazonaws.com/doc/2011-06-15/">'
-            "<AssumeRoleResult><Credentials>"
+            f"<{result}><Credentials>"
             f"<AccessKeyId>{cred.access_key}</AccessKeyId>"
             f"<SecretAccessKey>{cred.secret_key}</SecretAccessKey>"
             f"<SessionToken>minio-tpu-session</SessionToken>"
             f"<Expiration>{exp}</Expiration>"
-            "</Credentials></AssumeRoleResult></AssumeRoleResponse>"
+            f"</Credentials></{result}></{action}Response>"
         ).encode()
         self._send(200, xml)
 
@@ -367,11 +384,24 @@ class _S3Handler(BaseHTTPRequestHandler):
             from .admin import handle_admin
             return handle_admin(self)
         # STS endpoint: POST / with form-encoded Action (cmd/sts-handlers.go)
+        # — AssumeRoleWithWebIdentity carries no Authorization header (the
+        # JWT is the credential), so the gate is the Action itself
         if self.command == "POST" and self.url_path == "/" and \
-                "authorization" in self.hdr and self.s3.iam is not None:
+                self.s3.iam is not None:
             body = self._read_body()
             if b"Action=Assume" in body or b"Action=assume" in body:
                 return self._sts(body)
+        # browser POST uploads authenticate via the signed policy inside
+        # the form, not an Authorization header
+        if self.command == "POST" and self.key == "" and \
+                self.bucket and self.hdr.get("content-type", "").startswith(
+                    "multipart/form-data"):
+            try:
+                return self.post_policy_upload()
+            except dt.ObjectAPIError as e:
+                return self._api_error(e)
+            except AuthError as e:
+                return self._error(e.code, e.message, e.status)
         try:
             access_key = self._authenticate()
         except AuthError as e:
@@ -472,6 +502,171 @@ class _S3Handler(BaseHTTPRequestHandler):
             if s.has_q("delete"):
                 return s.delete_multiple(ak)
         return s._error("MethodNotAllowed", f"bad bucket op {m}", 405)
+
+    def post_policy_upload(self):
+        """Browser POST upload with a signed policy document (reference
+        PostPolicyBucketHandler, cmd/bucket-handlers.go +
+        cmd/postpolicyform.go): the form's base64 policy is signed with
+        the SigV4 signing key, conditions are enforced, then the file
+        field becomes the object."""
+        import base64
+        import email.parser
+        import email.policy as email_policy
+        import json as jsonmod
+        import re as remod
+
+        from .auth import signing_key
+        body = self._read_body()
+        blob = (b"Content-Type: " + self.hdr["content-type"].encode() +
+                b"\r\n\r\n" + body)
+        msg = email.parser.BytesParser(
+            policy=email_policy.default).parsebytes(blob)
+        fields: dict[str, str] = {}
+        file_bytes = b""
+        filename = ""
+        for part in msg.iter_parts():
+            cd = part.get("Content-Disposition", "")
+            m = remod.search(r'name="([^"]*)"', cd)
+            if not m:
+                continue
+            name = m.group(1)
+            if name == "file":
+                payload = part.get_payload(decode=True) or b""
+                file_bytes = payload
+                fm = remod.search(r'filename="([^"]*)"', cd)
+                filename = fm.group(1) if fm else ""
+            else:
+                fields[name.lower()] = str(
+                    part.get_payload(decode=True).decode(
+                        "utf-8", "replace"))
+        policy_b64 = fields.get("policy", "")
+        if not policy_b64:
+            return self._error("AccessDenied",
+                               "POST upload requires a policy", 403)
+        if fields.get("x-amz-algorithm", "") != "AWS4-HMAC-SHA256":
+            return self._error("InvalidArgument",
+                               "unsupported x-amz-algorithm", 400)
+        cred = fields.get("x-amz-credential", "")
+        try:
+            ak, scope_date, region, _service, _term = cred.split("/")
+        except ValueError:
+            return self._error("InvalidArgument",
+                               "malformed x-amz-credential", 400)
+        secret = self.s3.lookup_secret(ak)
+        if secret is None:
+            return self._error("InvalidAccessKeyId",
+                               "access key not found", 403)
+        key = signing_key(secret, scope_date, region)
+        import hmac as hmacmod
+        sig = hmacmod.new(key, policy_b64.encode(),
+                          hashlib.sha256).hexdigest()
+        if not hmacmod.compare_digest(sig,
+                                      fields.get("x-amz-signature", "")):
+            return self._error("SignatureDoesNotMatch",
+                               "policy signature mismatch", 403)
+        try:
+            policy = jsonmod.loads(base64.b64decode(policy_b64))
+        except Exception:  # noqa: BLE001
+            return self._error("InvalidPolicyDocument", "bad policy", 400)
+        # expiration + conditions (cmd/postpolicyform.go)
+        import datetime as dtmod
+        exp = policy.get("expiration", "")
+        try:
+            exp_t = dtmod.datetime.fromisoformat(
+                exp.replace("Z", "+00:00")).timestamp()
+        except ValueError:
+            return self._error("InvalidPolicyDocument",
+                               "bad expiration", 400)
+        import time as tmod
+        if exp_t < tmod.time():
+            return self._error("AccessDenied", "policy expired", 403)
+        key_field = fields.get("key", "")
+        if "${filename}" in key_field:
+            key_field = key_field.replace("${filename}", filename)
+        if not key_field:
+            return self._error("InvalidArgument", "missing key field", 400)
+        # every form field must be authorized by a policy condition
+        # (cmd/postpolicyform.go checkPostPolicy) — otherwise a signed
+        # grant for one key lets the holder inject arbitrary metadata
+        covered = {"policy", "x-amz-signature", "file"}
+        for cond in policy.get("conditions", []):
+            if isinstance(cond, dict):
+                covered.update(k.lower() for k in cond)
+            elif isinstance(cond, list) and len(cond) == 3:
+                covered.add(str(cond[1]).lstrip("$").lower())
+        for fname in fields:
+            if fname in covered or fname.startswith("x-ignore-"):
+                continue
+            return self._error(
+                "AccessDenied",
+                f"form field {fname!r} not covered by the policy", 403)
+        for cond in policy.get("conditions", []):
+            if isinstance(cond, dict):
+                for ck, cv in cond.items():
+                    got = self.bucket if ck == "bucket" else \
+                        fields.get(ck.lower(), "")
+                    if ck == "key":
+                        got = key_field
+                    if got != cv:
+                        return self._error(
+                            "AccessDenied",
+                            f"policy condition failed on {ck}", 403)
+            elif isinstance(cond, list) and len(cond) == 3:
+                op, name, val = cond
+                if op == "content-length-range":
+                    if not (int(name) <= len(file_bytes) <= int(val)):
+                        return self._error(
+                            "EntityTooLarge"
+                            if len(file_bytes) > int(val)
+                            else "EntityTooSmall",
+                            "content-length-range violated", 400)
+                    continue
+                name = str(name).lstrip("$").lower()
+                got = key_field if name == "key" else (
+                    self.bucket if name == "bucket"
+                    else fields.get(name, ""))
+                if op == "eq" and got != val:
+                    return self._error(
+                        "AccessDenied",
+                        f"policy eq condition failed on {name}", 403)
+                if op == "starts-with" and not str(got).startswith(val):
+                    return self._error(
+                        "AccessDenied",
+                        f"policy starts-with failed on {name}", 403)
+        self._authorize(ak, "s3:PutObject", self.bucket, key_field)
+        self.key = key_field
+        import io as iomod
+        opts = self._opts()
+        meta = {k: v for k, v in fields.items()
+                if k.startswith("x-amz-meta-")}
+        ct = fields.get("content-type", "")
+        if ct:
+            meta["content-type"] = ct
+        # the POST path enforces the SAME server policies as PUT: size cap,
+        # quota, object-lock defaults, transparent compression
+        if len(file_bytes) > MAX_PUT_SIZE:
+            raise dt.EntityTooLarge(self.bucket, key_field)
+        self._check_quota(len(file_bytes))
+        from ..bucket import objectlock as olock
+        lock_enabled, lock_default = self._lock_ctx()
+        meta.update(olock.check_put_headers(
+            fields, self.bucket, key_field, lock_enabled, lock_default))
+        hr = HashReader(iomod.BytesIO(file_bytes), len(file_bytes))
+        stream, put_size = hr, len(file_bytes)
+        from ..utils import compress as cz
+        if cz.should_compress(key_field, ct):
+            meta[cz.META_COMPRESSION] = cz.ALGO
+            meta[cz.META_ACTUAL_SIZE] = str(len(file_bytes))
+            stream, put_size = cz.CompressReader(hr), -1
+            opts.etag_source = hr
+        opts.user_defined = meta
+        oi = self.s3.obj.put_object(self.bucket, key_field, stream,
+                                    put_size, opts)
+        status = int(fields.get("success_action_status", "204") or 204)
+        if status not in (200, 201, 204):
+            status = 204
+        self._send(status, headers={"ETag": f'"{oi.etag}"'})
+        self._notify("s3:ObjectCreated:Post", oi)
 
     def _object_op(self, m: str, ak: str):
         s = self
@@ -1111,14 +1306,15 @@ class _S3Handler(BaseHTTPRequestHandler):
                 if pool is not None else None
             if res is None:
                 raise
-            status, body, hdrs = res
+            status, chunks, hdrs = res
             self.send_response(status)
             for k, v in hdrs.items():
                 self.send_header(k, v)
-            self.send_header("Content-Length", str(len(body)))
             self.send_header("x-minio-proxied-from-target", "true")
             self.end_headers()
-            self.wfile.write(body)
+            for chunk in chunks:  # streams: never fully resident
+                if chunk:
+                    self.wfile.write(chunk)
             return
         self._check_preconditions(oi)
         from ..bucket import transition as tx

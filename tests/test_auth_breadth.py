@@ -1,0 +1,257 @@
+"""Auth breadth: SigV2 (header + presigned), POST policy uploads, STS
+WebIdentity, disk-id-check wrapper, set disk monitor (reference
+cmd/signature-v2.go, cmd/postpolicyform.go, cmd/sts-handlers.go,
+cmd/xl-storage-disk-id-check.go, cmd/erasure-sets.go:196-300)."""
+import base64
+import hashlib
+import hmac
+import io
+import json
+import os
+import sys
+import time
+import urllib.parse
+
+import numpy as np
+import pytest
+import requests
+
+sys.path.insert(0, os.path.dirname(__file__))
+from s3client import S3Client  # noqa: E402
+
+from minio_tpu.objectlayer import ErasureObjects  # noqa: E402
+from minio_tpu.server import S3Server  # noqa: E402
+from minio_tpu.storage import XLStorage  # noqa: E402
+
+AK, SK = "v2ak", "v2secret1"
+
+
+@pytest.fixture
+def srv(tmp_path):
+    obj = ErasureObjects([XLStorage(str(tmp_path / f"d{i}"))
+                          for i in range(4)], default_parity=2)
+    server = S3Server(obj, "127.0.0.1", 0, access_key=AK, secret_key=SK)
+    server.start_background()
+    yield server
+    server.shutdown()
+
+
+@pytest.fixture
+def c(srv):
+    client = S3Client(srv.endpoint(), AK, SK)
+    assert client.request("PUT", "/v2b").status_code == 200
+    return client
+
+
+# --- SigV2 -------------------------------------------------------------------
+
+def _v2_auth(method, path, headers, query_subresources=""):
+    sts = "\n".join([
+        method, headers.get("content-md5", ""),
+        headers.get("content-type", ""), headers.get("date", ""),
+        path + query_subresources])
+    sig = base64.b64encode(
+        hmac.new(SK.encode(), sts.encode(), hashlib.sha1).digest()).decode()
+    return f"AWS {AK}:{sig}"
+
+
+def test_sigv2_header_roundtrip(srv, c):
+    import email.utils
+    date = email.utils.formatdate(usegmt=True)
+    h = {"date": date, "content-type": "text/plain"}
+    h["Authorization"] = _v2_auth("PUT", "/v2b/v2obj", h)
+    r = requests.put(srv.endpoint() + "/v2b/v2obj", data=b"sigv2 body",
+                     headers=h)
+    assert r.status_code == 200, r.text
+    h2 = {"date": date}
+    h2["Authorization"] = _v2_auth("GET", "/v2b/v2obj", h2)
+    r = requests.get(srv.endpoint() + "/v2b/v2obj", headers=h2)
+    assert r.status_code == 200 and r.content == b"sigv2 body"
+    # wrong secret rejected
+    bad = h2.copy()
+    bad["Authorization"] = f"AWS {AK}:{'x' * 28}"
+    r = requests.get(srv.endpoint() + "/v2b/v2obj", headers=bad)
+    assert r.status_code == 403
+
+
+def test_sigv2_presigned(srv, c):
+    c.request("PUT", "/v2b/pres", body=b"presigned v2")
+    expires = str(int(time.time()) + 300)
+    sts = f"GET\n\n\n{expires}\n/v2b/pres"
+    sig = base64.b64encode(
+        hmac.new(SK.encode(), sts.encode(), hashlib.sha1).digest()).decode()
+    qs = urllib.parse.urlencode(
+        {"AWSAccessKeyId": AK, "Expires": expires, "Signature": sig})
+    r = requests.get(srv.endpoint() + f"/v2b/pres?{qs}")
+    assert r.status_code == 200 and r.content == b"presigned v2"
+    # expired URL rejected
+    qs = urllib.parse.urlencode(
+        {"AWSAccessKeyId": AK, "Expires": str(int(time.time()) - 10),
+         "Signature": sig})
+    assert requests.get(srv.endpoint() + f"/v2b/pres?{qs}"
+                        ).status_code == 403
+
+
+# --- POST policy -------------------------------------------------------------
+
+def _post_form(srv, fields, file_bytes, filename="f.bin"):
+    boundary = "geoboundary42"
+    parts = []
+    for k, v in fields.items():
+        parts.append(f'--{boundary}\r\nContent-Disposition: form-data; '
+                     f'name="{k}"\r\n\r\n{v}\r\n'.encode())
+    parts.append(
+        (f'--{boundary}\r\nContent-Disposition: form-data; name="file"; '
+         f'filename="{filename}"\r\n'
+         'Content-Type: application/octet-stream\r\n\r\n').encode()
+        + file_bytes + b"\r\n")
+    parts.append(f"--{boundary}--\r\n".encode())
+    body = b"".join(parts)
+    return requests.post(
+        srv.endpoint() + "/v2b", data=body,
+        headers={"Content-Type":
+                 f"multipart/form-data; boundary={boundary}"})
+
+
+def _signed_policy_fields(key_cond, extra_conds=()):
+    from minio_tpu.server.auth import signing_key
+    now = time.gmtime(time.time() + 600)
+    expiration = time.strftime("%Y-%m-%dT%H:%M:%SZ", now)
+    scope_date = time.strftime("%Y%m%d", time.gmtime())
+    cred = f"{AK}/{scope_date}/us-east-1/s3/aws4_request"
+    amz_date = time.strftime("%Y%m%dT%H%M%SZ", time.gmtime())
+    policy = {"expiration": expiration,
+              "conditions": [{"bucket": "v2b"}, key_cond,
+                             {"x-amz-algorithm": "AWS4-HMAC-SHA256"},
+                             {"x-amz-credential": cred},
+                             {"x-amz-date": amz_date},
+                             *extra_conds]}
+    policy_b64 = base64.b64encode(json.dumps(policy).encode()).decode()
+    key = signing_key(SK, scope_date, "us-east-1")
+    sig = hmac.new(key, policy_b64.encode(), hashlib.sha256).hexdigest()
+    return {"policy": policy_b64, "x-amz-algorithm": "AWS4-HMAC-SHA256",
+            "x-amz-credential": cred, "x-amz-date": amz_date,
+            "x-amz-signature": sig}
+
+
+def test_post_policy_upload(srv, c):
+    fields = _signed_policy_fields({"key": "posted/doc.bin"})
+    fields["key"] = "posted/doc.bin"
+    r = _post_form(srv, fields, b"posted bytes")
+    assert r.status_code == 204, r.text
+    assert c.request("GET", "/v2b/posted/doc.bin").content == b"posted bytes"
+
+
+def test_post_policy_filename_substitution_and_starts_with(srv, c):
+    fields = _signed_policy_fields(
+        ["starts-with", "$key", "up/"],
+        extra_conds=(["content-length-range", 1, 1000],))
+    fields["key"] = "up/${filename}"
+    r = _post_form(srv, fields, b"x" * 100, filename="photo.jpg")
+    assert r.status_code == 204, r.text
+    assert c.request("GET", "/v2b/up/photo.jpg").status_code == 200
+    # violating starts-with fails
+    fields["key"] = "elsewhere/f"
+    assert _post_form(srv, fields, b"y").status_code == 403
+    # content-length-range enforced
+    fields["key"] = "up/too-big"
+    assert _post_form(srv, fields, b"z" * 2000).status_code == 400
+
+
+def test_post_policy_bad_signature(srv):
+    fields = _signed_policy_fields({"key": "nope"})
+    fields["key"] = "nope"
+    fields["x-amz-signature"] = "0" * 64
+    assert _post_form(srv, fields, b"data").status_code == 403
+
+
+# --- STS WebIdentity ---------------------------------------------------------
+
+def _jwt(claims, secret):
+    def enc(obj):
+        return base64.urlsafe_b64encode(
+            json.dumps(obj).encode()).rstrip(b"=").decode()
+    head = enc({"alg": "HS256", "typ": "JWT"})
+    pay = enc(claims)
+    sig = base64.urlsafe_b64encode(hmac.new(
+        secret.encode(), f"{head}.{pay}".encode(),
+        hashlib.sha256).digest()).rstrip(b"=").decode()
+    return f"{head}.{pay}.{sig}"
+
+
+def test_sts_web_identity(srv, c, monkeypatch):
+    monkeypatch.setenv("MINIO_TPU_OPENID_HMAC_SECRET", "oidc-secret")
+    srv.enable_iam()
+    token = _jwt({"sub": "user@idp", "policy": "readwrite",
+                  "exp": time.time() + 3600}, "oidc-secret")
+    r = requests.post(srv.endpoint() + "/", data={
+        "Action": "AssumeRoleWithWebIdentity",
+        "WebIdentityToken": token, "DurationSeconds": "900"})
+    assert r.status_code == 200, r.text
+    import re
+    ak = re.search(r"<AccessKeyId>([^<]+)</AccessKeyId>", r.text).group(1)
+    sk = re.search(r"<SecretAccessKey>([^<]+)</SecretAccessKey>",
+                   r.text).group(1)
+    c2 = S3Client(srv.endpoint(), ak, sk)
+    assert c2.request("GET", "/v2b").status_code == 200
+    # forged token rejected
+    bad = _jwt({"sub": "x"}, "wrong-secret")
+    r = requests.post(srv.endpoint() + "/", data={
+        "Action": "AssumeRoleWithWebIdentity", "WebIdentityToken": bad})
+    assert r.status_code == 400
+
+
+# --- disk-id check + set monitor ---------------------------------------------
+
+def test_disk_id_check_wrapper(tmp_path):
+    from minio_tpu.storage.idcheck import DiskIDCheck
+    from minio_tpu.utils import errors
+    d = XLStorage(str(tmp_path / "idd"))
+    d.set_disk_id("uuid-1")
+    w = DiskIDCheck(d, "uuid-1")
+    w.make_vol("b")
+    w.write_all("b", "f", b"x")
+    assert w.read_all("b", "f") == b"x"
+    assert w.healthy()
+    # swap the identity behind the wrapper's back
+    d.set_disk_id("uuid-OTHER")
+    w.expected_id = "uuid-1"
+    import minio_tpu.storage.idcheck as idm
+    w._last_check = 0  # force a re-check
+    with pytest.raises(errors.DiskNotFound):
+        w.read_all("b", "f")
+
+
+def test_set_monitor_reslot_and_reformat(tmp_path):
+    import shutil
+
+    from minio_tpu.dist.format import init_format_erasure, load_format
+    from minio_tpu.objectlayer.monitor import SetDiskMonitor
+    from minio_tpu.objectlayer.sets import ErasureSets
+    disks = [XLStorage(str(tmp_path / f"m{i}")) for i in range(8)]
+    fmt = init_format_erasure(disks, 2, 4)
+    sets = ErasureSets(disks, 2, 4, deployment_id=fmt["id"])
+    connects = []
+    mon = SetDiskMonitor(sets, fmt,
+                         on_connect=lambda si, sl, d: connects.append(
+                             (si, sl)))
+    # swap two disks across sets (cables moved)
+    a, b = sets.sets[0]._disks[1], sets.sets[1]._disks[2]
+    sets.sets[0]._disks[1], sets.sets[1]._disks[2] = b, a
+    res = mon.check_once()
+    assert res["reslotted"] >= 1
+    # every slot now carries its expected identity
+    for si, es in enumerate(sets.sets):
+        for sl in range(4):
+            d = es._disks[sl]
+            assert load_format(d)["xl"]["this"] == fmt["xl"]["sets"][si][sl]
+    # wipe one disk -> reformat + on_connect fires
+    victim = sets.sets[1]._disks[0]
+    shutil.rmtree(victim.base)
+    os.makedirs(os.path.join(victim.base, ".minio.sys", "tmp"),
+                exist_ok=True)
+    connects.clear()
+    res = mon.check_once()
+    assert res["reformatted"] == 1
+    assert connects == [(1, 0)]
+    assert load_format(victim)["xl"]["this"] == fmt["xl"]["sets"][1][0]
