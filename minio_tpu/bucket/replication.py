@@ -191,8 +191,16 @@ class ReplicationPool:
             return None
         keep = {k: v for k, v in r.headers.items()
                 if k.lower() in ("content-type", "content-range", "etag",
-                                 "last-modified", "content-length")}
-        return r.status_code, r.iter_content(1 << 20), keep
+                                 "last-modified")}
+        # framing: stream only when the target's Content-Length is usable
+        # as-is (present and not content-encoded — iter_content decodes
+        # gzip, which would desync the advertised length); otherwise
+        # materialize once and frame it ourselves
+        clen = r.headers.get("Content-Length")
+        if clen is not None and not r.headers.get("Content-Encoding"):
+            return r.status_code, r.iter_content(1 << 20), keep, int(clen)
+        body = r.content
+        return r.status_code, iter((body,)), keep, len(body)
 
     def drain(self, timeout: float = 30.0):
         """Block until the queue is empty AND no worker is mid-replication."""

@@ -1,0 +1,211 @@
+"""Disk cache ObjectLayer wrapper (reference cacheObjects,
+cmd/disk-cache.go:88 + disk-cache-backend.go): a write-through/read-through
+SSD cache in front of any ObjectLayer. GET hits serve from the local cache
+directory (with ETag validation against the backend's metadata so stale
+entries self-invalidate); misses populate the cache; LRU eviction keeps
+usage under the configured quota. Everything else delegates.
+
+The cache stores one file per (bucket, object): ``<root>/<bucket>/<sha of
+key>.data`` + ``.meta`` (json: etag, size, content-type, atime)."""
+from __future__ import annotations
+
+import hashlib
+import io
+import json
+import os
+import threading
+import time
+
+from .objectlayer import datatypes as dt
+
+
+class CacheObjects:
+    """Duck-typed ObjectLayer wrapper (NOT an ObjectLayer subclass: the
+    ABC's concrete no-op stubs would shadow the __getattr__ delegation)."""
+    def __init__(self, inner, cache_dir: str, quota_bytes: int = 1 << 30,
+                 watermark_low: float = 0.8):
+        self.inner = inner
+        self.dir = cache_dir
+        self.quota = quota_bytes
+        self.low = watermark_low
+        os.makedirs(cache_dir, exist_ok=True)
+        self._lock = threading.Lock()
+        self.hits = 0
+        self.misses = 0
+        # used-bytes tracked incrementally (store/drop/evict adjust it) so
+        # the hot path never walks the cache directory; one walk seeds it
+        self._used = self.usage()
+
+    # -- cache mechanics ------------------------------------------------------
+
+    def _paths(self, bucket: str, object: str) -> tuple[str, str]:
+        h = hashlib.sha256(object.encode()).hexdigest()[:48]
+        base = os.path.join(self.dir, bucket)
+        return os.path.join(base, h + ".data"), os.path.join(
+            base, h + ".meta")
+
+    def _load_meta(self, mpath: str) -> dict | None:
+        try:
+            with open(mpath, encoding="utf-8") as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return None
+
+    def _store(self, bucket: str, object: str, data: bytes, oi) -> None:
+        if len(data) > self.quota // 2:
+            return  # one object must not own the cache
+        dpath, mpath = self._paths(bucket, object)
+        os.makedirs(os.path.dirname(dpath), exist_ok=True)
+        try:
+            with open(dpath + ".tmp", "wb") as f:
+                f.write(data)
+            os.replace(dpath + ".tmp", dpath)
+            with open(mpath + ".tmp", "w", encoding="utf-8") as f:
+                json.dump({"etag": oi.etag, "size": len(data),
+                           "content_type": oi.content_type,
+                           "atime": time.time()}, f)
+            os.replace(mpath + ".tmp", mpath)
+        except OSError:
+            return
+        with self._lock:
+            self._used += len(data)
+        if self._used > self.quota:
+            self._evict_if_needed()
+
+    def _touch(self, mpath: str, meta: dict) -> None:
+        # throttle: rewriting the meta on EVERY hit doubles hit-path IO;
+        # LRU ordering survives with minute-granularity recency
+        if time.time() - meta.get("atime", 0) < 60:
+            return
+        meta["atime"] = time.time()
+        try:
+            with open(mpath, "w", encoding="utf-8") as f:
+                json.dump(meta, f)
+        except OSError:
+            pass
+
+    def _drop(self, bucket: str, object: str) -> None:
+        dpath, mpath = self._paths(bucket, object)
+        try:
+            size = os.path.getsize(dpath)
+        except OSError:
+            size = 0
+        for p in (dpath, mpath):
+            try:
+                os.unlink(p)
+            except OSError:
+                pass
+        if size:
+            with self._lock:
+                self._used = max(0, self._used - size)
+
+    def usage(self) -> int:
+        total = 0
+        for dirpath, _, files in os.walk(self.dir):
+            for f in files:
+                try:
+                    total += os.path.getsize(os.path.join(dirpath, f))
+                except OSError:
+                    pass
+        return total
+
+    def _evict_if_needed(self) -> None:
+        """LRU eviction to the low watermark (cmd/disk-cache.go gc). Runs
+        only when the incremental counter crosses quota — the directory
+        walk happens once per eviction episode, not per request."""
+        with self._lock:
+            used = self.usage()  # re-seed the counter while we're here
+            self._used = used
+            if used <= self.quota:
+                return
+            entries = []
+            for dirpath, _, files in os.walk(self.dir):
+                for f in files:
+                    if not f.endswith(".meta"):
+                        continue
+                    mpath = os.path.join(dirpath, f)
+                    meta = self._load_meta(mpath) or {}
+                    entries.append((meta.get("atime", 0.0), mpath))
+            entries.sort()
+            target = int(self.quota * self.low)
+            for _, mpath in entries:
+                if used <= target:
+                    break
+                dpath = mpath[:-5] + ".data"
+                try:
+                    used -= os.path.getsize(dpath)
+                    os.unlink(dpath)
+                except OSError:
+                    pass
+                try:
+                    os.unlink(mpath)
+                except OSError:
+                    pass
+            self._used = used
+
+    # -- hot paths ------------------------------------------------------------
+
+    def get_object(self, bucket, object, writer, offset=0, length=-1,
+                   opts=None):
+        opts = opts or dt.ObjectOptions()
+        if opts.version_id:
+            # versioned reads bypass the cache (it stores latest only)
+            return self.inner.get_object(bucket, object, writer, offset,
+                                         length, opts)
+        oi = self.inner.get_object_info(bucket, object, opts)
+        dpath, mpath = self._paths(bucket, object)
+        meta = self._load_meta(mpath)
+        if meta is not None and meta.get("etag") == oi.etag:
+            try:
+                with open(dpath, "rb") as f:
+                    f.seek(offset)
+                    n = meta["size"] - offset if length < 0 else length
+                    writer.write(f.read(max(0, n)))
+                self.hits += 1
+                self._touch(mpath, meta)
+                return oi
+            except OSError:
+                pass
+        self.misses += 1
+        # whole-object reads populate the cache (callers pass either -1 or
+        # the exact stored size for "everything")
+        if offset == 0 and (length < 0 or length >= oi.size):
+            buf = io.BytesIO()
+            out = self.inner.get_object(bucket, object, buf, 0, -1, opts)
+            data = buf.getvalue()
+            writer.write(data)
+            self._store(bucket, object, data, oi)
+            return out
+        return self.inner.get_object(bucket, object, writer, offset,
+                                     length, opts)
+
+    def put_object(self, bucket, object, stream, size, opts=None):
+        oi = self.inner.put_object(bucket, object, stream, size, opts)
+        self._drop(bucket, object)  # stale entry out; repopulate on read
+        return oi
+
+    def delete_object(self, bucket, object, opts=None):
+        self._drop(bucket, object)
+        return self.inner.delete_object(bucket, object, opts)
+
+    def delete_objects(self, bucket, objects, opts=None):
+        for obj in objects:
+            name = obj if isinstance(obj, str) else obj.get("object", "")
+            self._drop(bucket, name)
+        return self.inner.delete_objects(bucket, objects, opts)
+
+    def copy_object(self, src_bucket, src_object, dst_bucket, dst_object,
+                    src_info, src_opts, dst_opts):
+        self._drop(dst_bucket, dst_object)
+        return self.inner.copy_object(src_bucket, src_object, dst_bucket,
+                                      dst_object, src_info, src_opts,
+                                      dst_opts)
+
+    def stats(self) -> dict:
+        return {"hits": self.hits, "misses": self.misses,
+                "usage": self.usage(), "quota": self.quota}
+
+    # -- delegation -----------------------------------------------------------
+
+    def __getattr__(self, name):
+        return getattr(self.inner, name)

@@ -57,8 +57,13 @@ class Node:
             lambda: [self.local_locker, *self._lock_clients],
             owner=self.local_url or "standalone")
 
+        # per-host drive counts drive the set-symmetry filter
+        host_counts: dict[str, int] = {}
+        for ep in self.endpoints:
+            host_counts[ep.url or "local"] = \
+                host_counts.get(ep.url or "local", 0) + 1
         self.set_count, self.drives_per_set = pick_set_layout(
-            len(self.disks))
+            len(self.disks), list(host_counts.values()))
         self.obj = None
         self.bucket_meta = None
         self.server: S3Server | None = None

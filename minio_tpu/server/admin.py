@@ -39,7 +39,7 @@ def _dispatch_admin(h, op: str) -> None:
     if op == "storageinfo":
         return h._send(200, json.dumps(h.s3.obj.storage_info()).encode(),
                        "application/json")
-    if op.startswith("heal/"):
+    if op == "heal" or op.startswith("heal/"):
         return _heal(h, op)
     if op == "datausageinfo":
         from ..scanner.usage import load_usage
@@ -243,30 +243,34 @@ def _top_locks(h) -> None:
 
 
 def _heal(h, op: str) -> None:
+    """Heal sequences (reference admin-heal-ops.go): POST starts a
+    background sequence (or re-attaches to the running one for the same
+    path) and returns its token + snapshot; polling with
+    ?clientToken=<t> returns current status; ?forceStop=1 stops it."""
+    from ..scanner.healseq import HealSequenceManager
     parts = op.split("/")  # heal[/bucket[/prefix...]]
     bucket = parts[1] if len(parts) > 1 else ""
     prefix = "/".join(parts[2:]) if len(parts) > 2 else ""
-    dry_run = h.has_q("dryRun")
-    results = []
-    if not bucket:
-        for b in h.s3.obj.list_buckets():
-            results.append(_heal_bucket(h, b.name, "", dry_run))
-    else:
-        results.append(_heal_bucket(h, bucket, prefix, dry_run))
-    h._send(200, json.dumps({"results": results}).encode(),
-            "application/json")
-
-
-def _heal_bucket(h, bucket: str, prefix: str, dry_run: bool) -> dict:
-    res = h.s3.obj.heal_bucket(bucket, dry_run)
-    healed = []
-    listing = h.s3.obj.list_objects(bucket, prefix, max_keys=10_000)
-    for oi in listing.objects:
-        r = h.s3.obj.heal_object(bucket, oi.name, dry_run=dry_run)
-        healed.append({
-            "object": oi.name, "before": r.before_state,
-            "after": r.after_state})
-    return {"bucket": bucket,
-            "bucket_state": {"before": res.before_state,
-                             "after": res.after_state},
-            "objects": healed}
+    q = {k: v[0] for k, v in h.query.items()}
+    mgr = getattr(h.s3, "_heal_seqs", None)
+    if mgr is None:
+        mgr = h.s3._heal_seqs = HealSequenceManager(h.s3.obj)
+    token = q.get("clientToken", "")
+    if token:
+        seq = mgr.get(token)
+        if seq is None:
+            return h._error("InvalidArgument", "unknown heal token", 400)
+        if q.get("forceStop") == "1":
+            seq.stop()
+        return h._send(200, json.dumps(seq.summary()).encode(),
+                       "application/json")
+    try:
+        seq = mgr.start(bucket, prefix, dry_run=h.has_q("dryRun"))
+    except ValueError as e:
+        return h._error("XMinioHealOverlappingPaths", str(e), 409)
+    # give short sequences a moment so small heals return complete
+    import time as _t
+    deadline = _t.monotonic() + 2.0
+    while seq.status == "running" and _t.monotonic() < deadline:
+        _t.sleep(0.05)
+    h._send(200, json.dumps(seq.summary()).encode(), "application/json")

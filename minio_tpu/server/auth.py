@@ -170,8 +170,15 @@ class SigV4Verifier:
                 v = query[k][0] if query[k] and query[k][0] else ""
                 parts.append(f"{k}={v}" if v else k)
             resource += "?" + "&".join(parts)
-        date = expires or headers.get("date", "") or \
-            headers.get("x-amz-date", "")
+        # spec: when x-amz-date is sent it rides CanonicalizedAmzHeaders
+        # and the Date line is EMPTY (double-counting it rejects every
+        # conforming client that can't set Date)
+        if expires:
+            date = expires
+        elif headers.get("x-amz-date"):
+            date = ""
+        else:
+            date = headers.get("date", "")
         return "\n".join([
             method,
             headers.get("content-md5", ""),

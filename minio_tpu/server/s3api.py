@@ -516,7 +516,17 @@ class _S3Handler(BaseHTTPRequestHandler):
         import re as remod
 
         from .auth import signing_key
+        # the multipart parser is in-memory and the signature can only be
+        # checked AFTER parsing, so an unauthenticated body must be capped
+        # up front (DoS guard; env-tunable for big browser uploads)
+        max_post = int(os.environ.get("MINIO_TPU_MAX_POST_SIZE",
+                                      str(64 << 20)))
+        declared = int(self.hdr.get("content-length", "0") or 0)
+        if declared > max_post:
+            raise dt.EntityTooLarge(self.bucket, "")
         body = self._read_body()
+        if len(body) > max_post:
+            raise dt.EntityTooLarge(self.bucket, "")
         blob = (b"Content-Type: " + self.hdr["content-type"].encode() +
                 b"\r\n\r\n" + body)
         msg = email.parser.BytesParser(
@@ -614,10 +624,15 @@ class _S3Handler(BaseHTTPRequestHandler):
             elif isinstance(cond, list) and len(cond) == 3:
                 op, name, val = cond
                 if op == "content-length-range":
-                    if not (int(name) <= len(file_bytes) <= int(val)):
+                    try:
+                        lo, hi = int(name), int(val)
+                    except (TypeError, ValueError):
                         return self._error(
-                            "EntityTooLarge"
-                            if len(file_bytes) > int(val)
+                            "InvalidPolicyDocument",
+                            "bad content-length-range bounds", 400)
+                    if not (lo <= len(file_bytes) <= hi):
+                        return self._error(
+                            "EntityTooLarge" if len(file_bytes) > hi
                             else "EntityTooSmall",
                             "content-length-range violated", 400)
                     continue
@@ -662,7 +677,10 @@ class _S3Handler(BaseHTTPRequestHandler):
         opts.user_defined = meta
         oi = self.s3.obj.put_object(self.bucket, key_field, stream,
                                     put_size, opts)
-        status = int(fields.get("success_action_status", "204") or 204)
+        try:
+            status = int(fields.get("success_action_status", "204") or 204)
+        except ValueError:
+            status = 204
         if status not in (200, 201, 204):
             status = 204
         self._send(status, headers={"ETag": f'"{oi.etag}"'})
@@ -1306,10 +1324,11 @@ class _S3Handler(BaseHTTPRequestHandler):
                 if pool is not None else None
             if res is None:
                 raise
-            status, chunks, hdrs = res
+            status, chunks, hdrs, clen = res
             self.send_response(status)
             for k, v in hdrs.items():
                 self.send_header(k, v)
+            self.send_header("Content-Length", str(clen))
             self.send_header("x-minio-proxied-from-target", "true")
             self.end_headers()
             for chunk in chunks:  # streams: never fully resident

@@ -51,6 +51,16 @@ class DiskIDCheck(StorageAPI):
         self.inner.set_disk_id(disk_id)
         self.expected_id = disk_id
 
+    def _physical_id(self) -> str:
+        """The identity actually ON the disk (format.json's xl.this) — an
+        in-memory attribute would miss a disk swapped or wiped behind the
+        process's back, which is this wrapper's whole purpose."""
+        from ..dist.format import load_format
+        try:
+            return load_format(self.inner).get("xl", {}).get("this", "")
+        except errors.UnformattedDisk:
+            return ""  # wiped
+
     def _check_id(self):
         if not self.expected_id:
             return
@@ -62,7 +72,7 @@ class DiskIDCheck(StorageAPI):
                         f"{self.inner.endpoint()}: stale disk id")
                 return
             self._last_check = now
-        ok = self.inner.get_disk_id() == self.expected_id
+        ok = self._physical_id() == self.expected_id
         with self._lock:
             self._last_ok = ok
         if not ok:

@@ -204,20 +204,29 @@ def test_sts_web_identity(srv, c, monkeypatch):
 # --- disk-id check + set monitor ---------------------------------------------
 
 def test_disk_id_check_wrapper(tmp_path):
+    from minio_tpu.dist.format import new_format, save_format
     from minio_tpu.storage.idcheck import DiskIDCheck
     from minio_tpu.utils import errors
     d = XLStorage(str(tmp_path / "idd"))
+    fmt = new_format(1, 4)
+    fmt["xl"]["this"] = "uuid-1"
+    save_format(d, fmt)
     d.set_disk_id("uuid-1")
     w = DiskIDCheck(d, "uuid-1")
     w.make_vol("b")
     w.write_all("b", "f", b"x")
     assert w.read_all("b", "f") == b"x"
     assert w.healthy()
-    # swap the identity behind the wrapper's back
-    d.set_disk_id("uuid-OTHER")
-    w.expected_id = "uuid-1"
-    import minio_tpu.storage.idcheck as idm
+    # rewrite the PHYSICAL identity behind the wrapper's back (disk swap)
+    fmt["xl"]["this"] = "uuid-OTHER"
+    save_format(d, fmt)
     w._last_check = 0  # force a re-check
+    with pytest.raises(errors.DiskNotFound):
+        w.read_all("b", "f")
+    # a wiped disk (no format.json) also fails closed
+    d.delete_path(".minio.sys", "format.json")
+    w._last_check = 0
+    w._last_ok = True
     with pytest.raises(errors.DiskNotFound):
         w.read_all("b", "f")
 

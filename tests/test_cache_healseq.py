@@ -1,0 +1,132 @@
+"""Disk cache wrapper, heal sequences, set-layout symmetry (reference
+cmd/disk-cache.go, cmd/admin-heal-ops.go, cmd/endpoint-ellipses.go)."""
+import io
+import json
+import os
+import shutil
+import sys
+import time
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(__file__))
+from s3client import S3Client  # noqa: E402
+
+from minio_tpu.cache import CacheObjects  # noqa: E402
+from minio_tpu.dist.topology import pick_set_layout  # noqa: E402
+from minio_tpu.objectlayer import ErasureObjects  # noqa: E402
+from minio_tpu.storage import XLStorage  # noqa: E402
+
+
+def _mk(tmp_path, n=4):
+    return ErasureObjects([XLStorage(os.path.join(tmp_path, f"d{i}"))
+                           for i in range(n)], default_parity=2)
+
+
+def test_cache_hit_miss_and_invalidation(tmp_path):
+    inner = _mk(str(tmp_path / "backend"))
+    co = CacheObjects(inner, str(tmp_path / "cache"), quota_bytes=10 << 20)
+    co.make_bucket("cb")
+    body = np.random.default_rng(0).integers(0, 256, 256 << 10,
+                                             dtype=np.uint8).tobytes()
+    co.put_object("cb", "o", io.BytesIO(body), len(body))
+    sink = io.BytesIO()
+    co.get_object("cb", "o", sink)          # miss -> populate
+    assert sink.getvalue() == body and co.misses == 1
+    sink = io.BytesIO()
+    co.get_object("cb", "o", sink)          # hit
+    assert sink.getvalue() == body and co.hits == 1
+    # ranged read served from cache too
+    sink = io.BytesIO()
+    co.get_object("cb", "o", sink, offset=1000, length=500)
+    assert sink.getvalue() == body[1000:1500] and co.hits == 2
+    # overwrite invalidates; next read is a miss with the new content
+    body2 = b"new content" * 100
+    co.put_object("cb", "o", io.BytesIO(body2), len(body2))
+    sink = io.BytesIO()
+    co.get_object("cb", "o", sink)
+    assert sink.getvalue() == body2 and co.misses == 2
+    # delete drops the entry and delegates
+    co.delete_object("cb", "o")
+    from minio_tpu.objectlayer import datatypes as dt
+    with pytest.raises(dt.ObjectNotFound):
+        co.get_object("cb", "o", io.BytesIO())
+
+
+def test_cache_eviction_lru(tmp_path):
+    inner = _mk(str(tmp_path / "b2"))
+    co = CacheObjects(inner, str(tmp_path / "c2"), quota_bytes=300 << 10,
+                      watermark_low=0.5)
+    co.make_bucket("cb")
+    bodies = {}
+    for i in range(6):
+        b = np.random.default_rng(i).integers(0, 256, 64 << 10,
+                                              dtype=np.uint8).tobytes()
+        bodies[i] = b
+        co.put_object("cb", f"o{i}", io.BytesIO(b), len(b))
+        co.get_object("cb", f"o{i}", io.BytesIO())  # populate
+        time.sleep(0.01)
+    assert co.usage() <= 300 << 10  # eviction kept usage under quota
+    # most-recent entries survive
+    sink = io.BytesIO()
+    hits0 = co.hits
+    co.get_object("cb", "o5", sink)
+    assert co.hits == hits0 + 1 and sink.getvalue() == bodies[5]
+
+
+def test_heal_sequence_lifecycle(tmp_path):
+    from minio_tpu.server import S3Server
+    obj = _mk(str(tmp_path / "hs"))
+    srv = S3Server(obj, "127.0.0.1", 0, access_key="hk",
+                   secret_key="hsecret11")
+    srv.start_background()
+    try:
+        c = S3Client(srv.endpoint(), "hk", "hsecret11")
+        c.request("PUT", "/hb")
+        for i in range(6):
+            c.request("PUT", f"/hb/o{i}", body=b"x" * 2048)
+        # wipe one disk's bucket dir -> objects degraded
+        shutil.rmtree(os.path.join(obj.disks[1].base, "hb"))
+        os.makedirs(os.path.join(obj.disks[1].base, "hb"))
+        r = c.request("POST", "/minio/admin/v3/heal/hb")
+        assert r.status_code == 200, r.text
+        doc = json.loads(r.text)
+        token = doc["clientToken"]
+        deadline = time.time() + 20
+        while doc["status"] == "running" and time.time() < deadline:
+            time.sleep(0.2)
+            doc = json.loads(c.request(
+                "POST", "/minio/admin/v3/heal/hb",
+                query={"clientToken": token}).text)
+        assert doc["status"] == "done", doc
+        assert doc["scanned"] == 6 and doc["healed"] == 6, doc
+        # healed shards back on the wiped disk
+        obj.disks[1].read_version("hb", "o0")
+        # polling an unknown token errors cleanly
+        r = c.request("POST", "/minio/admin/v3/heal/hb",
+                      query={"clientToken": "nope"})
+        assert r.status_code == 400
+    finally:
+        srv.shutdown()
+
+
+def test_set_layout_symmetry():
+    # single host: largest divisor wins
+    assert pick_set_layout(16) == (1, 16)
+    assert pick_set_layout(24) == (2, 12)
+    # 4 hosts x 4 drives: 16 divides by 16, but 16 % 4 == 0 keeps it
+    assert pick_set_layout(16, [4, 4, 4, 4]) == (1, 16)
+    # 3 hosts x 5 drives = 15: sizes {5, 15->no}; candidates {5, 15?} ->
+    # 15 not in 4..16? it is. 15 % 3 == 0 symmetric; 5 % 3 != 0, gcd=5,
+    # 5 % 5 == 0 also symmetric -> prefers 15
+    assert pick_set_layout(15, [5, 5, 5]) == (1, 15)
+    # 2 hosts x 3 drives = 6: candidates {6}; 6 % 2 == 0 -> symmetric
+    assert pick_set_layout(6, [3, 3]) == (1, 6)
+    # asymmetric preference: 2 hosts x 6 = 12; candidates {4, 6, 12};
+    # symmetric: 4 (%2), 6 (%2 and gcd 6 % 6), 12 (%2) -> 12
+    assert pick_set_layout(12, [6, 6]) == (1, 12)
+    # undersized
+    assert pick_set_layout(2) == (1, 2)
+    with pytest.raises(ValueError):
+        pick_set_layout(17)
