@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import hashlib
 import os
+import socket
 import threading
 import urllib.parse
 import xml.etree.ElementTree as ET
@@ -138,8 +139,26 @@ class S3Server:
         class Handler(_S3Handler):
             s3 = server
 
-        httpd = ThreadingHTTPServer((self.address, self.port), Handler)
-        httpd.daemon_threads = True
+        class TunedServer(ThreadingHTTPServer):
+            """Listener tuning (reference cmd/http/server.go +
+            listener.go): deep accept backlog for bursty S3 clients, and
+            TCP_NODELAY + keepalive on every accepted connection so small
+            metadata responses don't sit in Nagle buffers and dead peers
+            get reaped."""
+            request_queue_size = 1024
+            daemon_threads = True
+
+            def process_request(self, request, client_address):
+                try:
+                    request.setsockopt(socket.IPPROTO_TCP,
+                                       socket.TCP_NODELAY, 1)
+                    request.setsockopt(socket.SOL_SOCKET,
+                                       socket.SO_KEEPALIVE, 1)
+                except OSError:
+                    pass
+                super().process_request(request, client_address)
+
+        httpd = TunedServer((self.address, self.port), Handler)
         self._httpd = httpd
         self.port = httpd.server_address[1]
         return httpd
