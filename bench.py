@@ -380,16 +380,35 @@ def heal_latency(rng) -> dict:
     return out
 
 
+def finish(payload: dict) -> None:
+    """Print the one-line result, quiesce framework threads, and exit 0
+    deterministically. The axon JAX client's teardown intermittently aborts
+    the process (pthread-cancel of a C++ thread -> "FATAL: exception not
+    rethrown") after all useful work is done; our own threads are stopped
+    first, output is flushed, then os._exit skips the crash-prone
+    interpreter/third-party finalization."""
+    print(json.dumps(payload))
+    sys.stdout.flush()
+    sys.stderr.flush()
+    import minio_tpu
+    minio_tpu.shutdown()
+    os._exit(0)
+
+
 def main() -> None:
     rng = np.random.default_rng(0)
     cpu_gibs = cpu_baseline(rng)
     host = host_profile(rng)
-    dev = device_configs(rng)
+    # e2e before the device configs: the device stages' multi-GiB host
+    # staging churn measurably degrades kernel page allocation afterwards
+    # (tmpfs writes -25%, syscall time ~2x on this host), which would tax
+    # the e2e numbers with state the data plane didn't create
     put = e2e_put(rng)
+    dev = device_configs(rng)
     lat = heal_latency(rng)
 
     enc = dev["encode_16p4_1MiB_b128"]
-    print(json.dumps({
+    finish({
         "metric": "erasure_encode_gibs_16+4_1MiB_batch128",
         "value": round(enc, 2),
         "unit": "GiB/s",
@@ -411,7 +430,7 @@ def main() -> None:
             "reconstruct_vs_cpu": round(
                 dev["reconstruct_2loss_16p4_b128"] / cpu_gibs, 2),
         },
-    }))
+    })
 
 
 if __name__ == "__main__":

@@ -15,3 +15,48 @@ mirrors SURVEY.md §1:
 """
 
 __version__ = "0.1.0"
+
+
+def _tune_malloc() -> None:
+    """Pin glibc's mmap threshold so block-sized data-plane buffers
+    (~1-2 MiB per erasure block) are always mmap-served instead of landing
+    in malloc arenas.
+
+    Why: glibc grows M_MMAP_THRESHOLD dynamically to the size of the
+    largest freed mmapped chunk (up to 32 MiB). After the JAX/XLA client
+    frees its multi-hundred-MiB staging buffers, every per-block buffer
+    drops into the (now fragmented) main arena and concurrent PUT streams
+    convoy on arena free-list scans — measured 3.7x total-CPU inflation and
+    a ~2.5x parallel-PUT collapse on a 1-core host. Setting the threshold
+    explicitly disables the dynamic growth (glibc keeps a no_dyn_threshold
+    flag once mallopt is called). Gate: MINIO_TPU_MALLOC_TUNE=0.
+    """
+    import ctypes
+    import os
+    if os.environ.get("MINIO_TPU_MALLOC_TUNE", "1") == "0":
+        return
+    try:
+        libc = ctypes.CDLL(None, use_errno=True)
+        m_mmap_threshold = -3  # malloc.h M_MMAP_THRESHOLD
+        libc.mallopt(m_mmap_threshold,
+                     int(os.environ.get("MINIO_TPU_MMAP_THRESHOLD",
+                                        str(128 * 1024))))
+    except (OSError, AttributeError, ValueError, TypeError):
+        # non-glibc platform or malformed env override: run un-tuned
+        # rather than making the package unimportable
+        pass
+
+
+_tune_malloc()
+
+
+def shutdown() -> None:
+    """Quiesce framework background threads (dispatch queue + completers,
+    link-probe, shared encode/IO pools) so a process can exit without a
+    daemon thread mid-flight in native or device code. Safe to call when
+    nothing was started; components re-create their pools lazily if used
+    again afterwards."""
+    from .runtime import dispatch as _dispatch
+    _dispatch.shutdown_global()
+    from .erasure import streaming as _streaming
+    _streaming.shutdown_pools()

@@ -59,6 +59,17 @@ def encode_pool() -> ThreadPoolExecutor:
     return _encode_pool
 
 
+def shutdown_pools() -> None:
+    """Drain and drop the shared IO/encode pools (minio_tpu.shutdown());
+    they are rebuilt lazily on next use."""
+    global _io_pool, _encode_pool
+    io_p, _io_pool = _io_pool, None
+    enc_p, _encode_pool = _encode_pool, None
+    for p in (io_p, enc_p):
+        if p is not None:
+            p.shutdown(wait=True)
+
+
 def _native_put_eligible(erasure: Erasure, writers: list) -> bool:
     """True when the whole block pipeline (split+encode+hash+frame) can run
     as one native GIL-releasing call per block (native/pipeline.cpp
@@ -230,12 +241,14 @@ def erasure_encode(erasure: Erasure, stream, writers: list,
     native_path = _native_put_eligible(erasure, writers)
     if native_path:
         from .. import native
+        from ..runtime.bufpool import global_pool
         from .bitrot import HIGHWAY_KEY, native_algo_id
         k, m = erasure.data_blocks, erasure.parity_blocks
         pmat = np.ascontiguousarray(erasure.codec.parity_rows)
         live0 = next(w for w in writers if w is not None)
         chunk = live0.shard_size
         algo_id = native_algo_id(live0.algo)
+        pool = global_pool()
 
     def encode_block(buf: bytes):
         if not native_path:
@@ -245,12 +258,14 @@ def erasure_encode(erasure: Erasure, stream, writers: list,
         shard_len = ceil_div(len(buf), k)
         fut = encode_pool().submit(
             native.put_block, buf, len(buf), pmat, k, m, shard_len, chunk,
-            HIGHWAY_KEY, algo_id)
+            HIGHWAY_KEY, algo_id,
+            out=pool.get((k + m) * native.framed_len(shard_len, chunk)))
         return ("nat", fut, shard_len)
 
     def start_writes(entry):
         kind, fut, shard_len = entry
         futs = {}
+        framed = None
         if kind == "py":
             shards = fut.result()
             for i, ow in enumerate(owriters):
@@ -267,10 +282,10 @@ def erasure_encode(erasure: Erasure, stream, writers: list,
                 span = framed[i * fl:(i + 1) * fl] \
                     if framed is not None else b""
                 futs[i] = ow.write_framed_async(span)
-        write_window.append(futs)
+        write_window.append((futs, framed))
 
     def harvest_writes():
-        futs = write_window.popleft()
+        futs, framed = write_window.popleft()
         errs: list[BaseException | None] = [None] * len(writers)
         for i in range(len(writers)):
             if writers[i] is None:
@@ -282,6 +297,10 @@ def erasure_encode(erasure: Erasure, stream, writers: list,
                 errs[i] = e if isinstance(e, errors.StorageError) \
                     else errors.FaultyDisk(str(e))
                 writers[i] = None
+        if native_path:
+            # all shard writes for this block are done (results harvested
+            # above); its framed buffer can carry the next block
+            pool.put(framed)
         err = errors.reduce_write_quorum_errs(
             errs, errors.BASE_IGNORED_ERRS, write_quorum)
         if err is not None:
@@ -312,7 +331,7 @@ def erasure_encode(erasure: Erasure, stream, writers: list,
         # quiesce in-flight chained writes before propagating: the caller
         # will abort/close the writers, and a background write racing an
         # abort corrupts the writer state
-        for futs in write_window:
+        for futs, _framed in write_window:
             for f in futs.values():
                 try:
                     f.result()
@@ -463,9 +482,11 @@ def erasure_decode(erasure: Erasure, writer, readers: list, offset: int,
     native_get = _native_get_eligible(erasure, readers)
     if native_get:
         from .. import native
+        from ..runtime.bufpool import global_pool
         from .bitrot import HIGHWAY_KEY, native_algo_id
         fuse_chunk = readers[0].shard_size
         get_algo_id = native_algo_id(readers[0].algo)
+        pool = global_pool()
 
     def read_framed_k(shard_offset: int, shard_len: int):
         """Concurrently read the k data shards' framed spans; on any read
@@ -512,7 +533,8 @@ def erasure_decode(erasure: Erasure, writer, readers: list, offset: int,
             if framed is not None:
                 fut = encode_pool().submit(
                     native.get_block, framed, k, shard_len, fuse_chunk,
-                    HIGHWAY_KEY, get_algo_id)
+                    HIGHWAY_KEY, get_algo_id,
+                    out=pool.get(k * shard_len))
                 return ["native", fut, b, block_data_len, boff, blen]
         # Degraded data read + device-hash-capable sources -> fused
         # verify+reconstruct: one launch hashes every source shard AND
@@ -557,8 +579,10 @@ def erasure_decode(erasure: Erasure, writer, readers: list, offset: int,
             out_arr, bad = res
             if bad < 0:
                 writer.write(out_arr[boff: boff + blen].tobytes())
+                pool.put(out_arr)
                 stats.bytes_written += blen
                 return
+            pool.put(out_arr)
             blocks = recover_block((bad,), b, block_data_len)
         elif kind == "fused":
             blocks, corrupt = res

@@ -168,18 +168,24 @@ _u8p = ctypes.POINTER(ctypes.c_uint8)
 
 def put_block(data, data_len: int, pmat: np.ndarray, k: int, m: int,
               shard_len: int, chunk: int, key: bytes,
-              algo: int = ALGO_HIGHWAY) -> np.ndarray:
+              algo: int = ALGO_HIGHWAY, out: np.ndarray | None = None
+              ) -> np.ndarray:
     """Fused split+encode+hash+frame for one erasure block.
 
     ``data`` is a readable buffer of ``data_len`` bytes; returns a uint8
     array of (k+m)*framed_len bytes — shard i's framed bytes are
     ``out[i*framed_len:(i+1)*framed_len]`` (slice views, no copies).
+    ``out``, when given, must be a uint8 array of exactly that size
+    (bufpool recycling); it is filled and returned.
     """
     lib = load_native()
     if k + m > 256 or k <= 0 or m < 0 or chunk <= 0:
         raise ValueError(f"unsupported geometry k={k} m={m} chunk={chunk}")
     fl = lib.mt_framed_len(shard_len, chunk)
-    out = np.empty((k + m) * fl, dtype=np.uint8)
+    if out is None:
+        out = np.empty((k + m) * fl, dtype=np.uint8)
+    elif out.nbytes != (k + m) * fl:
+        raise ValueError("put_block: out buffer size mismatch")
     src = np.frombuffer(data, dtype=np.uint8, count=data_len)
     pmat = np.ascontiguousarray(pmat, dtype=np.uint8)
     lib.mt_put_block(
@@ -190,15 +196,20 @@ def put_block(data, data_len: int, pmat: np.ndarray, k: int, m: int,
 
 
 def get_block(framed: list, k: int, plen: int, chunk: int, key: bytes,
-              algo: int = ALGO_HIGHWAY) -> tuple[np.ndarray, int]:
+              algo: int = ALGO_HIGHWAY, out: np.ndarray | None = None
+              ) -> tuple[np.ndarray, int]:
     """Fused verify+assemble: k framed shard buffers -> (block uint8
-    [k*plen], bad_shard) where bad_shard is -1 on success."""
+    [k*plen], bad_shard) where bad_shard is -1 on success. ``out``, when
+    given, must be uint8 of exactly k*plen bytes (bufpool recycling)."""
     lib = load_native()
     if k <= 0 or k > 256 or chunk <= 0:
         raise ValueError(f"unsupported geometry k={k} chunk={chunk}")
     arrs = [np.frombuffer(f, dtype=np.uint8) for f in framed]
     ptrs = (ctypes.c_void_p * k)(*[a.ctypes.data for a in arrs])
-    out = np.empty(k * plen, dtype=np.uint8)
+    if out is None:
+        out = np.empty(k * plen, dtype=np.uint8)
+    elif out.nbytes != k * plen:
+        raise ValueError("get_block: out buffer size mismatch")
     bad = lib.mt_get_block(ptrs, k, plen, chunk, key,
                            out.ctypes.data_as(_u8p), algo)
     return out, bad

@@ -1,0 +1,91 @@
+"""Recycling pool for block-sized data-plane buffers.
+
+The erasure data plane allocates a handful of large (0.5-2 MiB) buffers per
+block: the framed shard output of a PUT block, the assembled payload of a
+GET block. With glibc these exceed the (pinned, see minio_tpu._tune_malloc)
+mmap threshold, so every allocation is an mmap + zero-fill-fault + munmap
+round-trip — measured as the dominant system-time cost of the concurrent
+PUT path once the device client is active (the reference leans on Go's
+size-classed allocator for the same pattern; cmd/erasure-encode.go's block
+buffers come from a sync.Pool).
+
+Buckets are exact-size free lists (the data plane re-uses a few distinct
+sizes per erasure geometry); total retained bytes are bounded, and get()
+never blocks — a miss is just a fresh numpy allocation.
+"""
+from __future__ import annotations
+
+import os
+import threading
+from collections import defaultdict
+
+import numpy as np
+
+#: Retained-bytes cap across all buckets (not a cap on live buffers).
+MAX_RETAINED = int(os.environ.get("MINIO_TPU_BUFPOOL_BYTES",
+                                  str(256 << 20)))
+#: Allocations below this are cheap malloc traffic; pooling them only adds
+#: lock crossings.
+MIN_POOLED = int(os.environ.get("MINIO_TPU_BUFPOOL_MIN", str(128 << 10)))
+
+
+class BufferPool:
+    def __init__(self, max_retained: int = MAX_RETAINED,
+                 min_pooled: int = MIN_POOLED):
+        self.max_retained = max_retained
+        self.min_pooled = min_pooled
+        self._lock = threading.Lock()
+        self._free: dict[int, list[np.ndarray]] = defaultdict(list)
+        self._retained = 0
+        # telemetry
+        self.hits = 0
+        self.misses = 0
+
+    def get(self, nbytes: int) -> np.ndarray:
+        """A uint8 array of exactly ``nbytes``; contents are undefined."""
+        if nbytes >= self.min_pooled:
+            with self._lock:
+                lst = self._free.get(nbytes)
+                if lst:
+                    self._retained -= nbytes
+                    self.hits += 1
+                    return lst.pop()
+                self.misses += 1
+        return np.empty(nbytes, dtype=np.uint8)
+
+    def put(self, arr: np.ndarray | None) -> None:
+        """Return a buffer obtained from get(). The caller must not touch
+        the array afterwards (views included). None is ignored so release
+        paths don't need their own guards."""
+        if arr is None or arr.nbytes < self.min_pooled \
+                or not arr.flags.owndata:
+            return
+        with self._lock:
+            if self._retained + arr.nbytes > self.max_retained:
+                return
+            self._free[arr.nbytes].append(arr)
+            self._retained += arr.nbytes
+
+    def clear(self) -> None:
+        with self._lock:
+            self._free.clear()
+            self._retained = 0
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"retained": self._retained, "hits": self.hits,
+                    "misses": self.misses,
+                    "buckets": {k: len(v) for k, v in self._free.items()}}
+
+
+_global: BufferPool | None = None
+_global_lock = threading.Lock()
+
+
+def global_pool() -> BufferPool:
+    global _global
+    if _global is None:
+        with _global_lock:
+            if _global is None:
+                _global = BufferPool()
+    return _global

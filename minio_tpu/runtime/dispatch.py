@@ -302,8 +302,9 @@ class DispatchQueue:
                 with self._profile_lock:
                     self._probe_running = False
 
-        threading.Thread(target=run, name="minio-tpu-probe",
-                         daemon=True).start()
+        self._probe_thread = threading.Thread(
+            target=run, name="minio-tpu-probe", daemon=True)
+        self._probe_thread.start()
 
     def _get_profile(self) -> LinkProfile | None:
         """Current link profile; stale or missing profiles trigger a
@@ -491,6 +492,13 @@ class DispatchQueue:
             self._stop = True
             self._cv.notify_all()
         self._thread.join(timeout=5)
+        # a probe mid-device-transfer at interpreter exit is one of the two
+        # known teardown-abort sources (the other is axon client teardown
+        # itself); wait it out before the caller tears the process down
+        t = getattr(self, "_probe_thread", None)
+        if t is not None and t.is_alive():
+            t.join(timeout=10)
+        self._completers.shutdown(wait=True)
 
     def stats(self) -> dict:
         return {"batches": self.batches, "items": self.items,
@@ -509,3 +517,14 @@ def global_queue() -> DispatchQueue:
             if _global is None:
                 _global = DispatchQueue()
     return _global
+
+
+def shutdown_global() -> None:
+    """Stop the global queue (drains pending work, joins the dispatcher,
+    shuts the completer pool down) and forget it; the next global_queue()
+    call builds a fresh one. Part of minio_tpu.shutdown()."""
+    global _global
+    with _global_lock:
+        q, _global = _global, None
+    if q is not None:
+        q.stop()
