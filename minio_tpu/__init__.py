@@ -60,3 +60,5 @@ def shutdown() -> None:
     _dispatch.shutdown_global()
     from .erasure import streaming as _streaming
     _streaming.shutdown_pools()
+    from .utils import md5simd as _md5simd
+    _md5simd.shutdown_server()

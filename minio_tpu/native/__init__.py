@@ -29,7 +29,8 @@ _LOCK = threading.Lock()
 _lib: ctypes.CDLL | None = None
 _load_error: Exception | None = None
 
-_SOURCES = ("pipeline.cpp", "gf256_simd.cpp", "highwayhash.cpp", "mur3.cpp")
+_SOURCES = ("pipeline.cpp", "gf256_simd.cpp", "highwayhash.cpp", "mur3.cpp",
+            "md5_simd.cpp")
 
 #: Bitrot algorithm ids shared with native/pipeline.cpp hash_many().
 ALGO_HIGHWAY = 0
@@ -128,6 +129,18 @@ def _load_native_locked() -> ctypes.CDLL:
                                       ctypes.POINTER(ctypes.c_long),
                                       ctypes.c_int, ctypes.c_char_p]
         lib.mur3x256_many.restype = None
+        lib.md5_multi_segments.argtypes = [
+            ctypes.POINTER(ctypes.c_uint32),
+            ctypes.POINTER(ctypes.c_void_p),
+            ctypes.POINTER(ctypes.c_long),
+            ctypes.POINTER(ctypes.c_int), ctypes.c_int]
+        lib.md5_multi_segments.restype = None
+        lib.md5_init_state.argtypes = [ctypes.POINTER(ctypes.c_uint32)]
+        lib.md5_init_state.restype = None
+        lib.md5_finish.argtypes = [
+            ctypes.POINTER(ctypes.c_uint32), ctypes.c_char_p, ctypes.c_long,
+            ctypes.c_ulonglong, c_u8p]
+        lib.md5_finish.restype = None
         _lib = lib
     return _lib
 

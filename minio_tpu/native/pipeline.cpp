@@ -23,6 +23,7 @@
 // gf256, highwayhash, and pipeline entry points.
 #include "gf256_simd.cpp"
 #include "highwayhash.cpp"
+#include "md5_simd.cpp"
 #include "mur3.cpp"
 
 #include <cstdint>

@@ -2,10 +2,13 @@
 stream, enforces declared size, computes MD5 (ETag) + optional SHA256 and
 verifies expected digests on EOF — the PutObject ingress integrity gate.
 
-The digest chain is moved OFF the read path onto a per-reader worker thread
-for large bodies (hashlib releases the GIL for buffers >2 KiB, so the MD5
-chain genuinely overlaps the erasure-encode pipeline instead of serializing
-with it — the TPU-build answer to the reference's md5-simd ingest)."""
+Large MD5-only bodies hash on the shared multi-lane AVX2 server
+(utils/md5simd.py, the md5-simd analogue): concurrent PUT streams share
+lanes, which is where the reference gets its concurrent-ingest throughput.
+Bodies that also need SHA256 (signed payloads) or whose size is unknown
+keep the per-reader worker thread below — hashlib releases the GIL for
+buffers >2 KiB, so the digest chain still overlaps the erasure-encode
+pipeline instead of serializing with it."""
 from __future__ import annotations
 
 import binascii
@@ -82,8 +85,19 @@ class HashReader:
         self._read = 0
         self._eof = False
         self._async: _AsyncDigest | None = None
+        self._lane = False  # md5 runs on the shared lane server
         if size >= ASYNC_DIGEST_MIN:
-            self._async = _AsyncDigest(self._hashes())
+            if self._sha256 is None:
+                # MD5-only large body: hash on the shared multi-lane
+                # server (md5simd) — concurrent PUT streams share AVX2
+                # lanes instead of each paying a scalar MD5 pass
+                from .md5simd import global_server
+                srv = global_server()
+                if srv is not None:
+                    self._md5 = srv.stream()
+                    self._lane = True
+            if not self._lane:
+                self._async = _AsyncDigest(self._hashes())
 
     def _hashes(self) -> list:
         return [self._md5] + (

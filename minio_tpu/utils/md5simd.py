@@ -1,0 +1,224 @@
+"""Shared multi-lane MD5 hash server (the reference's md5-simd analogue).
+
+The S3 ETag contract makes every PutObject pay an MD5 pass; measured on the
+bench host it is the dominant CPU cost of concurrent PUTs (2.4 cpu-s/GiB).
+MD5 cannot be parallelized *within* a stream, but independent streams can
+share AVX2 lanes (reference: the md5-simd module its hash.Reader uses).
+
+Architecture: one worker thread owns all native MD5 states. Streams enqueue
+(ordered) buffers; each scheduling round the worker drains EVERYTHING
+queued for up to 8 streams and advances them together through one
+GIL-released ``md5_multi_segments`` call (per-lane segment lists — one
+call per round matters on few-core hosts, where frequent worker GIL
+round-trips convoy with producer threads). One active stream degrades to
+the scalar path inside the native call; two or more share AVX2 lanes.
+Digest order per stream is preserved by construction (a stream's buffers
+are processed FIFO and a stream is in at most one batch at a time).
+
+Streams fall back to hashlib when the native library is unavailable.
+"""
+from __future__ import annotations
+
+import ctypes
+import threading
+from collections import deque
+
+import numpy as np
+
+_LANES = 8
+
+
+class MD5Stream:
+    """One sequential MD5 chain, fed through the shared server.
+
+    update() enqueues and returns immediately (the bytes object is
+    retained until hashed); digest()/hexdigest() block until the chain
+    drains. Not thread-safe per stream (one producer), like hashlib.
+    """
+
+    def __init__(self, server: "MD5Server"):
+        self._srv = server
+        self._state = np.empty(4, dtype=np.uint32)
+        server._lib.md5_init_state(
+            self._state.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)))
+        self._tail = b""
+        self._total = 0
+        self._queue: deque[bytes] = deque()
+        self._qbytes = 0
+        self._done = threading.Event()
+        self._done.set()  # nothing pending
+        self._digest: bytes | None = None
+        self._error: BaseException | None = None
+
+    def update(self, b: bytes) -> None:
+        if self._digest is not None:
+            raise ValueError("update after digest")
+        if not b:
+            return
+        self._total += len(b)
+        self._srv._enqueue(self, b)
+
+    #: Queued-bytes cap per stream; update() blocks above it so a fast
+    #: producer can't buffer its whole body in the hash queue.
+    MAX_QUEUED = 8 << 20
+
+    def _drain(self) -> None:
+        self._done.wait()
+
+    def digest(self) -> bytes:
+        if self._digest is None:
+            self._drain()
+            if self._error is not None:
+                raise self._error
+            out = np.empty(16, dtype=np.uint8)
+            self._srv._lib.md5_finish(
+                self._state.ctypes.data_as(
+                    ctypes.POINTER(ctypes.c_uint32)),
+                self._tail, len(self._tail), self._total,
+                out.ctypes.data_as(
+                    ctypes.POINTER(ctypes.c_uint8)))
+            self._digest = out.tobytes()
+        return self._digest
+
+    def hexdigest(self) -> str:
+        return self.digest().hex()
+
+
+class MD5Server:
+    def __init__(self, lib):
+        self._lib = lib
+        self._cv = threading.Condition()
+        self._pending: deque[MD5Stream] = deque()  # streams with queued bufs
+        self._member: set[int] = set()             # ids in _pending
+        self._stop = False
+        # telemetry: rounds by lane count (lane_rounds[n-1] += 1)
+        self.lane_rounds = [0] * _LANES
+        self.bytes_hashed = 0
+        self._thread = threading.Thread(
+            target=self._loop, name="minio-tpu-md5", daemon=True)
+        self._thread.start()
+
+    def stream(self) -> MD5Stream:
+        return MD5Stream(self)
+
+    def _enqueue(self, s: MD5Stream, b: bytes) -> None:
+        with self._cv:
+            while s._qbytes >= MD5Stream.MAX_QUEUED:
+                self._cv.wait()
+            s._queue.append(b)
+            s._qbytes += len(b)
+            s._done.clear()  # under the lock: pairs with the worker's set
+            if id(s) not in self._member:
+                self._member.add(id(s))
+                self._pending.append(s)
+            self._cv.notify()
+
+    def _loop(self) -> None:
+        while True:
+            with self._cv:
+                while not self._pending and not self._stop:
+                    self._cv.wait()
+                if self._stop and not self._pending:
+                    return
+                # take EVERYTHING queued for up to 8 streams: one native
+                # call per scheduling round keeps the worker's GIL
+                # round-trips rare (they convoy with producer threads on
+                # few-core hosts otherwise)
+                batch: list[tuple[MD5Stream, list[bytes]]] = []
+                while self._pending and len(batch) < _LANES:
+                    s = self._pending.popleft()
+                    batch.append((s, list(s._queue)))
+                    s._queue.clear()
+                    s._qbytes = 0
+                    self._member.discard(id(s))
+                self._cv.notify_all()  # wake producers in backpressure
+            try:
+                self._run_batch(batch)
+            except BaseException as e:  # noqa: BLE001 — isolate the batch
+                # fail only the affected streams; the shared worker must
+                # survive (a dead singleton would hang every future PUT)
+                with self._cv:
+                    for s, _ in batch:
+                        s._error = e
+                        s._queue.clear()
+                        s._qbytes = 0
+                        self._member.discard(id(s))
+                        s._done.set()
+                    self._cv.notify_all()
+
+    def _run_batch(self, batch: list) -> None:
+        u32p = ctypes.POINTER(ctypes.c_uint32)
+        states = np.concatenate([s._state for s, _ in batch])
+        seg_ptrs: list[int] = []
+        seg_blocks: list[int] = []
+        seg_off = [0]
+        anchors: list[object] = []  # keep buffers alive through call
+        for s, bufs in batch:
+            # stitch the stream's chunk sequence into whole-block
+            # segments, carrying non-64-aligned remainders forward
+            # (copies only at unaligned boundaries — the data plane's
+            # 1 MiB reads never hit that path)
+            carry = s._tail
+            s._tail = b""
+            for buf in bufs:
+                if carry:
+                    buf = carry + buf
+                    carry = b""
+                nb = len(buf) // 64
+                if nb:
+                    arr = np.frombuffer(buf, dtype=np.uint8,
+                                        count=nb * 64)
+                    anchors.append(arr)
+                    seg_ptrs.append(arr.ctypes.data)
+                    seg_blocks.append(nb)
+                if len(buf) > nb * 64:
+                    carry = bytes(buf[nb * 64:])
+            s._tail = carry
+            seg_off.append(len(seg_ptrs))
+        n = len(batch)
+        self.lane_rounds[n - 1] += 1
+        self.bytes_hashed += sum(seg_blocks) * 64
+        c_ptrs = (ctypes.c_void_p * max(1, len(seg_ptrs)))(*seg_ptrs)
+        c_blocks = (ctypes.c_long * max(1, len(seg_blocks)))(*seg_blocks)
+        c_off = (ctypes.c_int * (n + 1))(*seg_off)
+        self._lib.md5_multi_segments(
+            states.ctypes.data_as(u32p), c_ptrs, c_blocks, c_off, n)
+        with self._cv:
+            for i, (s, _) in enumerate(batch):
+                s._state[:] = states[4 * i: 4 * i + 4]
+                if not s._queue and id(s) not in self._member:
+                    s._done.set()
+
+    def stop(self) -> None:
+        with self._cv:
+            self._stop = True
+            self._cv.notify_all()
+        self._thread.join(timeout=5)
+
+
+_server: MD5Server | None = None
+_server_lock = threading.Lock()
+_unavailable = False
+
+
+def global_server() -> MD5Server | None:
+    """The process-wide hash server, or None when the native library is
+    missing (callers fall back to hashlib)."""
+    global _server, _unavailable
+    if _server is None and not _unavailable:
+        with _server_lock:
+            if _server is None and not _unavailable:
+                try:
+                    from .. import native
+                    _server = MD5Server(native.load_native())
+                except Exception:  # noqa: BLE001 — no toolchain
+                    _unavailable = True
+    return _server
+
+
+def shutdown_server() -> None:
+    global _server
+    with _server_lock:
+        srv, _server = _server, None
+    if srv is not None:
+        srv.stop()

@@ -1,0 +1,95 @@
+"""Multi-lane MD5 hash server (utils/md5simd.py) — the md5-simd analogue
+feeding the PutObject ETag path (reference pkg/hash/reader.go:62 + its
+md5-simd dependency)."""
+import hashlib
+import threading
+
+import numpy as np
+import pytest
+
+from minio_tpu.utils import md5simd
+
+
+@pytest.fixture(scope="module")
+def srv():
+    s = md5simd.global_server()
+    if s is None:
+        pytest.skip("native library unavailable")
+    return s
+
+
+def test_matches_hashlib_odd_boundaries(srv):
+    rng = np.random.default_rng(5)
+    cases = [
+        [b""],
+        [b"a"],
+        [b"x" * 64],
+        [b"x" * 55, b"y" * 9, b"z" * 130],
+        [b"q" * 63, b"r" * 65],
+        [rng.integers(0, 256, n, dtype=np.uint8).tobytes()
+         for n in (1, 63, 64, 65, 1000, 100000, 1 << 20)],
+    ]
+    for chunks in cases:
+        s = srv.stream()
+        ref = hashlib.md5()
+        for c in chunks:
+            s.update(c)
+            ref.update(c)
+        assert s.hexdigest() == ref.hexdigest()
+
+
+def test_concurrent_streams_lane_parallel(srv):
+    rng = np.random.default_rng(6)
+    data = rng.integers(0, 256, 4 << 20, dtype=np.uint8).tobytes()
+    want = hashlib.md5(data).hexdigest()
+    outs = {}
+
+    def one(j):
+        s = srv.stream()
+        for off in range(0, len(data), 1 << 18):
+            s.update(data[off:off + (1 << 18)])
+        outs[j] = s.hexdigest()
+
+    ths = [threading.Thread(target=one, args=(j,)) for j in range(9)]
+    for t in ths:
+        t.start()
+    for t in ths:
+        t.join()
+    assert outs == {j: want for j in range(9)}
+
+
+def test_update_after_digest_rejected(srv):
+    s = srv.stream()
+    s.update(b"abc")
+    assert s.hexdigest() == hashlib.md5(b"abc").hexdigest()
+    with pytest.raises(ValueError):
+        s.update(b"more")
+
+
+def test_backpressure_bounds_queue(srv):
+    s = srv.stream()
+    big = b"\x00" * (1 << 20)
+    for _ in range(64):  # 64 MiB through an 8 MiB queue cap
+        s.update(big)
+        assert s._qbytes <= md5simd.MD5Stream.MAX_QUEUED + len(big)
+    assert s.hexdigest() == hashlib.md5(big * 64).hexdigest()
+
+
+def test_hashreader_uses_lane_server_for_large_bodies(srv):
+    import io
+
+    from minio_tpu.utils.hashreader import HashReader
+    from minio_tpu.utils.md5simd import MD5Stream
+    body = b"\x37" * (8 << 20)
+    hr = HashReader(io.BytesIO(body), len(body))
+    assert isinstance(hr._md5, MD5Stream)
+    while hr.read(1 << 20):
+        pass
+    assert hr.etag() == hashlib.md5(body).hexdigest()
+    # sha256 requirement keeps the hashlib path (server is md5-only)
+    hr2 = HashReader(io.BytesIO(body), len(body),
+                     sha256_hex=hashlib.sha256(body).hexdigest())
+    assert not isinstance(hr2._md5, MD5Stream)
+    while hr2.read(1 << 20):
+        pass
+    assert hr2.etag() == hashlib.md5(body).hexdigest()
