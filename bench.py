@@ -143,9 +143,9 @@ def device_configs(rng) -> dict:
     from minio_tpu.ops import hh_jax, mur3_jax
     C = 16384
     nc = shard // C
+    rec_masks_np = codec.target_masks_np(present, (2, 9))  # [8, o=2, K]
     rec_masks_b = jnp.asarray(np.broadcast_to(
-        codec.target_masks_np(present, (2, 9)),
-        (B, 8, M, K)))
+        rec_masks_np, (B,) + rec_masks_np.shape))
     for algo_name, algo_id, batch_hash, key_fn in (
             ("mur3", 1, mur3py.hash256_batch, mur3_jax._key_words),
             ("hh", 0, hhn.hash256_batch, hh_jax._key_words)):

@@ -181,18 +181,19 @@ class ReedSolomon:
 
     def target_masks_np(self, present: tuple[int, ...],
                         targets: tuple[int, ...]) -> np.ndarray:
-        """Host-side uint32 [8, m, k] masks for rebuilding ``targets`` from
-        ``present`` — zero-padded to m rows so every loss pattern shares one
-        batch shape (the dispatch queue's 'masked' op). Cached per pattern."""
+        """Host-side uint32 [8, o, k] masks (o = len(targets)) for
+        rebuilding ``targets`` from ``present``. Rows are exact, not
+        padded to m: the dispatch queue keys batches by o, and through a
+        thin host<->device link the padded rows' readback was pure waste
+        (2x the downlink bytes for the common 1-2-loss rebuild on the
+        measured 0.02 GiB/s tunnel downlink). Cached per pattern."""
         if len(targets) > self.m:
             raise ValueError(
                 f"{len(targets)} targets > parity {self.m}: unrecoverable")
         key = ("np-tgt", present, targets)
         masks = self._np_mask_cache.get(key)
         if masks is None:
-            rows = np.zeros((self.m, self.k), dtype=np.uint8)
-            rows[: len(targets)] = self.rebuild_rows(present, targets)
-            masks = gf256.coeff_masks(rows)
+            masks = gf256.coeff_masks(self.rebuild_rows(present, targets))
             self._np_mask_cache[key] = masks
         return masks
 

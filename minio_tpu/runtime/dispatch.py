@@ -111,15 +111,25 @@ class LinkProfile:
                  rt * 1e3, up, down, cpu)
         return prof
 
+    def device_flush_s(self, bytes_in: int, bytes_out: int,
+                       kernel_s: float = 2e-3) -> float:
+        """Predicted wall seconds for one device flush (link + kernel)."""
+        return self.rt_s + bytes_in / self.up_gibs / (1 << 30) \
+            + bytes_out / self.down_gibs / (1 << 30) + kernel_s
+
     def device_wins(self, bytes_in: int, bytes_out: int, n_items: int = 1,
                     cpu_workers: int = COMPLETERS,
-                    kernel_s: float = 2e-3) -> bool:
-        """Predicted device time vs CPU time for one flush. The CPU route
-        runs per-item on ``cpu_workers`` completer threads (the native
-        kernel releases the GIL), so its wall time divides by the effective
-        parallelism — the model must agree with the executor it models."""
-        t_dev = self.rt_s + bytes_in / self.up_gibs / (1 << 30) \
-            + bytes_out / self.down_gibs / (1 << 30) + kernel_s
+                    kernel_s: float = 2e-3, backlog_s: float = 0.0) -> bool:
+        """Predicted device time vs CPU time for one flush. The device
+        route pays the current queue of already-dispatched flushes
+        (``backlog_s``) before its own transfer — routing on one flush's
+        cost alone let a saturated link build an unbounded queue (r03:
+        12.5 s p99 at conc 128). The CPU route runs per-item on
+        ``cpu_workers`` completer threads (the native kernel releases the
+        GIL), so its wall time divides by the effective parallelism — the
+        model must agree with the executor it models."""
+        t_dev = backlog_s + self.device_flush_s(bytes_in, bytes_out,
+                                                kernel_s)
         par = max(1, min(n_items, cpu_workers))
         t_cpu = (bytes_in + bytes_out) / self.cpu_gibs / (1 << 30) / par
         return t_dev < t_cpu
@@ -177,6 +187,12 @@ class DispatchQueue:
         self.batches = 0
         self.items = 0
         self.cpu_batches = 0
+        # predicted drain deadline for device flushes already dispatched
+        # and their in-flight count (under _profile_lock); the estimate
+        # self-corrects — when the last in-flight flush completes early
+        # the deadline resets to now
+        self._dev_busy_until = 0.0
+        self._dev_inflight = 0
         # warm the profile off the request path: in auto mode the first
         # flush would otherwise absorb the full probe cost (device
         # transfers + 8 CPU encodes) inside its latency
@@ -196,8 +212,9 @@ class DispatchQueue:
 
         Per-element masks let one batch mix arbitrary loss patterns — the
         same launch serves degraded reads and multi-object heal (BASELINE
-        configs 3/5). o is fixed at codec.m (rows zero-padded) so all
-        patterns share one compiled shape."""
+        configs 3/5). Batches are keyed by o (= rows per element), so
+        same-loss-count patterns share a compiled shape and no padded
+        rows ride the link."""
         key = ("masked", codec.k, masks.shape[1], words.shape[-1])
         return self._submit(key, codec, "masked", words, masks)
 
@@ -323,6 +340,17 @@ class DispatchQueue:
             self._kick_probe()
         return prof
 
+    def _flush_bytes(self, b: _Bucket, items: list[_Pending]
+                     ) -> tuple[int, int]:
+        n = len(items)
+        w = items[0].words
+        bytes_in = n * w.nbytes
+        out_rows = b.codec.m
+        if items[0].masks is not None:
+            out_rows = items[0].masks.shape[1]
+            bytes_in += n * items[0].masks.nbytes
+        return bytes_in, n * out_rows * w.shape[-1] * 4
+
     def _route(self, b: _Bucket, items: list[_Pending]) -> str:
         mode = os.environ.get("MINIO_TPU_DISPATCH_MODE", "auto")
         if mode in ("device", "cpu"):
@@ -332,16 +360,11 @@ class DispatchQueue:
             # probe still in flight (or failed): CPU is the safe default —
             # it always works and single-flush latency never eats a probe
             return "cpu"
-        n = len(items)
-        w = items[0].words
-        bytes_in = n * w.nbytes
-        out_rows = b.codec.m
-        if items[0].masks is not None:
-            out_rows = items[0].masks.shape[1]
-            bytes_in += n * items[0].masks.nbytes
-        bytes_out = n * out_rows * w.shape[-1] * 4
+        bytes_in, bytes_out = self._flush_bytes(b, items)
+        backlog = max(0.0, self._dev_busy_until - time.monotonic())
         return "device" if prof.device_wins(
-            bytes_in, bytes_out, n, self.completer_count) else "cpu"
+            bytes_in, bytes_out, len(items), self.completer_count,
+            backlog_s=backlog) else "cpu"
 
     @staticmethod
     def _rows_from_masks(masks: np.ndarray) -> np.ndarray:
@@ -463,10 +486,30 @@ class DispatchQueue:
                 fn = sharded_batched(inner, mesh, (True, True, True),
                                      out_batch=2)
                 out_dev = fn(masks, stack, digs)
+        # queue model: extend the predicted drain deadline by this
+        # flush's link+kernel estimate so _route sees the backlog
+        prof = self._profile
+        if prof is not None:
+            bytes_in, bytes_out = self._flush_bytes(b, items)
+            now = time.monotonic()
+            with self._profile_lock:
+                self._dev_inflight += 1
+                self._dev_busy_until = max(self._dev_busy_until, now) + \
+                    prof.device_flush_s(bytes_in, bytes_out)
         # hand host readback to a completer so the next batch launches now
         self._completers.submit(self._complete, b, out_dev, items)
 
     def _complete(self, b: _Bucket, out_dev, items: list[_Pending]):
+        try:
+            self._finish_readback(b, out_dev, items)
+        finally:
+            with self._profile_lock:
+                self._dev_inflight = max(0, self._dev_inflight - 1)
+                if self._dev_inflight == 0:
+                    # drained ahead of (or behind) the model: resync
+                    self._dev_busy_until = time.monotonic()
+
+    def _finish_readback(self, b: _Bucket, out_dev, items: list[_Pending]):
         try:
             if b.op == "fused":
                 out = np.asarray(out_dev[0])
