@@ -27,6 +27,10 @@ from .utils.hashreader import HashReader, etag_from_parts
 class FSObjects(ObjectLayer):
     def __init__(self, base_dir: str):
         self.disk = XLStorage(base_dir, endpoint=f"fs://{base_dir}")
+        from .objectlayer.metacache import MetacacheStore
+        # single-disk store: the borrowed erasure listing path serves
+        # from / builds persisted caches here too
+        self.metacache = MetacacheStore(self)
 
     def backend_type(self) -> str:
         return "FS"
@@ -66,6 +70,7 @@ class FSObjects(ObjectLayer):
         self.get_bucket_info(bucket)
         from .scanner.tracker import global_tracker
         global_tracker().mark(bucket, object)
+        self.metacache.on_write(bucket)
         hr = stream if isinstance(stream, HashReader) else \
             HashReader(stream, size)
         data_dir = str(uuid.uuid4())
@@ -120,6 +125,7 @@ class FSObjects(ObjectLayer):
             writer.close()
             self.disk.rename_data(META_TMP, tmp_path.split("/")[0], fi,
                                   bucket, object)
+        self.metacache.on_write(bucket)  # post-commit: closes build races
         return ObjectInfo.from_file_info(fi, bucket, object, opts.versioned)
 
     def _fi(self, bucket, object, opts) -> FileInfo:
@@ -180,6 +186,7 @@ class FSObjects(ObjectLayer):
         self.get_bucket_info(bucket)
         from .scanner.tracker import global_tracker
         global_tracker().mark(bucket, object)
+        self.metacache.on_write(bucket)
         vid = "" if opts.version_id in ("", "null") else opts.version_id
         if opts.versioned and not opts.version_id:
             fi = FileInfo(volume=bucket, name=object,
@@ -194,6 +201,7 @@ class FSObjects(ObjectLayer):
             pass
         except errors.FileVersionNotFound:
             raise dt.VersionNotFound(bucket, object) from None
+        self.metacache.on_write(bucket)  # post-commit: closes build races
         return ObjectInfo(bucket=bucket, name=object,
                           version_id=fi.version_id if opts.versioned else "",
                           delete_marker=fi.deleted, mod_time=fi.mod_time)

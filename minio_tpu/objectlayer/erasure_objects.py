@@ -102,6 +102,9 @@ class ErasureObjects(MultipartMixin, ObjectLayer):
         #: namespace lock map (dist.dsync.NSLockMap) — None in library use;
         #: the Node wires the cluster lockers in distributed mode
         self.ns_lock = None
+        from .metacache import MetacacheStore
+        #: persisted-listing coordinator (reference cmd/metacache.go:42)
+        self.metacache = MetacacheStore(self)
 
     def _locked(self, bucket: str, object: str, write: bool = True):
         """Context manager taking the namespace lock if configured
@@ -220,6 +223,7 @@ class ErasureObjects(MultipartMixin, ObjectLayer):
             write_quorum)
         if err is not None:
             raise to_object_err(err, bucket)
+        self.metacache.on_write(bucket)
 
     # --- put ---------------------------------------------------------------
 
@@ -350,6 +354,7 @@ class ErasureObjects(MultipartMixin, ObjectLayer):
             self._notify_partial(bucket, object, fi.version_id)
         from ..scanner.tracker import global_tracker
         global_tracker().mark(bucket, object)
+        self.metacache.on_write(bucket)
         oi = ObjectInfo.from_file_info(fi, bucket, object, opts.versioned)
         return oi
 
@@ -518,6 +523,7 @@ class ErasureObjects(MultipartMixin, ObjectLayer):
         self.get_bucket_info(bucket)
         from ..scanner.tracker import global_tracker
         global_tracker().mark(bucket, object)
+        self.metacache.on_write(bucket)
         disks = self.disks
         write_quorum = len(disks) // 2 + 1
 
@@ -559,6 +565,10 @@ class ErasureObjects(MultipartMixin, ObjectLayer):
         if any(isinstance(e, (errors.DiskNotFound, errors.FaultyDisk))
                for e in errs):
             self._notify_partial(bucket, object, fi.version_id)
+        # second bump AFTER the mutation landed: a cache build that
+        # started between the pre-bump and the quorum delete would have
+        # captured the old namespace under the new sequence
+        self.metacache.on_write(bucket)
         return ObjectInfo(bucket=bucket, name=object,
                           version_id=fi.version_id if opts.versioned else "",
                           delete_marker=fi.deleted, mod_time=fi.mod_time)
@@ -595,17 +605,22 @@ class ErasureObjects(MultipartMixin, ObjectLayer):
     # --- list --------------------------------------------------------------
 
     def _iter_resolved(self, bucket: str, prefix: str = "",
-                       marker: str = ""):
-        """Stream (name, XLMeta) pairs from the metacache merge — O(page)
-        metadata touched per page consumed (replaces the full-namespace
-        _walk_merged + per-key quorum fan-out the round-2 review flagged).
-        """
-        from .metacache import merged_entries
-        for entry in merged_entries(self.disks, bucket, prefix, marker):
-            meta = entry.resolve()
-            if meta is None or not meta.versions:
+                       marker: str = "", build: bool = True):
+        """Stream (name, XLMeta) pairs through the metacache store:
+        served from persisted listing blocks when a usable cache exists
+        (this node's or a peer's), walking + building the cache
+        otherwise — O(page) metadata touched per page consumed either
+        way."""
+        from ..storage.xlmeta import XLMeta
+        for name, raw in self.metacache.iter_entries(bucket, prefix,
+                                                     marker, build):
+            try:
+                meta = XLMeta.load(raw)
+            except errors.FileCorrupt:
                 continue
-            yield entry.name, meta
+            if not meta.versions:
+                continue
+            yield name, meta
 
     def iter_objects(self, bucket: str, prefix: str = "") -> "Iterator":
         """Streaming iterator of latest-version ObjectInfo for background
@@ -640,8 +655,9 @@ class ErasureObjects(MultipartMixin, ObjectLayer):
             done = False
             while not done:
                 done = True
-                for name, meta in self._iter_resolved(bucket, prefix,
-                                                      walk_from):
+                for name, meta in self._iter_resolved(
+                        bucket, prefix, walk_from,
+                        build=not delimiter):
                     if delimiter:
                         rest = name[len(prefix):]
                         if delimiter in rest:
@@ -691,7 +707,8 @@ class ErasureObjects(MultipartMixin, ObjectLayer):
         walk_marker = ""
         if marker:
             walk_marker = marker[:-1] if version_marker else marker
-        for name, meta in self._iter_resolved(bucket, prefix, walk_marker):
+        for name, meta in self._iter_resolved(bucket, prefix, walk_marker,
+                                              build=not delimiter):
             if marker and name < marker:
                 continue
             if marker and name == marker and not version_marker:
@@ -797,7 +814,10 @@ class ErasureObjects(MultipartMixin, ObjectLayer):
                     d.update_metadata(bucket, object, fid)
                 except errors.StorageError:
                     pass
-            return fi
+        # after the journals landed: listings must not serve a cache
+        # built against the pre-rewrite metadata
+        self.metacache.on_write(bucket)
+        return fi
 
     def update_object_meta(self, bucket: str, object: str, updates: dict,
                            opts: ObjectOptions = None) -> None:
@@ -932,6 +952,20 @@ class ErasureObjects(MultipartMixin, ObjectLayer):
         """Heal one object version (reference healObject,
         cmd/erasure-healing.go:233): classify per-disk state, rebuild missing
         /corrupt shards via decode→encode, rewrite xl.meta on healed disks."""
+        try:
+            return self._heal_object_inner(bucket, object, version_id,
+                                           dry_run, remove_dangling,
+                                           scan_mode)
+        finally:
+            if not dry_run:
+                # healed journals change quorum resolution; listings must
+                # not serve a cache built before (or during) the repair
+                self.metacache.on_write(bucket)
+
+    def _heal_object_inner(self, bucket: str, object: str,
+                           version_id: str = "", dry_run: bool = False,
+                           remove_dangling: bool = False,
+                           scan_mode: str = "normal") -> HealResultItem:
         from ..obs import metrics as mx
         mx.inc("minio_tpu_heal_objects_total",
                mode=scan_mode, dry=str(dry_run).lower())

@@ -366,6 +366,7 @@ class MultipartMixin:
                 pass
         from ..scanner.tracker import global_tracker
         global_tracker().mark(bucket, object)
+        self.metacache.on_write(bucket)
         return ObjectInfo.from_file_info(fi, bucket, object, opts.versioned)
 
     def _commit_one_disk(self, d, upath: str, tmp_id: str, fi: FileInfo,

@@ -489,7 +489,8 @@ class DispatchQueue:
         # queue model: extend the predicted drain deadline by this
         # flush's link+kernel estimate so _route sees the backlog
         prof = self._profile
-        if prof is not None:
+        accounted = prof is not None
+        if accounted:
             bytes_in, bytes_out = self._flush_bytes(b, items)
             now = time.monotonic()
             with self._profile_lock:
@@ -497,17 +498,20 @@ class DispatchQueue:
                 self._dev_busy_until = max(self._dev_busy_until, now) + \
                     prof.device_flush_s(bytes_in, bytes_out)
         # hand host readback to a completer so the next batch launches now
-        self._completers.submit(self._complete, b, out_dev, items)
+        self._completers.submit(self._complete, b, out_dev, items,
+                                accounted)
 
-    def _complete(self, b: _Bucket, out_dev, items: list[_Pending]):
+    def _complete(self, b: _Bucket, out_dev, items: list[_Pending],
+                  accounted: bool = True):
         try:
             self._finish_readback(b, out_dev, items)
         finally:
-            with self._profile_lock:
-                self._dev_inflight = max(0, self._dev_inflight - 1)
-                if self._dev_inflight == 0:
-                    # drained ahead of (or behind) the model: resync
-                    self._dev_busy_until = time.monotonic()
+            if accounted:  # pairs with _flush_device's increment
+                with self._profile_lock:
+                    self._dev_inflight = max(0, self._dev_inflight - 1)
+                    if self._dev_inflight == 0:
+                        # drained ahead of (or behind) the model: resync
+                        self._dev_busy_until = time.monotonic()
 
     def _finish_readback(self, b: _Bucket, out_dev, items: list[_Pending]):
         try:

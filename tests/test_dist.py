@@ -334,3 +334,54 @@ def test_bucket_metadata_propagation(cluster):
     c0.request("PUT", "/metab", query={"versioning": ""}, body=body)
     # node1's cache was invalidated via peer RPC; it reads the new config
     assert n1.bucket_meta.versioning_enabled("metab")
+
+
+def test_metacache_cluster_reuse(cluster, monkeypatch):
+    """Node B serves a listing from the metacache blocks node A's walk
+    persisted on the shared disks — no namespace walk on B (reference
+    cluster-shared metacache streams, cmd/metacache-server-pool.go:59)."""
+    n0, n1 = cluster
+    c0 = S3Client(f"http://127.0.0.1:{n0.server.port}", AK, SK)
+    c1 = S3Client(f"http://127.0.0.1:{n1.server.port}", AK, SK)
+    assert c0.request("PUT", "/mcbucket").status_code == 200
+    data = rng_bytes(256)
+    for i in range(25):
+        assert c0.put_object("mcbucket", f"k{i:03d}", data).status_code \
+            == 200
+    # node A lists (recursive) -> becomes the builder
+    r = c0.request("GET", "/mcbucket", query={"list-type": "2"})
+    assert r.status_code == 200
+    # wait for every set's build on node A to finish
+    from minio_tpu.objectlayer.erasure_objects import ErasureObjects
+
+    def each_set(node):
+        obj = node.obj
+        pools = getattr(obj, "pools", [obj])
+        for p in pools:
+            sets = getattr(p, "sets", [p])
+            for s in sets:
+                if isinstance(s, ErasureObjects):
+                    yield s
+    deadline = time.time() + 15
+    while time.time() < deadline:
+        states = [st for s in each_set(n0)
+                  for st in s.metacache._states.values()]
+        if states and all(st.ended and st.error is None for st in states):
+            break
+        time.sleep(0.05)
+    assert states and all(st.ended for st in states)
+    # node B lists: must come from blocks, not a walk
+    from minio_tpu.objectlayer import metacache as mc
+    walked = {"n": 0}
+    real = mc.merged_entries
+
+    def counting(disks, bucket, *a, **kw):
+        if bucket == "mcbucket":
+            walked["n"] += 1
+        return real(disks, bucket, *a, **kw)
+
+    monkeypatch.setattr(mc, "merged_entries", counting)
+    r1 = c1.request("GET", "/mcbucket", query={"list-type": "2"})
+    assert r1.status_code == 200
+    assert all(f"k{i:03d}" in r1.text for i in range(25))
+    assert walked["n"] == 0, "node B walked despite node A's cache"

@@ -1,0 +1,170 @@
+"""Persisted metacache listing blocks (objectlayer/metacache.py
+MetacacheStore; reference cmd/metacache.go:42, cmd/metacache-stream.go:79).
+"""
+import io
+import os
+
+import pytest
+
+from minio_tpu.objectlayer import ErasureObjects
+from minio_tpu.objectlayer import metacache as mc
+from minio_tpu.storage import XLStorage
+
+
+def make_layer(tmp_path, n=4, parity=1):
+    disks = [XLStorage(os.path.join(tmp_path, f"d{i}")) for i in range(n)]
+    return ErasureObjects(disks, default_parity=parity), disks
+
+
+def fill(ol, bucket, n, prefix="o"):
+    ol.make_bucket(bucket)
+    for i in range(n):
+        ol.put_object(bucket, f"{prefix}{i:05d}", io.BytesIO(b"x" * 64), 64)
+
+
+def wait_built(store, bucket, prefix="", timeout=10.0):
+    import time
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < timeout:
+        st = store._states.get((bucket, prefix))
+        if st is not None and st.ended:
+            assert st.error is None, st.error
+            return st
+        time.sleep(0.02)
+    raise AssertionError("cache build did not finish")
+
+
+def count_walks(monkeypatch):
+    """Patch merged_entries to count walk starts."""
+    calls = {"n": 0}
+    orig = mc.merged_entries
+
+    def counting(*a, **kw):
+        calls["n"] += 1
+        return orig(*a, **kw)
+
+    monkeypatch.setattr(mc, "merged_entries", counting)
+    return calls
+
+
+def test_second_list_serves_from_cache(tmp_path, monkeypatch):
+    ol, _ = make_layer(str(tmp_path))
+    fill(ol, "b", 120)
+    calls = count_walks(monkeypatch)
+    r1 = ol.list_objects("b", max_keys=50)
+    assert len(r1.objects) == 50 and r1.is_truncated
+    wait_built(ol.metacache, "b")
+    walks_after_first = calls["n"]
+    assert walks_after_first >= 1
+    # every subsequent page including a full relist comes from blocks
+    r2 = ol.list_objects("b", marker=r1.next_marker, max_keys=1000)
+    assert len(r2.objects) == 70
+    r3 = ol.list_objects("b", max_keys=1000)
+    assert [o.name for o in r3.objects] == \
+        [f"o{i:05d}" for i in range(120)]
+    assert calls["n"] == walks_after_first, "list re-walked despite cache"
+    assert ol.metacache.serves_cached >= 2
+
+
+def test_blocks_persist_and_serve_other_instance(tmp_path, monkeypatch):
+    """A second ObjectLayer over the same disks (a 'peer node') must list
+    from the finished cache without walking — the cluster-reuse property."""
+    ol, _ = make_layer(str(tmp_path))
+    n = mc.BLOCK_SIZE + 37  # force multiple blocks
+    fill(ol, "b", n)
+    ol.list_objects("b", max_keys=1)
+    wait_built(ol.metacache, "b")
+
+    ol2, _ = make_layer(str(tmp_path))
+    calls = count_walks(monkeypatch)
+    r = ol2.list_objects("b", max_keys=1000)
+    assert len(r.objects) == 1000
+    assert calls["n"] == 0, "peer walked despite finished cache"
+    # and paging via marker stays cache-served
+    r2 = ol2.list_objects("b", marker=r.next_marker, max_keys=5000)
+    assert len(r2.objects) == n - 1000
+    assert calls["n"] == 0
+
+
+def test_write_invalidates_local_cache(tmp_path):
+    ol, _ = make_layer(str(tmp_path))
+    fill(ol, "b", 30)
+    ol.list_objects("b")
+    wait_built(ol.metacache, "b")
+    ol.put_object("b", "zzz-new", io.BytesIO(b"y"), 1)
+    r = ol.list_objects("b", max_keys=100)
+    assert "zzz-new" in [o.name for o in r.objects]
+    ol.delete_object("b", "o00005")
+    names = [o.name for o in ol.list_objects("b", max_keys=100).objects]
+    assert "o00005" not in names
+
+
+def test_cache_survives_block_loss_by_falling_back(tmp_path):
+    ol, disks = make_layer(str(tmp_path))
+    n = mc.BLOCK_SIZE + 10
+    fill(ol, "b", n)
+    ol.list_objects("b", max_keys=1)
+    st = wait_built(ol.metacache, "b")
+    # destroy every replica of every block
+    cdir = mc._cache_dir("b", "")
+    for d in disks:
+        try:
+            d.delete_path(mc.META_BUCKET, cdir, recursive=True)
+        except Exception:  # noqa: BLE001
+            pass
+    r = ol.list_objects("b", max_keys=2000)
+    assert len(r.objects) == 2000  # transparent walk fallback
+    assert st is not None
+
+
+def test_ttl_expiry_forces_rebuild(tmp_path, monkeypatch):
+    ol, _ = make_layer(str(tmp_path))
+    fill(ol, "b", 10)
+    ol.list_objects("b")
+    st = wait_built(ol.metacache, "b")
+    monkeypatch.setattr(mc, "CACHE_TTL_S", 0.0)
+    assert not st.usable(ol.metacache._seq("b"))
+    r = ol.list_objects("b")
+    assert len(r.objects) == 10
+
+
+def test_prefix_scoped_cache(tmp_path, monkeypatch):
+    ol, _ = make_layer(str(tmp_path))
+    ol.make_bucket("b")
+    for i in range(20):
+        ol.put_object("b", f"a/{i:03d}", io.BytesIO(b"x"), 1)
+        ol.put_object("b", f"z/{i:03d}", io.BytesIO(b"x"), 1)
+    r = ol.list_objects("b", prefix="a/", max_keys=5)
+    assert [o.name for o in r.objects] == [f"a/{i:03d}" for i in range(5)]
+    wait_built(ol.metacache, "b", "a/")
+    calls = count_walks(monkeypatch)
+    r2 = ol.list_objects("b", prefix="a/", max_keys=100)
+    assert len(r2.objects) == 20
+    assert calls["n"] == 0
+
+
+def test_delimiter_listing_through_cache(tmp_path):
+    ol, _ = make_layer(str(tmp_path))
+    ol.make_bucket("b")
+    for d in ("x", "y"):
+        for i in range(5):
+            ol.put_object("b", f"{d}/{i}", io.BytesIO(b"x"), 1)
+    ol.put_object("b", "top", io.BytesIO(b"x"), 1)
+    r1 = ol.list_objects("b", delimiter="/")
+    assert r1.prefixes == ["x/", "y/"]
+    assert [o.name for o in r1.objects] == ["top"]
+    # delimiter pages never start a build (O(page) guarantee)...
+    assert ("b", "") not in ol.metacache._states
+    # ...but serve from a cache built by a recursive listing
+    ol.list_objects("b")
+    wait_built(ol.metacache, "b")
+    r2 = ol.list_objects("b", delimiter="/")
+    assert r2.prefixes == r1.prefixes
+    assert [o.name for o in r2.objects] == ["top"]
+
+
+def test_system_bucket_never_cached(tmp_path):
+    ol, _ = make_layer(str(tmp_path))
+    fill(ol, "b", 3)
+    list(ol._iter_resolved(mc.META_BUCKET, "buckets/"))
+    assert (mc.META_BUCKET, "buckets/") not in ol.metacache._states
