@@ -1,14 +1,22 @@
-"""Bucket event notification (reference pkg/event, 8k LoC: 11 target
-types + persistent queue store + ARN routing; here the load-bearing core:
-S3-shaped event records, notification-rule matching, a webhook target, and
-a crash-safe on-disk delivery queue with retry)."""
-from .notifier import EventNotifier, targets_from_env
+"""Bucket event notification (reference pkg/event: 11 target types +
+persistent queue store + ARN routing). Here: S3-shaped event records,
+notification-rule matching, a crash-safe on-disk delivery queue with
+retry, and eight target kinds — webhook, kafka, amqp, mqtt, redis,
+elasticsearch, nats, nsq — the broker-backed ones speaking minimal
+native wire protocols (event/wire.py) instead of vendor SDKs."""
+from .notifier import (EventNotifier, targets_from_config,
+                       targets_from_env)
 from .queuestore import QueueStore
 from .record import new_event_record
 from .rules import NotificationRules, parse_notification_xml
-from .targets import WebhookTarget
+from .targets import (AMQPTarget, ElasticsearchTarget, KafkaTarget,
+                      MQTTTarget, NATSTarget, NSQTarget, RedisTarget,
+                      WebhookTarget)
 
 __all__ = [
-    "EventNotifier", "targets_from_env", "QueueStore", "new_event_record",
-    "NotificationRules", "parse_notification_xml", "WebhookTarget",
+    "EventNotifier", "targets_from_env", "targets_from_config",
+    "QueueStore", "new_event_record", "NotificationRules",
+    "parse_notification_xml", "WebhookTarget", "KafkaTarget",
+    "AMQPTarget", "MQTTTarget", "RedisTarget", "ElasticsearchTarget",
+    "NATSTarget", "NSQTarget",
 ]

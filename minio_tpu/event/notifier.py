@@ -32,6 +32,78 @@ def targets_from_env(region: str = "us-east-1") -> list[WebhookTarget]:
     return out
 
 
+def targets_from_config(cfg, region: str = "us-east-1") -> list:
+    """Build every enabled broker-backed target from the config KVS
+    (subsystems notify_kafka/_amqp/_mqtt/_redis/_elasticsearch/_nats/
+    _nsq, env > stored > default per key). Bad configs are skipped with a
+    log line rather than failing server start (the reference validates at
+    set-time; we also tolerate stored configs going stale)."""
+    from . import targets as T
+    out: list = []
+
+    def on(subsys):
+        return cfg.get(subsys, "enable").lower() in ("on", "1", "true")
+
+    # (subsystem, required-endpoint key): enable=on with an empty
+    # endpoint must be SKIPPED, not built — the wire clients connect
+    # lazily, so an empty host would silently resolve to localhost and
+    # retry against whatever listens there
+    required = {
+        "notify_kafka": "brokers", "notify_amqp": "url",
+        "notify_mqtt": "broker", "notify_redis": "address",
+        "notify_elasticsearch": "url", "notify_nats": "address",
+        "notify_nsq": "nsqd_address",
+    }
+    builders = [
+        ("notify_kafka", lambda: T.KafkaTarget(
+            "1", cfg.get("notify_kafka", "brokers").split(",")[0],
+            cfg.get("notify_kafka", "topic"), region)),
+        ("notify_amqp", lambda: T.AMQPTarget(
+            "1", cfg.get("notify_amqp", "url"),
+            cfg.get("notify_amqp", "exchange"),
+            cfg.get("notify_amqp", "routing_key"), region)),
+        ("notify_mqtt", lambda: T.MQTTTarget(
+            "1", cfg.get("notify_mqtt", "broker"),
+            cfg.get("notify_mqtt", "topic"),
+            cfg.get("notify_mqtt", "username"),
+            cfg.get("notify_mqtt", "password"),
+            int(cfg.get("notify_mqtt", "qos") or 1), region)),
+        ("notify_redis", lambda: T.RedisTarget(
+            "1", cfg.get("notify_redis", "address"),
+            cfg.get("notify_redis", "key"),
+            cfg.get("notify_redis", "password"),
+            cfg.get("notify_redis", "format"), region)),
+        ("notify_elasticsearch", lambda: T.ElasticsearchTarget(
+            "1", cfg.get("notify_elasticsearch", "url"),
+            cfg.get("notify_elasticsearch", "index"),
+            cfg.get("notify_elasticsearch", "format"),
+            cfg.get("notify_elasticsearch", "username"),
+            cfg.get("notify_elasticsearch", "password"), region)),
+        ("notify_nats", lambda: T.NATSTarget(
+            "1", cfg.get("notify_nats", "address"),
+            cfg.get("notify_nats", "subject"),
+            cfg.get("notify_nats", "username"),
+            cfg.get("notify_nats", "password"),
+            cfg.get("notify_nats", "token"), region)),
+        ("notify_nsq", lambda: T.NSQTarget(
+            "1", cfg.get("notify_nsq", "nsqd_address"),
+            cfg.get("notify_nsq", "topic"), region)),
+    ]
+    for subsys, build in builders:
+        try:
+            if not on(subsys):
+                continue
+            if not cfg.get(subsys, required[subsys]).strip():
+                log.warning("%s enabled but %s is empty; skipping",
+                            subsys, required[subsys])
+                continue
+            out.append(build())
+        except Exception:  # noqa: BLE001 — bad target config: skip it
+            log.warning("skipping misconfigured %s target", subsys,
+                        exc_info=True)
+    return out
+
+
 class EventNotifier:
     def __init__(self, bucket_meta, targets: list, queue_root: str,
                  region: str = "us-east-1", queue_limit: int = 10000):

@@ -381,6 +381,7 @@ class FSObjects(ObjectLayer):
             self.disk.delete_path(META_MULTIPART, upath, recursive=True)
         except errors.StorageError:
             pass
+        self.metacache.on_write(bucket)  # post-commit: closes build races
         return ObjectInfo.from_file_info(fi, bucket, object, opts.versioned)
 
     # --- object tags --------------------------------------------------------

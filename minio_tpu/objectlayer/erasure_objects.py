@@ -612,12 +612,13 @@ class ErasureObjects(MultipartMixin, ObjectLayer):
         otherwise — O(page) metadata touched per page consumed either
         way."""
         from ..storage.xlmeta import XLMeta
-        for name, raw in self.metacache.iter_entries(bucket, prefix,
-                                                     marker, build):
-            try:
-                meta = XLMeta.load(raw)
-            except errors.FileCorrupt:
-                continue
+        for name, raw, meta in self.metacache.iter_entries(bucket, prefix,
+                                                           marker, build):
+            if meta is None:  # block-served: parse the stored journal
+                try:
+                    meta = XLMeta.load(raw)
+                except errors.FileCorrupt:
+                    continue
             if not meta.versions:
                 continue
             yield name, meta

@@ -154,6 +154,9 @@ BLOCK_SIZE = 5000
 CACHE_TTL_S = 60.0
 #: Replicas per block — cache loss is only a walk, not data loss.
 BLOCK_COPIES = 2
+#: How long a failed manifest probe suppresses re-probing (bounds the
+#: per-restart disk fan-out of uncached delimiter pages).
+NEG_MANIFEST_TTL_S = 5.0
 
 from ..storage.xlstorage import META_BUCKET  # noqa: E402
 
@@ -259,6 +262,10 @@ class MetacacheStore:
         self._states: dict[tuple[str, str], _CacheState] = {}
         self._seqs: dict[str, int] = {}  # bucket -> local write sequence
         self._dirty_at: dict[str, float] = {}  # bucket -> last write time
+        # negative manifest-probe memo: (bucket, prefix) -> probe time.
+        # Without it, every collapsed-subtree restart of a delimiter page
+        # fans a failing read_all to all live disks.
+        self._no_manifest: dict[tuple[str, str], float] = {}
         self._builders = ThreadPoolExecutor(
             max_workers=2, thread_name_prefix="minio-tpu-metacache")
         self._building = 0
@@ -423,9 +430,14 @@ class MetacacheStore:
     # --- serve -----------------------------------------------------------
 
     def iter_entries(self, bucket: str, prefix: str = "", marker: str = "",
-                     build: bool = True) -> Iterator[tuple[str, bytes]]:
-        """(name, winning-raw-journal) pairs with name > marker, under
-        ``prefix``. Cache path when possible, else plain walk.
+                     build: bool = True
+                     ) -> Iterator[tuple[str, bytes, object]]:
+        """(name, winning-raw-journal, parsed-XLMeta-or-None) triples with
+        name > marker, under ``prefix``. The walk path hands back the
+        XLMeta it already parsed for quorum resolution (so local
+        consumers don't re-parse); block-served entries carry None and
+        the consumer parses the raw. Cache path when possible, else
+        plain walk.
 
         ``build=False`` serves from an existing cache but never starts a
         background build: delimiter pages restart the stream past each
@@ -445,23 +457,27 @@ class MetacacheStore:
         last = marker
         try:
             for name, raw in self._serve(st, marker):
-                yield name, raw
+                yield name, raw, None
                 last = name
         except errors.StorageError:
-            # cache path failed mid-stream: drop the cache and continue
-            # transparently from the last yielded name via the plain walk
+            # cache path failed mid-stream: continue transparently from
+            # the last yielded name via the plain walk. Drop the state
+            # only if its build FINISHED — popping a running build would
+            # let a second builder start into the same cache directory
+            # and clobber the first's block files.
             with self._lock:
-                self._states.pop((bucket, prefix), None)
+                if self._states.get((bucket, prefix)) is st and st.ended:
+                    self._states.pop((bucket, prefix), None)
             yield from self._walk(bucket, prefix, last)
 
     def _walk(self, bucket: str, prefix: str, marker: str
-              ) -> Iterator[tuple[str, bytes]]:
+              ) -> Iterator[tuple[str, bytes, object]]:
         self.serves_walked += 1
         for entry in merged_entries(self.obj.disks, bucket, prefix,
                                     marker):
-            win = self._winning_raw(entry)
-            if win is not None:
-                yield entry.name, win
+            meta = entry.resolve()
+            if meta is not None and entry._win_raw is not None:
+                yield entry.name, entry._win_raw, meta
 
     def _get_or_start(self, bucket: str, prefix: str, build: bool = True
                       ) -> _CacheState | None:
@@ -478,10 +494,20 @@ class MetacacheStore:
                     return None
                 self._states.pop((bucket, prefix), None)
         # a finished cache another node built?
-        try:
-            loaded = self._load_manifest(bucket, prefix)
-        except Exception:  # noqa: BLE001 — any surprise: walk
-            loaded = None
+        loaded = None
+        with self._lock:
+            neg_at = self._no_manifest.get((bucket, prefix), 0.0)
+        if time.time() - neg_at > NEG_MANIFEST_TTL_S:
+            try:
+                loaded = self._load_manifest(bucket, prefix)
+            except Exception:  # noqa: BLE001 — any surprise: walk
+                loaded = None
+            if loaded is None:
+                with self._lock:
+                    self._no_manifest[(bucket, prefix)] = time.time()
+                    while len(self._no_manifest) > 512:
+                        self._no_manifest.pop(
+                            next(iter(self._no_manifest)))
         if loaded is not None and loaded.usable(cur_seq, dirty):
             with self._lock:
                 self._states[(bucket, prefix)] = loaded

@@ -102,8 +102,15 @@ class S3Server:
         (MINIO_TPU_NOTIFY_WEBHOOK_ENDPOINT_<ID>); the queue root defaults
         to MINIO_TPU_NOTIFY_QUEUE_DIR or .events under the cwd."""
         from ..event import EventNotifier, targets_from_env
+        from ..event.notifier import targets_from_config
         if targets is None:
             targets = targets_from_env(self.region)
+            try:
+                from ..config import get_config_sys
+                targets += targets_from_config(get_config_sys(self.obj),
+                                               self.region)
+            except Exception:  # noqa: BLE001 — no config plane wired
+                pass
         if not queue_root:
             queue_root = os.environ.get(
                 "MINIO_TPU_NOTIFY_QUEUE_DIR",
