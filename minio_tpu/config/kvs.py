@@ -77,8 +77,8 @@ SUB_SYSTEMS: dict[str, dict[str, KV]] = {
         "queue_limit": KV("10000"),
     },
     # broker-backed event targets (reference pkg/event/target/*): one
-    # default instance per kind via KVS; additional instances via the
-    # MINIO_TPU_NOTIFY_<KIND>_..._<ID> env scheme
+    # default instance per kind via KVS (multi-instance env naming is
+    # implemented for the webhook kind only — targets_from_env)
     "notify_kafka": {
         "enable": KV("off", env="MINIO_TPU_NOTIFY_KAFKA_ENABLE"),
         "brokers": KV("", env="MINIO_TPU_NOTIFY_KAFKA_BROKERS"),

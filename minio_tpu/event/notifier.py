@@ -56,7 +56,7 @@ def targets_from_config(cfg, region: str = "us-east-1") -> list:
     }
     builders = [
         ("notify_kafka", lambda: T.KafkaTarget(
-            "1", cfg.get("notify_kafka", "brokers").split(",")[0],
+            "1", cfg.get("notify_kafka", "brokers"),
             cfg.get("notify_kafka", "topic"), region)),
         ("notify_amqp", lambda: T.AMQPTarget(
             "1", cfg.get("notify_amqp", "url"),

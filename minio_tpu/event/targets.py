@@ -65,20 +65,39 @@ class WebhookTarget:
 class KafkaTarget:
     KIND = "kafka"
 
-    def __init__(self, target_id: str, broker: str, topic: str = "minio",
-                 region: str = "us-east-1", timeout_s: float = 5.0):
+    def __init__(self, target_id: str, brokers: str | list,
+                 topic: str = "minio", region: str = "us-east-1",
+                 timeout_s: float = 5.0):
+        """``brokers``: "host[:port]" or comma-separated list — a failed
+        produce rotates to the next broker before surfacing the error."""
         from .wire import KafkaProducer
         self.id = target_id
-        host, _, port = broker.partition(":")
-        self.client = KafkaProducer(host, int(port or 9092), topic,
-                                    timeout_s=timeout_s)
+        if isinstance(brokers, str):
+            brokers = [b.strip() for b in brokers.split(",") if b.strip()]
+        if not brokers:
+            raise ValueError("kafka target needs at least one broker")
+        self.clients = []
+        for b in brokers:
+            host, _, port = b.partition(":")
+            self.clients.append(KafkaProducer(host, int(port or 9092),
+                                              topic, timeout_s=timeout_s))
+        self._cur = 0
         self.arn = f"arn:minio:sqs:{region}:{target_id}:kafka"
 
     def send(self, record: dict) -> None:
-        self.client.produce(
-            _event_key(record).encode(),
-            json.dumps(_envelope(record), separators=(",", ":")).encode(),
-            int(time.time() * 1000))
+        key = _event_key(record).encode()
+        value = json.dumps(_envelope(record),
+                           separators=(",", ":")).encode()
+        ts = int(time.time() * 1000)
+        last: Exception | None = None
+        for _ in range(len(self.clients)):
+            try:
+                self.clients[self._cur].produce(key, value, ts)
+                return
+            except Exception as e:  # noqa: BLE001 — try the next broker
+                last = e
+                self._cur = (self._cur + 1) % len(self.clients)
+        raise last if last is not None else RuntimeError("kafka send")
 
 
 class AMQPTarget:

@@ -73,6 +73,17 @@ class _SocketClient:
             buf += chunk
         return buf
 
+    def _retry_once(self, op, *args):
+        """Run ``op(sock, *args)`` under the client lock; one transparent
+        reconnect on socket/protocol failure (shared by every publish
+        path so fixes land in one place)."""
+        with self._lock:
+            try:
+                return op(self._ensure(), *args)
+            except (OSError, WireError):
+                self._reset()
+                return op(self._ensure(), *args)
+
 
 # --- Redis (RESP2) ---------------------------------------------------------
 
@@ -133,12 +144,7 @@ class RESPClient(_SocketClient):
         return self._read_reply(s)
 
     def command(self, *args):
-        with self._lock:
-            try:
-                return self._cmd_on(self._ensure(), *args)
-            except (OSError, WireError):
-                self._reset()
-                return self._cmd_on(self._ensure(), *args)
+        return self._retry_once(self._cmd_on, *args)
 
 
 # --- MQTT 3.1.1 ------------------------------------------------------------
@@ -188,12 +194,7 @@ class MQTTClient(_SocketClient):
             raise WireError(f"mqtt connack refused: {hdr!r}")
 
     def publish(self, topic: str, payload: bytes) -> None:
-        with self._lock:
-            try:
-                self._publish_on(self._ensure(), topic, payload)
-            except (OSError, WireError):
-                self._reset()
-                self._publish_on(self._ensure(), topic, payload)
+        self._retry_once(self._publish_on, topic, payload)
 
     def _publish_on(self, s: socket.socket, topic: str,
                     payload: bytes) -> None:
@@ -289,12 +290,7 @@ class KafkaProducer(_SocketClient):
         return struct.pack(">qi", 0, len(body)) + body
 
     def produce(self, key: bytes, value: bytes, ts_ms: int) -> None:
-        with self._lock:
-            try:
-                self._produce_on(self._ensure(), key, value, ts_ms)
-            except (OSError, WireError):
-                self._reset()
-                self._produce_on(self._ensure(), key, value, ts_ms)
+        self._retry_once(self._produce_on, key, value, ts_ms)
 
     def _produce_on(self, s: socket.socket, key: bytes, value: bytes,
                     ts_ms: int) -> None:
@@ -401,12 +397,7 @@ class AMQPPublisher(_SocketClient):
         self._read_method(s, 20, 11)
 
     def publish(self, body: bytes) -> None:
-        with self._lock:
-            try:
-                self._publish_on(self._ensure(), body)
-            except (OSError, WireError):
-                self._reset()
-                self._publish_on(self._ensure(), body)
+        self._retry_once(self._publish_on, body)
 
     def _publish_on(self, s: socket.socket, body: bytes) -> None:
         self._send_method(s, 1, 60, 40,
@@ -467,24 +458,28 @@ class NATSClient(_SocketClient):
             opts["user"] = self.user
             opts["pass"] = self.password
         s.sendall(b"CONNECT " + json.dumps(opts).encode() + b"\r\n")
-        ok = self._read_line(s)
-        if ok != b"+OK":
-            raise WireError(f"nats connect: {ok!r}")
+        self._read_ok(s)
 
     def publish(self, payload: bytes) -> None:
-        with self._lock:
-            try:
-                self._publish_on(self._ensure(), payload)
-            except (OSError, WireError):
-                self._reset()
-                self._publish_on(self._ensure(), payload)
+        self._retry_once(self._publish_on, payload)
+
+    def _read_ok(self, s: socket.socket) -> None:
+        """Next control line, answering server PINGs in between (an idle
+        server pings every couple of minutes; treating a buffered PING as
+        a failed +OK would double-deliver via the reconnect retry)."""
+        while True:
+            line = self._read_line(s)
+            if line == b"PING":
+                s.sendall(b"PONG\r\n")
+                continue
+            if line != b"+OK":
+                raise WireError(f"nats: {line!r}")
+            return
 
     def _publish_on(self, s: socket.socket, payload: bytes) -> None:
         s.sendall(b"PUB %s %d\r\n%s\r\n"
                   % (self.subject.encode(), len(payload), payload))
-        ok = self._read_line(s)
-        if ok != b"+OK":
-            raise WireError(f"nats pub: {ok!r}")
+        self._read_ok(s)
 
 
 # --- NSQ (V2) --------------------------------------------------------------
@@ -500,12 +495,7 @@ class NSQClient(_SocketClient):
         s.sendall(b"  V2")
 
     def publish(self, payload: bytes) -> None:
-        with self._lock:
-            try:
-                self._publish_on(self._ensure(), payload)
-            except (OSError, WireError):
-                self._reset()
-                self._publish_on(self._ensure(), payload)
+        self._retry_once(self._publish_on, payload)
 
     def _publish_on(self, s: socket.socket, payload: bytes) -> None:
         s.sendall(b"PUB " + self.topic.encode() + b"\n"

@@ -224,6 +224,10 @@ class ErasureObjects(MultipartMixin, ObjectLayer):
         if err is not None:
             raise to_object_err(err, bucket)
         self.metacache.on_write(bucket)
+        # drop stale accounting: a recreated bucket must not serve the
+        # deleted namespace's usage tree
+        from ..scanner import usage as usage_mod
+        usage_mod.delete_tree(self, bucket)
 
     # --- put ---------------------------------------------------------------
 

@@ -251,10 +251,11 @@ class _CacheState:
 class MetacacheStore:
     """Persisted-listing coordinator for one erasure set.
 
-    ``iter_entries`` is the only entry point: it serves (name, raw-journal)
-    pairs after ``marker`` from a finished or in-progress cache when one
-    is usable, becomes the builder when none is, and falls back to the
-    plain merged walk whenever anything about the cache path fails."""
+    ``iter_entries`` is the only entry point: it serves (name,
+    raw-journal, parsed-meta-or-None) triples after ``marker`` from a
+    finished or in-progress cache when one is usable, becomes the
+    builder when none is, and falls back to the plain merged walk
+    whenever anything about the cache path fails."""
 
     def __init__(self, objlayer):
         self.obj = objlayer  # ErasureObjects (for .disks)

@@ -16,12 +16,16 @@ DEEP_SCAN_EVERY = 16  # healDeepScanCycleMultiplier (cmd/data-scanner.go:48)
 
 class DataScanner:
     def __init__(self, objlayer, interval_s: float = 60.0,
-                 mrf=None, lifecycle=None, sleep_per_object: float = 0.001):
+                 mrf=None, lifecycle=None, sleep_per_object: float = 0.001,
+                 compact_least: int | None = None):
         self.obj = objlayer
         self.interval = interval_s
         self.mrf = mrf
         self.lifecycle = lifecycle
         self.sleep_per_object = sleep_per_object
+        self.compact_least = usage_mod.COMPACT_LEAST \
+            if compact_least is None else compact_least
+        self.compact_max_nodes = usage_mod.MAX_NODES
         self.cycle = 0
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
@@ -69,27 +73,31 @@ class DataScanner:
                 total_size += prev.get("size", 0)
                 continue
             count = size = versions = 0
-            prefixes: dict[str, dict] = {}
+            tree = usage_mod.UsageTree()
             # one streaming metacache pass per bucket — no paging restarts
             # (cmd/data-scanner.go crawls the disks directly the same way)
             for oi in self.obj.iter_objects(b.name):
                 if self._stop.is_set():
                     return self.last_usage
+                nv = max(1, oi.num_versions)
                 count += 1
                 size += oi.size
-                versions += max(1, oi.num_versions)
-                # hierarchical breakdown: one level of prefixes
-                # (cmd/data-usage-cache.go's tree, depth-limited)
-                top = oi.name.split("/", 1)[0] if "/" in oi.name else ""
-                p = prefixes.setdefault(top or "/",
-                                        {"objects": 0, "size": 0})
-                p["objects"] += 1
-                p["size"] += oi.size
+                versions += nv
+                # hierarchical per-folder tree (cmd/data-usage-cache.go),
+                # compacted + persisted below
+                tree.add(oi.name, oi.size, nv)
                 self._check_object(b.name, oi, deep)
                 if self.sleep_per_object:
                     time.sleep(self.sleep_per_object)
+            tree.compact(self.compact_least, self.compact_max_nodes)
+            try:
+                usage_mod.save_tree(self.obj, b.name, tree)
+            except Exception:  # noqa: BLE001 — accounting is best-effort
+                pass
             buckets[b.name] = {"objects": count, "size": size,
-                               "versions": versions, "prefixes": prefixes}
+                               "versions": versions,
+                               "prefixes": tree.prefixes(1),
+                               "histogram": tree.histogram()}
             total_objects += count
             total_size += size
         tracker.end_cycle(gen)

@@ -42,8 +42,14 @@ def _dispatch_admin(h, op: str) -> None:
     if op == "heal" or op.startswith("heal/"):
         return _heal(h, op)
     if op == "datausageinfo":
-        from ..scanner.usage import load_usage
-        return h._send(200, json.dumps(load_usage(h.s3.obj)).encode(),
+        from ..scanner.usage import data_usage_info
+        try:
+            depth = int(h.query.get("depth", ["2"])[0])
+        except (ValueError, TypeError, AttributeError):
+            depth = 2
+        return h._send(200,
+                       json.dumps(data_usage_info(h.s3.obj,
+                                                  depth)).encode(),
                        "application/json")
     if op.startswith("service"):
         # restart/stop accepted; process supervisor owns actual signals
