@@ -66,12 +66,22 @@ class AdminClient:
 
     # -- heal -----------------------------------------------------------------
 
+    @staticmethod
+    def _heal_op(bucket: str, prefix: str) -> str:
+        if prefix and not bucket:
+            raise ValueError("heal prefix requires a bucket")
+        return "heal" + (f"/{bucket}" if bucket else "") + \
+            (f"/{prefix}" if prefix else "")
+
     def heal(self, bucket: str = "", prefix: str = "",
              dry_run: bool = False) -> dict:
-        op = "heal" + (f"/{bucket}" if bucket else "") + \
-            (f"/{prefix}" if prefix else "")
-        return self._json("POST", op,
+        return self._json("POST", self._heal_op(bucket, prefix),
                           {"dryRun": "true"} if dry_run else None)
+
+    def heal_status(self, token: str, bucket: str = "",
+                    prefix: str = "") -> dict:
+        return self._json("POST", self._heal_op(bucket, prefix),
+                          {"clientToken": token})
 
     # -- IAM ------------------------------------------------------------------
 

@@ -225,9 +225,12 @@ class ErasureObjects(MultipartMixin, ObjectLayer):
             raise to_object_err(err, bucket)
         self.metacache.on_write(bucket)
         # drop stale accounting: a recreated bucket must not serve the
-        # deleted namespace's usage tree
+        # deleted namespace's usage tree, and the scanner's clean-bucket
+        # skip must not reuse the deleted namespace's snapshot entry
         from ..scanner import usage as usage_mod
+        from ..scanner.tracker import global_tracker
         usage_mod.delete_tree(self, bucket)
+        global_tracker().mark(bucket, "")
 
     # --- put ---------------------------------------------------------------
 

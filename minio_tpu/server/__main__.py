@@ -1,22 +1,36 @@
 """CLI entry: ``python -m minio_tpu.server [--address HOST:PORT] DIR...``
 — the analogue of ``minio server`` (reference cmd/server-main.go:404).
 Disk args may use ellipses patterns (``/data/disk{1...8}``, expanded by
-minio_tpu.dist.ellipses) and are grouped into erasure sets of 4-16 drives."""
+minio_tpu.dist.ellipses) and are grouped into erasure sets of 4-16
+drives. ``http://host:port/path`` endpoint args select DISTRIBUTED mode:
+every process gets the same full endpoint list, serves the disks whose
+URL matches its --address, and reaches the rest over storage RPC
+(reference dist-erasure startup; buildscripts/verify-healing.sh drives
+it the same way). Root credentials: MINIO_TPU_ROOT_USER/_PASSWORD
+(default minioadmin/minioadmin)."""
 from __future__ import annotations
 
 import argparse
+import os
 import sys
 
 
 def main(argv=None):
     ap = argparse.ArgumentParser(prog="minio-tpu server")
     ap.add_argument("dirs", nargs="+", help="disk directories or "
-                    "ellipses patterns like /data/disk{1...8}")
+                    "ellipses patterns like /data/disk{1...8}; "
+                    "http://host:port/path endpoints = distributed mode")
     ap.add_argument("--address", default="0.0.0.0:9000")
     ap.add_argument("--region", default="us-east-1")
     ap.add_argument("--parity", type=int, default=None,
                     help="parity drives per set (default: drives/2)")
     args = ap.parse_args(argv)
+
+    ak = os.environ.get("MINIO_TPU_ROOT_USER", "minioadmin")
+    sk = os.environ.get("MINIO_TPU_ROOT_PASSWORD", "minioadmin")
+
+    if any(d.startswith(("http://", "https://")) for d in args.dirs):
+        return _serve_distributed(args, ak, sk)
 
     from ..dist.ellipses import expand_endpoints
     dirs = expand_endpoints(args.dirs)
@@ -41,12 +55,56 @@ def main(argv=None):
 
     host, _, port = args.address.rpartition(":")
     from . import S3Server
-    srv = S3Server(obj, host or "0.0.0.0", int(port), args.region)
+    srv = S3Server(obj, host or "0.0.0.0", int(port), args.region,
+                   access_key=ak, secret_key=sk)
     print(f"listening on {args.address}", file=sys.stderr)
     try:
         srv.serve_forever()
     except KeyboardInterrupt:
         pass
+
+
+def _serve_distributed(args, ak: str, sk: str):
+    """Distributed startup: build the Node from the full endpoint list,
+    identify ourselves by --address, serve until killed."""
+    import socket
+    import threading
+
+    from ..dist.node import Node
+    host, _, port = args.address.rpartition(":")
+    host = host or "0.0.0.0"
+    local_url = f"http://{host}:{port}"
+    node = Node(args.dirs, local_url=local_url, address=host,
+                port=int(port), access_key=ak, secret_key=sk,
+                region=args.region, default_parity=args.parity)
+    if not node.local_disks:
+        # --address 0.0.0.0 (or a host alias) matches no endpoint URL;
+        # retry with any endpoint on our port whose host resolves to a
+        # local interface — silently owning zero disks makes a cluster
+        # that comes up dead
+        local_names = {"127.0.0.1", "localhost", socket.gethostname(),
+                       socket.getfqdn()}
+        candidates = {e.url for e in node.endpoints
+                      if e.url and e.url.rsplit(":", 1)[-1] == port
+                      and e.url.split("//", 1)[-1].rsplit(":", 1)[0]
+                      in local_names}
+        if len(candidates) == 1:
+            node = Node(args.dirs, local_url=candidates.pop(),
+                        address=host, port=int(port), access_key=ak,
+                        secret_key=sk, region=args.region,
+                        default_parity=args.parity)
+    if not node.local_disks:
+        sys.exit(f"error: --address {args.address} matches no endpoint "
+                 f"URL; pass the URL this node serves (endpoints: "
+                 f"{sorted({str(e.url) for e in node.endpoints})})")
+    node.start()
+    print(f"distributed node listening on {args.address} "
+          f"({len(node.endpoints)} endpoints)", file=sys.stderr)
+    try:
+        threading.Event().wait()  # serve until SIGTERM/SIGINT
+    except KeyboardInterrupt:
+        pass
+    node.shutdown()
 
 
 if __name__ == "__main__":
