@@ -1,0 +1,36 @@
+"""Gateway layer (reference cmd/gateway-interface.go:34 + cmd/gateway/):
+serve the S3 API in front of a non-erasure backend. A gateway supplies an
+ObjectLayer; everything above it (HTTP handlers, auth, IAM, events) is
+the regular server stack.
+
+Implemented backends, mirroring the two reference adapters with no
+external-cloud dependency:
+
+- ``nas``  — a shared filesystem path (reference cmd/gateway/nas):
+  single-disk FS ObjectLayer over the mount.
+- ``s3``   — an upstream S3-compatible endpoint (reference
+  cmd/gateway/s3): every call proxies over SigV4-signed HTTP.
+"""
+from __future__ import annotations
+
+REGISTRY = {}
+
+
+def register(name):
+    def deco(cls):
+        REGISTRY[name] = cls
+        return cls
+
+    return deco
+
+
+def new_gateway_layer(kind: str, target: str, access_key: str = "",
+                      secret_key: str = "", region: str = "us-east-1"):
+    """Instantiate the ObjectLayer for gateway ``kind`` over ``target``
+    (a path for nas, an endpoint URL for s3)."""
+    from . import nas, s3  # noqa: F401 — populate REGISTRY
+    cls = REGISTRY.get(kind)
+    if cls is None:
+        raise ValueError(
+            f"unknown gateway {kind!r}; available: {sorted(REGISTRY)}")
+    return cls.new_layer(target, access_key, secret_key, region)

@@ -1,0 +1,22 @@
+"""NAS gateway (reference cmd/gateway/nas/gateway-nas.go): the S3 API
+over a shared filesystem mount — exactly the single-disk FS ObjectLayer,
+registered under the gateway CLI surface."""
+from __future__ import annotations
+
+from . import register
+
+
+@register("nas")
+class NASGateway:
+    NAME = "nas"
+
+    @staticmethod
+    def new_layer(target: str, access_key: str = "", secret_key: str = "",
+                  region: str = "us-east-1"):
+        from ..fs import FSObjects
+
+        class _NASObjects(FSObjects):
+            def backend_type(self) -> str:
+                return "Gateway:nas"
+
+        return _NASObjects(target)

@@ -1,0 +1,557 @@
+"""S3 gateway (reference cmd/gateway/s3/gateway-s3.go): an ObjectLayer
+whose every call proxies to an upstream S3-compatible endpoint over
+SigV4-signed HTTP. The reference rides minio-go; this build signs with
+the framework's own SigV4 implementation and speaks http.client
+directly, streaming bodies both ways."""
+from __future__ import annotations
+
+import http.client
+import urllib.parse
+import xml.etree.ElementTree as ET
+from email.utils import parsedate_to_datetime
+
+from ..objectlayer.datatypes import (BucketInfo, CompletePart,
+                                     DeletedObject, ListMultipartsInfo,
+                                     ListObjectsInfo, ListPartsInfo,
+                                     MultipartInfo, ObjectInfo,
+                                     ObjectOptions, PartInfo)
+from ..objectlayer.interface import ObjectLayer
+from ..objectlayer import datatypes as dterr
+from ..utils import errors
+from . import register
+
+
+def _strip_ns(tag: str) -> str:
+    return tag.rsplit("}", 1)[-1]
+
+
+def _find(el, name: str):
+    for child in el:
+        if _strip_ns(child.tag) == name:
+            return child
+    return None
+
+
+def _text(el, name: str, default: str = "") -> str:
+    c = _find(el, name)
+    return default if c is None or c.text is None else c.text
+
+
+def _iso_to_ts(s: str) -> float:
+    import datetime
+    if not s:
+        return 0.0
+    try:
+        return datetime.datetime.fromisoformat(
+            s.replace("Z", "+00:00")).timestamp()
+    except ValueError:
+        return 0.0
+
+
+class _ResponseReader:
+    """File-like over an http response, closing the connection at EOF."""
+
+    def __init__(self, resp, conn):
+        self.resp = resp
+        self.conn = conn
+
+    def read(self, n: int = -1) -> bytes:
+        return self.resp.read(n)
+
+    def close(self):
+        try:
+            self.resp.close()
+        finally:
+            self.conn.close()
+
+
+@register("s3")
+class S3Gateway:
+    NAME = "s3"
+
+    @staticmethod
+    def new_layer(target: str, access_key: str = "", secret_key: str = "",
+                  region: str = "us-east-1") -> "S3GatewayLayer":
+        return S3GatewayLayer(target, access_key, secret_key, region)
+
+
+class S3GatewayLayer(ObjectLayer):
+    def __init__(self, endpoint: str, access_key: str, secret_key: str,
+                 region: str = "us-east-1", timeout_s: float = 60.0):
+        from ..server.auth import SigV4Verifier
+        u = urllib.parse.urlparse(endpoint)
+        if u.scheme not in ("http", "https"):
+            raise ValueError(f"s3 gateway endpoint must be a URL: "
+                             f"{endpoint!r}")
+        self.https = u.scheme == "https"
+        self.host = u.hostname or "localhost"
+        self.port = u.port or (443 if self.https else 80)
+        self.netloc = u.netloc
+        self.ak = access_key
+        self.sk = secret_key
+        self.region = region
+        self.timeout = timeout_s
+        self._signer = SigV4Verifier(lambda a: None, region)
+
+    # --- transport --------------------------------------------------------
+
+    def _request(self, method: str, path: str,
+                 query: dict[str, str] | None = None, body=b"",
+                 headers: dict[str, str] | None = None,
+                 body_len: int | None = None, stream: bool = False):
+        """Signed request; returns (status, headers, body-bytes) or, with
+        stream=True, (status, headers, reader)."""
+        q = {k: [v] for k, v in (query or {}).items()}
+        h = {"host": self.netloc}
+        for k, v in (headers or {}).items():
+            h[k.lower()] = v
+        if body_len is None:
+            body_len = len(body) if isinstance(body, (bytes, bytearray)) \
+                else 0
+        h["content-length"] = str(body_len)
+        auth = self._signer.sign_request(self.ak, self.sk, method, path,
+                                         q, h)
+        h["authorization"] = auth
+        qs = urllib.parse.urlencode([(k, v[0]) for k, v in q.items()])
+        url = urllib.parse.quote(path) + (f"?{qs}" if qs else "")
+        cls = http.client.HTTPSConnection if self.https \
+            else http.client.HTTPConnection
+        conn = cls(self.host, self.port, timeout=self.timeout)
+        try:
+            conn.request(method, url, body=body or None, headers=h)
+            resp = conn.getresponse()
+            if stream and resp.status < 300:
+                return resp.status, dict(resp.getheaders()), \
+                    _ResponseReader(resp, conn)
+            data = resp.read()
+            conn.close()
+            return resp.status, dict(resp.getheaders()), data
+        except Exception:
+            conn.close()
+            raise
+
+    @staticmethod
+    def _raise(status: int, data: bytes, bucket: str = "",
+               object: str = ""):
+        code = ""
+        try:
+            code = _text(ET.fromstring(data), "Code") if data else ""
+        except ET.ParseError:
+            pass
+        if status == 404 or code in ("NoSuchKey", "NoSuchBucket",
+                                     "NoSuchUpload", "NoSuchVersion"):
+            if code == "NoSuchBucket" or (object == "" and bucket):
+                raise dterr.BucketNotFound(bucket)
+            raise dterr.ObjectNotFound(bucket, object)
+        if status == 409 and code == "BucketNotEmpty":
+            raise dterr.BucketNotEmpty(bucket)
+        if status == 409 and code in ("BucketAlreadyOwnedByYou",
+                                      "BucketAlreadyExists"):
+            raise dterr.BucketExists(bucket)
+        if status in (301, 400) and code == "InvalidRange":
+            raise dterr.InvalidRange(bucket, object)
+        raise errors.FaultyDisk(
+            f"upstream s3: {status} {code or data[:120]!r}")
+
+    # --- buckets ----------------------------------------------------------
+
+    def make_bucket(self, bucket: str, opts: ObjectOptions = None) -> None:
+        st, _h, data = self._request("PUT", f"/{bucket}")
+        if st >= 300:
+            self._raise(st, data, bucket)
+
+    def get_bucket_info(self, bucket: str) -> BucketInfo:
+        st, _h, data = self._request("HEAD", f"/{bucket}")
+        if st >= 300:
+            raise dterr.BucketNotFound(bucket)
+        return BucketInfo(name=bucket)
+
+    def list_buckets(self) -> list[BucketInfo]:
+        st, _h, data = self._request("GET", "/")
+        if st >= 300:
+            self._raise(st, data)
+        out = []
+        root = ET.fromstring(data)
+        buckets = _find(root, "Buckets")
+        for b in (buckets if buckets is not None else []):
+            out.append(BucketInfo(name=_text(b, "Name"),
+                                  created=_iso_to_ts(
+                                      _text(b, "CreationDate"))))
+        return out
+
+    def delete_bucket(self, bucket: str, force: bool = False) -> None:
+        if force:
+            r = self.list_objects(bucket, max_keys=1000)
+            for oi in r.objects:
+                self.delete_object(bucket, oi.name)
+        st, _h, data = self._request("DELETE", f"/{bucket}")
+        if st >= 300:
+            self._raise(st, data, bucket)
+
+    # --- objects ----------------------------------------------------------
+
+    @staticmethod
+    def _meta_headers(opts: ObjectOptions | None) -> dict[str, str]:
+        h = {}
+        for k, v in (opts.user_defined if opts else {}).items():
+            lk = k.lower()
+            if lk == "content-type":
+                h["content-type"] = v
+            elif lk.startswith("x-amz-"):
+                h[lk] = v
+            else:
+                h[f"x-amz-meta-{lk}"] = v
+        return h
+
+    def put_object(self, bucket: str, object: str, stream, size: int,
+                   opts: ObjectOptions = None) -> ObjectInfo:
+        body = stream.read(size) if size >= 0 else stream.read()
+        st, hdrs, data = self._request(
+            "PUT", f"/{bucket}/{object}", body=body,
+            headers=self._meta_headers(opts))
+        if st >= 300:
+            self._raise(st, data, bucket, object)
+        return ObjectInfo(bucket=bucket, name=object, size=len(body),
+                          etag=hdrs.get("ETag", "").strip('"'),
+                          version_id=hdrs.get("x-amz-version-id", ""))
+
+    def get_object(self, bucket: str, object: str, writer,
+                   offset: int = 0, length: int = -1,
+                   opts: ObjectOptions = None) -> ObjectInfo:
+        headers = {}
+        if offset or length >= 0:
+            end = "" if length < 0 else str(offset + length - 1)
+            headers["range"] = f"bytes={offset}-{end}"
+        query = {}
+        if opts and opts.version_id:
+            query["versionId"] = opts.version_id
+        st, hdrs, rd = self._request("GET", f"/{bucket}/{object}",
+                                     query=query, headers=headers,
+                                     stream=True)
+        if st >= 300:
+            self._raise(st, rd, bucket, object)
+        try:
+            while True:
+                chunk = rd.read(1 << 20)
+                if not chunk:
+                    break
+                writer.write(chunk)
+        finally:
+            rd.close()
+        return self._info_from_headers(bucket, object, hdrs)
+
+    @staticmethod
+    def _info_from_headers(bucket: str, object: str,
+                           hdrs: dict) -> ObjectInfo:
+        user = {}
+        for k, v in hdrs.items():
+            lk = k.lower()
+            if lk.startswith("x-amz-meta-"):
+                # keep the full header name: the server stack stores user
+                # metadata under its x-amz-meta-* key (s3api._user_meta)
+                user[lk] = v
+        size = int(hdrs.get("Content-Length", "0") or 0)
+        crange = hdrs.get("Content-Range", "")
+        if crange.startswith("bytes ") and "/" in crange:
+            try:
+                size = int(crange.rsplit("/", 1)[1])
+            except ValueError:
+                pass
+        mod = 0.0
+        if hdrs.get("Last-Modified"):
+            try:
+                mod = parsedate_to_datetime(
+                    hdrs["Last-Modified"]).timestamp()
+            except (ValueError, TypeError):
+                pass
+        return ObjectInfo(
+            bucket=bucket, name=object, size=size,
+            etag=hdrs.get("ETag", "").strip('"'),
+            content_type=hdrs.get("Content-Type", ""),
+            mod_time=mod, user_defined=user,
+            version_id=hdrs.get("x-amz-version-id", ""),
+            delete_marker=hdrs.get("x-amz-delete-marker") == "true")
+
+    def get_object_info(self, bucket: str, object: str,
+                        opts: ObjectOptions = None) -> ObjectInfo:
+        query = {}
+        if opts and opts.version_id:
+            query["versionId"] = opts.version_id
+        st, hdrs, data = self._request("HEAD", f"/{bucket}/{object}",
+                                       query=query)
+        if st >= 300:
+            # HEAD carries no error body; probe bucket for the right 404
+            self.get_bucket_info(bucket)
+            raise dterr.ObjectNotFound(bucket, object)
+        return self._info_from_headers(bucket, object, hdrs)
+
+    def delete_object(self, bucket: str, object: str,
+                      opts: ObjectOptions = None) -> ObjectInfo:
+        query = {}
+        if opts and opts.version_id:
+            query["versionId"] = opts.version_id
+        st, hdrs, data = self._request("DELETE", f"/{bucket}/{object}",
+                                       query=query)
+        if st >= 300:
+            self._raise(st, data, bucket, object)
+        return ObjectInfo(
+            bucket=bucket, name=object,
+            version_id=hdrs.get("x-amz-version-id", ""),
+            delete_marker=hdrs.get("x-amz-delete-marker") == "true")
+
+    def delete_objects(self, bucket: str, objects: list, opts=None
+                       ) -> tuple[list[DeletedObject], list]:
+        deleted, errs = [], []
+        for obj in objects:
+            name = obj if isinstance(obj, str) else obj["object"]
+            vid = "" if isinstance(obj, str) else obj.get("version_id", "")
+            try:
+                self.delete_object(bucket, name,
+                                   ObjectOptions(version_id=vid))
+                deleted.append(DeletedObject(object_name=name,
+                                             version_id=vid))
+                errs.append(None)
+            except dterr.ObjectNotFound:
+                deleted.append(DeletedObject(object_name=name,
+                                             version_id=vid))
+                errs.append(None)
+            except Exception as e:  # noqa: BLE001
+                deleted.append(DeletedObject(object_name=name,
+                                             version_id=vid))
+                errs.append(e)
+        return deleted, errs
+
+    def list_objects(self, bucket: str, prefix: str = "", marker: str = "",
+                     delimiter: str = "", max_keys: int = 1000
+                     ) -> ListObjectsInfo:
+        q = {"list-type": "2", "max-keys": str(max_keys)}
+        if prefix:
+            q["prefix"] = prefix
+        if delimiter:
+            q["delimiter"] = delimiter
+        if marker:
+            q["start-after"] = marker
+        st, _h, data = self._request("GET", f"/{bucket}", query=q)
+        if st >= 300:
+            self._raise(st, data, bucket)
+        root = ET.fromstring(data)
+        out = ListObjectsInfo()
+        out.is_truncated = _text(root, "IsTruncated") == "true"
+        for el in root:
+            tag = _strip_ns(el.tag)
+            if tag == "Contents":
+                out.objects.append(ObjectInfo(
+                    bucket=bucket, name=_text(el, "Key"),
+                    size=int(_text(el, "Size", "0")),
+                    etag=_text(el, "ETag").strip('"'),
+                    mod_time=_iso_to_ts(_text(el, "LastModified"))))
+            elif tag == "CommonPrefixes":
+                out.prefixes.append(_text(el, "Prefix"))
+        if out.is_truncated and out.objects:
+            out.next_marker = out.objects[-1].name
+        return out
+
+    def copy_object(self, src_bucket, src_object, dst_bucket, dst_object,
+                    src_info=None, src_opts=None,
+                    dst_opts=None) -> ObjectInfo:
+        src = f"/{src_bucket}/{src_object}"
+        if src_opts and src_opts.version_id:
+            src += f"?versionId={src_opts.version_id}"
+        headers = {"x-amz-copy-source": src}
+        headers.update(self._meta_headers(dst_opts))
+        if dst_opts and dst_opts.metadata_replace:
+            headers["x-amz-metadata-directive"] = "REPLACE"
+        st, hdrs, data = self._request("PUT", f"/{dst_bucket}/{dst_object}",
+                                       headers=headers)
+        if st >= 300:
+            self._raise(st, data, dst_bucket, dst_object)
+        etag = ""
+        try:
+            etag = _text(ET.fromstring(data), "ETag").strip('"')
+        except ET.ParseError:
+            pass
+        return ObjectInfo(bucket=dst_bucket, name=dst_object, etag=etag)
+
+    # --- multipart --------------------------------------------------------
+
+    def new_multipart_upload(self, bucket: str, object: str,
+                             opts: ObjectOptions = None) -> str:
+        st, _h, data = self._request("POST", f"/{bucket}/{object}",
+                                     query={"uploads": ""},
+                                     headers=self._meta_headers(opts))
+        if st >= 300:
+            self._raise(st, data, bucket, object)
+        return _text(ET.fromstring(data), "UploadId")
+
+    def put_object_part(self, bucket: str, object: str, upload_id: str,
+                        part_number: int, stream, size: int,
+                        opts: ObjectOptions = None) -> PartInfo:
+        body = stream.read(size) if size >= 0 else stream.read()
+        st, hdrs, data = self._request(
+            "PUT", f"/{bucket}/{object}",
+            query={"partNumber": str(part_number), "uploadId": upload_id},
+            body=body)
+        if st >= 300:
+            self._raise(st, data, bucket, object)
+        return PartInfo(part_number=part_number,
+                        etag=hdrs.get("ETag", "").strip('"'),
+                        size=len(body))
+
+    def list_object_parts(self, bucket: str, object: str, upload_id: str,
+                          part_marker: int = 0, max_parts: int = 1000
+                          ) -> ListPartsInfo:
+        st, _h, data = self._request(
+            "GET", f"/{bucket}/{object}",
+            query={"uploadId": upload_id,
+                   "part-number-marker": str(part_marker),
+                   "max-parts": str(max_parts)})
+        if st >= 300:
+            self._raise(st, data, bucket, object)
+        root = ET.fromstring(data)
+        out = ListPartsInfo(bucket=bucket, object=object,
+                            upload_id=upload_id)
+        out.is_truncated = _text(root, "IsTruncated") == "true"
+        for el in root:
+            if _strip_ns(el.tag) == "Part":
+                out.parts.append(PartInfo(
+                    part_number=int(_text(el, "PartNumber", "0")),
+                    etag=_text(el, "ETag").strip('"'),
+                    size=int(_text(el, "Size", "0"))))
+        return out
+
+    def list_multipart_uploads(self, bucket: str, prefix: str = "",
+                               max_uploads: int = 1000
+                               ) -> ListMultipartsInfo:
+        q = {"uploads": "", "max-uploads": str(max_uploads)}
+        if prefix:
+            q["prefix"] = prefix
+        st, _h, data = self._request("GET", f"/{bucket}", query=q)
+        if st >= 300:
+            self._raise(st, data, bucket)
+        root = ET.fromstring(data)
+        out = ListMultipartsInfo()
+        for el in root:
+            if _strip_ns(el.tag) == "Upload":
+                out.uploads.append(MultipartInfo(
+                    bucket=bucket, object=_text(el, "Key"),
+                    upload_id=_text(el, "UploadId")))
+        return out
+
+    def abort_multipart_upload(self, bucket: str, object: str,
+                               upload_id: str) -> None:
+        st, _h, data = self._request("DELETE", f"/{bucket}/{object}",
+                                     query={"uploadId": upload_id})
+        if st >= 300:
+            self._raise(st, data, bucket, object)
+
+    def complete_multipart_upload(self, bucket: str, object: str,
+                                  upload_id: str,
+                                  parts: list[CompletePart],
+                                  opts: ObjectOptions = None
+                                  ) -> ObjectInfo:
+        body = ["<CompleteMultipartUpload>"]
+        for p in parts:
+            body.append(f"<Part><PartNumber>{p.part_number}</PartNumber>"
+                        f"<ETag>\"{p.etag}\"</ETag></Part>")
+        body.append("</CompleteMultipartUpload>")
+        st, _h, data = self._request(
+            "POST", f"/{bucket}/{object}",
+            query={"uploadId": upload_id}, body="".join(body).encode())
+        if st >= 300:
+            self._raise(st, data, bucket, object)
+        root = ET.fromstring(data)
+        if _strip_ns(root.tag) == "Error":
+            self._raise(400, data, bucket, object)
+        return ObjectInfo(bucket=bucket, name=object,
+                          etag=_text(root, "ETag").strip('"'))
+
+    # --- tags -------------------------------------------------------------
+
+    def put_object_tags(self, bucket: str, object: str, tags_enc: str,
+                        opts: ObjectOptions = None) -> None:
+        body = ["<Tagging><TagSet>"]
+        for pair in (tags_enc.split("&") if tags_enc else []):
+            k, _, v = pair.partition("=")
+            body.append(
+                f"<Tag><Key>{urllib.parse.unquote_plus(k)}</Key>"
+                f"<Value>{urllib.parse.unquote_plus(v)}</Value></Tag>")
+        body.append("</TagSet></Tagging>")
+        st, _h, data = self._request("PUT", f"/{bucket}/{object}",
+                                     query={"tagging": ""},
+                                     body="".join(body).encode())
+        if st >= 300:
+            self._raise(st, data, bucket, object)
+
+    def get_object_tags(self, bucket: str, object: str,
+                        opts: ObjectOptions = None) -> str:
+        st, _h, data = self._request("GET", f"/{bucket}/{object}",
+                                     query={"tagging": ""})
+        if st >= 300:
+            self._raise(st, data, bucket, object)
+        pairs = []
+        root = ET.fromstring(data)
+        tagset = _find(root, "TagSet")
+        for tag in (tagset if tagset is not None else []):
+            pairs.append(
+                f"{urllib.parse.quote_plus(_text(tag, 'Key'))}="
+                f"{urllib.parse.quote_plus(_text(tag, 'Value'))}")
+        return "&".join(pairs)
+
+    def delete_object_tags(self, bucket: str, object: str,
+                           opts: ObjectOptions = None) -> None:
+        st, _h, data = self._request("DELETE", f"/{bucket}/{object}",
+                                     query={"tagging": ""})
+        if st >= 300 and st != 404:
+            self._raise(st, data, bucket, object)
+
+    # --- the rest ---------------------------------------------------------
+
+    def list_object_versions(self, bucket: str, prefix: str = "",
+                             marker: str = "", version_marker: str = "",
+                             delimiter: str = "", max_keys: int = 1000):
+        raise errors.MethodNotSupported(
+            "version listing through the s3 gateway")
+
+    def heal_object(self, *a, **kw):
+        raise errors.MethodNotSupported("heal through a gateway")
+
+    def heal_bucket(self, *a, **kw):
+        raise errors.MethodNotSupported("heal through a gateway")
+
+    def heal_format(self, *a, **kw):
+        raise errors.MethodNotSupported("heal through a gateway")
+
+    def put_config(self, path: str, data: bytes) -> None:
+        st, _h, body = self._request(
+            "PUT", f"/{self.CONFIG_BUCKET}/{path}", body=data)
+        if st >= 300:
+            if st == 404:
+                self.make_bucket(self.CONFIG_BUCKET)
+                return self.put_config(path, data)
+            self._raise(st, body, self.CONFIG_BUCKET, path)
+
+    CONFIG_BUCKET = "minio-tpu-gateway-config"
+
+    def get_config(self, path: str) -> bytes:
+        st, _h, data = self._request(
+            "GET", f"/{self.CONFIG_BUCKET}/{path}")
+        if st >= 300:
+            raise errors.FileNotFound(path)
+        return data
+
+    def delete_config(self, path: str) -> None:
+        self._request("DELETE", f"/{self.CONFIG_BUCKET}/{path}")
+
+    def is_ready(self) -> bool:
+        try:
+            st, _h, _d = self._request("GET", "/")
+            return st < 500
+        except OSError:
+            return False
+
+    def storage_info(self) -> dict:
+        return {"backend": "gateway", "gateway": "s3",
+                "endpoint": self.netloc}
+
+    def backend_type(self) -> str:
+        return "Gateway:s3"

@@ -6,13 +6,22 @@ drives. ``http://host:port/path`` endpoint args select DISTRIBUTED mode:
 every process gets the same full endpoint list, serves the disks whose
 URL matches its --address, and reaches the rest over storage RPC
 (reference dist-erasure startup; buildscripts/verify-healing.sh drives
-it the same way). Root credentials: MINIO_TPU_ROOT_USER/_PASSWORD
-(default minioadmin/minioadmin)."""
+it the same way). ``--gateway nas|s3`` serves the S3 API over a backend.
+Root credentials: MINIO_TPU_ROOT_USER/_PASSWORD (MINIO_ROOT_USER/
+_PASSWORD also honored; default minioadmin/minioadmin)."""
 from __future__ import annotations
 
 import argparse
 import os
 import sys
+
+
+def _root_creds() -> tuple[str, str]:
+    ak = os.environ.get("MINIO_TPU_ROOT_USER") \
+        or os.environ.get("MINIO_ROOT_USER") or "minioadmin"
+    sk = os.environ.get("MINIO_TPU_ROOT_PASSWORD") \
+        or os.environ.get("MINIO_ROOT_PASSWORD") or "minioadmin"
+    return ak, sk
 
 
 def main(argv=None):
@@ -24,40 +33,49 @@ def main(argv=None):
     ap.add_argument("--region", default="us-east-1")
     ap.add_argument("--parity", type=int, default=None,
                     help="parity drives per set (default: drives/2)")
+    ap.add_argument("--gateway", choices=["nas", "s3"], default=None,
+                    help="gateway mode: serve the S3 API over a backend "
+                         "(nas: shared mount path; s3: upstream endpoint)")
     args = ap.parse_args(argv)
+    ak, sk = _root_creds()
 
-    ak = os.environ.get("MINIO_TPU_ROOT_USER", "minioadmin")
-    sk = os.environ.get("MINIO_TPU_ROOT_PASSWORD", "minioadmin")
-
-    if any(d.startswith(("http://", "https://")) for d in args.dirs):
+    if args.gateway:
+        from ..gateway import new_gateway_layer
+        if len(args.dirs) != 1:
+            ap.error("gateway mode takes exactly one target")
+        up_ak = os.environ.get("MINIO_TPU_GATEWAY_ACCESS_KEY", ak)
+        up_sk = os.environ.get("MINIO_TPU_GATEWAY_SECRET_KEY", sk)
+        obj = new_gateway_layer(args.gateway, args.dirs[0], up_ak, up_sk,
+                                args.region)
+        banner = f"gateway {args.gateway} -> {args.dirs[0]}"
+    elif any(d.startswith(("http://", "https://")) for d in args.dirs):
         return _serve_distributed(args, ak, sk)
-
-    from ..dist.ellipses import expand_endpoints
-    dirs = expand_endpoints(args.dirs)
-
-    from ..objectlayer import ErasureObjects, ErasureSets
-    from ..storage import XLStorage
-    from ..dist.topology import pick_set_layout
-    disks = [XLStorage(d) for d in dirs]
-    if len(disks) == 1:
-        from ..fs import FSObjects
-        obj = FSObjects(dirs[0])
-        print(f"FS mode on {dirs[0]}", file=sys.stderr)
     else:
-        set_count, per_set = pick_set_layout(len(disks))
-        if set_count == 1:
-            obj = ErasureObjects(disks, default_parity=args.parity)
+        from ..dist.ellipses import expand_endpoints
+        dirs = expand_endpoints(args.dirs)
+
+        from ..dist.topology import pick_set_layout
+        from ..objectlayer import ErasureObjects, ErasureSets
+        from ..storage import XLStorage
+        disks = [XLStorage(d) for d in dirs]
+        if len(disks) == 1:
+            from ..fs import FSObjects
+            obj = FSObjects(dirs[0])
+            banner = f"FS mode on {dirs[0]}"
         else:
-            obj = ErasureSets(disks, set_count, per_set,
-                              default_parity=args.parity)
-        print(f"erasure: {set_count} set(s) x {per_set} drives",
-              file=sys.stderr)
+            set_count, per_set = pick_set_layout(len(disks))
+            if set_count == 1:
+                obj = ErasureObjects(disks, default_parity=args.parity)
+            else:
+                obj = ErasureSets(disks, set_count, per_set,
+                                  default_parity=args.parity)
+            banner = f"erasure: {set_count} set(s) x {per_set} drives"
 
     host, _, port = args.address.rpartition(":")
     from . import S3Server
     srv = S3Server(obj, host or "0.0.0.0", int(port), args.region,
                    access_key=ak, secret_key=sk)
-    print(f"listening on {args.address}", file=sys.stderr)
+    print(f"{banner}; listening on {args.address}", file=sys.stderr)
     try:
         srv.serve_forever()
     except KeyboardInterrupt:
@@ -73,10 +91,15 @@ def _serve_distributed(args, ak: str, sk: str):
     from ..dist.node import Node
     host, _, port = args.address.rpartition(":")
     host = host or "0.0.0.0"
-    local_url = f"http://{host}:{port}"
-    node = Node(args.dirs, local_url=local_url, address=host,
-                port=int(port), access_key=ak, secret_key=sk,
-                region=args.region, default_parity=args.parity)
+
+    def build(local_url: str) -> Node:
+        return Node(args.dirs, local_url=local_url, address=host,
+                    port=int(port), access_key=ak, secret_key=sk,
+                    region=args.region, default_parity=args.parity)
+
+    node = build(f"http://{host}:{port}")
+    if not node.local_disks:
+        node = build(f"https://{host}:{port}")
     if not node.local_disks:
         # --address 0.0.0.0 (or a host alias) matches no endpoint URL;
         # retry with any endpoint on our port whose host resolves to a
@@ -89,10 +112,7 @@ def _serve_distributed(args, ak: str, sk: str):
                       and e.url.split("//", 1)[-1].rsplit(":", 1)[0]
                       in local_names}
         if len(candidates) == 1:
-            node = Node(args.dirs, local_url=candidates.pop(),
-                        address=host, port=int(port), access_key=ak,
-                        secret_key=sk, region=args.region,
-                        default_parity=args.parity)
+            node = build(candidates.pop())
     if not node.local_disks:
         sys.exit(f"error: --address {args.address} matches no endpoint "
                  f"URL; pass the URL this node serves (endpoints: "
