@@ -46,11 +46,18 @@ def save_format(disk, fmt: dict) -> None:
                    json.dumps(fmt, indent=1).encode())
 
 
-def init_format_erasure(disks: list, set_count: int, drives_per_set: int
-                        ) -> dict:
+def init_format_erasure(disks: list, set_count: int, drives_per_set: int,
+                        may_init: bool = True) -> dict:
     """Format fresh disks / validate existing ones; returns the reference
     format. Disks are ordered set-major (disk i belongs to set
-    i // drives_per_set, slot i % drives_per_set)."""
+    i // drives_per_set, slot i % drives_per_set).
+
+    ``may_init=False``: when EVERY disk is unformatted, raise
+    UnformattedDisk (retryable) instead of stamping a new deployment —
+    in a fresh cluster only the node owning the first endpoint
+    initializes (reference cmd/prepare-storage.go: firstDisk), otherwise
+    two nodes race to write different deployment ids and the format is
+    permanently split."""
     fmts: list[dict | None] = []
     for d in disks:
         if d is None:
@@ -62,6 +69,10 @@ def init_format_erasure(disks: list, set_count: int, drives_per_set: int
             fmts.append(None)
     ref = next((f for f in fmts if f is not None), None)
     if ref is None:
+        if not may_init:
+            raise errors.UnformattedDisk(
+                "fresh cluster: waiting for the first node to write the "
+                "reference format")
         ref = new_format(set_count, drives_per_set)
     sets = ref["xl"]["sets"]
     if len(sets) != set_count or len(sets[0]) != drives_per_set:

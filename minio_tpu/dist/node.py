@@ -128,12 +128,19 @@ class Node:
 
     def wait_format(self, timeout: float):
         """waitForFormatErasure (cmd/prepare-storage.go:331): retry until
-        every disk is reachable and consistently formatted."""
+        every disk is reachable and consistently formatted. Only the node
+        owning the FIRST endpoint may stamp a brand-new deployment; the
+        rest wait for its format to land (first-disk rule, else two fresh
+        nodes race to different deployment ids)."""
+        first = self.endpoints[0] if self.endpoints else None
+        may_init = first is None or not first.url \
+            or first.url == self.local_url
         deadline = time.monotonic() + timeout
         while True:
             try:
                 self.format = init_format_erasure(
-                    self.disks, self.set_count, self.drives_per_set)
+                    self.disks, self.set_count, self.drives_per_set,
+                    may_init=may_init)
                 return
             except errors.StorageError:
                 if time.monotonic() >= deadline:

@@ -120,12 +120,12 @@ class S3GatewayLayer(ObjectLayer):
         try:
             conn.request(method, url, body=body or None, headers=h)
             resp = conn.getresponse()
+            hdrs = {k.lower(): v for k, v in resp.getheaders()}
             if stream and resp.status < 300:
-                return resp.status, dict(resp.getheaders()), \
-                    _ResponseReader(resp, conn)
+                return resp.status, hdrs, _ResponseReader(resp, conn)
             data = resp.read()
             conn.close()
-            return resp.status, dict(resp.getheaders()), data
+            return resp.status, hdrs, data
         except Exception:
             conn.close()
             raise
@@ -148,7 +148,7 @@ class S3GatewayLayer(ObjectLayer):
         if status == 409 and code in ("BucketAlreadyOwnedByYou",
                                       "BucketAlreadyExists"):
             raise dterr.BucketExists(bucket)
-        if status in (301, 400) and code == "InvalidRange":
+        if status == 416 or code == "InvalidRange":
             raise dterr.InvalidRange(bucket, object)
         raise errors.FaultyDisk(
             f"upstream s3: {status} {code or data[:120]!r}")
@@ -181,9 +181,15 @@ class S3GatewayLayer(ObjectLayer):
 
     def delete_bucket(self, bucket: str, force: bool = False) -> None:
         if force:
-            r = self.list_objects(bucket, max_keys=1000)
-            for oi in r.objects:
-                self.delete_object(bucket, oi.name)
+            marker = ""
+            while True:
+                r = self.list_objects(bucket, marker=marker,
+                                      max_keys=1000)
+                for oi in r.objects:
+                    self.delete_object(bucket, oi.name)
+                if not r.is_truncated or not r.next_marker:
+                    break
+                marker = r.next_marker
         st, _h, data = self._request("DELETE", f"/{bucket}")
         if st >= 300:
             self._raise(st, data, bucket)
@@ -205,23 +211,29 @@ class S3GatewayLayer(ObjectLayer):
 
     def put_object(self, bucket: str, object: str, stream, size: int,
                    opts: ObjectOptions = None) -> ObjectInfo:
-        body = stream.read(size) if size >= 0 else stream.read()
+        # known-size bodies stream straight through http.client (no
+        # buffering); unknown-size bodies must materialize for the
+        # content-length the upstream requires
+        body = stream if size >= 0 else stream.read()
+        blen = size if size >= 0 else len(body)
         st, hdrs, data = self._request(
-            "PUT", f"/{bucket}/{object}", body=body,
+            "PUT", f"/{bucket}/{object}", body=body, body_len=blen,
             headers=self._meta_headers(opts))
         if st >= 300:
             self._raise(st, data, bucket, object)
-        return ObjectInfo(bucket=bucket, name=object, size=len(body),
-                          etag=hdrs.get("ETag", "").strip('"'),
+        return ObjectInfo(bucket=bucket, name=object, size=blen,
+                          etag=hdrs.get("etag", "").strip('"'),
                           version_id=hdrs.get("x-amz-version-id", ""))
 
     def get_object(self, bucket: str, object: str, writer,
                    offset: int = 0, length: int = -1,
                    opts: ObjectOptions = None) -> ObjectInfo:
         headers = {}
-        if offset or length >= 0:
-            end = "" if length < 0 else str(offset + length - 1)
-            headers["range"] = f"bytes={offset}-{end}"
+        if length > 0:
+            headers["range"] = f"bytes={offset}-{offset + length - 1}"
+        elif offset > 0:
+            headers["range"] = f"bytes={offset}-"
+        # length == 0 with offset 0 (empty object): plain GET, no Range
         query = {}
         if opts and opts.version_id:
             query["versionId"] = opts.version_id
@@ -250,24 +262,24 @@ class S3GatewayLayer(ObjectLayer):
                 # keep the full header name: the server stack stores user
                 # metadata under its x-amz-meta-* key (s3api._user_meta)
                 user[lk] = v
-        size = int(hdrs.get("Content-Length", "0") or 0)
-        crange = hdrs.get("Content-Range", "")
+        size = int(hdrs.get("content-length", "0") or 0)
+        crange = hdrs.get("content-range", "")
         if crange.startswith("bytes ") and "/" in crange:
             try:
                 size = int(crange.rsplit("/", 1)[1])
             except ValueError:
                 pass
         mod = 0.0
-        if hdrs.get("Last-Modified"):
+        if hdrs.get("last-modified"):
             try:
                 mod = parsedate_to_datetime(
-                    hdrs["Last-Modified"]).timestamp()
+                    hdrs["last-modified"]).timestamp()
             except (ValueError, TypeError):
                 pass
         return ObjectInfo(
             bucket=bucket, name=object, size=size,
-            etag=hdrs.get("ETag", "").strip('"'),
-            content_type=hdrs.get("Content-Type", ""),
+            etag=hdrs.get("etag", "").strip('"'),
+            content_type=hdrs.get("content-type", ""),
             mod_time=mod, user_defined=user,
             version_id=hdrs.get("x-amz-version-id", ""),
             delete_marker=hdrs.get("x-amz-delete-marker") == "true")
@@ -347,14 +359,20 @@ class S3GatewayLayer(ObjectLayer):
                     mod_time=_iso_to_ts(_text(el, "LastModified"))))
             elif tag == "CommonPrefixes":
                 out.prefixes.append(_text(el, "Prefix"))
-        if out.is_truncated and out.objects:
-            out.next_marker = out.objects[-1].name
+        if out.is_truncated:
+            # the upstream's token is a start-after KEY here because we
+            # page with start-after (works against any S3 dialect)
+            nct = _text(root, "NextContinuationToken")
+            out.next_marker = nct or (
+                out.objects[-1].name if out.objects
+                else (out.prefixes[-1] if out.prefixes else ""))
+            out.next_continuation_token = out.next_marker
         return out
 
     def copy_object(self, src_bucket, src_object, dst_bucket, dst_object,
                     src_info=None, src_opts=None,
                     dst_opts=None) -> ObjectInfo:
-        src = f"/{src_bucket}/{src_object}"
+        src = urllib.parse.quote(f"/{src_bucket}/{src_object}")
         if src_opts and src_opts.version_id:
             src += f"?versionId={src_opts.version_id}"
         headers = {"x-amz-copy-source": src}
@@ -386,16 +404,17 @@ class S3GatewayLayer(ObjectLayer):
     def put_object_part(self, bucket: str, object: str, upload_id: str,
                         part_number: int, stream, size: int,
                         opts: ObjectOptions = None) -> PartInfo:
-        body = stream.read(size) if size >= 0 else stream.read()
+        body = stream if size >= 0 else stream.read()
+        blen = size if size >= 0 else len(body)
         st, hdrs, data = self._request(
             "PUT", f"/{bucket}/{object}",
             query={"partNumber": str(part_number), "uploadId": upload_id},
-            body=body)
+            body=body, body_len=blen)
         if st >= 300:
             self._raise(st, data, bucket, object)
         return PartInfo(part_number=part_number,
-                        etag=hdrs.get("ETag", "").strip('"'),
-                        size=len(body))
+                        etag=hdrs.get("etag", "").strip('"'),
+                        size=blen)
 
     def list_object_parts(self, bucket: str, object: str, upload_id: str,
                           part_marker: int = 0, max_parts: int = 1000
@@ -469,12 +488,14 @@ class S3GatewayLayer(ObjectLayer):
 
     def put_object_tags(self, bucket: str, object: str, tags_enc: str,
                         opts: ObjectOptions = None) -> None:
+        from xml.sax.saxutils import escape
         body = ["<Tagging><TagSet>"]
         for pair in (tags_enc.split("&") if tags_enc else []):
             k, _, v = pair.partition("=")
             body.append(
-                f"<Tag><Key>{urllib.parse.unquote_plus(k)}</Key>"
-                f"<Value>{urllib.parse.unquote_plus(v)}</Value></Tag>")
+                f"<Tag><Key>{escape(urllib.parse.unquote_plus(k))}</Key>"
+                f"<Value>{escape(urllib.parse.unquote_plus(v))}</Value>"
+                f"</Tag>")
         body.append("</TagSet></Tagging>")
         st, _h, data = self._request("PUT", f"/{bucket}/{object}",
                                      query={"tagging": ""},

@@ -387,8 +387,14 @@ class _S3Handler(BaseHTTPRequestHandler):
 
     def _route(self):
         self._parse()
-        # unauthenticated health endpoints (cmd/healthcheck-handler.go)
+        # unauthenticated health endpoints (cmd/healthcheck-handler.go):
+        # liveness = this process serves HTTP (the RPC reconnect pings
+        # probe it DURING cluster bootstrap, when no node has an object
+        # layer yet — gating it on readiness deadlocks a fresh cluster);
+        # readiness/cluster = storage is actually online
         if self.url_path.startswith("/minio/health/"):
+            if self.url_path.rstrip("/").endswith("/live"):
+                return self._send(200, b"", "text/plain; charset=utf-8")
             ok = self.s3.obj is not None and self.s3.obj.is_ready()
             return self._send(200 if ok else 503, b"",
                               "text/plain; charset=utf-8")
