@@ -67,6 +67,23 @@ SUB_SYSTEMS: dict[str, dict[str, KV]] = {
     "heal": {
         "concurrency": KV("128"),
     },
+    "identity_openid": {
+        "config_url": KV("", env="MINIO_TPU_IDENTITY_OPENID_CONFIG_URL",
+                         help="OIDC discovery document URL"),
+        "jwks_url": KV("", env="MINIO_TPU_IDENTITY_OPENID_JWKS_URL"),
+        "client_id": KV("", env="MINIO_TPU_IDENTITY_OPENID_CLIENT_ID"),
+        "claim_name": KV("policy",
+                         env="MINIO_TPU_IDENTITY_OPENID_CLAIM_NAME"),
+    },
+    "identity_ldap": {
+        "server_addr": KV("", env="MINIO_TPU_IDENTITY_LDAP_SERVER_ADDR"),
+        "user_dn_format": KV(
+            "", env="MINIO_TPU_IDENTITY_LDAP_USER_DN_FORMAT",
+            help="bind DN template, %s replaced by the username"),
+        "sts_policy": KV(
+            "", env="MINIO_TPU_IDENTITY_LDAP_STS_POLICY",
+            help="comma-separated policies attached to LDAP identities"),
+    },
     "kms": {
         "master_key": KV("", env="MINIO_TPU_KMS_MASTER_KEY",
                          help="hex 32-byte SSE-S3 master key"),

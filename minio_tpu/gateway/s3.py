@@ -181,15 +181,8 @@ class S3GatewayLayer(ObjectLayer):
 
     def delete_bucket(self, bucket: str, force: bool = False) -> None:
         if force:
-            marker = ""
-            while True:
-                r = self.list_objects(bucket, marker=marker,
-                                      max_keys=1000)
-                for oi in r.objects:
-                    self.delete_object(bucket, oi.name)
-                if not r.is_truncated or not r.next_marker:
-                    break
-                marker = r.next_marker
+            for oi in self.iter_objects(bucket):
+                self.delete_object(bucket, oi.name)
         st, _h, data = self._request("DELETE", f"/{bucket}")
         if st >= 300:
             self._raise(st, data, bucket)
@@ -360,12 +353,14 @@ class S3GatewayLayer(ObjectLayer):
             elif tag == "CommonPrefixes":
                 out.prefixes.append(_text(el, "Prefix"))
         if out.is_truncated:
-            # the upstream's token is a start-after KEY here because we
-            # page with start-after (works against any S3 dialect)
-            nct = _text(root, "NextContinuationToken")
-            out.next_marker = nct or (
-                out.objects[-1].name if out.objects
-                else (out.prefixes[-1] if out.prefixes else ""))
+            # we page with start-after, so the marker must be a KEY (the
+            # upstream's NextContinuationToken is opaque on real S3). The
+            # next page starts after the greatest item returned; for a
+            # trailing CommonPrefix that means past its whole subtree.
+            high = "\U0010ffff"
+            last_key = out.objects[-1].name if out.objects else ""
+            last_pfx = (out.prefixes[-1] + high) if out.prefixes else ""
+            out.next_marker = max(last_key, last_pfx)
             out.next_continuation_token = out.next_marker
         return out
 

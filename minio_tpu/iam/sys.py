@@ -6,8 +6,6 @@ STS temporary credentials live in the same table with an expiry."""
 from __future__ import annotations
 
 import base64
-import hashlib
-import hmac
 import json
 import secrets
 import threading
@@ -19,34 +17,6 @@ from ..utils import errors
 from . import policy as pol
 
 IAM_PREFIX = "iam"
-
-
-def _b64url_decode(s: str) -> bytes:
-    pad = "=" * (-len(s) % 4)
-    return base64.urlsafe_b64decode(s + pad)
-
-
-def _verify_jwt_hs256(token: str, secret: str) -> dict:
-    """Minimal JWT validation: HS256 signature + exp check. Raises
-    ValueError on any problem."""
-    try:
-        header_b64, payload_b64, sig_b64 = token.split(".")
-        header = json.loads(_b64url_decode(header_b64))
-        payload = json.loads(_b64url_decode(payload_b64))
-        sig = _b64url_decode(sig_b64)
-    except (ValueError, json.JSONDecodeError):
-        raise ValueError("malformed JWT") from None
-    if header.get("alg") != "HS256":
-        raise ValueError(f"unsupported JWT alg {header.get('alg')!r}")
-    want = hmac.new(secret.encode(),
-                    f"{header_b64}.{payload_b64}".encode(),
-                    hashlib.sha256).digest()
-    if not hmac.compare_digest(want, sig):
-        raise ValueError("JWT signature mismatch")
-    exp = payload.get("exp")
-    if isinstance(exp, (int, float)) and exp < time.time():
-        raise ValueError("JWT expired")
-    return payload
 
 
 @dataclass
@@ -265,25 +235,37 @@ class IAMSys:
             self.users[ak] = u
         return u
 
-    def assume_role_with_web_identity(self, token: str,
-                                      duration_s: int = 3600,
-                                      session_policy: bytes = b""
-                                      ) -> UserIdentity:
-        """STS AssumeRoleWithWebIdentity (reference
-        cmd/sts-handlers.go:43-93 + cmd/config/identity/openid): validate
-        the IdP's JWT and mint temporary credentials for its subject.
-
-        Token validation here covers HS256 with the shared secret from
-        MINIO_TPU_OPENID_HMAC_SECRET (the dev/test IdP shape); RS256/JWKS
-        discovery against a real OpenID provider is not wired. Claims:
-        ``sub`` (required), ``policy`` (comma-separated policy names
-        applied to the temporary identity), ``exp`` honored as an upper
-        bound."""
+    def _openid_provider(self):
+        """The configured OpenID provider (JWKS/RS256 + HS256 secret),
+        cached per config tuple so the JWKS cache survives across STS
+        calls but a config change rebuilds it."""
         import os
-        secret = os.environ.get("MINIO_TPU_OPENID_HMAC_SECRET", "")
-        if not secret:
+
+        from ..config import get_config_sys
+        from .openid import provider_from_config
+        cfg = get_config_sys(None)
+        key = (cfg.get("identity_openid", "jwks_url"),
+               cfg.get("identity_openid", "config_url"),
+               cfg.get("identity_openid", "client_id"),
+               cfg.get("identity_openid", "claim_name"),
+               os.environ.get("MINIO_TPU_OPENID_HMAC_SECRET", ""))
+        cached = getattr(self, "_openid_cache", None)
+        if cached is not None and cached[0] == key:
+            return cached[1]
+        prov = provider_from_config(cfg)
+        self._openid_cache = (key, prov)
+        return prov
+
+    def _mint_openid_identity(self, token: str, duration_s: int,
+                              session_policy: bytes, prefix: str,
+                              parent_kind: str) -> UserIdentity:
+        """Shared WebIdentity/ClientGrants flow (reference
+        cmd/sts-handlers.go:43-93: both validate an IdP token and mint
+        temporary credentials; they differ only in the request shape)."""
+        prov = self._openid_provider()
+        if not prov.configured():
             raise ValueError("no OpenID provider configured")
-        claims = _verify_jwt_hs256(token, secret)
+        claims = prov.verify(token)
         sub = claims.get("sub", "")
         if not sub:
             raise ValueError("token has no sub claim")
@@ -291,14 +273,83 @@ class IAMSys:
         expiry = time.time() + duration_s
         if isinstance(claims.get("exp"), (int, float)):
             expiry = min(expiry, float(claims["exp"]))
-        policies = [p for p in str(claims.get("policy", "")).split(",")
-                    if p]
-        ak = "STSWI" + secrets.token_hex(8).upper()
+        policies = [p for p in
+                    str(claims.get(prov.claim_name, "")).split(",") if p]
+        ak = prefix + secrets.token_hex(8).upper()
         sk = secrets.token_urlsafe(30)
         u = UserIdentity(access_key=ak, secret_key=sk,
-                         parent=f"web-identity:{sub}",
+                         parent=f"{parent_kind}:{sub}",
                          policies=policies,
                          expiration=expiry,
+                         session_policy=session_policy)
+        with self._mutating():
+            self._purge_expired_locked()
+            self.users[ak] = u
+        return u
+
+    def assume_role_with_web_identity(self, token: str,
+                                      duration_s: int = 3600,
+                                      session_policy: bytes = b""
+                                      ) -> UserIdentity:
+        """STS AssumeRoleWithWebIdentity: validate the IdP's JWT (RS256
+        against the configured JWKS, or HS256 with the shared secret) and
+        mint temporary credentials for its subject. The provider's
+        claim_name (default ``policy``) carries comma-separated policy
+        names; ``exp`` bounds the credential lifetime."""
+        return self._mint_openid_identity(token, duration_s,
+                                          session_policy, "STSWI",
+                                          "web-identity")
+
+    def assume_role_with_client_grants(self, token: str,
+                                       duration_s: int = 3600,
+                                       session_policy: bytes = b""
+                                       ) -> UserIdentity:
+        """STS AssumeRoleWithClientGrants: the OAuth2 client-credentials
+        sibling of WebIdentity — same token validation, same minting
+        (reference cmd/sts-handlers.go ClientGrants)."""
+        return self._mint_openid_identity(token, duration_s,
+                                          session_policy, "STSCG",
+                                          "client-grants")
+
+    def assume_role_with_ldap_identity(self, username: str, password: str,
+                                       duration_s: int = 3600,
+                                       session_policy: bytes = b""
+                                       ) -> UserIdentity:
+        """STS AssumeRoleWithLDAPIdentity (reference
+        cmd/sts-handlers.go + cmd/config/identity/ldap): validate the
+        password with a simple bind against the configured server, then
+        mint temporary credentials. Policies come from the
+        identity_ldap.sts_policy config (the reference's group->policy
+        mapping is richer; this maps all LDAP identities to one policy
+        set, documented divergence)."""
+        from ..config import get_config_sys
+        from .ldap import LDAPError, simple_bind
+        cfg = get_config_sys(None)
+        server = cfg.get("identity_ldap", "server_addr")
+        dn_format = cfg.get("identity_ldap", "user_dn_format")
+        if not server or not dn_format:
+            raise ValueError("no LDAP provider configured")
+        if not username or "," in username or "=" in username:
+            raise ValueError("invalid LDAP username")
+        if not password:
+            raise ValueError("empty LDAP password")
+        try:
+            simple_bind(server, dn_format.replace("%s", username),
+                        password)
+        except LDAPError as e:
+            raise ValueError(f"LDAP bind failed: {e}") from e
+        except OSError as e:
+            raise ValueError(f"LDAP server unreachable: {e}") from e
+        duration_s = max(900, min(duration_s, 7 * 24 * 3600))
+        policies = [p for p in
+                    cfg.get("identity_ldap", "sts_policy").split(",")
+                    if p]
+        ak = "STSLDAP" + secrets.token_hex(8).upper()
+        sk = secrets.token_urlsafe(30)
+        u = UserIdentity(access_key=ak, secret_key=sk,
+                         parent=f"ldap:{username}",
+                         policies=policies,
+                         expiration=time.time() + duration_s,
                          session_policy=session_policy)
         with self._mutating():
             self._purge_expired_locked()

@@ -320,8 +320,10 @@ class _S3Handler(BaseHTTPRequestHandler):
             raise AuthError("AccessDenied", f"not allowed to {action}")
 
     def _sts(self, body: bytes):
-        """STS: AssumeRole (signed caller) and AssumeRoleWithWebIdentity
-        (OIDC JWT) — reference cmd/sts-handlers.go:43-93."""
+        """STS: AssumeRole (signed caller), AssumeRoleWithWebIdentity /
+        AssumeRoleWithClientGrants (OIDC JWT against the configured
+        provider) and AssumeRoleWithLDAPIdentity (simple bind) —
+        reference cmd/sts-handlers.go:43-93."""
         form = dict(urllib.parse.parse_qsl(body.decode("utf-8", "replace")))
         action = form.get("Action", "AssumeRole")
         try:
@@ -330,22 +332,32 @@ class _S3Handler(BaseHTTPRequestHandler):
             return self._error("InvalidParameterValue",
                                "DurationSeconds must be an integer", 400)
         session_policy = form.get("Policy", "").encode()
-        if action == "AssumeRoleWithWebIdentity":
-            token = form.get("WebIdentityToken", "")
-            try:
+        try:
+            if action == "AssumeRoleWithWebIdentity":
                 cred = self.s3.iam.assume_role_with_web_identity(
-                    token, duration, session_policy)
-            except ValueError as e:
-                return self._error("InvalidParameterValue", str(e), 400)
-        elif action == "AssumeRole":
-            try:
-                ak = self._authenticate()
-            except AuthError as e:
-                return self._error(e.code, e.message, e.status)
-            cred = self.s3.iam.assume_role(ak, duration, session_policy)
-        else:
-            return self._error("InvalidAction",
-                               f"unsupported STS action {action}", 400)
+                    form.get("WebIdentityToken", ""), duration,
+                    session_policy)
+            elif action == "AssumeRoleWithClientGrants":
+                cred = self.s3.iam.assume_role_with_client_grants(
+                    form.get("Token", ""), duration, session_policy)
+            elif action == "AssumeRoleWithLDAPIdentity":
+                cred = self.s3.iam.assume_role_with_ldap_identity(
+                    form.get("LDAPUsername", ""),
+                    form.get("LDAPPassword", ""), duration,
+                    session_policy)
+            elif action == "AssumeRole":
+                try:
+                    ak = self._authenticate()
+                except AuthError as e:
+                    return self._error(e.code, e.message, e.status)
+                cred = self.s3.iam.assume_role(ak, duration,
+                                               session_policy)
+            else:
+                return self._error("InvalidAction",
+                                   f"unsupported STS action {action}",
+                                   400)
+        except ValueError as e:
+            return self._error("InvalidParameterValue", str(e), 400)
         import datetime
         exp = datetime.datetime.fromtimestamp(
             cred.expiration, tz=datetime.timezone.utc
