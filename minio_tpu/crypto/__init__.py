@@ -4,15 +4,18 @@ per-object key (OEK) sealed by the request key (SSE-C) or a KMS data key
 (SSE-S3), and an AES-256-GCM package stream (64 KiB packages, sequence
 numbers bound into nonce+AAD) that supports ranged reads by package
 alignment."""
-from .kms import LocalKMS, get_kms
+from .kms import (KESClient, KMS, KMSError, KMSUnreachable, LocalKMS,
+                  get_kms, set_kms)
 from .sse import (META_SCHEME, PKG_SIZE, DecryptWriter, EncryptReader,
                   SSEInfo, decrypt_range_bounds, enc_size,
                   parse_sse_headers, plain_size_of, seal_object_key,
-                  unseal_object_key)
+                  sse_kms_context, unseal_object_key)
 
 __all__ = [
-    "LocalKMS", "get_kms", "META_SCHEME", "PKG_SIZE", "DecryptWriter",
-    "EncryptReader", "SSEInfo", "decrypt_range_bounds", "enc_size",
-    "parse_sse_headers", "plain_size_of", "seal_object_key",
+    "KESClient", "KMS", "KMSError", "KMSUnreachable", "LocalKMS",
+    "get_kms", "set_kms",
+    "META_SCHEME", "PKG_SIZE", "DecryptWriter", "EncryptReader", "SSEInfo",
+    "decrypt_range_bounds", "enc_size", "parse_sse_headers",
+    "plain_size_of", "seal_object_key", "sse_kms_context",
     "unseal_object_key",
 ]

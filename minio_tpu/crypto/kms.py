@@ -1,39 +1,210 @@
-"""Local KMS — the SSE-S3 master-key service (reference cmd/crypto/kms.go:
-a KES/Vault client in production; here a single master key held by the
-process, the same role as the reference's masterKeyKMS dev fallback).
+"""KMS backends for SSE-S3 / SSE-KMS (reference cmd/crypto/kms.go,
+kes.go, vault.go).
 
-GenerateKey returns (plaintext data key, sealed blob); the sealed blob is
-stored in object metadata and unsealed on read. Context binds the blob to
-its object so blobs can't be replayed across objects."""
+The reference abstracts master-key services behind a ``KMS`` interface
+(cmd/crypto/kms.go:31 ``GenerateKey/UnsealKey/Info``) with three
+implementations: a dev master-key KMS, a KES client (cmd/crypto/kes.go)
+and a Vault client (cmd/crypto/vault.go). Here:
+
+* ``LocalKMS`` — process-local AES-GCM master key, with per-key-id
+  subkeys derived by HKDF-style expansion so SSE-KMS requests that name
+  a key id work without an external service.
+* ``KESClient`` — the reference's KES wire protocol
+  (``POST /v1/key/create|generate|decrypt/{name}``, base64 JSON bodies,
+  mTLS client certs), over urllib so no extra dependency is needed.
+
+``generate_key`` returns (plaintext data key, sealed blob); the sealed
+blob is stored in object metadata and unsealed on read. Context binds
+the blob to its object so blobs can't be replayed across objects."""
 from __future__ import annotations
 
+import base64
 import hashlib
+import hmac
+import json
 import os
 import secrets
+import ssl
+import urllib.error
+import urllib.parse
+import urllib.request
 
 from cryptography.hazmat.primitives.ciphers.aead import AESGCM
 
 
-class LocalKMS:
+class KMSError(Exception):
+    pass
+
+
+class KMSUnreachable(KMSError):
+    """No KMS endpoint answered — a transient availability failure, not a
+    wrong-key condition; callers should surface 503, not AccessDenied."""
+
+
+class KMS:
+    """What the SSE paths need from any master-key service
+    (cmd/crypto/kms.go:31)."""
+
+    key_id: str = ""
+
+    def generate_key(self, context: str, key_id: str = ""
+                     ) -> tuple[bytes, bytes]:
+        raise NotImplementedError
+
+    def unseal(self, blob: bytes, context: str, key_id: str = "") -> bytes:
+        raise NotImplementedError
+
+    def create_key(self, key_id: str) -> None:
+        raise NotImplementedError
+
+    def info(self) -> dict:
+        raise NotImplementedError
+
+
+class LocalKMS(KMS):
     def __init__(self, master_key: bytes, key_id: str = "minio-tpu-default"):
         if len(master_key) != 32:
             raise ValueError("KMS master key must be 32 bytes")
         self.key_id = key_id
-        self._aead = AESGCM(master_key)
+        self._master = master_key
+        self._aead_cache: dict[str, AESGCM] = {}
 
-    def generate_key(self, context: str) -> tuple[bytes, bytes]:
+    def _aead(self, key_id: str) -> AESGCM:
+        a = self._aead_cache.get(key_id)
+        if a is None:
+            if key_id == self.key_id:
+                # the default key seals directly under the master key —
+                # blobs written before named-key support stay readable
+                sub = self._master
+            else:
+                sub = hmac.new(self._master, b"minio-tpu-kms-sub:" +
+                               key_id.encode(), hashlib.sha256).digest()
+            a = self._aead_cache[key_id] = AESGCM(sub)
+        return a
+
+    def generate_key(self, context: str, key_id: str = ""
+                     ) -> tuple[bytes, bytes]:
         """(plaintext 32-byte data key, sealed blob)."""
+        kid = key_id or self.key_id
         key = secrets.token_bytes(32)
         nonce = secrets.token_bytes(12)
-        blob = nonce + self._aead.encrypt(nonce, key, context.encode())
+        blob = nonce + self._aead(kid).encrypt(nonce, key, context.encode())
         return key, blob
 
-    def unseal(self, blob: bytes, context: str) -> bytes:
+    def unseal(self, blob: bytes, context: str, key_id: str = "") -> bytes:
         nonce, ct = blob[:12], blob[12:]
-        return self._aead.decrypt(nonce, ct, context.encode())
+        return self._aead(key_id or self.key_id).decrypt(
+            nonce, ct, context.encode())
+
+    def create_key(self, key_id: str) -> None:
+        self._aead(key_id)  # derived on demand; nothing to persist
+
+    def info(self) -> dict:
+        return {"name": "local", "endpoints": [], "default_key_id":
+                self.key_id, "status": "online"}
 
 
-_kms: LocalKMS | None = None
+class KESClient(KMS):
+    """Client for a KES key-management server speaking the reference wire
+    protocol (cmd/crypto/kes.go:222-320):
+
+    * ``POST /v1/key/create/{name}``
+    * ``POST /v1/key/generate/{name}`` body ``{"context": b64}`` →
+      ``{"plaintext": b64, "ciphertext": b64}``
+    * ``POST /v1/key/decrypt/{name}`` body ``{"ciphertext": b64,
+      "context": b64}`` → ``{"plaintext": b64}``
+
+    mTLS client authentication mirrors KesConfig (cert_file/key_file/
+    ca_path); plain http endpoints are accepted for tests."""
+
+    def __init__(self, endpoints: list[str], default_key_id: str,
+                 cert_file: str = "", key_file: str = "", ca_path: str = "",
+                 timeout: float = 5.0):
+        if not endpoints:
+            raise KMSError("kes: missing endpoint")
+        self.endpoints = [e.rstrip("/") for e in endpoints]
+        self.key_id = default_key_id
+        self.timeout = timeout
+        self._ctx = None
+        if any(e.startswith("https") for e in self.endpoints):
+            self._ctx = ssl.create_default_context(
+                cafile=ca_path or None)
+            if not ca_path:
+                self._ctx.check_hostname = False
+                self._ctx.verify_mode = ssl.CERT_NONE
+            if cert_file and key_file:
+                self._ctx.load_cert_chain(cert_file, key_file)
+        self._rr = 0
+
+    def _post(self, path: str, body: dict) -> dict:
+        data = json.dumps(body).encode()
+        last: Exception | None = None
+        for i in range(len(self.endpoints)):
+            ep = self.endpoints[(self._rr + i) % len(self.endpoints)]
+            req = urllib.request.Request(
+                ep + path, data=data, method="POST",
+                headers={"Content-Type": "application/json"})
+            try:
+                with urllib.request.urlopen(
+                        req, timeout=self.timeout, context=self._ctx) as r:
+                    self._rr = (self._rr + i) % len(self.endpoints)
+                    payload = r.read()
+                    return json.loads(payload) if payload else {}
+            except urllib.error.HTTPError as e:
+                detail = e.read().decode(errors="replace")[:200]
+                if e.code >= 500:
+                    # server-side trouble on this endpoint; another may
+                    # be healthy
+                    last = KMSError(f"kes: {e.code} {detail}")
+                    continue
+                # 4xx is a definitive server answer, not a connectivity
+                # failure — don't fail over, surface it.
+                raise KMSError(f"kes: {e.code} {detail}") from None
+            except Exception as e:  # noqa: BLE001 — connectivity: try next
+                last = e
+        raise KMSUnreachable(f"kes: all endpoints unreachable: {last}")
+
+    def create_key(self, key_id: str) -> None:
+        self._post(f"/v1/key/create/{urllib.parse.quote(key_id, safe='')}",
+                   {})
+
+    def generate_key(self, context: str, key_id: str = ""
+                     ) -> tuple[bytes, bytes]:
+        kid = key_id or self.key_id
+        resp = self._post(
+            f"/v1/key/generate/{urllib.parse.quote(kid, safe='')}",
+            {"context": base64.b64encode(context.encode()).decode()})
+        try:
+            key = base64.b64decode(resp["plaintext"])
+            blob = base64.b64decode(resp["ciphertext"])
+        except (KeyError, TypeError, ValueError) as e:
+            raise KMSError(f"kes: malformed generate response: {e!r}") \
+                from None
+        if len(key) != 32:
+            raise KMSError("kes: invalid plaintext key size from KMS")
+        return key, blob
+
+    def unseal(self, blob: bytes, context: str, key_id: str = "") -> bytes:
+        kid = key_id or self.key_id
+        resp = self._post(
+            f"/v1/key/decrypt/{urllib.parse.quote(kid, safe='')}",
+            {"ciphertext": base64.b64encode(blob).decode(),
+             "context": base64.b64encode(context.encode()).decode()})
+        try:
+            key = base64.b64decode(resp["plaintext"])
+        except (KeyError, TypeError, ValueError) as e:
+            raise KMSError(f"kes: malformed decrypt response: {e!r}") \
+                from None
+        if len(key) != 32:
+            raise KMSError("kes: invalid plaintext key size from KMS")
+        return key
+
+    def info(self) -> dict:
+        return {"name": "KES", "endpoints": self.endpoints,
+                "default_key_id": self.key_id, "status": "online"}
+
+
+_kms: KMS | None = None
 _seed_secret = ""
 
 
@@ -44,15 +215,30 @@ def configure(seed_secret: str):
     _seed_secret = seed_secret
 
 
-def get_kms() -> LocalKMS:
-    """Process KMS: master key from MINIO_TPU_KMS_MASTER_KEY (hex). With
-    no explicit master key, a key derived from the deployment's root
-    secret is used and a warning is logged — the sealed blobs are then
-    only as strong as the root credential, so production deployments must
-    set a real master key (the reference refuses SSE-S3 without a KMS for
-    the same reason)."""
+def set_kms(kms: KMS | None):
+    """Install a specific KMS (tests, or explicit server config)."""
+    global _kms
+    _kms = kms
+
+
+def get_kms() -> KMS:
+    """Process KMS resolution order (reference cmd/crypto/config.go
+    LookupConfig): explicit set_kms > KES from env > local master key from
+    MINIO_TPU_KMS_MASTER_KEY (hex) > key derived from the root secret
+    (with a warning — production must set a real master key; the
+    reference refuses SSE without a KMS for the same reason)."""
     global _kms
     if _kms is None:
+        kes_ep = os.environ.get("MINIO_TPU_KMS_KES_ENDPOINT", "")
+        if kes_ep:
+            _kms = KESClient(
+                kes_ep.split(","),
+                os.environ.get("MINIO_TPU_KMS_KES_KEY_NAME",
+                               "minio-tpu-default"),
+                cert_file=os.environ.get("MINIO_TPU_KMS_KES_CERT_FILE", ""),
+                key_file=os.environ.get("MINIO_TPU_KMS_KES_KEY_FILE", ""),
+                ca_path=os.environ.get("MINIO_TPU_KMS_KES_CAPATH", ""))
+            return _kms
         hexkey = os.environ.get("MINIO_TPU_KMS_MASTER_KEY", "")
         if hexkey:
             master = bytes.fromhex(hexkey)

@@ -27,22 +27,27 @@ TAG = 16
 _AAD = b"minio-tpu-sse-v1"
 
 # internal metadata keys (reference: X-Minio-Internal-Server-Side-Encryption-*)
-META_SCHEME = "x-minio-internal-sse-scheme"          # "C" | "S3"
+META_SCHEME = "x-minio-internal-sse-scheme"          # "C" | "S3" | "KMS"
 META_SEALED = "x-minio-internal-sse-sealed-key"      # b64 sealed OEK
 META_IV = "x-minio-internal-sse-iv"                  # b64 12-byte base IV
 META_KEY_MD5 = "x-minio-internal-sse-c-key-md5"      # SSE-C key fingerprint
-META_KMS_BLOB = "x-minio-internal-sse-kms-blob"      # SSE-S3 sealed data key
+META_KMS_BLOB = "x-minio-internal-sse-kms-blob"      # S3/KMS sealed data key
+META_KMS_KEY_ID = "x-minio-internal-sse-kms-key-id"  # SSE-KMS master key id
+META_KMS_CONTEXT = "x-minio-internal-sse-kms-context"  # b64 JSON context
 META_PLAIN_SIZE = "x-minio-internal-sse-plain-size"
 
 SSE_META_KEYS = (META_SCHEME, META_SEALED, META_IV, META_KEY_MD5,
-                 META_KMS_BLOB, META_PLAIN_SIZE)
+                 META_KMS_BLOB, META_KMS_KEY_ID, META_KMS_CONTEXT,
+                 META_PLAIN_SIZE)
 
 
 @dataclass
 class SSEInfo:
-    scheme: str                    # "C" or "S3"
+    scheme: str                    # "C", "S3" or "KMS"
     key: bytes = b""               # SSE-C: client key (never persisted)
     key_md5: str = ""
+    kms_key_id: str = ""           # SSE-KMS: requested master key id
+    kms_context: str = ""          # SSE-KMS: canonical JSON context
 
 
 def parse_sse_headers(hdr, bucket: str, object: str) -> SSEInfo | None:
@@ -66,10 +71,39 @@ def parse_sse_headers(hdr, bucket: str, object: str) -> SSEInfo | None:
             raise dt.SSEKeyMD5Mismatch(bucket, object)
         return SSEInfo(scheme="C", key=key, key_md5=md5_b64)
     if sse:
-        if sse != "AES256":
-            raise dt.InvalidEncryptionAlgo(bucket, object)
-        return SSEInfo(scheme="S3")
+        if sse == "AES256":
+            return SSEInfo(scheme="S3")
+        if sse == "aws:kms":
+            key_id = hdr.get(
+                "x-amz-server-side-encryption-aws-kms-key-id", "")
+            ctx_b64 = hdr.get("x-amz-server-side-encryption-context", "")
+            ctx = ""
+            if ctx_b64:
+                # cmd/crypto/sse-kms.go ParseHTTP: context is b64 JSON;
+                # re-serialize with sorted keys so the stored form is
+                # canonical and unseal can't fail on key-order drift.
+                import json as _json
+                try:
+                    parsed = _json.loads(base64.b64decode(
+                        ctx_b64, validate=True))
+                    if not isinstance(parsed, dict):
+                        raise ValueError
+                    ctx = _json.dumps(parsed, sort_keys=True,
+                                      separators=(",", ":"))
+                except Exception:  # noqa: BLE001
+                    raise dt.InvalidSSEContext(bucket, object) from None
+            return SSEInfo(scheme="KMS", kms_key_id=key_id,
+                           kms_context=ctx)
+        raise dt.InvalidEncryptionAlgo(bucket, object)
     return None
+
+
+def sse_kms_context(bucket: str, object: str, user_ctx: str) -> str:
+    """The KMS context string for an SSE-KMS object: the object path plus
+    the caller's canonical JSON context (cmd/crypto/sse-kms.go binds both
+    into the sealed blob so a blob replayed on another object — or with a
+    different context — fails to unseal)."""
+    return f"{bucket}/{object}|{user_ctx}"
 
 
 def _kek(scheme_key: bytes, bucket: str, object: str) -> AESGCM:

@@ -79,13 +79,26 @@ class OpenIDProvider:
         return self.jwks_url
 
     def _refresh_keys(self, force: bool = False) -> None:
+        if not force and self._keys and \
+                time.time() - self._fetched_at < JWKS_TTL_S:
+            return
         with self._lock:
             if not force and self._keys and \
                     time.time() - self._fetched_at < JWKS_TTL_S:
                 return
-            url = self._discover_jwks_url()
-            with urllib.request.urlopen(url, timeout=self.timeout) as r:
-                doc = json.loads(r.read())
+            try:
+                url = self._discover_jwks_url()
+                with urllib.request.urlopen(url, timeout=self.timeout) as r:
+                    doc = json.loads(r.read())
+            except Exception as e:  # noqa: BLE001
+                if self._keys:
+                    # IdP briefly unreachable: keep serving with the
+                    # cached keys rather than failing every STS request;
+                    # back off further fetches for one TTL window.
+                    self._fetched_at = time.time()
+                    return
+                raise ValueError(f"openid: JWKS fetch failed: {e}") \
+                    from None
             keys = {}
             for jwk in doc.get("keys", []):
                 if jwk.get("kty") != "RSA":

@@ -149,6 +149,18 @@ class AdminClient:
     def remove_tier(self, name: str) -> None:
         self._json("DELETE", "tier", {"name": name})
 
+    # -- kms ------------------------------------------------------------------
+
+    def kms_status(self) -> dict:
+        return self._json("GET", "kms/status")
+
+    def kms_key_status(self, key_id: str = "") -> dict:
+        q = {"key-id": key_id} if key_id else None
+        return self._json("GET", "kms/key/status", q)
+
+    def kms_create_key(self, key_id: str) -> None:
+        self._json("POST", "kms/key/create", {"key-id": key_id})
+
     # -- observability --------------------------------------------------------
 
     def top_locks(self) -> dict:

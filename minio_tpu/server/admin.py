@@ -123,9 +123,47 @@ def _dispatch_admin(h, op: str) -> None:
         q = {k: v[0] for k, v in h.query.items()}
         cfg.delete(q.get("subsys", ""), q.get("key", ""))
         return h._send(200, b"{}", "application/json")
+    if op == "kms/key/status":
+        return _kms_key_status(h)
+    if op == "kms/key/create":
+        from ..crypto import KMSError, get_kms
+        q = {k: v[0] for k, v in h.query.items()}
+        try:
+            get_kms().create_key(q.get("key-id", ""))
+        except KMSError as e:
+            return h._error("XMinioKMSError", str(e), 500)
+        return h._send(200, b"{}", "application/json")
+    if op == "kms/status":
+        from ..crypto import get_kms
+        return h._send(200, json.dumps(get_kms().info()).encode(),
+                       "application/json")
     if _iam_op(h, op):
         return
     h._error("NotImplemented", f"admin op {op}", 501)
+
+
+def _kms_key_status(h) -> None:
+    """Round-trip sanity check of a KMS master key (reference
+    cmd/admin-handlers.go KMSKeyStatusHandler): generate a data key under
+    the key id, unseal it back, and report each step's outcome."""
+    from ..crypto import get_kms
+    kms = get_kms()
+    q = {k: v[0] for k, v in h.query.items()}
+    key_id = q.get("key-id", "") or kms.key_id
+    status: dict = {"key-id": key_id}
+    try:
+        dk, blob = kms.generate_key("admin-kms-check", key_id=key_id)
+        status["encryption-err"] = ""
+    except Exception as e:  # noqa: BLE001
+        status["encryption-err"] = str(e)
+        return h._send(200, json.dumps(status).encode(), "application/json")
+    try:
+        dk2 = kms.unseal(blob, "admin-kms-check", key_id=key_id)
+        status["decryption-err"] = "" if dk2 == dk else \
+            "decrypted key does not match generated key"
+    except Exception as e:  # noqa: BLE001
+        status["decryption-err"] = str(e)
+    h._send(200, json.dumps(status).encode(), "application/json")
 
 
 def _iam_op(h, op: str) -> bool:

@@ -1215,8 +1215,9 @@ class _S3Handler(BaseHTTPRequestHandler):
         import secrets
 
         from ..crypto import (EncryptReader, enc_size, get_kms,
-                              seal_object_key)
+                              seal_object_key, sse_kms_context)
         from ..crypto.sse import (META_IV, META_KEY_MD5, META_KMS_BLOB,
+                                  META_KMS_CONTEXT, META_KMS_KEY_ID,
                                   META_PLAIN_SIZE, META_SCHEME, META_SEALED)
         oek = secrets.token_bytes(32)
         base_iv = secrets.token_bytes(12)
@@ -1230,6 +1231,19 @@ class _S3Handler(BaseHTTPRequestHandler):
                 "x-amz-server-side-encryption-customer-algorithm": "AES256",
                 "x-amz-server-side-encryption-customer-key-MD5":
                     sse.key_md5}
+        elif sse.scheme == "KMS":
+            kms = get_kms()
+            key_id = sse.kms_key_id or kms.key_id
+            ctx = sse_kms_context(self.bucket, self.key, sse.kms_context)
+            dk, blob = kms.generate_key(ctx, key_id=key_id)
+            sealed = seal_object_key(oek, dk, self.bucket, self.key)
+            user_defined[META_KMS_BLOB] = base64.b64encode(blob).decode()
+            user_defined[META_KMS_KEY_ID] = key_id
+            if sse.kms_context:
+                user_defined[META_KMS_CONTEXT] = base64.b64encode(
+                    sse.kms_context.encode()).decode()
+            resp = {"x-amz-server-side-encryption": "aws:kms",
+                    "x-amz-server-side-encryption-aws-kms-key-id": key_id}
         else:
             kms = get_kms()
             dk, blob = kms.generate_key(f"{self.bucket}/{self.key}")
@@ -1247,8 +1261,10 @@ class _S3Handler(BaseHTTPRequestHandler):
         the KMS (cmd/encryption-v1.go DecryptRequest)."""
         import base64
 
-        from ..crypto import get_kms, parse_sse_headers, unseal_object_key
+        from ..crypto import (get_kms, parse_sse_headers, sse_kms_context,
+                              unseal_object_key)
         from ..crypto.sse import (META_IV, META_KEY_MD5, META_KMS_BLOB,
+                                  META_KMS_CONTEXT, META_KMS_KEY_ID,
                                   META_PLAIN_SIZE, META_SCHEME, META_SEALED)
         from ..crypto import plain_size_of
         scheme = oi.internal.get(META_SCHEME, "")
@@ -1268,6 +1284,26 @@ class _S3Handler(BaseHTTPRequestHandler):
                 "x-amz-server-side-encryption-customer-algorithm": "AES256",
                 "x-amz-server-side-encryption-customer-key-MD5":
                     req.key_md5}
+        elif scheme == "KMS":
+            blob = base64.b64decode(oi.internal.get(META_KMS_BLOB, ""))
+            key_id = oi.internal.get(META_KMS_KEY_ID, "")
+            stored_ctx = ""
+            if oi.internal.get(META_KMS_CONTEXT, ""):
+                stored_ctx = base64.b64decode(
+                    oi.internal[META_KMS_CONTEXT]).decode()
+            ctx = sse_kms_context(self.bucket, self.key, stored_ctx)
+            from ..crypto import KMSUnreachable
+            try:
+                dk = get_kms().unseal(blob, ctx, key_id=key_id)
+            except KMSUnreachable as e:
+                # transient KMS outage — not a wrong-key condition
+                raise dt.KMSNotAvailable(self.bucket, self.key,
+                                         extra=str(e)) from None
+            except Exception:  # noqa: BLE001 — rotated/deleted master key
+                raise dt.SSEKeyMismatch(self.bucket, self.key) from None
+            oek = unseal_object_key(sealed, dk, self.bucket, self.key)
+            resp = {"x-amz-server-side-encryption": "aws:kms",
+                    "x-amz-server-side-encryption-aws-kms-key-id": key_id}
         else:
             blob = base64.b64decode(oi.internal.get(META_KMS_BLOB, ""))
             try:
