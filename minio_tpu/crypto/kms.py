@@ -119,7 +119,7 @@ class KESClient(KMS):
 
     def __init__(self, endpoints: list[str], default_key_id: str,
                  cert_file: str = "", key_file: str = "", ca_path: str = "",
-                 timeout: float = 5.0):
+                 timeout: float = 5.0, insecure: bool = False):
         if not endpoints:
             raise KMSError("kes: missing endpoint")
         self.endpoints = [e.rstrip("/") for e in endpoints]
@@ -127,9 +127,12 @@ class KESClient(KMS):
         self.timeout = timeout
         self._ctx = None
         if any(e.startswith("https") for e in self.endpoints):
+            # no ca_path -> system trust store; verification is only ever
+            # dropped on explicit request (self-signed dev KES), because a
+            # MITM'd KES connection leaks every object data key
             self._ctx = ssl.create_default_context(
                 cafile=ca_path or None)
-            if not ca_path:
+            if insecure:
                 self._ctx.check_hostname = False
                 self._ctx.verify_mode = ssl.CERT_NONE
             if cert_file and key_file:
@@ -237,7 +240,9 @@ def get_kms() -> KMS:
                                "minio-tpu-default"),
                 cert_file=os.environ.get("MINIO_TPU_KMS_KES_CERT_FILE", ""),
                 key_file=os.environ.get("MINIO_TPU_KMS_KES_KEY_FILE", ""),
-                ca_path=os.environ.get("MINIO_TPU_KMS_KES_CAPATH", ""))
+                ca_path=os.environ.get("MINIO_TPU_KMS_KES_CAPATH", ""),
+                insecure=os.environ.get(
+                    "MINIO_TPU_KMS_KES_INSECURE", "") == "1")
             return _kms
         hexkey = os.environ.get("MINIO_TPU_KMS_MASTER_KEY", "")
         if hexkey:

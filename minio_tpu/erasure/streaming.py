@@ -239,6 +239,7 @@ def erasure_encode(erasure: Erasure, stream, writers: list,
     write_window: deque = deque()  # per-block {writer idx: write Future}
 
     native_path = _native_put_eligible(erasure, writers)
+    fd_path = False
     if native_path:
         from .. import native
         from ..runtime.bufpool import global_pool
@@ -249,6 +250,29 @@ def erasure_encode(erasure: Erasure, stream, writers: list,
         chunk = live0.shard_size
         algo_id = native_algo_id(live0.algo)
         pool = global_pool()
+        # fused-write eligibility: every live sink is a local file (has a
+        # real fd) — then the whole block, shard writes included, runs as
+        # ONE native call and Python never touches the framed bytes
+        fds = []
+        for w in writers:
+            try:
+                fds.append(-1 if w is None else w.sink.fileno())
+            except (AttributeError, OSError):
+                fds = []
+                break
+        fd_path = bool(fds)
+        fd_offset = 0
+
+    def fd_block(buf: bytes, shard_len: int, offset: int):
+        scratch = pool.get((k + m) * native.framed_len(shard_len, chunk))
+        try:
+            use = [fds[i] if writers[i] is not None else -1
+                   for i in range(len(writers))]
+            return native.put_block_fds(buf, len(buf), pmat, k, m,
+                                        shard_len, chunk, HIGHWAY_KEY, use,
+                                        offset, algo_id, scratch=scratch)
+        finally:
+            pool.put(scratch)
 
     def encode_block(buf: bytes):
         if not native_path:
@@ -256,6 +280,12 @@ def erasure_encode(erasure: Erasure, stream, writers: list,
         if not buf:
             return ("nat", None, 0)
         shard_len = ceil_div(len(buf), k)
+        if fd_path:
+            nonlocal fd_offset
+            off = fd_offset
+            fd_offset += native.framed_len(shard_len, chunk)
+            return ("fd", encode_pool().submit(fd_block, buf, shard_len,
+                                               off), shard_len)
         fut = encode_pool().submit(
             native.put_block, buf, len(buf), pmat, k, m, shard_len, chunk,
             HIGHWAY_KEY, algo_id,
@@ -266,6 +296,10 @@ def erasure_encode(erasure: Erasure, stream, writers: list,
         kind, fut, shard_len = entry
         futs = {}
         framed = None
+        if kind == "fd":
+            # shard writes already ride inside the native call
+            write_window.append(("fd", fut))
+            return
         if kind == "py":
             shards = fut.result()
             for i, ow in enumerate(owriters):
@@ -282,25 +316,44 @@ def erasure_encode(erasure: Erasure, stream, writers: list,
                 span = framed[i * fl:(i + 1) * fl] \
                     if framed is not None else b""
                 futs[i] = ow.write_framed_async(span)
-        write_window.append((futs, framed))
+        write_window.append(("w", (futs, framed)))
 
     def harvest_writes():
-        futs, framed = write_window.popleft()
+        kind, payload = write_window.popleft()
         errs: list[BaseException | None] = [None] * len(writers)
         for i in range(len(writers)):
             if writers[i] is None:
                 errs[i] = errors.DiskNotFound()
-        for i, f in futs.items():
+        if kind == "fd":
             try:
-                f.result()
-            except Exception as e:  # noqa: BLE001 — disk errors become votes
-                errs[i] = e if isinstance(e, errors.StorageError) \
-                    else errors.FaultyDisk(str(e))
-                writers[i] = None
-        if native_path:
-            # all shard writes for this block are done (results harvested
-            # above); its framed buffer can carry the next block
-            pool.put(framed)
+                codes = payload.result()
+            except Exception as e:  # noqa: BLE001 — whole block failed:
+                # every live disk gets a vote, quorum math decides
+                codes = None
+                for i in range(len(writers)):
+                    if writers[i] is not None:
+                        errs[i] = errors.FaultyDisk(str(e))
+                        writers[i] = None
+            if codes is not None:
+                for i, code in enumerate(codes):
+                    if code and writers[i] is not None:
+                        errs[i] = errors.FaultyDisk(
+                            f"pwrite failed: {os.strerror(code)}"
+                            if code > 0 else "pwrite: short write")
+                        writers[i] = None
+        else:
+            futs, framed = payload
+            for i, f in futs.items():
+                try:
+                    f.result()
+                except Exception as e:  # noqa: BLE001 — disk errors are votes
+                    errs[i] = e if isinstance(e, errors.StorageError) \
+                        else errors.FaultyDisk(str(e))
+                    writers[i] = None
+            if native_path:
+                # all shard writes for this block are done (results
+                # harvested above); its framed buffer can carry the next
+                pool.put(framed)
         err = errors.reduce_write_quorum_errs(
             errs, errors.BASE_IGNORED_ERRS, write_quorum)
         if err is not None:
@@ -328,11 +381,24 @@ def erasure_encode(erasure: Erasure, stream, writers: list,
                                        else 0):
                 harvest_writes()
     except BaseException:
-        # quiesce in-flight chained writes before propagating: the caller
-        # will abort/close the writers, and a background write racing an
-        # abort corrupts the writer state
-        for futs, _framed in write_window:
-            for f in futs.values():
+        # quiesce in-flight writes before propagating: the caller will
+        # abort/close the writers, and a background write racing an abort
+        # corrupts writer state (or, on the fd path, pwrites into a
+        # recycled file descriptor)
+        for kind, fut, _sl in enc_window:
+            if kind == "fd" and fut is not None:
+                try:
+                    fut.result()
+                except Exception:  # noqa: BLE001
+                    pass
+        for kind, payload in write_window:
+            if kind == "fd":
+                try:
+                    payload.result()
+                except Exception:  # noqa: BLE001
+                    pass
+                continue
+            for f in payload[0].values():
                 try:
                     f.result()
                 except Exception:  # noqa: BLE001

@@ -26,8 +26,10 @@
 #include "md5_simd.cpp"
 #include "mur3.cpp"
 
+#include <cerrno>
 #include <cstdint>
 #include <cstring>
+#include <unistd.h>
 
 namespace {
 
@@ -151,6 +153,45 @@ void mt_put_block(const uint8_t* data, long data_len, const uint8_t* pmat,
     uint8_t digs[256 * 32];
     hash_many(algo, key, hp, hl, nh, digs);
     for (int i = 0; i < nh; i++) std::memcpy(hd[i], digs + i * 32, 32);
+  }
+}
+
+// mt_put_block + direct shard-file writes in the same GIL-released call:
+// after framing into `scratch`, each live shard span is pwrite()n to
+// fds[i] at `offset` (pwrite needs no file-position ordering, so blocks
+// of one stream can flush out of order from pool workers). fds[i] < 0
+// skips shard i (offline disk). errs[i] returns 0 on success, the errno
+// on write failure, or -1 on an unexpectedly short write. This replaces
+// the per-shard Python write chain (6+ futures per block) with zero
+// Python-level writes — the reference leans on per-disk goroutines for
+// the same fan-out (cmd/erasure-encode.go:36-54).
+void mt_put_block_fds(const uint8_t* data, long data_len, const uint8_t* pmat,
+                      int k, int m, long shard_len, long chunk,
+                      const uint64_t key[4], uint8_t* scratch, int algo,
+                      const int* fds, long offset, int* errs) {
+  if (k + m > 256 || k <= 0 || m < 0 || chunk <= 0) return;
+  mt_put_block(data, data_len, pmat, k, m, shard_len, chunk, key, scratch,
+               algo);
+  const long framed_len = mt_framed_len(shard_len, chunk);
+  for (int i = 0; i < k + m; i++) {
+    errs[i] = 0;
+    if (fds[i] < 0) continue;
+    const uint8_t* span = scratch + (size_t)i * framed_len;
+    long done = 0;
+    while (done < framed_len) {
+      ssize_t w = pwrite(fds[i], span + done, (size_t)(framed_len - done),
+                         offset + done);
+      if (w < 0) {
+        if (errno == EINTR) continue;
+        errs[i] = errno ? errno : -1;
+        break;
+      }
+      if (w == 0) {
+        errs[i] = -1;
+        break;
+      }
+      done += w;
+    }
   }
 }
 

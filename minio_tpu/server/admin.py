@@ -128,8 +128,11 @@ def _dispatch_admin(h, op: str) -> None:
     if op == "kms/key/create":
         from ..crypto import KMSError, get_kms
         q = {k: v[0] for k, v in h.query.items()}
+        key_id = q.get("key-id", "")
+        if not key_id:
+            return h._error("InvalidArgument", "missing key-id", 400)
         try:
-            get_kms().create_key(q.get("key-id", ""))
+            get_kms().create_key(key_id)
         except KMSError as e:
             return h._error("XMinioKMSError", str(e), 500)
         return h._send(200, b"{}", "application/json")

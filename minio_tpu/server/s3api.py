@@ -1235,7 +1235,7 @@ class _S3Handler(BaseHTTPRequestHandler):
             kms = get_kms()
             key_id = sse.kms_key_id or kms.key_id
             ctx = sse_kms_context(self.bucket, self.key, sse.kms_context)
-            dk, blob = kms.generate_key(ctx, key_id=key_id)
+            dk, blob = self._kms_generate(kms, ctx, key_id)
             sealed = seal_object_key(oek, dk, self.bucket, self.key)
             user_defined[META_KMS_BLOB] = base64.b64encode(blob).decode()
             user_defined[META_KMS_KEY_ID] = key_id
@@ -1246,12 +1246,23 @@ class _S3Handler(BaseHTTPRequestHandler):
                     "x-amz-server-side-encryption-aws-kms-key-id": key_id}
         else:
             kms = get_kms()
-            dk, blob = kms.generate_key(f"{self.bucket}/{self.key}")
+            dk, blob = self._kms_generate(kms, f"{self.bucket}/{self.key}")
             sealed = seal_object_key(oek, dk, self.bucket, self.key)
             user_defined[META_KMS_BLOB] = base64.b64encode(blob).decode()
             resp = {"x-amz-server-side-encryption": "AES256"}
         user_defined[META_SEALED] = base64.b64encode(sealed).decode()
         return EncryptReader(hr, oek, base_iv), enc_size(size), resp
+
+    def _kms_generate(self, kms, ctx: str, key_id: str = ""):
+        """generate_key with a KMS outage surfaced as a retryable 503
+        (matching the read path) instead of a generic 500."""
+        from ..crypto import KMSUnreachable
+        try:
+            return kms.generate_key(ctx, key_id=key_id) if key_id \
+                else kms.generate_key(ctx)
+        except KMSUnreachable as e:
+            raise dt.KMSNotAvailable(self.bucket, self.key,
+                                     extra=str(e)) from None
 
     def _sse_read_ctx(self, oi):
         """For an encrypted object: unseal the OEK using this request's
@@ -1305,9 +1316,13 @@ class _S3Handler(BaseHTTPRequestHandler):
             resp = {"x-amz-server-side-encryption": "aws:kms",
                     "x-amz-server-side-encryption-aws-kms-key-id": key_id}
         else:
+            from ..crypto import KMSUnreachable
             blob = base64.b64decode(oi.internal.get(META_KMS_BLOB, ""))
             try:
                 dk = get_kms().unseal(blob, f"{self.bucket}/{self.key}")
+            except KMSUnreachable as e:
+                raise dt.KMSNotAvailable(self.bucket, self.key,
+                                         extra=str(e)) from None
             except Exception:  # noqa: BLE001 — rotated/wrong master key
                 raise dt.SSEKeyMismatch(self.bucket, self.key) from None
             oek = unseal_object_key(sealed, dk, self.bucket, self.key)

@@ -51,6 +51,11 @@ class _FileWriter:
     def write(self, b: bytes):
         self._f.write(b)
 
+    def fileno(self) -> int:
+        """Expose the fd for the fused native write path (pwrite from
+        C++); callers must not mix fd writes with buffered write()s."""
+        return self._f.fileno()
+
     def close(self):
         self._f.close()
 

@@ -100,3 +100,89 @@ def test_native_get_detects_bitrot(ol):
         with open(part, "r+b") as fh:  # restore for the next iteration
             fh.seek(40)
             fh.write(orig)
+
+
+def test_put_block_fds_roundtrip(tmp_path):
+    """put_block_fds writes the same framed bytes mt_put_block produces,
+    honours fd=-1 skips, and reports per-fd errors without raising."""
+    from minio_tpu.erasure.bitrot import HIGHWAY_KEY
+    from minio_tpu.ops import gf256
+    k, m, chunk = 4, 2, 16384
+    data = np.random.default_rng(7).integers(
+        0, 256, 1 << 20, dtype=np.uint8).tobytes()
+    shard_len = len(data) // k
+    pmat = gf256.build_matrix(k, m)[k:]
+    want = native.put_block(data, len(data), pmat, k, m, shard_len, chunk,
+                            HIGHWAY_KEY)
+    fl = native.framed_len(shard_len, chunk)
+    paths = [os.path.join(tmp_path, f"s{i}") for i in range(k + m)]
+    fds = [os.open(p, os.O_CREAT | os.O_WRONLY) for p in paths]
+    use = list(fds)
+    use[2] = -1          # offline disk: skipped
+    errs = native.put_block_fds(data, len(data), pmat, k, m, shard_len,
+                                chunk, HIGHWAY_KEY, use, 0)
+    for fd in fds:
+        os.close(fd)
+    assert errs[2] == 0  # skipped, not an error
+    assert all(e == 0 for e in errs)
+    for i in range(k + m):
+        if i == 2:
+            assert os.path.getsize(paths[i]) == 0
+            continue
+        with open(paths[i], "rb") as f:
+            assert f.read() == want[i * fl:(i + 1) * fl].tobytes(), i
+
+
+def test_put_block_fds_reports_bad_fd(tmp_path):
+    from minio_tpu.erasure.bitrot import HIGHWAY_KEY
+    from minio_tpu.ops import gf256
+    k, m, chunk = 2, 1, 4096
+    data = b"x" * 8192
+    shard_len = 4096
+    pmat = gf256.build_matrix(k, m)[k:]
+    good = os.open(os.path.join(tmp_path, "g"), os.O_CREAT | os.O_WRONLY)
+    ro = os.open(os.path.join(tmp_path, "r"), os.O_CREAT | os.O_RDONLY)
+    errs = native.put_block_fds(data, len(data), pmat, k, m, shard_len,
+                                chunk, HIGHWAY_KEY, [good, ro, -1], 0)
+    os.close(good)
+    os.close(ro)
+    assert errs[0] == 0
+    assert errs[1] != 0   # EBADF on the read-only fd
+    assert errs[2] == 0   # skipped
+
+
+def test_fd_path_survives_one_dead_writer_mid_stream(tmp_path):
+    """A PUT over 6 disks where one sink's fd goes bad must still land
+    with write quorum (the dead disk becomes a vote, not a failure)."""
+    ol = _mk(str(tmp_path))
+    body = np.random.default_rng(11).integers(
+        0, 256, 3 << 20, dtype=np.uint8).tobytes()
+    # sabotage disk 5's file writer factory to hand out read-only fds
+    orig = ol.disks[5].create_file_writer
+
+    class _RoWriter:
+        def __init__(self, inner):
+            self._inner = inner
+            self._ro = os.open(inner._path, os.O_RDONLY)
+
+        def write(self, b):
+            raise OSError("read-only sink")
+
+        def fileno(self):
+            return self._ro
+
+        def close(self):
+            os.close(self._ro)
+            self._inner.close()
+
+        def abort(self):
+            os.close(self._ro)
+            self._inner.abort()
+
+    ol.disks[5].create_file_writer = \
+        lambda v, p: _RoWriter(orig(v, p))
+    try:
+        ol.put_object("b", "o", io.BytesIO(body), len(body))
+    finally:
+        ol.disks[5].create_file_writer = orig
+    assert ol.get_object_bytes("b", "o") == body
