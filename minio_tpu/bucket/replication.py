@@ -18,12 +18,16 @@ class S3Target:
     outbound S3 client, like the reference's internal miniogo client)."""
 
     def __init__(self, endpoint: str, access_key: str, secret_key: str,
-                 target_bucket: str, region: str = "us-east-1"):
+                 target_bucket: str, region: str = "us-east-1",
+                 bandwidth_limit: int = 0):
         self.endpoint = endpoint.rstrip("/")
         self.bucket = target_bucket
         self.ak, self.sk = access_key, secret_key
         self.signer = SigV4Verifier(lambda a: None, region)
         self.http = requests.Session()
+        #: bytes/sec cap for replication uploads to this target (0 = none;
+        #: reference cmd/bucket-targets.go BandwidthLimit)
+        self.bandwidth_limit = int(bandwidth_limit)
 
     def _req(self, method: str, key: str, body: bytes = b"",
              headers: dict | None = None, query: dict | None = None,
@@ -137,12 +141,17 @@ class ReplicationPool:
             if k.startswith("x-amz-meta-"):
                 headers[k] = v
         from ..utils.compress import META_COMPRESSION, DecompressWriter
+        from .bandwidth import MonitoredReader, global_monitor
         compressed = bool(oi.internal.get(META_COMPRESSION))
         if not compressed and oi.size <= self.SPOOL_THRESHOLD:
             from ..erasure.streaming import BufferSink
             sink = BufferSink()
             self.obj.get_object(bucket, key, sink)
-            r = tgt.put(key, sink.getvalue(), headers)
+            size = sink.buf.tell()
+            sink.buf.seek(0)
+            body = MonitoredReader(global_monitor(), bucket, sink.buf,
+                                   tgt.bandwidth_limit, total_size=size)
+            r = tgt.put(key, body, headers)
         else:
             # spool to disk so multi-GB objects never sit in RAM; the
             # replica must hold PLAINTEXT, so compressed objects stream
@@ -154,8 +163,12 @@ class ReplicationPool:
                     dz.finish()
                 else:
                     self.obj.get_object(bucket, key, spool)
+                size = spool.tell()
                 spool.seek(0)
-                r = tgt.put(key, spool, headers)
+                body = MonitoredReader(global_monitor(), bucket, spool,
+                                       tgt.bandwidth_limit,
+                                       total_size=size)
+                r = tgt.put(key, body, headers)
         if r.status_code != 200:
             raise RuntimeError(f"replication target: {r.status_code}")
 

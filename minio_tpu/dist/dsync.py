@@ -10,6 +10,12 @@ import threading
 import time
 import uuid
 
+from ..utils.dyntimeout import DynamicTimeout
+
+#: shared lock-acquisition timeout (reference globalOperationTimeout):
+#: starts at 10 s, floor 1 s, adapts to observed acquisition behavior
+OPERATION_TIMEOUT = DynamicTimeout(10.0, 1.0)
+
 #: reference quorum rule (drwmutex.go:160-171)
 
 
@@ -125,14 +131,22 @@ class DRWMutex:
 
     # -- acquisition ---------------------------------------------------------
 
-    def get_lock(self, timeout: float = 10.0) -> bool:
+    def get_lock(self, timeout: float | None = None) -> bool:
         return self._acquire(timeout, writer=True)
 
-    def get_rlock(self, timeout: float = 10.0) -> bool:
+    def get_rlock(self, timeout: float | None = None) -> bool:
         return self._acquire(timeout, writer=False)
 
-    def _acquire(self, timeout: float, writer: bool) -> bool:
-        deadline = time.monotonic() + timeout
+    def _acquire(self, timeout: float | None, writer: bool) -> bool:
+        # no explicit timeout -> the self-adapting operation timeout
+        # (reference globalOperationTimeout, cmd/dynamic-timeouts.go):
+        # raised 25% when >33% of recent acquisitions time out, decayed
+        # toward the slowest recent success otherwise
+        dyn = OPERATION_TIMEOUT if timeout is None else None
+        if timeout is None:
+            timeout = dyn.timeout()
+        start = time.monotonic()
+        deadline = start + timeout
         n = len(self.lockers)
         quorum = write_quorum(n) if writer else read_quorum(n)
         quorum = max(quorum, 1)
@@ -151,10 +165,14 @@ class DRWMutex:
                 self.uid = uid
                 self._held = granted
                 self._is_write = writer
+                if dyn is not None:
+                    dyn.log_success(time.monotonic() - start)
                 return True
             # failed quorum: async release-all (drwmutex.go:297)
             self._release(granted, uid, writer)
             if time.monotonic() >= deadline:
+                if dyn is not None:
+                    dyn.log_failure()
                 return False
             time.sleep(random.uniform(0.005, 0.05))  # retry with jitter
 

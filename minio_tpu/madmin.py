@@ -149,6 +149,11 @@ class AdminClient:
     def remove_tier(self, name: str) -> None:
         self._json("DELETE", "tier", {"name": name})
 
+    def bandwidth_report(self, buckets: list[str] | None = None) -> dict:
+        """Per-bucket replication bandwidth limits + measured rates."""
+        q = {"buckets": ",".join(buckets)} if buckets else None
+        return self._json("GET", "bandwidth", q)
+
     # -- kms ------------------------------------------------------------------
 
     def kms_status(self) -> dict:

@@ -243,7 +243,7 @@ def put_block_fds(data, data_len: int, pmat: np.ndarray, k: int, m: int,
         raise ValueError(f"unsupported geometry k={k} m={m} chunk={chunk}")
     if len(fds) != k + m:
         raise ValueError("put_block_fds: need one fd slot per shard")
-    fl = lib.mt_framed_len(shard_len, chunk)
+    fl = framed_len(shard_len, chunk)
     if scratch is None:
         scratch = np.empty((k + m) * fl, dtype=np.uint8)
     elif scratch.nbytes != (k + m) * fl:

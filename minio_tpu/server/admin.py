@@ -123,6 +123,13 @@ def _dispatch_admin(h, op: str) -> None:
         q = {k: v[0] for k, v in h.query.items()}
         cfg.delete(q.get("subsys", ""), q.get("key", ""))
         return h._send(200, b"{}", "application/json")
+    if op == "bandwidth":
+        from ..bucket.bandwidth import global_monitor
+        q = {k: v[0] for k, v in h.query.items()}
+        buckets = [b for b in q.get("buckets", "").split(",") if b]
+        return h._send(200, json.dumps(
+            global_monitor().report(buckets or None)).encode(),
+            "application/json")
     if op == "kms/key/status":
         return _kms_key_status(h)
     if op == "kms/key/create":

@@ -1258,8 +1258,7 @@ class _S3Handler(BaseHTTPRequestHandler):
         (matching the read path) instead of a generic 500."""
         from ..crypto import KMSUnreachable
         try:
-            return kms.generate_key(ctx, key_id=key_id) if key_id \
-                else kms.generate_key(ctx)
+            return kms.generate_key(ctx, key_id=key_id)
         except KMSUnreachable as e:
             raise dt.KMSNotAvailable(self.bucket, self.key,
                                      extra=str(e)) from None

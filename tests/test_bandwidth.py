@@ -1,0 +1,141 @@
+"""Replication bandwidth throttling + dynamic timeouts (reference
+pkg/bucket/bandwidth, cmd/dynamic-timeouts.go): token-window rate
+enforcement, per-bucket measurement/reporting, the admin surface, and
+timeout adaptation."""
+import io
+import time
+
+import pytest
+
+from minio_tpu.bucket.bandwidth import (Monitor, MonitoredReader, Throttle,
+                                        global_monitor)
+from minio_tpu.utils.dyntimeout import DynamicTimeout
+
+
+def test_throttle_limits_rate():
+    t = Throttle(1 << 20)  # 1 MiB/s -> 256 KiB per 250 ms window
+    total = 0
+    t0 = time.monotonic()
+    while total < 600_000:
+        total += t.take(64 << 10)
+    elapsed = time.monotonic() - t0
+    # 600 KB at 1 MiB/s needs at least one window rollover (~0.25 s);
+    # without throttling this loop is microseconds
+    assert elapsed >= 0.2, elapsed
+
+
+def test_throttle_zero_is_unlimited():
+    t = Throttle(0)
+    t0 = time.monotonic()
+    for _ in range(1000):
+        assert t.take(1 << 20) == 1 << 20
+    assert time.monotonic() - t0 < 0.5
+
+
+def test_throttle_release_returns_budget():
+    t = Throttle(1 << 20)
+    got = t.take(200_000)
+    t.release(got)
+    # the same budget can be taken again without waiting for a window
+    assert t.take(got) == got
+
+
+def test_monitored_reader_tracks_and_reports():
+    mon = Monitor()
+    src = io.BytesIO(b"x" * 300_000)
+    r = MonitoredReader(mon, "bkt", src, bytes_per_second=0,
+                        total_size=300_000)
+    assert len(r) == 300_000
+    while r.read(64 << 10):
+        pass
+    rep = mon.report()
+    assert "bkt" in rep["bucketStats"]
+
+
+def test_monitor_report_filters_buckets():
+    mon = Monitor()
+    mon.track("a", 100)
+    mon.track("b", 100)
+    rep = mon.report(["a"])
+    assert set(rep["bucketStats"]) == {"a"}
+
+
+def test_replication_respects_bandwidth_limit(tmp_path):
+    """End-to-end: replicate a 512 KB object through a 1 MiB/s-limited
+    target and check it took a rate-limited amount of time."""
+    import numpy as np
+    from minio_tpu.bucket.replication import ReplicationPool, S3Target
+    from minio_tpu.objectlayer import ErasureObjects
+    from minio_tpu.server import S3Server
+    from minio_tpu.storage import XLStorage
+
+    dst_obj = ErasureObjects(
+        [XLStorage(str(tmp_path / f"dst{i}")) for i in range(4)],
+        default_parity=1)
+    dst = S3Server(dst_obj, "127.0.0.1", 0, access_key="ak",
+                   secret_key="sk")
+    dst.start_background()
+    src_obj = ErasureObjects(
+        [XLStorage(str(tmp_path / f"src{i}")) for i in range(4)],
+        default_parity=1)
+    src_obj.make_bucket("rb")
+    body = np.random.default_rng(0).integers(
+        0, 256, 512 << 10, dtype=np.uint8).tobytes()
+    src_obj.put_object("rb", "o", io.BytesIO(body), len(body))
+    pool = ReplicationPool(src_obj, workers=1).start()
+    try:
+        tgt = S3Target(dst.endpoint(), "ak", "sk", "rb",
+                       bandwidth_limit=1 << 20)
+        pool.set_target("rb", tgt)
+        t0 = time.monotonic()
+        pool.schedule("rb", "o", "put")
+        pool.drain(timeout=30)
+        elapsed = time.monotonic() - t0
+        assert pool.replicated == 1 and pool.failed == 0
+        assert dst_obj.get_object_bytes("rb", "o") == body
+        # 512 KB at 1 MiB/s ≈ 0.5 s minimum (several windows)
+        assert elapsed >= 0.3, elapsed
+        rep = global_monitor().report()
+        assert rep["bucketStats"]["rb"]["limitInBits"] == 1 << 20
+    finally:
+        pool.stop()
+        dst.shutdown()
+
+
+def test_dynamic_timeout_increases_on_failures():
+    dt = DynamicTimeout(10.0, 1.0)
+    for _ in range(16):
+        dt.log_failure()
+    assert dt.timeout() == pytest.approx(12.5)
+
+
+def test_dynamic_timeout_decays_toward_observed():
+    dt = DynamicTimeout(10.0, 1.0)
+    for _ in range(16):
+        dt.log_success(0.05)
+    # decayed toward 125% of slowest success, floored at minimum
+    assert dt.timeout() == pytest.approx(1.0)
+    dt2 = DynamicTimeout(10.0, 0.01)
+    for _ in range(16):
+        dt2.log_success(2.0)
+    assert dt2.timeout() == pytest.approx(2.5)
+
+
+def test_dynamic_timeout_mixed_stays_put():
+    dt = DynamicTimeout(10.0, 1.0)
+    for i in range(16):
+        if i % 4 == 0:  # 25% failures: between the two thresholds
+            dt.log_failure()
+        else:
+            dt.log_success(0.5)
+    assert dt.timeout() == pytest.approx(10.0)
+
+
+def test_dsync_uses_dynamic_timeout():
+    from minio_tpu.dist import dsync
+    from minio_tpu.dist.dsync import DRWMutex, LocalLocker
+    lk = LocalLocker()
+    mtx = DRWMutex([lk], "b/o", owner="me")
+    assert mtx.get_lock()  # no explicit timeout -> dynamic path
+    mtx.unlock()
+    assert dsync.OPERATION_TIMEOUT.timeout() > 0
