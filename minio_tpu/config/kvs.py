@@ -16,6 +16,8 @@ from dataclasses import dataclass, field
 from ..utils import errors
 
 CONFIG_PATH = "config/config.json"
+HISTORY_PREFIX = "config/history/"
+HISTORY_KEEP = 50
 
 
 @dataclass
@@ -207,15 +209,83 @@ class ConfigSys:
         if key not in SUB_SYSTEMS.get(subsys, {}):
             raise KeyError(f"unknown config key {subsys}.{key}")
         with self._lock:
+            self._snapshot_locked(f"set {subsys}.{key}")
             self._stored.setdefault(subsys, {})[key] = value
             self._persist()
         self._fire(subsys)
 
     def delete(self, subsys: str, key: str):
         with self._lock:
+            self._snapshot_locked(f"del {subsys}.{key}")
             self._stored.get(subsys, {}).pop(key, None)
             self._persist()
         self._fire(subsys)
+
+    # -- history (reference cmd/config.go saveServerConfigHistory /
+    # admin-handlers-config-kv.go ListConfigHistoryKVHandler /
+    # RestoreConfigHistoryKVHandler) --------------------------------------
+
+    def _snapshot_locked(self, cause: str):
+        """Persist the pre-change stored config as a history entry;
+        trimmed to the newest HISTORY_KEEP entries."""
+        if self.obj is None:
+            return
+        import time
+        import uuid
+        # nanosecond prefix: same-second snapshots must still sort in
+        # creation order or list/restore/trim pick the wrong entry
+        rid = f"{time.time_ns():020d}-{uuid.uuid4().hex[:8]}"
+        entry = {"restore_id": rid, "cause": cause,
+                 "at": time.time(), "config": self._stored}
+        try:
+            self.obj.put_config(HISTORY_PREFIX + rid + ".json",
+                                json.dumps(entry).encode())
+            names = sorted(self.obj.list_config(HISTORY_PREFIX))
+            for stale in names[:-HISTORY_KEEP]:
+                self.obj.delete_config(HISTORY_PREFIX + stale)
+        except Exception:  # noqa: BLE001 — history must not block set()
+            pass
+
+    def list_history(self) -> list[dict]:
+        """Newest-first history entries (id, cause, timestamp)."""
+        if self.obj is None:
+            return []
+        out = []
+        for name in sorted(self.obj.list_config(HISTORY_PREFIX),
+                           reverse=True):
+            try:
+                doc = json.loads(
+                    self.obj.get_config(HISTORY_PREFIX + name))
+                out.append({"restore_id": doc.get("restore_id", name),
+                            "cause": doc.get("cause", ""),
+                            "at": doc.get("at", 0)})
+            except Exception:  # noqa: BLE001 — skip corrupt entries
+                continue
+        return out
+
+    def restore_history(self, restore_id: str):
+        """Replace the stored config with a history snapshot (the current
+        config is itself snapshotted first, so restores are undoable)."""
+        if self.obj is None:
+            raise KeyError("no persistence attached")
+        doc = json.loads(self.obj.get_config(
+            HISTORY_PREFIX + restore_id + ".json"))
+        cfg = doc.get("config", {})
+        with self._lock:
+            self._snapshot_locked(f"restore {restore_id}")
+            self._stored = {k: dict(v) for k, v in cfg.items()}
+            self._persist()
+        for subsys in DYNAMIC:
+            self._fire(subsys)
+
+    def clear_history(self):
+        if self.obj is None:
+            return
+        for name in self.obj.list_config(HISTORY_PREFIX):
+            try:
+                self.obj.delete_config(HISTORY_PREFIX + name)
+            except Exception:  # noqa: BLE001
+                continue
 
     def dump(self) -> dict:
         """Effective config: every registered key with its resolved value

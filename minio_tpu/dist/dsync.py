@@ -12,9 +12,12 @@ import uuid
 
 from ..utils.dyntimeout import DynamicTimeout
 
-#: shared lock-acquisition timeout (reference globalOperationTimeout):
-#: starts at 10 s, floor 1 s, adapts to observed acquisition behavior
-OPERATION_TIMEOUT = DynamicTimeout(10.0, 1.0)
+#: shared lock-acquisition timeout (reference globalOperationTimeout,
+#: cmd/server-main.go: 10 min default, 5 min floor). The generous floor
+#: matters: decay is driven by *successful* acquisition times (usually
+#: milliseconds), and a floor near that would make any lock legitimately
+#: held longer than the floor fail its competitors spuriously.
+OPERATION_TIMEOUT = DynamicTimeout(600.0, 300.0)
 
 #: reference quorum rule (drwmutex.go:160-171)
 

@@ -106,12 +106,17 @@ class RPCClient:
              timeout: float | None = None):
         """POST the method; returns response bytes (or the raw response when
         stream=True). Typed storage errors re-raise as their class."""
+        from ..obs import metrics as mx
         if not self._online:
             raise errors.DiskNotFound(f"{self.base} offline")
         qs = urllib.parse.urlencode(
             {k: str(v) for k, v in (params or {}).items()})
         url = (f"{self.base}/minio/{self.service}/{RPC_VERSION}/{method}"
                + (f"?{qs}" if qs else ""))
+        mx.inc("minio_tpu_inter_node_calls_total", service=self.service)
+        if body:
+            mx.inc("minio_tpu_inter_node_sent_bytes_total", len(body),
+                   service=self.service)
         try:
             r = self._session.post(
                 url, data=body,
@@ -120,6 +125,8 @@ class RPCClient:
                 timeout=timeout or self.timeout, stream=stream)
         except requests.RequestException as e:
             self._mark_offline()
+            mx.inc("minio_tpu_inter_node_errors_total",
+                   service=self.service)
             raise errors.DiskNotFound(f"{self.base}: {e}") from e
         if r.status_code == 200:
             return r if stream else r.content

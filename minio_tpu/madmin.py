@@ -149,6 +149,29 @@ class AdminClient:
     def remove_tier(self, name: str) -> None:
         self._json("DELETE", "tier", {"name": name})
 
+    def start_profiling(self, profiler_type: str = "cpu") -> dict:
+        return self._json("POST", "profiling/start",
+                          {"profilerType": profiler_type})
+
+    def download_profiling(self) -> bytes:
+        return self._request("GET", "profiling/download")
+
+    def thread_dump(self) -> str:
+        return self._request("GET", "profiling/threads").decode()
+
+    def health_info(self) -> dict:
+        return self._json("GET", "healthinfo")
+
+    def list_config_history(self) -> list:
+        return self._json("GET", "list-config-history")
+
+    def restore_config_history(self, restore_id: str) -> None:
+        self._json("PUT", "restore-config-history",
+                   {"restoreId": restore_id})
+
+    def clear_config_history(self) -> None:
+        self._json("DELETE", "clear-config-history")
+
     def bandwidth_report(self, buckets: list[str] | None = None) -> dict:
         """Per-bucket replication bandwidth limits + measured rates."""
         q = {"buckets": ",".join(buckets)} if buckets else None

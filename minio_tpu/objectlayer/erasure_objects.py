@@ -106,6 +106,27 @@ class ErasureObjects(MultipartMixin, ObjectLayer):
         #: persisted-listing coordinator (reference cmd/metacache.go:42)
         self.metacache = MetacacheStore(self)
 
+    def storage_info(self) -> dict:
+        """Single-set view (reference StorageInfo for one erasure set);
+        sets.py/pools.py aggregate their own."""
+        online = offline = 0
+        for d in self.disks:
+            ok = d is not None
+            if ok:
+                check = getattr(d, "is_online", None)
+                if callable(check):
+                    try:
+                        ok = check()
+                    except Exception:  # noqa: BLE001
+                        ok = False
+            if ok:
+                online += 1
+            else:
+                offline += 1
+        return {"disks_online": online, "disks_offline": offline,
+                "set_count": 1, "drives_per_set": len(self._disks),
+                "parity": self.default_parity}
+
     def _locked(self, bucket: str, object: str, write: bool = True):
         """Context manager taking the namespace lock if configured
         (reference NSLock; PutObject locks AFTER the data upload —

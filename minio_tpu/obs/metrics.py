@@ -1,8 +1,22 @@
-"""Prometheus metrics endpoint (reference cmd/metrics-v2.go:147: MetricsGroup
-generators → text exposition). Counters are process-wide and lock-free-ish
-(GIL-atomic int adds)."""
+"""Prometheus metrics, v2-style grouped registry (reference
+cmd/metrics-v2.go: MetricsGroup generators with cached reads, namespaced
+descriptors, cluster vs node exposition paths; cmd/metrics-router.go
+mounts /minio/v2/metrics/{cluster,node}).
+
+Two layers:
+
+* A process-wide counter/histogram store (``inc``/``observe``) that hot
+  paths write to with GIL-atomic dict ops — request counts, TTFB, heal
+  totals, inter-node RPC.
+* ``MetricsGroup`` generators that sample subsystem state on demand —
+  capacity, usage, replication bandwidth, disk cache, dispatch/TPU,
+  process IO — each cached for ``interval`` seconds the way the
+  reference caches group reads (metrics-v2.go cacheInterval), so a
+  scrape storm can't hammer the scanner's usage files or /proc.
+"""
 from __future__ import annotations
 
+import os
 import threading
 import time
 
@@ -12,6 +26,10 @@ _counters: dict[str, float] = {}
 _histograms: dict[str, list[float]] = {}
 
 BUCKETS = (0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 30.0)
+
+#: group cache interval (reference metricsGroupCacheInterval 10s; kept
+#: short enough that tests see fresh numbers)
+CACHE_INTERVAL_S = float(os.environ.get("MINIO_TPU_METRICS_CACHE_S", "3"))
 
 
 def inc(name: str, value: float = 1.0, **labels):
@@ -35,57 +53,259 @@ def _key(name: str, labels: dict) -> str:
     return f"{name}{{{lab}}}"
 
 
-def render_prometheus(server) -> bytes:
-    """One pass over counters + gauges; server gives cluster state
-    (reference cmd/metrics-v2.go MetricsGroup generators: capacity,
-    request histograms, heal, usage, dispatch)."""
-    lines = [
-        "# HELP minio_tpu_uptime_seconds Server uptime",
+class MetricsGroup:
+    """One generator of related metrics, output cached for ``interval``
+    seconds (reference MetricsGroup + timedValue)."""
+
+    def __init__(self, name: str, scope: str, gen,
+                 interval: float | None = None):
+        self.name = name
+        self.scope = scope              # "cluster" | "node"
+        self.gen = gen                  # (server) -> list[str]
+        self.interval = CACHE_INTERVAL_S if interval is None else interval
+        self._cached: list[str] = []
+        self._at = 0.0
+        self._lock = threading.Lock()
+
+    def lines(self, server) -> list[str]:
+        with self._lock:
+            now = time.monotonic()
+            if now - self._at >= self.interval:
+                try:
+                    self._cached = self.gen(server)
+                except Exception:  # noqa: BLE001 — one group must never
+                    self._cached = []  # take down the whole exposition
+                self._at = now
+            return self._cached
+
+
+def _all_disks(obj) -> list:
+    """Every disk under any ObjectLayer shape: one set (.disks), a sets
+    layer (.sets -> .disks), or server pools (.pools -> recurse)."""
+    if hasattr(obj, "disks"):
+        return [d for d in obj.disks if d is not None]
+    if hasattr(obj, "sets"):
+        return [d for s in obj.sets for d in s.disks if d is not None]
+    if hasattr(obj, "pools"):
+        return [d for p in obj.pools for d in _all_disks(p)]
+    return []
+
+
+# -- group generators ---------------------------------------------------------
+
+
+def _g_software(server) -> list[str]:
+    from .. import __version__
+    return [
         "# TYPE minio_tpu_uptime_seconds gauge",
         f"minio_tpu_uptime_seconds {time.time() - _start:.1f}",
+        "# TYPE minio_tpu_info gauge",
+        f'minio_tpu_info{{version="{__version__}"}} 1',
     ]
-    try:
-        info = server.obj.storage_info()
+
+
+def _g_capacity(server) -> list[str]:
+    """Cluster capacity + drive states (reference getClusterCapacityMD,
+    getNodeDiskMetrics)."""
+    info = server.obj.storage_info()
+    lines = [
+        "# TYPE minio_tpu_cluster_disk_online_total gauge",
+        f"minio_tpu_cluster_disk_online_total {info.get('disks_online', 0)}",
+        "# TYPE minio_tpu_cluster_disk_offline_total gauge",
+        "minio_tpu_cluster_disk_offline_total "
+        f"{info.get('disks_offline', 0)}",
+    ]
+    pools = info.get("pools")
+    if pools:
+        lines.append("# TYPE minio_tpu_cluster_pool_count gauge")
+        lines.append(f"minio_tpu_cluster_pool_count {len(pools)}")
+    # raw fs capacity of each local disk root (statvfs — the reference
+    # reads the same from disk.GetInfo)
+    total = free = 0
+    for d in _all_disks(server.obj):
+        base = getattr(d, "base", None)
+        if not base:
+            continue
+        try:
+            st = os.statvfs(base)
+        except OSError:
+            continue
+        total += st.f_frsize * st.f_blocks
+        free += st.f_frsize * st.f_bavail
+    if total:
         lines += [
-            "# TYPE minio_tpu_disks_online gauge",
-            f"minio_tpu_disks_online {info.get('disks_online', 0)}",
-            "# TYPE minio_tpu_disks_offline gauge",
-            f"minio_tpu_disks_offline {info.get('disks_offline', 0)}",
+            "# TYPE minio_tpu_cluster_capacity_raw_total_bytes gauge",
+            f"minio_tpu_cluster_capacity_raw_total_bytes {total}",
+            "# TYPE minio_tpu_cluster_capacity_raw_free_bytes gauge",
+            f"minio_tpu_cluster_capacity_raw_free_bytes {free}",
         ]
-    except Exception:  # noqa: BLE001
-        pass
-    try:  # usage group (from the scanner's last sweep)
-        from ..scanner.usage import load_usage
-        usage = load_usage(server.obj)
+    return lines
+
+
+def _g_usage(server) -> list[str]:
+    """Scanner-derived usage (reference getBucketUsageMetrics)."""
+    from ..scanner.usage import load_usage
+    usage = load_usage(server.obj)
+    lines = [
+        "# TYPE minio_tpu_cluster_usage_object_total gauge",
+        f"minio_tpu_cluster_usage_object_total "
+        f"{usage.get('objects_total', 0)}",
+        "# TYPE minio_tpu_cluster_usage_total_bytes gauge",
+        f"minio_tpu_cluster_usage_total_bytes {usage.get('size_total', 0)}",
+        "# TYPE minio_tpu_bucket_usage_total_bytes gauge",
+        "# TYPE minio_tpu_bucket_usage_object_total gauge",
+    ]
+    for b, st in sorted(usage.get("buckets", {}).items()):
+        lines.append(
+            f'minio_tpu_bucket_usage_total_bytes{{bucket="{b}"}} '
+            f'{st.get("size", 0)}')
+        lines.append(
+            f'minio_tpu_bucket_usage_object_total{{bucket="{b}"}} '
+            f'{st.get("objects", 0)}')
+    return lines
+
+
+def _g_replication(server) -> list[str]:
+    """Replication queue + per-bucket bandwidth (reference
+    getBucketReplicationMetrics + bandwidth Report)."""
+    lines = []
+    pool = getattr(server, "replication", None)
+    if pool is not None:
         lines += [
-            "# TYPE minio_tpu_usage_objects_total gauge",
-            f"minio_tpu_usage_objects_total {usage.get('objects_total', 0)}",
-            "# TYPE minio_tpu_usage_bytes_total gauge",
-            f"minio_tpu_usage_bytes_total {usage.get('size_total', 0)}",
+            "# TYPE minio_tpu_replication_completed_total counter",
+            f"minio_tpu_replication_completed_total {pool.replicated}",
+            "# TYPE minio_tpu_replication_failed_total counter",
+            f"minio_tpu_replication_failed_total {pool.failed}",
+            "# TYPE minio_tpu_replication_queued gauge",
+            f"minio_tpu_replication_queued {pool.q.qsize()}",
         ]
-        for b, st in sorted(usage.get("buckets", {}).items()):
+    from ..bucket.bandwidth import global_monitor
+    rep = global_monitor().report()
+    stats = rep.get("bucketStats", {})
+    if stats:
+        lines.append("# TYPE minio_tpu_bucket_bandwidth_limit_bytes gauge")
+        lines.append(
+            "# TYPE minio_tpu_bucket_bandwidth_current_bytes gauge")
+        for b, st in sorted(stats.items()):
             lines.append(
-                f'minio_tpu_bucket_usage_bytes{{bucket="{b}"}} '
-                f'{st.get("size", 0)}')
+                f'minio_tpu_bucket_bandwidth_limit_bytes{{bucket="{b}"}} '
+                f'{st["limitInBits"]}')
             lines.append(
-                f'minio_tpu_bucket_usage_objects{{bucket="{b}"}} '
-                f'{st.get("objects", 0)}')
-    except Exception:  # noqa: BLE001
+                f'minio_tpu_bucket_bandwidth_current_bytes{{bucket="{b}"}}'
+                f' {st["currentBandwidth"]}')
+    return lines
+
+
+def _g_cache(server) -> list[str]:
+    """Disk cache layer (reference getCacheMetrics)."""
+    cache = getattr(server, "cache", None) or \
+        getattr(server.obj, "cache_stats", None)
+    st = None
+    if cache is not None:
+        st = cache.stats() if callable(getattr(cache, "stats", None)) \
+            else None
+    if st is None:
+        return []
+    lines = [
+        "# TYPE minio_tpu_cache_hits_total counter",
+        f"minio_tpu_cache_hits_total {st.get('hits', 0)}",
+        "# TYPE minio_tpu_cache_missed_total counter",
+        f"minio_tpu_cache_missed_total {st.get('misses', 0)}",
+    ]
+    if "bytes" in st:
+        lines += ["# TYPE minio_tpu_cache_usage_bytes gauge",
+                  f"minio_tpu_cache_usage_bytes {st['bytes']}"]
+    return lines
+
+
+def _g_dispatch(server) -> list[str]:
+    """TPU dispatch runtime — no reference analogue; this is the
+    device-side observability the TPU build adds."""
+    from ..runtime.dispatch import _global
+    if _global is None:
+        return []
+    st = _global.stats()
+    lines = [
+        "# TYPE minio_tpu_dispatch_batches_total counter",
+        f"minio_tpu_dispatch_batches_total {st['batches']}",
+        "# TYPE minio_tpu_dispatch_items_total counter",
+        f"minio_tpu_dispatch_items_total {st['items']}",
+        "# TYPE minio_tpu_dispatch_avg_batch gauge",
+        f"minio_tpu_dispatch_avg_batch {st['avg_batch']:.2f}",
+    ]
+    for k in ("cpu_batches", "device_batches", "queue_depth"):
+        if k in st:
+            lines.append(f"# TYPE minio_tpu_dispatch_{k} gauge")
+            lines.append(f"minio_tpu_dispatch_{k} {st[k]}")
+    return lines
+
+
+def _g_process(server) -> list[str]:
+    """Node process resources (reference getMinioProcMetrics:
+    /proc/self/io rchar/wchar, fds, rss)."""
+    lines = []
+    try:
+        with open("/proc/self/io") as f:
+            io_stats = dict(ln.strip().split(": ") for ln in f
+                            if ": " in ln)
+        lines += [
+            "# TYPE minio_tpu_node_io_rchar_bytes counter",
+            f"minio_tpu_node_io_rchar_bytes {io_stats.get('rchar', 0)}",
+            "# TYPE minio_tpu_node_io_wchar_bytes counter",
+            f"minio_tpu_node_io_wchar_bytes {io_stats.get('wchar', 0)}",
+        ]
+    except OSError:
         pass
     try:
-        from ..runtime.dispatch import _global
-        if _global is not None:
-            st = _global.stats()
-            lines += [
-                "# TYPE minio_tpu_dispatch_batches_total counter",
-                f"minio_tpu_dispatch_batches_total {st['batches']}",
-                "# TYPE minio_tpu_dispatch_items_total counter",
-                f"minio_tpu_dispatch_items_total {st['items']}",
-                "# TYPE minio_tpu_dispatch_avg_batch gauge",
-                f"minio_tpu_dispatch_avg_batch {st['avg_batch']:.2f}",
-            ]
-    except Exception:  # noqa: BLE001
+        with open("/proc/self/status") as f:
+            for ln in f:
+                if ln.startswith("VmRSS:"):
+                    rss_kb = int(ln.split()[1])
+                    lines += [
+                        "# TYPE minio_tpu_node_process_resident_memory_bytes"
+                        " gauge",
+                        "minio_tpu_node_process_resident_memory_bytes "
+                        f"{rss_kb * 1024}",
+                    ]
+                    break
+    except OSError:
         pass
+    try:
+        nfds = len(os.listdir("/proc/self/fd"))
+        lines += ["# TYPE minio_tpu_node_file_descriptor_open_total gauge",
+                  f"minio_tpu_node_file_descriptor_open_total {nfds}"]
+    except OSError:
+        pass
+    return lines
+
+
+def _g_locks(server) -> list[str]:
+    locker = getattr(server, "local_locker", None)
+    if locker is None:
+        return []
+    try:
+        n = len(locker.dump())
+    except Exception:  # noqa: BLE001
+        return []
+    return ["# TYPE minio_tpu_locks_held gauge",
+            f"minio_tpu_locks_held {n}"]
+
+
+_GROUPS = [
+    MetricsGroup("software", "node", _g_software, interval=0),
+    MetricsGroup("capacity", "cluster", _g_capacity),
+    MetricsGroup("usage", "cluster", _g_usage),
+    MetricsGroup("replication", "cluster", _g_replication),
+    MetricsGroup("cache", "node", _g_cache),
+    MetricsGroup("dispatch", "node", _g_dispatch),
+    MetricsGroup("process", "node", _g_process),
+    MetricsGroup("locks", "node", _g_locks),
+]
+
+
+def _store_lines() -> list[str]:
+    """The counter/histogram store: request totals, TTFB, heal, RPC."""
+    lines = []
     with _lock:
         for key, v in sorted(_counters.items()):
             lines.append(f"{key} {v:g}")
@@ -96,11 +316,23 @@ def render_prometheus(server) -> bytes:
             total = sum(vals)
             for b in BUCKETS:
                 c = sum(1 for x in vals if x <= b)
-                lines.append(
-                    f'{base}_bucket{{le="{b}"{labels}}} {c}')
+                lines.append(f'{base}_bucket{{le="{b}"{labels}}} {c}')
             lines.append(f'{base}_bucket{{le="+Inf"{labels}}} {n}')
             lines.append(f"{base}_count{{{labels[1:]}}} {n}"
                          if labels else f"{base}_count {n}")
             lines.append(f"{base}_sum{{{labels[1:]}}} {total:.6f}"
                          if labels else f"{base}_sum {total:.6f}")
+    return lines
+
+
+def render_prometheus(server, scope: str = "") -> bytes:
+    """Text exposition. scope "" or "cluster" renders every group;
+    "node" renders only node-scoped groups (reference mounts
+    /minio/v2/metrics/cluster and /minio/v2/metrics/node)."""
+    lines: list[str] = []
+    for g in _GROUPS:
+        if scope == "node" and g.scope != "node":
+            continue
+        lines.extend(g.lines(server))
+    lines.extend(_store_lines())
     return ("\n".join(lines) + "\n").encode()

@@ -1,0 +1,216 @@
+"""Admin profiling + OBD/health info (reference cmd/admin-handlers.go
+StartProfilingHandler/DownloadProfilingHandler backed by pkg/pprof, and
+HealthInfoHandler/ServerOBDInfoHandler backed by pkg/smart, cgroup,
+disk).
+
+Go gets pprof for free; the Python runtime equivalents here:
+
+* ``cpu``     — a sampling profiler: a daemon thread walks
+                ``sys._current_frames()`` at ~100 Hz and aggregates
+                collapsed stacks across EVERY live thread. (cProfile
+                would hook only the thread that enabled it — useless in
+                a thread-per-request server.) Output is flamegraph-ready
+                collapsed-stack lines plus a leaf-function table.
+* ``threads`` — a goroutine-dump analogue: every live thread's stack.
+* ``mem``     — tracemalloc snapshot (top allocating sites).
+
+One profiling session at a time (the reference enforces the same via
+globalProfiler)."""
+from __future__ import annotations
+
+import io
+import sys
+import threading
+import time
+import traceback
+from collections import Counter
+
+_lock = threading.Lock()
+_active: dict | None = None
+
+SAMPLE_INTERVAL_S = 0.01
+
+
+class _Sampler(threading.Thread):
+    """~100 Hz collapsed-stack sampler over all threads."""
+
+    def __init__(self):
+        super().__init__(name="minio-tpu-profiler", daemon=True)
+        self.stacks: Counter = Counter()
+        self.leaves: Counter = Counter()
+        self.samples = 0
+        self._halt = threading.Event()
+
+    def run(self):
+        me = threading.get_ident()
+        while not self._halt.is_set():
+            for tid, frame in sys._current_frames().items():
+                if tid == me:
+                    continue
+                parts = []
+                f = frame
+                depth = 0
+                while f is not None and depth < 40:
+                    code = f.f_code
+                    parts.append(f"{code.co_filename.rsplit('/', 1)[-1]}"
+                                 f":{code.co_name}")
+                    f = f.f_back
+                    depth += 1
+                parts.reverse()
+                self.stacks[";".join(parts)] += 1
+                self.leaves[parts[-1] if parts else "?"] += 1
+                self.samples += 1
+            self._halt.wait(SAMPLE_INTERVAL_S)
+
+    def stop(self) -> bytes:
+        self._halt.set()
+        self.join(timeout=2)
+        out = io.StringIO()
+        out.write(f"# samples: {self.samples} "
+                  f"(interval {SAMPLE_INTERVAL_S * 1e3:.0f} ms)\n")
+        out.write("# --- top leaf functions ---\n")
+        for name, n in self.leaves.most_common(50):
+            out.write(f"{n:8d} {name}\n")
+        out.write("# --- collapsed stacks (flamegraph.pl format) ---\n")
+        for stack, n in self.stacks.most_common(500):
+            out.write(f"{stack} {n}\n")
+        return out.getvalue().encode()
+
+
+def start(kind: str) -> dict:
+    """Begin a profiling session; returns {kind, started_at}. Raises
+    ValueError on unknown kind or if a session is already running."""
+    global _active
+    with _lock:
+        if _active is not None:
+            raise ValueError(
+                f"profiling already running ({_active['kind']})")
+        if kind == "cpu":
+            sampler = _Sampler()
+            sampler.start()
+            _active = {"kind": kind, "sampler": sampler}
+        elif kind == "mem":
+            import tracemalloc
+            tracemalloc.start(10)
+            _active = {"kind": kind}
+        elif kind == "threads":
+            _active = {"kind": kind}
+        else:
+            raise ValueError(f"unknown profiler type {kind!r}")
+        _active["started_at"] = time.time()
+        return {"kind": kind, "started_at": _active["started_at"]}
+
+
+def stop_and_dump() -> tuple[str, bytes]:
+    """End the session and return (kind, report bytes)."""
+    global _active
+    with _lock:
+        if _active is None:
+            raise ValueError("no profiling session running")
+        sess, _active = _active, None
+    kind = sess["kind"]
+    if kind == "cpu":
+        return kind, sess["sampler"].stop()
+    if kind == "mem":
+        import tracemalloc
+        snap = tracemalloc.take_snapshot()
+        tracemalloc.stop()
+        lines = [str(s) for s in snap.statistics("lineno")[:100]]
+        return kind, ("\n".join(lines) + "\n").encode()
+    # threads: always available, also without start()
+    return kind, thread_dump()
+
+
+def thread_dump() -> bytes:
+    """Every live thread's stack — the goroutine-dump analogue the
+    reference exposes as the 'goroutines' profile."""
+    names = {t.ident: t.name for t in threading.enumerate()}
+    out = io.StringIO()
+    for tid, frame in sys._current_frames().items():
+        out.write(f"--- thread {tid} ({names.get(tid, '?')}) ---\n")
+        traceback.print_stack(frame, file=out)
+        out.write("\n")
+    return out.getvalue().encode()
+
+
+def health_info(server) -> dict:
+    """OBD health report (reference getServerOBDInfo subset that applies
+    to this runtime): cpu, memory, per-disk capacity + latency probe,
+    process info, and the cluster view."""
+    import os
+    info: dict = {"ts": time.time(), "hostname": os.uname().nodename}
+    # cpu
+    try:
+        info["cpu"] = {"count": os.cpu_count(),
+                       "loadavg": list(os.getloadavg())}
+    except OSError:
+        info["cpu"] = {"count": os.cpu_count()}
+    # memory
+    mem = {}
+    try:
+        with open("/proc/meminfo") as f:
+            for ln in f:
+                k, _, rest = ln.partition(":")
+                if k in ("MemTotal", "MemAvailable", "SwapTotal"):
+                    mem[k] = int(rest.split()[0]) * 1024
+    except OSError:
+        pass
+    info["memory"] = mem
+    # process
+    info["process"] = {"pid": os.getpid(),
+                       "uptime_s": round(time.time() - _proc_start, 1),
+                       "threads": threading.active_count()}
+    # drives: capacity + a small write/read latency probe per local disk
+    from .metrics import _all_disks
+    drives = []
+    for d in _all_disks(server.obj):
+        base = getattr(d, "base", None)
+        if not base:
+            continue
+        entry: dict = {"path": base}
+        try:
+            st = os.statvfs(base)
+            entry["total_bytes"] = st.f_frsize * st.f_blocks
+            entry["free_bytes"] = st.f_frsize * st.f_bavail
+        except OSError as e:
+            entry["error"] = str(e)
+            drives.append(entry)
+            continue
+        try:
+            probe = os.path.join(base, ".minio.sys", "tmp",
+                                 f".obd-{os.getpid()}")
+            os.makedirs(os.path.dirname(probe), exist_ok=True)
+            blob = b"\0" * (256 << 10)
+            t0 = time.perf_counter()
+            with open(probe, "wb") as f:
+                f.write(blob)
+                f.flush()
+                os.fsync(f.fileno())
+            entry["write_256k_ms"] = round(
+                (time.perf_counter() - t0) * 1e3, 3)
+            t0 = time.perf_counter()
+            with open(probe, "rb") as f:
+                f.read()
+            entry["read_256k_ms"] = round(
+                (time.perf_counter() - t0) * 1e3, 3)
+            os.unlink(probe)
+        except OSError as e:
+            entry["error"] = str(e)
+        drives.append(entry)
+    info["drives"] = drives
+    # cluster view
+    try:
+        info["cluster"] = server.obj.storage_info()
+    except Exception:  # noqa: BLE001
+        pass
+    # device runtime (TPU) — no reference analogue
+    try:
+        from ..runtime.dispatch import _global
+        if _global is not None:
+            info["dispatch"] = _global.stats()
+    except Exception:  # noqa: BLE001
+        pass
+    return info
+
+
+_proc_start = time.time()

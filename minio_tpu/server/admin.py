@@ -123,6 +123,31 @@ def _dispatch_admin(h, op: str) -> None:
         q = {k: v[0] for k, v in h.query.items()}
         cfg.delete(q.get("subsys", ""), q.get("key", ""))
         return h._send(200, b"{}", "application/json")
+    if op.startswith("profiling/") or op == "healthinfo" or \
+            op == "obdinfo":
+        return _profiling_obd(h, op)
+    if op == "list-config-history":
+        from ..config import get_config_sys
+        cfg = get_config_sys(h.s3.obj)
+        return h._send(200, json.dumps(cfg.list_history()).encode(),
+                       "application/json")
+    if op == "restore-config-history":
+        from ..config import get_config_sys
+        cfg = get_config_sys(h.s3.obj)
+        q = {k: v[0] for k, v in h.query.items()}
+        rid = q.get("restoreId", "")
+        if not rid:
+            return h._error("InvalidArgument", "missing restoreId", 400)
+        try:
+            cfg.restore_history(rid)
+        except Exception as e:  # noqa: BLE001
+            return h._error("InvalidArgument",
+                            f"restore {rid}: {e}", 400)
+        return h._send(200, b"{}", "application/json")
+    if op == "clear-config-history":
+        from ..config import get_config_sys
+        get_config_sys(h.s3.obj).clear_history()
+        return h._send(200, b"{}", "application/json")
     if op == "bandwidth":
         from ..bucket.bandwidth import global_monitor
         q = {k: v[0] for k, v in h.query.items()}
@@ -149,6 +174,40 @@ def _dispatch_admin(h, op: str) -> None:
                        "application/json")
     if _iam_op(h, op):
         return
+    h._error("NotImplemented", f"admin op {op}", 501)
+
+
+def _profiling_obd(h, op: str) -> None:
+    """Profiling start/download (reference StartProfilingHandler,
+    DownloadProfilingDataHandler) and the OBD health report
+    (HealthInfoHandler)."""
+    from ..obs import profiling
+    q = {k: v[0] for k, v in h.query.items()}
+    if op == "profiling/start":
+        try:
+            info = profiling.start(q.get("profilerType", "cpu"))
+        except ValueError as e:
+            return h._error("InvalidArgument", str(e), 400)
+        return h._send(200, json.dumps(info).encode(), "application/json")
+    if op == "profiling/download":
+        try:
+            kind, data = profiling.stop_and_dump()
+        except ValueError as e:
+            return h._error("InvalidArgument", str(e), 400)
+        h.send_response(200)
+        h.send_header("Content-Type", "application/octet-stream")
+        h.send_header("Content-Disposition",
+                      f'attachment; filename="profile-{kind}.txt"')
+        h.send_header("Content-Length", str(len(data)))
+        h.end_headers()
+        h.wfile.write(data)
+        return
+    if op == "profiling/threads":
+        data = profiling.thread_dump()
+        return h._send(200, data, "text/plain")
+    if op in ("healthinfo", "obdinfo"):
+        return h._send(200, json.dumps(
+            profiling.health_info(h.s3)).encode(), "application/json")
     h._error("NotImplemented", f"admin op {op}", 501)
 
 

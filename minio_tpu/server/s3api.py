@@ -422,7 +422,9 @@ class _S3Handler(BaseHTTPRequestHandler):
         if self.url_path.startswith("/minio/metrics") or \
                 self.url_path.startswith("/minio/v2/metrics"):
             from ..obs.metrics import render_prometheus
-            return self._send(200, render_prometheus(self.s3),
+            scope = "node" if self.url_path.rstrip("/").endswith("/node") \
+                else "cluster"
+            return self._send(200, render_prometheus(self.s3, scope),
                               "text/plain; version=0.0.4")
         if self.url_path.startswith("/minio/admin/"):
             from .admin import handle_admin

@@ -139,3 +139,62 @@ def test_log_once_dedup():
     for _ in range(5):
         ls.log_once("disk-d0-offline", "error", "storage", "disk offline")
     assert len(sent) == 1
+
+
+def test_metrics_v2_groups(c, srv):
+    """The grouped v2 registry: capacity, usage, process, and the node
+    scope filter (reference /minio/v2/metrics/{cluster,node})."""
+    c.request("PUT", "/mg")
+    c.request("PUT", "/mg/o", body=b"y" * 2000)
+    text = c.http.get(srv.endpoint() + "/minio/v2/metrics/cluster").text
+    assert "minio_tpu_cluster_disk_online_total" in text
+    assert "minio_tpu_cluster_capacity_raw_total_bytes" in text
+    assert "minio_tpu_node_io_rchar_bytes" in text
+    assert "minio_tpu_node_process_resident_memory_bytes" in text
+    assert 'minio_tpu_info{version=' in text
+    node = c.http.get(srv.endpoint() + "/minio/v2/metrics/node").text
+    assert "minio_tpu_node_io_rchar_bytes" in node
+    # cluster-scoped groups are filtered out of the node exposition
+    assert "minio_tpu_cluster_disk_online_total" not in node
+
+
+def test_metrics_group_caching(srv):
+    """A group generator runs at most once per cache interval."""
+    from minio_tpu.obs.metrics import MetricsGroup
+    calls = []
+
+    def gen(server):
+        calls.append(1)
+        return ["x 1"]
+
+    g = MetricsGroup("t", "node", gen, interval=60)
+    assert g.lines(srv) == ["x 1"]
+    assert g.lines(srv) == ["x 1"]
+    assert len(calls) == 1
+
+
+def test_metrics_group_failure_isolated(srv):
+    """One failing generator yields [] instead of breaking exposition."""
+    from minio_tpu.obs.metrics import MetricsGroup
+
+    def boom(server):
+        raise RuntimeError("subsystem down")
+
+    g = MetricsGroup("t", "node", boom, interval=0)
+    assert g.lines(srv) == []
+
+
+def test_inter_node_rpc_metrics():
+    from minio_tpu.obs import metrics as mx
+    before = {k: v for k, v in mx._counters.items()
+              if "inter_node" in k}
+    from minio_tpu.dist.rpc import RPCClient
+    cl = RPCClient("http://127.0.0.1:1", "storage", "secret",
+                   timeout=0.2)
+    try:
+        cl.call("ping")
+    except Exception:  # noqa: BLE001 — expected: nothing listening
+        pass
+    after = {k: v for k, v in mx._counters.items() if "inter_node" in k}
+    assert any("calls_total" in k for k in after)
+    assert sum(after.values()) > sum(before.values())
