@@ -63,20 +63,27 @@ class MetricsGroup:
         self.scope = scope              # "cluster" | "node"
         self.gen = gen                  # (server) -> list[str]
         self.interval = CACHE_INTERVAL_S if interval is None else interval
-        self._cached: list[str] = []
-        self._at = 0.0
+        #: cache keyed per server instance — several servers in one
+        #: process (tests, embedded use) must not serve each other's
+        #: disk counts
+        self._cached: dict[int, tuple[float, list[str]]] = {}
         self._lock = threading.Lock()
 
     def lines(self, server) -> list[str]:
+        key = id(server)
         with self._lock:
             now = time.monotonic()
-            if now - self._at >= self.interval:
+            hit = self._cached.get(key)
+            if hit is None or now - hit[0] >= self.interval:
                 try:
-                    self._cached = self.gen(server)
+                    out = self.gen(server)
                 except Exception:  # noqa: BLE001 — one group must never
-                    self._cached = []  # take down the whole exposition
-                self._at = now
-            return self._cached
+                    out = []  # take down the whole exposition
+                if len(self._cached) > 64:
+                    self._cached.clear()
+                self._cached[key] = (now, out)
+                return out
+            return hit[1]
 
 
 def _all_disks(obj) -> list:
@@ -197,15 +204,14 @@ def _g_replication(server) -> list[str]:
 
 
 def _g_cache(server) -> list[str]:
-    """Disk cache layer (reference getCacheMetrics)."""
-    cache = getattr(server, "cache", None) or \
-        getattr(server.obj, "cache_stats", None)
-    st = None
-    if cache is not None:
-        st = cache.stats() if callable(getattr(cache, "stats", None)) \
-            else None
-    if st is None:
+    """Disk cache layer (reference getCacheMetrics): present when the
+    server's object layer is (or wraps) cache.CacheObjects."""
+    from ..cache import CacheObjects
+    cache = server.obj if isinstance(server.obj, CacheObjects) else \
+        getattr(server, "cache", None)
+    if not isinstance(cache, CacheObjects):
         return []
+    st = cache.stats()
     lines = [
         "# TYPE minio_tpu_cache_hits_total counter",
         f"minio_tpu_cache_hits_total {st.get('hits', 0)}",

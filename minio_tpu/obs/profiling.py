@@ -29,6 +29,12 @@ _lock = threading.Lock()
 _active: dict | None = None
 
 SAMPLE_INTERVAL_S = 0.01
+#: a session abandoned by its admin client must not sample forever —
+#: auto-halt after this long (results stay downloadable)
+MAX_PROFILE_S = 300.0
+#: cap on distinct stack signatures kept (deep recursion / very varied
+#: workloads would otherwise grow the Counter without bound)
+MAX_STACKS = 50_000
 
 
 class _Sampler(threading.Thread):
@@ -43,7 +49,8 @@ class _Sampler(threading.Thread):
 
     def run(self):
         me = threading.get_ident()
-        while not self._halt.is_set():
+        deadline = time.monotonic() + MAX_PROFILE_S
+        while not self._halt.is_set() and time.monotonic() < deadline:
             for tid, frame in sys._current_frames().items():
                 if tid == me:
                     continue
@@ -57,7 +64,9 @@ class _Sampler(threading.Thread):
                     f = f.f_back
                     depth += 1
                 parts.reverse()
-                self.stacks[";".join(parts)] += 1
+                sig = ";".join(parts)
+                if sig in self.stacks or len(self.stacks) < MAX_STACKS:
+                    self.stacks[sig] += 1
                 self.leaves[parts[-1] if parts else "?"] += 1
                 self.samples += 1
             self._halt.wait(SAMPLE_INTERVAL_S)

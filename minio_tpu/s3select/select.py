@@ -38,8 +38,8 @@ def _find(el, tag):
 @dataclass
 class S3SelectRequest:
     expression: str = ""
-    input_format: str = "csv"          # csv | json
-    compression: str = "NONE"          # NONE | GZIP
+    input_format: str = "csv"          # csv | json | parquet
+    compression: str = "NONE"          # NONE | GZIP | BZIP2 | SNAPPY
     csv_header: str = "NONE"           # NONE | USE | IGNORE
     csv_delim: str = ","
     csv_quote: str = '"'
@@ -64,7 +64,9 @@ class S3SelectRequest:
                                or "NONE").upper()
             csv_el = _find(inp, "CSV")
             json_el = _find(inp, "JSON")
-            if json_el is not None:
+            if _find(inp, "Parquet") is not None:
+                req.input_format = "parquet"
+            elif json_el is not None:
                 req.input_format = "json"
                 req.json_type = (_findtext(json_el, "Type")
                                  or "LINES").upper()
@@ -95,8 +97,31 @@ class S3SelectRequest:
 
 
 def _records(req: S3SelectRequest, raw: bytes, alias: str):
+    if req.input_format == "parquet":
+        # parquet is its own container; AWS rejects CompressionType for
+        # it (column chunks carry their own codec)
+        if req.compression not in ("", "NONE"):
+            raise SQLError("CompressionType must be NONE for Parquet")
+        from .parquet import ParquetError, iter_parquet_rows
+        try:
+            for row in iter_parquet_rows(raw):
+                yield Record(obj=row, alias=alias)
+        except ParquetError as e:
+            raise SQLError(f"parquet: {e}") from None
+        return
     if req.compression == "GZIP":
         raw = gzip.decompress(raw)
+    elif req.compression == "BZIP2":
+        import bz2
+        raw = bz2.decompress(raw)
+    elif req.compression == "SNAPPY":
+        # the reference accepts snappy/s2-framed CSV+JSON inputs
+        from ..utils.snappy import SnappyError
+        from ..utils.snappy import decompress as snappy_decompress
+        try:
+            raw = snappy_decompress(raw)
+        except SnappyError as e:
+            raise SQLError(f"snappy: {e}") from None
     elif req.compression not in ("", "NONE"):
         raise SQLError(f"unsupported CompressionType {req.compression}")
     if req.input_format == "json":

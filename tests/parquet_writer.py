@@ -1,0 +1,222 @@
+"""Minimal Parquet writer for test fixtures (PLAIN + optional
+dictionary encoding, UNCOMPRESSED/GZIP/SNAPPY codecs, flat schemas with
+REQUIRED/OPTIONAL fields). Kept in tests: the framework only needs to
+READ parquet (as the reference does for S3 Select); this writer exists
+so fixtures don't require pyarrow."""
+from __future__ import annotations
+
+import gzip
+import struct
+
+MAGIC = b"PAR1"
+CT_TRUE, CT_FALSE, CT_BYTE, CT_I16, CT_I32, CT_I64, CT_DOUBLE, CT_BINARY, \
+    CT_LIST, CT_SET, CT_MAP, CT_STRUCT = range(1, 13)
+
+
+class _W:
+    def __init__(self):
+        self.b = bytearray()
+
+    def varint(self, v: int):
+        while True:
+            if v < 0x80:
+                self.b.append(v)
+                return
+            self.b.append((v & 0x7F) | 0x80)
+            v >>= 7
+
+    def zigzag(self, v: int):
+        self.varint((v << 1) ^ (v >> 63) if v >= 0 else ((-v) << 1) - 1)
+
+
+def _field(w: _W, last_id: int, fid: int, ctype: int):
+    delta = fid - last_id
+    if 0 < delta <= 15:
+        w.b.append((delta << 4) | ctype)
+    else:
+        w.b.append(ctype)
+        w.zigzag(fid)
+    return fid
+
+
+def _struct(fields: list[tuple[int, int, object]]) -> bytes:
+    """fields: (field_id, ctype, value) sorted by id -> encoded struct."""
+    w = _W()
+    last = 0
+    for fid, ctype, val in fields:
+        if ctype in (CT_TRUE, CT_FALSE):
+            last = _field(w, last, fid,
+                          CT_TRUE if val else CT_FALSE)
+            continue
+        last = _field(w, last, fid, ctype)
+        if ctype in (CT_BYTE, CT_I16, CT_I32, CT_I64):
+            w.zigzag(int(val))
+        elif ctype == CT_BINARY:
+            raw = val.encode() if isinstance(val, str) else bytes(val)
+            w.varint(len(raw))
+            w.b += raw
+        elif ctype == CT_STRUCT:
+            w.b += val  # already-encoded struct bytes
+        elif ctype == CT_LIST:
+            etype, items = val
+            if len(items) < 15:
+                w.b.append((len(items) << 4) | etype)
+            else:
+                w.b.append(0xF0 | etype)
+                w.varint(len(items))
+            for it in items:
+                if etype in (CT_BYTE, CT_I16, CT_I32, CT_I64):
+                    w.zigzag(int(it))
+                elif etype == CT_BINARY:
+                    raw = it.encode() if isinstance(it, str) else bytes(it)
+                    w.varint(len(raw))
+                    w.b += raw
+                elif etype == CT_STRUCT:
+                    w.b += it
+                else:
+                    raise ValueError(f"list elem type {etype}")
+        else:
+            raise ValueError(f"ctype {ctype}")
+    w.b.append(0)
+    return bytes(w.b)
+
+
+# parquet physical types
+BOOLEAN, INT32, INT64, FLOAT, DOUBLE, BYTE_ARRAY = 0, 1, 2, 4, 5, 6
+_PACK = {INT32: "<i", INT64: "<q", FLOAT: "<f", DOUBLE: "<d"}
+
+
+def _plain(ptype: int, values: list) -> bytes:
+    if ptype == BOOLEAN:
+        out = bytearray((len(values) + 7) // 8)
+        for k, v in enumerate(values):
+            if v:
+                out[k >> 3] |= 1 << (k & 7)
+        return bytes(out)
+    if ptype == BYTE_ARRAY:
+        out = bytearray()
+        for v in values:
+            raw = v.encode() if isinstance(v, str) else bytes(v)
+            out += struct.pack("<I", len(raw)) + raw
+        return bytes(out)
+    return b"".join(struct.pack(_PACK[ptype], v) for v in values)
+
+
+def _rle_runs(bit_width: int, values: list[int]) -> bytes:
+    """Encode as simple RLE runs (no bit-packing)."""
+    w = _W()
+    byte_w = (bit_width + 7) // 8
+    i = 0
+    while i < len(values):
+        j = i
+        while j < len(values) and values[j] == values[i]:
+            j += 1
+        w.varint((j - i) << 1)
+        w.b += values[i].to_bytes(byte_w, "little")
+        i = j
+    return bytes(w.b)
+
+
+def _compress(data: bytes, codec: str) -> bytes:
+    if codec == "gzip":
+        return gzip.compress(data)
+    if codec == "snappy":
+        from minio_tpu.utils.snappy import compress
+        return compress(data)
+    return data
+
+
+_CODEC_ID = {"none": 0, "snappy": 1, "gzip": 2}
+
+
+def write_parquet(columns: list[dict], num_rows: int,
+                  codec: str = "none") -> bytes:
+    """columns: [{name, type, values, optional?, dictionary?}]; values
+    may contain None when optional. Returns the full file bytes."""
+    out = bytearray(MAGIC)
+    chunk_metas = []
+    for col in columns:
+        name = col["name"]
+        ptype = col["type"]
+        values = col["values"]
+        optional = col.get("optional", False)
+        use_dict = col.get("dictionary", False)
+        data_off = len(out)
+        dict_off = None
+        present = [v for v in values if v is not None]
+        encodings = [0]
+        if use_dict:
+            # dictionary page (PLAIN dictionary values)
+            uniq = sorted(set(present), key=str)
+            index = {v: i for i, v in enumerate(uniq)}
+            dict_raw = _plain(ptype, uniq)
+            dict_comp = _compress(dict_raw, codec)
+            dict_hdr = _struct([
+                (1, CT_I32, 2), (2, CT_I32, len(dict_raw)),
+                (3, CT_I32, len(dict_comp)),
+                (7, CT_STRUCT, _struct([(1, CT_I32, len(uniq)),
+                                        (2, CT_I32, 0)]))])
+            dict_off = len(out)
+            out += dict_hdr + dict_comp
+            data_off = len(out)
+            bw = max(1, (len(uniq) - 1).bit_length() if len(uniq) > 1
+                     else 1)
+            body = bytes([bw]) + _rle_runs(
+                bw, [index[v] for v in present])
+            encodings = [8]
+        else:
+            body = _plain(ptype, present)
+        page = bytearray()
+        if optional:
+            defs = _rle_runs(1, [0 if v is None else 1 for v in values])
+            page += struct.pack("<I", len(defs)) + defs
+        page += body
+        comp = _compress(bytes(page), codec)
+        hdr = _struct([
+            (1, CT_I32, 0),                      # DATA_PAGE
+            (2, CT_I32, len(page)),
+            (3, CT_I32, len(comp)),
+            (5, CT_STRUCT, _struct([
+                (1, CT_I32, len(values)),
+                (2, CT_I32, encodings[0]),
+                (3, CT_I32, 3),                  # def levels: RLE
+                (4, CT_I32, 3)]))])
+        page_start = dict_off if dict_off is not None else len(out)
+        out += hdr + comp
+        total_comp = len(out) - page_start
+        meta = _struct([
+            (1, CT_I32, ptype),
+            (2, CT_LIST, (CT_I32, encodings)),
+            (3, CT_LIST, (CT_BINARY, [name])),
+            (4, CT_I32, _CODEC_ID[codec]),
+            (5, CT_I64, len(values)),
+            (6, CT_I64, total_comp),
+            (7, CT_I64, total_comp),
+            (9, CT_I64, data_off),
+        ] + ([(11, CT_I64, dict_off)] if dict_off is not None else []))
+        chunk_metas.append(_struct([
+            (2, CT_I64, page_start),
+            (3, CT_STRUCT, meta)]))
+    # schema: root + leaves
+    schema = [_struct([(4, CT_BINARY, "root"),
+                       (5, CT_I32, len(columns))])]
+    for col in columns:
+        fields = [(1, CT_I32, col["type"]),
+                  (3, CT_I32, 1 if col.get("optional") else 0),
+                  (4, CT_BINARY, col["name"])]
+        if col["type"] == BYTE_ARRAY and not col.get("raw_bytes"):
+            fields.append((6, CT_I32, 0))  # ConvertedType UTF8
+        schema.append(_struct(fields))
+    rg = _struct([
+        (1, CT_LIST, (CT_STRUCT, chunk_metas)),
+        (2, CT_I64, sum(len(c) for c in chunk_metas)),
+        (3, CT_I64, num_rows)])
+    fmeta = _struct([
+        (1, CT_I32, 1),
+        (2, CT_LIST, (CT_STRUCT, schema)),
+        (3, CT_I64, num_rows),
+        (4, CT_LIST, (CT_STRUCT, [rg])),
+        (6, CT_BINARY, "minio-tpu-test-writer")])
+    out += fmeta
+    out += struct.pack("<I", len(fmeta)) + MAGIC
+    return bytes(out)
