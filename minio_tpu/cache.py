@@ -1,107 +1,113 @@
 """Disk cache ObjectLayer wrapper (reference cacheObjects,
-cmd/disk-cache.go:88 + disk-cache-backend.go): a write-through/read-through
-SSD cache in front of any ObjectLayer. GET hits serve from the local cache
-directory (with ETag validation against the backend's metadata so stale
-entries self-invalidate); misses populate the cache; LRU eviction keeps
-usage under the configured quota. Everything else delegates.
+cmd/disk-cache.go:88 + cmd/disk-cache-backend.go): a read-through SSD
+cache in front of any ObjectLayer, with the reference's on-disk format:
 
-The cache stores one file per (bucket, object): ``<root>/<bucket>/<sha of
-key>.data`` + ``.meta`` (json: etag, size, content-type, atime)."""
+* one directory per object — ``<dir>/<sha256(bucket/object)>/`` holding
+  ``cache.json`` (metadata: etag, size, user metadata, hits, ranges) and
+  ``part.1`` (full object data), plus ``range-<start>-<end>`` files for
+  cached partial reads (disk-cache-backend.go:47-74)
+* multiple cache drives, objects distributed by key hash
+* watermark GC: when usage crosses quota*high%, evict by atime/hits
+  score down to quota*low% (disk-cache-backend.go:204-224)
+* ``exclude`` glob patterns and ``after`` (cache only after N reads —
+  cache.json carries the hit counter before any data is cached)
+* backend-offline serving: when the inner layer errors (not a
+  NotFound), a cached entry still serves reads — the reference's
+  BackendDown path (cmd/disk-cache.go GetObjectNInfo)
+
+GET hits validate the cached etag against the backend's metadata so
+stale entries self-invalidate; writes drop the entry (read-through, not
+write-back)."""
 from __future__ import annotations
 
+import fnmatch
 import hashlib
 import io
 import json
 import os
+import shutil
 import threading
 import time
 
 from .objectlayer import datatypes as dt
 
+CACHE_META = "cache.json"
+CACHE_DATA = "part.1"
+#: one cached range must not exceed this (whole objects have no cap
+#: beyond the half-quota rule)
+MAX_RANGE_BYTES = 64 << 20
+
 
 class CacheObjects:
     """Duck-typed ObjectLayer wrapper (NOT an ObjectLayer subclass: the
     ABC's concrete no-op stubs would shadow the __getattr__ delegation)."""
-    def __init__(self, inner, cache_dir: str, quota_bytes: int = 1 << 30,
-                 watermark_low: float = 0.8):
+
+    def __init__(self, inner, cache_dir, quota_bytes: int = 1 << 30,
+                 watermark_low: int = 70, watermark_high: int = 80,
+                 exclude: list[str] | None = None, after: int = 0):
         self.inner = inner
-        self.dir = cache_dir
-        self.quota = quota_bytes
-        self.low = watermark_low
-        os.makedirs(cache_dir, exist_ok=True)
+        self.dirs = [cache_dir] if isinstance(cache_dir, str) \
+            else list(cache_dir)
+        self.quota = quota_bytes                    # per cache dir
+        self.low = watermark_low / 100.0
+        self.high = watermark_high / 100.0
+        self.exclude = list(exclude or [])
+        self.after = after
+        for d in self.dirs:
+            os.makedirs(d, exist_ok=True)
         self._lock = threading.Lock()
         self.hits = 0
         self.misses = 0
-        # used-bytes tracked incrementally (store/drop/evict adjust it) so
-        # the hot path never walks the cache directory; one walk seeds it
-        self._used = self.usage()
+        #: per-entry hit counts not yet flushed into cache.json (the
+        #: flush throttle must not lose increments between flushes)
+        self._pending_hits: dict[str, int] = {}
+        # per-dir used-bytes tracked incrementally so the hot path never
+        # walks the cache; one walk per dir seeds the counters
+        self._used = [self._walk_usage(d) for d in self.dirs]
 
-    # -- cache mechanics ------------------------------------------------------
+    # -- layout ---------------------------------------------------------------
 
-    def _paths(self, bucket: str, object: str) -> tuple[str, str]:
-        h = hashlib.sha256(object.encode()).hexdigest()[:48]
-        base = os.path.join(self.dir, bucket)
-        return os.path.join(base, h + ".data"), os.path.join(
-            base, h + ".meta")
+    def _entry_dir(self, bucket: str, object: str) -> tuple[int, str]:
+        h = hashlib.sha256(f"{bucket}/{object}".encode()).hexdigest()
+        di = int(h[:8], 16) % len(self.dirs)
+        return di, os.path.join(self.dirs[di], h)
 
-    def _load_meta(self, mpath: str) -> dict | None:
+    def _load_meta(self, edir: str) -> dict | None:
         try:
-            with open(mpath, encoding="utf-8") as f:
+            with open(os.path.join(edir, CACHE_META),
+                      encoding="utf-8") as f:
                 return json.load(f)
         except (OSError, ValueError):
             return None
 
-    def _store(self, bucket: str, object: str, data: bytes, oi) -> None:
-        if len(data) > self.quota // 2:
-            return  # one object must not own the cache
-        dpath, mpath = self._paths(bucket, object)
-        os.makedirs(os.path.dirname(dpath), exist_ok=True)
+    def _save_meta(self, edir: str, meta: dict) -> None:
+        tmp = os.path.join(edir, CACHE_META + ".tmp")
         try:
-            with open(dpath + ".tmp", "wb") as f:
-                f.write(data)
-            os.replace(dpath + ".tmp", dpath)
-            with open(mpath + ".tmp", "w", encoding="utf-8") as f:
-                json.dump({"etag": oi.etag, "size": len(data),
-                           "content_type": oi.content_type,
-                           "atime": time.time()}, f)
-            os.replace(mpath + ".tmp", mpath)
-        except OSError:
-            return
-        with self._lock:
-            self._used += len(data)
-        if self._used > self.quota:
-            self._evict_if_needed()
-
-    def _touch(self, mpath: str, meta: dict) -> None:
-        # throttle: rewriting the meta on EVERY hit doubles hit-path IO;
-        # LRU ordering survives with minute-granularity recency
-        if time.time() - meta.get("atime", 0) < 60:
-            return
-        meta["atime"] = time.time()
-        try:
-            with open(mpath, "w", encoding="utf-8") as f:
+            os.makedirs(edir, exist_ok=True)
+            with open(tmp, "w", encoding="utf-8") as f:
                 json.dump(meta, f)
+            os.replace(tmp, os.path.join(edir, CACHE_META))
         except OSError:
             pass
 
-    def _drop(self, bucket: str, object: str) -> None:
-        dpath, mpath = self._paths(bucket, object)
-        try:
-            size = os.path.getsize(dpath)
-        except OSError:
-            size = 0
-        for p in (dpath, mpath):
-            try:
-                os.unlink(p)
-            except OSError:
-                pass
-        if size:
-            with self._lock:
-                self._used = max(0, self._used - size)
+    def _excluded(self, bucket: str, object: str) -> bool:
+        key = f"{bucket}/{object}"
+        return any(fnmatch.fnmatch(key, pat) or
+                   fnmatch.fnmatch(bucket, pat)
+                   for pat in self.exclude)
 
-    def usage(self) -> int:
+    def _new_meta(self, bucket: str, object: str, oi) -> dict:
+        return {"version": "1.0.0", "bucket": bucket, "object": object,
+                "etag": oi.etag, "size": oi.size,
+                "content_type": oi.content_type,
+                "user_defined": dict(getattr(oi, "user_defined", {}) or {}),
+                "atime": time.time(), "hits": 0, "ranges": {}}
+
+    # -- accounting / gc ------------------------------------------------------
+
+    def _walk_usage(self, d: str) -> int:
         total = 0
-        for dirpath, _, files in os.walk(self.dir):
+        for dirpath, _, files in os.walk(d):
             for f in files:
                 try:
                     total += os.path.getsize(os.path.join(dirpath, f))
@@ -109,39 +115,172 @@ class CacheObjects:
                     pass
         return total
 
-    def _evict_if_needed(self) -> None:
-        """LRU eviction to the low watermark (cmd/disk-cache.go gc). Runs
-        only when the incremental counter crosses quota — the directory
-        walk happens once per eviction episode, not per request."""
+    def usage(self) -> int:
         with self._lock:
-            used = self.usage()  # re-seed the counter while we're here
-            self._used = used
-            if used <= self.quota:
+            return sum(self._used)
+
+    def _account(self, di: int, delta: int) -> None:
+        with self._lock:
+            self._used[di] = max(0, self._used[di] + delta)
+            trigger = self._used[di] > self.quota * self.high
+        if trigger:
+            self._gc(di)
+
+    def _gc(self, di: int) -> None:
+        """Evict whole entries by (atime, hits) score until the dir is
+        under quota*low (disk-cache-backend.go gc + scorer)."""
+        with self._lock:
+            d = self.dirs[di]
+            used = self._walk_usage(d)   # re-seed while we're here
+            self._used[di] = used
+            target = self.quota * self.low
+            if used <= target:
                 return
             entries = []
-            for dirpath, _, files in os.walk(self.dir):
-                for f in files:
-                    if not f.endswith(".meta"):
-                        continue
-                    mpath = os.path.join(dirpath, f)
-                    meta = self._load_meta(mpath) or {}
-                    entries.append((meta.get("atime", 0.0), mpath))
+            for name in os.listdir(d):
+                edir = os.path.join(d, name)
+                if not os.path.isdir(edir):
+                    continue
+                meta = self._load_meta(edir) or {}
+                size = self._walk_usage(edir)
+                # older + colder first; each hit is worth five minutes
+                # of recency, so hot objects survive a sweep
+                hits = meta.get("hits", 0) + self._pending_hits.get(
+                    edir, 0)
+                score = meta.get("atime", 0.0) + 300.0 * hits
+                entries.append((score, size, edir))
             entries.sort()
-            target = int(self.quota * self.low)
-            for _, mpath in entries:
+            for _, size, edir in entries:
                 if used <= target:
                     break
-                dpath = mpath[:-5] + ".data"
-                try:
-                    used -= os.path.getsize(dpath)
-                    os.unlink(dpath)
-                except OSError:
-                    pass
-                try:
-                    os.unlink(mpath)
-                except OSError:
-                    pass
-            self._used = used
+                shutil.rmtree(edir, ignore_errors=True)
+                used -= size
+            self._used[di] = used
+
+    def _drop(self, bucket: str, object: str) -> None:
+        di, edir = self._entry_dir(bucket, object)
+        with self._lock:
+            self._pending_hits.pop(edir, None)
+        if os.path.isdir(edir):
+            size = self._walk_usage(edir)
+            shutil.rmtree(edir, ignore_errors=True)
+            self._account(di, -size)
+
+    # -- store/serve ----------------------------------------------------------
+
+    def _store_full(self, bucket: str, object: str, data: bytes, oi):
+        if len(data) > self.quota // 2 or self._excluded(bucket, object):
+            return
+        di, edir = self._entry_dir(bucket, object)
+        old = self._load_meta(edir)
+        meta = self._new_meta(bucket, object, oi)
+        meta["hits"] = (old or {}).get("hits", 0) + 1
+        try:
+            os.makedirs(edir, exist_ok=True)
+            # a full copy supersedes any cached ranges
+            for name in os.listdir(edir):
+                if name.startswith("range-"):
+                    try:
+                        os.unlink(os.path.join(edir, name))
+                    except OSError:
+                        pass
+            tmp = os.path.join(edir, CACHE_DATA + ".tmp")
+            with open(tmp, "wb") as f:
+                f.write(data)
+            os.replace(tmp, os.path.join(edir, CACHE_DATA))
+        except OSError:
+            return
+        self._save_meta(edir, meta)
+        self._account(di, len(data) + 256)
+
+    def _clear_stale_data(self, edir: str) -> None:
+        """Remove part.1 and range files left by a previous object
+        version: meta about to be written with a NEW etag must never
+        coexist with old data files (a later full-read hit would serve
+        the old bytes under the new etag)."""
+        removed = 0
+        try:
+            for name in os.listdir(edir):
+                if name == CACHE_DATA or name.startswith("range-"):
+                    p = os.path.join(edir, name)
+                    try:
+                        removed += os.path.getsize(p)
+                        os.unlink(p)
+                    except OSError:
+                        pass
+        except OSError:
+            return
+        if removed:
+            di = int(os.path.basename(edir)[:8], 16) % len(self.dirs)
+            self._account(di, -removed)
+
+    def _store_range(self, bucket: str, object: str, start: int,
+                     data: bytes, oi):
+        if not data or len(data) > MAX_RANGE_BYTES or \
+                self._excluded(bucket, object):
+            return
+        di, edir = self._entry_dir(bucket, object)
+        meta = self._load_meta(edir)
+        if meta is None or meta.get("etag") != oi.etag:
+            if meta is not None:
+                self._clear_stale_data(edir)
+            meta = self._new_meta(bucket, object, oi)
+        end = start + len(data) - 1
+        fname = f"range-{start}-{end}"
+        try:
+            os.makedirs(edir, exist_ok=True)
+            tmp = os.path.join(edir, fname + ".tmp")
+            with open(tmp, "wb") as f:
+                f.write(data)
+            os.replace(tmp, os.path.join(edir, fname))
+        except OSError:
+            return
+        meta.setdefault("ranges", {})[f"{start}-{end}"] = fname
+        meta["atime"] = time.time()
+        self._save_meta(edir, meta)
+        self._account(di, len(data) + 256)
+
+    def _serve(self, edir: str, meta: dict, writer, offset: int,
+               length: int) -> bool:
+        """Serve [offset, offset+length) from part.1 or a covering cached
+        range. Returns False when nothing covers the request."""
+        size = meta.get("size", 0)
+        if length < 0:
+            length = size - offset
+        end = offset + length - 1
+        data_path = os.path.join(edir, CACHE_DATA)
+        try:
+            if os.path.exists(data_path):
+                with open(data_path, "rb") as f:
+                    f.seek(offset)
+                    writer.write(f.read(max(0, length)))
+                return True
+            for rng, fname in (meta.get("ranges") or {}).items():
+                s, _, e = rng.partition("-")
+                rs, re_ = int(s), int(e)
+                if rs <= offset and end <= re_:
+                    with open(os.path.join(edir, fname), "rb") as f:
+                        f.seek(offset - rs)
+                        writer.write(f.read(max(0, length)))
+                    return True
+        except (OSError, ValueError):
+            return False
+        return False
+
+    def _bump(self, edir: str, meta: dict) -> None:
+        # throttle: rewriting cache.json on EVERY hit doubles hit-path
+        # IO; increments accumulate in memory and flush every few hits
+        # (or when recency is stale), so none are lost to the throttle
+        with self._lock:
+            pending = self._pending_hits.get(edir, 0) + 1
+            stale = time.time() - meta.get("atime", 0) >= 60
+            if pending < 8 and not stale:
+                self._pending_hits[edir] = pending
+                return
+            self._pending_hits.pop(edir, None)
+        meta["hits"] = meta.get("hits", 0) + pending
+        meta["atime"] = time.time()
+        self._save_meta(edir, meta)
 
     # -- hot paths ------------------------------------------------------------
 
@@ -152,32 +291,82 @@ class CacheObjects:
             # versioned reads bypass the cache (it stores latest only)
             return self.inner.get_object(bucket, object, writer, offset,
                                          length, opts)
-        oi = self.inner.get_object_info(bucket, object, opts)
-        dpath, mpath = self._paths(bucket, object)
-        meta = self._load_meta(mpath)
-        if meta is not None and meta.get("etag") == oi.etag:
-            try:
-                with open(dpath, "rb") as f:
-                    f.seek(offset)
-                    n = meta["size"] - offset if length < 0 else length
-                    writer.write(f.read(max(0, n)))
+        di, edir = self._entry_dir(bucket, object)
+        meta = self._load_meta(edir)
+        try:
+            oi = self.inner.get_object_info(bucket, object, opts)
+        except (dt.ObjectNotFound, dt.BucketNotFound, dt.VersionNotFound):
+            self._drop(bucket, object)
+            raise
+        except Exception:  # noqa: BLE001 — backend down: serve cached
+            if meta is not None and self._serve(edir, meta, writer,
+                                                offset, length):
                 self.hits += 1
-                self._touch(mpath, meta)
-                return oi
-            except OSError:
-                pass
+                return self._oi_from_meta(bucket, object, meta)
+            raise
+        if meta is not None and meta.get("etag") == oi.etag and \
+                self._serve(edir, meta, writer, offset, length):
+            self.hits += 1
+            self._bump(edir, meta)
+            return oi
         self.misses += 1
-        # whole-object reads populate the cache (callers pass either -1 or
-        # the exact stored size for "everything")
+        # "after" gate: count reads in a meta-only entry until the
+        # object earns a cached copy (config cache.after). A new object
+        # version (etag change) starts counting over.
+        if self.after > 0:
+            same = meta is not None and meta.get("etag") == oi.etag
+            seen = (meta.get("hits", 0) + 1) if same else 1
+            if seen < self.after:
+                m = meta if same else self._new_meta(bucket, object, oi)
+                if not same and meta is not None:
+                    self._clear_stale_data(edir)
+                m["hits"] = seen
+                if not self._excluded(bucket, object):
+                    self._save_meta(edir, m)
+                return self.inner.get_object(bucket, object, writer,
+                                             offset, length, opts)
         if offset == 0 and (length < 0 or length >= oi.size):
             buf = io.BytesIO()
             out = self.inner.get_object(bucket, object, buf, 0, -1, opts)
             data = buf.getvalue()
             writer.write(data)
-            self._store(bucket, object, data, oi)
+            self._store_full(bucket, object, data, oi)
             return out
-        return self.inner.get_object(bucket, object, writer, offset,
-                                     length, opts)
+        # ranged miss: buffer + cache only when the range is cacheable;
+        # oversized or excluded ranges stream straight through (one huge
+        # Range request must not balloon into a full in-RAM copy)
+        want = length if length >= 0 else max(0, oi.size - offset)
+        if want > MAX_RANGE_BYTES or self._excluded(bucket, object):
+            return self.inner.get_object(bucket, object, writer, offset,
+                                         length, opts)
+        buf = io.BytesIO()
+        out = self.inner.get_object(bucket, object, buf, offset, length,
+                                    opts)
+        data = buf.getvalue()
+        writer.write(data)
+        self._store_range(bucket, object, offset, data, oi)
+        return out
+
+    def _oi_from_meta(self, bucket: str, object: str, meta: dict):
+        return dt.ObjectInfo(
+            bucket=bucket, name=object, size=meta.get("size", 0),
+            etag=meta.get("etag", ""),
+            content_type=meta.get("content_type", ""),
+            user_defined=dict(meta.get("user_defined", {})))
+
+    def get_object_info(self, bucket, object, opts=None):
+        opts = opts or dt.ObjectOptions()
+        try:
+            return self.inner.get_object_info(bucket, object, opts)
+        except (dt.ObjectNotFound, dt.BucketNotFound, dt.VersionNotFound):
+            raise
+        except Exception:  # noqa: BLE001 — backend down: cached HEAD
+            if not opts.version_id:
+                _, edir = self._entry_dir(bucket, object)
+                meta = self._load_meta(edir)
+                if meta is not None:
+                    return self._oi_from_meta(bucket, object, meta)
+            raise
 
     def put_object(self, bucket, object, stream, size, opts=None):
         oi = self.inner.put_object(bucket, object, stream, size, opts)
@@ -203,7 +392,8 @@ class CacheObjects:
 
     def stats(self) -> dict:
         return {"hits": self.hits, "misses": self.misses,
-                "usage": self.usage(), "quota": self.quota}
+                "usage": self.usage(), "quota": self.quota * len(self.dirs),
+                "dirs": len(self.dirs)}
 
     # -- delegation -----------------------------------------------------------
 

@@ -63,25 +63,25 @@ class MetricsGroup:
         self.scope = scope              # "cluster" | "node"
         self.gen = gen                  # (server) -> list[str]
         self.interval = CACHE_INTERVAL_S if interval is None else interval
-        #: cache keyed per server instance — several servers in one
-        #: process (tests, embedded use) must not serve each other's
+        #: cache keyed per live server instance (weak keys: an id()-based
+        #: map could hand a recycled address another server's numbers) —
+        #: several servers in one process must not serve each other's
         #: disk counts
-        self._cached: dict[int, tuple[float, list[str]]] = {}
+        import weakref
+        self._cached: "weakref.WeakKeyDictionary" = \
+            weakref.WeakKeyDictionary()
         self._lock = threading.Lock()
 
     def lines(self, server) -> list[str]:
-        key = id(server)
         with self._lock:
             now = time.monotonic()
-            hit = self._cached.get(key)
+            hit = self._cached.get(server)
             if hit is None or now - hit[0] >= self.interval:
                 try:
                     out = self.gen(server)
                 except Exception:  # noqa: BLE001 — one group must never
                     out = []  # take down the whole exposition
-                if len(self._cached) > 64:
-                    self._cached.clear()
-                self._cached[key] = (now, out)
+                self._cached[server] = (now, out)
                 return out
             return hit[1]
 

@@ -1,0 +1,206 @@
+"""Disk cache on-disk format (reference cmd/disk-cache-backend.go):
+cache.json + part.1 + range files per object hash dir, multi-drive
+distribution, watermark GC, the `after` hit gate, exclude patterns, and
+backend-offline serving."""
+import io
+import json
+import os
+import sys
+import time
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(__file__))
+
+from minio_tpu.cache import CACHE_DATA, CACHE_META, CacheObjects  # noqa: E402
+from minio_tpu.objectlayer import ErasureObjects  # noqa: E402
+from minio_tpu.objectlayer import datatypes as dt  # noqa: E402
+from minio_tpu.storage import XLStorage  # noqa: E402
+
+
+def _mk(tmp):
+    return ErasureObjects([XLStorage(os.path.join(tmp, f"d{i}"))
+                           for i in range(4)], default_parity=1)
+
+
+def _body(seed, n=256 << 10):
+    return np.random.default_rng(seed).integers(
+        0, 256, n, dtype=np.uint8).tobytes()
+
+
+def test_on_disk_layout(tmp_path):
+    co = CacheObjects(_mk(str(tmp_path / "b")), str(tmp_path / "c"))
+    co.make_bucket("cb")
+    body = _body(1)
+    co.put_object("cb", "obj", io.BytesIO(body), len(body))
+    co.get_object("cb", "obj", io.BytesIO())  # populate
+    _, edir = co._entry_dir("cb", "obj")
+    assert os.path.isfile(os.path.join(edir, CACHE_META))
+    assert os.path.isfile(os.path.join(edir, CACHE_DATA))
+    with open(os.path.join(edir, CACHE_META)) as f:
+        meta = json.load(f)
+    assert meta["bucket"] == "cb" and meta["object"] == "obj"
+    assert meta["size"] == len(body) and meta["etag"]
+    # hit serves from cache (mutate backend file -> still cached answer)
+    sink = io.BytesIO()
+    co.get_object("cb", "obj", sink)
+    assert sink.getvalue() == body
+    assert co.hits == 1
+
+
+def test_range_caching(tmp_path):
+    co = CacheObjects(_mk(str(tmp_path / "b")), str(tmp_path / "c"))
+    co.make_bucket("cb")
+    body = _body(2)
+    co.put_object("cb", "obj", io.BytesIO(body), len(body))
+    sink = io.BytesIO()
+    co.get_object("cb", "obj", sink, offset=1000, length=5000)
+    assert sink.getvalue() == body[1000:6000]
+    _, edir = co._entry_dir("cb", "obj")
+    meta = json.load(open(os.path.join(edir, CACHE_META)))
+    assert "1000-5999" in meta["ranges"]
+    assert not os.path.exists(os.path.join(edir, CACHE_DATA))
+    # a sub-range of the cached range is a HIT
+    sink = io.BytesIO()
+    co.get_object("cb", "obj", sink, offset=2000, length=100)
+    assert sink.getvalue() == body[2000:2100]
+    assert co.hits == 1
+    # a full read replaces ranges with part.1
+    sink = io.BytesIO()
+    co.get_object("cb", "obj", sink)
+    assert sink.getvalue() == body
+    assert os.path.exists(os.path.join(edir, CACHE_DATA))
+    assert not [f for f in os.listdir(edir) if f.startswith("range-")]
+
+
+def test_multi_dir_distribution(tmp_path):
+    dirs = [str(tmp_path / f"c{i}") for i in range(3)]
+    co = CacheObjects(_mk(str(tmp_path / "b")), dirs,
+                      quota_bytes=64 << 20)
+    co.make_bucket("cb")
+    for i in range(24):
+        b = _body(i, 4 << 10)
+        co.put_object("cb", f"o{i}", io.BytesIO(b), len(b))
+        co.get_object("cb", f"o{i}", io.BytesIO())
+    per_dir = [len(os.listdir(d)) for d in dirs]
+    assert sum(per_dir) == 24
+    assert all(n > 0 for n in per_dir)  # all drives carry entries
+
+
+def test_after_gate(tmp_path):
+    co = CacheObjects(_mk(str(tmp_path / "b")), str(tmp_path / "c"),
+                      after=3)
+    co.make_bucket("cb")
+    body = _body(3)
+    co.put_object("cb", "obj", io.BytesIO(body), len(body))
+    _, edir = co._entry_dir("cb", "obj")
+    for _ in range(2):  # first two reads: meta-only entry, no data
+        co.get_object("cb", "obj", io.BytesIO())
+        assert not os.path.exists(os.path.join(edir, CACHE_DATA))
+    co.get_object("cb", "obj", io.BytesIO())  # third read populates
+    assert os.path.exists(os.path.join(edir, CACHE_DATA))
+
+
+def test_exclude_patterns(tmp_path):
+    co = CacheObjects(_mk(str(tmp_path / "b")), str(tmp_path / "c"),
+                      exclude=["cb/tmp*", "scratch"])
+    co.make_bucket("cb")
+    co.make_bucket("scratch")
+    for bkt, key in (("cb", "tmp-1"), ("scratch", "x")):
+        b = _body(4)
+        co.put_object(bkt, key, io.BytesIO(b), len(b))
+        co.get_object(bkt, key, io.BytesIO())
+        _, edir = co._entry_dir(bkt, key)
+        assert not os.path.exists(os.path.join(edir, CACHE_DATA)), (bkt,
+                                                                    key)
+    b = _body(5)
+    co.put_object("cb", "keep", io.BytesIO(b), len(b))
+    co.get_object("cb", "keep", io.BytesIO())
+    _, edir = co._entry_dir("cb", "keep")
+    assert os.path.exists(os.path.join(edir, CACHE_DATA))
+
+
+def test_watermark_gc_prefers_cold_entries(tmp_path):
+    co = CacheObjects(_mk(str(tmp_path / "b")), str(tmp_path / "c"),
+                      quota_bytes=400 << 10, watermark_low=50,
+                      watermark_high=75)
+    co.make_bucket("cb")
+    bodies = {}
+    for i in range(4):
+        bodies[i] = _body(10 + i, 64 << 10)
+        co.put_object("cb", f"o{i}", io.BytesIO(bodies[i]),
+                      len(bodies[i]))
+        co.get_object("cb", f"o{i}", io.BytesIO())
+        time.sleep(0.02)
+    # keep o0 hot: many hits outweigh its age in the eviction score
+    for _ in range(20):
+        co.get_object("cb", "o0", io.BytesIO())
+    for i in range(4, 8):
+        bodies[i] = _body(10 + i, 64 << 10)
+        co.put_object("cb", f"o{i}", io.BytesIO(bodies[i]),
+                      len(bodies[i]))
+        co.get_object("cb", f"o{i}", io.BytesIO())
+    assert co.usage() <= 400 << 10
+    _, e0 = co._entry_dir("cb", "o0")
+    assert os.path.exists(os.path.join(e0, CACHE_DATA))  # hot survived
+
+
+def test_backend_offline_serving(tmp_path):
+    co = CacheObjects(_mk(str(tmp_path / "b")), str(tmp_path / "c"))
+    co.make_bucket("cb")
+    body = _body(6)
+    co.put_object("cb", "obj", io.BytesIO(body), len(body))
+    co.get_object("cb", "obj", io.BytesIO())  # populate
+
+    class _Down:
+        def __getattr__(self, name):
+            def boom(*a, **kw):
+                raise ConnectionError("backend down")
+            return boom
+
+    co.inner = _Down()
+    sink = io.BytesIO()
+    oi = co.get_object("cb", "obj", sink)
+    assert sink.getvalue() == body
+    assert oi.etag
+    assert co.get_object_info("cb", "obj").size == len(body)
+    # objects never cached still fail
+    with pytest.raises(ConnectionError):
+        co.get_object("cb", "nope", io.BytesIO())
+
+
+def test_not_found_drops_entry(tmp_path):
+    inner = _mk(str(tmp_path / "b"))
+    co = CacheObjects(inner, str(tmp_path / "c"))
+    co.make_bucket("cb")
+    body = _body(7)
+    co.put_object("cb", "obj", io.BytesIO(body), len(body))
+    co.get_object("cb", "obj", io.BytesIO())
+    inner.delete_object("cb", "obj")
+    with pytest.raises(dt.ObjectNotFound):
+        co.get_object("cb", "obj", io.BytesIO())
+    _, edir = co._entry_dir("cb", "obj")
+    assert not os.path.exists(edir)
+
+
+def test_etag_change_never_serves_stale_data(tmp_path):
+    """Out-of-band backend overwrite (another gateway node sharing the
+    backend): a ranged miss on the new etag must invalidate the old
+    part.1, or a later full read would serve old bytes as the new etag."""
+    shared = str(tmp_path / "b")
+    inner = _mk(shared)
+    co = CacheObjects(inner, str(tmp_path / "c"))
+    co.make_bucket("cb")
+    v1 = _body(20)
+    co.put_object("cb", "obj", io.BytesIO(v1), len(v1))
+    co.get_object("cb", "obj", io.BytesIO())  # cache v1 fully
+    # overwrite BEHIND the cache (co._drop never runs)
+    v2 = _body(21)
+    inner.put_object("cb", "obj", io.BytesIO(v2), len(v2))
+    sink = io.BytesIO()
+    co.get_object("cb", "obj", sink, offset=0, length=1000)  # ranged miss
+    assert sink.getvalue() == v2[:1000]
+    sink = io.BytesIO()
+    co.get_object("cb", "obj", sink)  # full read: must be v2, not v1
+    assert sink.getvalue() == v2

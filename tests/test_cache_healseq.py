@@ -57,7 +57,7 @@ def test_cache_hit_miss_and_invalidation(tmp_path):
 def test_cache_eviction_lru(tmp_path):
     inner = _mk(str(tmp_path / "b2"))
     co = CacheObjects(inner, str(tmp_path / "c2"), quota_bytes=300 << 10,
-                      watermark_low=0.5)
+                      watermark_low=50)
     co.make_bucket("cb")
     bodies = {}
     for i in range(6):
