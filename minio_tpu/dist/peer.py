@@ -66,6 +66,39 @@ class PeerRESTClient:
         import json as _json
         return _json.loads(self.rpc.call("tracerecent", {"n": str(n)}))
 
+    # --- observability / OBD fan-out (reference peer-rest-common.go:
+    # CPULoadInfo, MemUsageInfo, DriveOBDInfo, Log, GetBandwidth,
+    # GetLocks, StartProfiling, DownloadProfilingData,
+    # BackgroundHealStatus) --------------------------------------------------
+
+    def proc_info(self) -> dict:
+        """Peer cpu/mem/drive OBD report."""
+        return json.loads(self.rpc.call("procinfo"))
+
+    def metrics(self) -> dict:
+        """Peer's raw counter store for cluster-level aggregation."""
+        return json.loads(self.rpc.call("metrics"))
+
+    def get_locks(self) -> list:
+        return json.loads(self.rpc.call("getlocks"))
+
+    def get_bandwidth(self) -> dict:
+        return json.loads(self.rpc.call("getbandwidth"))
+
+    def console_log(self, n: int = 100) -> list:
+        """Peer's recent structured log entries (reference
+        peerRESTMethodLog console streaming, one-shot)."""
+        return json.loads(self.rpc.call("consolelog", {"n": str(n)}))
+
+    def start_profiling(self, kind: str = "cpu") -> None:
+        self.rpc.call("startprofiling", {"profilerType": kind})
+
+    def download_profiling(self) -> bytes:
+        return self.rpc.call("downloadprofiling")
+
+    def background_heal_status(self) -> dict:
+        return json.loads(self.rpc.call("backgroundhealstatus"))
+
 
 class PeerRESTService:
     def __init__(self, node):
@@ -113,5 +146,47 @@ class PeerRESTService:
             n = int(params.get("n", "256"))
             return json.dumps(
                 [t.to_dict() for t in recent(n)]).encode()
+        if method == "procinfo":
+            from ..obs.profiling import health_info
+            srv = getattr(self.node, "server", None)
+            if srv is None:
+                return b"{}"
+            return json.dumps(health_info(srv)).encode()
+        if method == "metrics":
+            from ..obs import metrics as mx
+            with mx._lock:
+                return json.dumps(dict(mx._counters)).encode()
+        if method == "getlocks":
+            srv = getattr(self.node, "server", None)
+            locker = getattr(srv, "local_locker", None)
+            return json.dumps(
+                locker.dump() if locker is not None else []).encode()
+        if method == "getbandwidth":
+            from ..bucket.bandwidth import global_monitor
+            return json.dumps(global_monitor().report()).encode()
+        if method == "consolelog":
+            from ..obs.logger import log_sys
+            n = int(params.get("n", "100"))
+            return json.dumps(list(log_sys().ring)[-n:]).encode()
+        if method == "startprofiling":
+            from ..obs import profiling
+            try:
+                profiling.start(params.get("profilerType", "cpu"))
+            except ValueError:
+                pass  # idempotent across fan-out retries
+            return b""
+        if method == "downloadprofiling":
+            from ..obs import profiling
+            try:
+                _, data = profiling.stop_and_dump()
+            except ValueError:
+                data = b""
+            return data
+        if method == "backgroundhealstatus":
+            from ..scanner import background_heal_stats
+            srv = getattr(self.node, "server", None)
+            return json.dumps(
+                background_heal_stats(srv) if srv is not None else {}
+            ).encode()
         from ..utils import errors
         raise errors.MethodNotSupported(method)

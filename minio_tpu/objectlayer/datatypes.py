@@ -198,6 +198,11 @@ class InvalidRequest(ObjectAPIError):
     http_status = 400
 
 
+class AccessDenied(ObjectAPIError):
+    code = "AccessDenied"
+    http_status = 403
+
+
 class ObjectLocked(ObjectAPIError):
     """WORM: retention or legal hold forbids the operation
     (cmd/bucket-object-lock.go)."""

@@ -1,2 +1,14 @@
 """Background services (reference §2.4): data scanner + usage accounting,
 auto-heal, MRF. Expanded by the heal/lifecycle managers."""
+
+
+def background_heal_stats(server) -> dict:
+    """Stats of the heal services attached to a server (autoheal/mrf) —
+    shared by the admin bg-heal-status op and the peer RPC handler."""
+    out = {}
+    for name in ("autoheal", "mrf"):
+        svc = getattr(server, name, None)
+        stats = getattr(svc, "stats", None)
+        if callable(stats):
+            out[name] = stats()
+    return out

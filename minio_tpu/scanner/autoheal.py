@@ -112,6 +112,10 @@ class AutoHealMonitor:
         self._thread.start()
         return self
 
+    def stats(self) -> dict:
+        return {"heal_passes": self.heal_passes,
+                "disks_watched": len(self.local_disks)}
+
     def _loop(self):
         while not self._stop.wait(self.interval):
             try:

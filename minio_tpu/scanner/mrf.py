@@ -37,6 +37,10 @@ class MRFHealer:
         self._thread.start()
         return self
 
+    def stats(self) -> dict:
+        return {"healed": self.healed, "failed": self.failed,
+                "queued": self.q.qsize()}
+
     def _loop(self):
         while not self._stop.is_set():
             try:

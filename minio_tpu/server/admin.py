@@ -152,9 +152,35 @@ def _dispatch_admin(h, op: str) -> None:
         from ..bucket.bandwidth import global_monitor
         q = {k: v[0] for k, v in h.query.items()}
         buckets = [b for b in q.get("buckets", "").split(",") if b]
-        return h._send(200, json.dumps(
-            global_monitor().report(buckets or None)).encode(),
-            "application/json")
+        rep = global_monitor().report(buckets or None)
+        if q.get("peers") == "1":
+            # cluster-wide: merge every peer's report (reference
+            # peerRESTMethodGetBandwidth fan-out)
+            for peer in getattr(h.s3, "peers", lambda: [])():
+                try:
+                    theirs = peer.get_bandwidth().get("bucketStats", {})
+                except Exception:  # noqa: BLE001 — peer down: skip
+                    continue
+                for b, st in theirs.items():
+                    if buckets and b not in buckets:
+                        continue
+                    mine = rep["bucketStats"].setdefault(
+                        b, {"limitInBits": st.get("limitInBits", 0),
+                            "currentBandwidth": 0.0})
+                    mine["currentBandwidth"] = round(
+                        mine["currentBandwidth"] +
+                        st.get("currentBandwidth", 0.0), 2)
+        return h._send(200, json.dumps(rep).encode(), "application/json")
+    if op == "bg-heal-status":
+        from ..scanner import background_heal_stats
+        out = background_heal_stats(h.s3)
+        for peer in getattr(h.s3, "peers", lambda: [])():
+            try:
+                out.setdefault("peers", []).append(
+                    peer.background_heal_status())
+            except Exception:  # noqa: BLE001
+                continue
+        return h._send(200, json.dumps(out).encode(), "application/json")
     if op == "kms/key/status":
         return _kms_key_status(h)
     if op == "kms/key/create":
@@ -345,12 +371,20 @@ def _trace(h) -> None:
 
 
 def _top_locks(h) -> None:
-    """`mc admin top locks` analogue: the node's lock table
-    (cmd/admin-handlers.go TopLocksHandler)."""
+    """`mc admin top locks` analogue: the node's lock table, optionally
+    merged across peers (cmd/admin-handlers.go TopLocksHandler fans out
+    peerRESTMethodGetLocks the same way)."""
+    q = {k: v[0] for k, v in h.query.items()}
     locker = getattr(h.s3, "local_locker", None)
     entries = []
     if locker is not None:
         entries = locker.dump()
+    if q.get("peers") == "1":
+        for peer in getattr(h.s3, "peers", lambda: [])():
+            try:
+                entries.extend(peer.get_locks())
+            except Exception:  # noqa: BLE001 — peer down: skip
+                continue
     h._send(200, json.dumps({"locks": entries}).encode(),
             "application/json")
 

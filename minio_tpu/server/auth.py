@@ -412,3 +412,34 @@ class ChunkedSigV4Reader:
             out = bytes(self._buf[:n])
             del self._buf[:n]
         return out
+
+
+def presign_v4(method: str, scheme: str, host: str, path: str,
+               access_key: str, secret: str, region: str,
+               expires_s: int) -> str:
+    """Generate a presigned URL (client side of _verify_presigned —
+    reference pkg/s3signer PresignV4). ``path`` is the RAW (unquoted)
+    object path — parsing a joined URL string would misread keys
+    containing '?' or '#'."""
+    import urllib.parse
+    path = path or "/"
+    now = datetime.datetime.now(datetime.timezone.utc)
+    timestamp = now.strftime("%Y%m%dT%H%M%SZ")
+    scope = f"{timestamp[:8]}/{region}/s3/aws4_request"
+    query = {
+        "X-Amz-Algorithm": [SIGN_V4_ALGO],
+        "X-Amz-Credential": [f"{access_key}/{scope}"],
+        "X-Amz-Date": [timestamp],
+        "X-Amz-Expires": [str(expires_s)],
+        "X-Amz-SignedHeaders": ["host"],
+    }
+    headers = {"host": host}
+    creq = canonical_request(method, path, query, headers, ["host"],
+                             UNSIGNED_PAYLOAD)
+    sts = string_to_sign(timestamp, scope, creq)
+    key = signing_key(secret, timestamp[:8], region)
+    sig = hmac.new(key, sts.encode(), hashlib.sha256).hexdigest()
+    qs = urllib.parse.urlencode(
+        [(k, v[0]) for k, v in query.items()] +
+        [("X-Amz-Signature", sig)])
+    return f"{scheme}://{host}{urllib.parse.quote(path)}?{qs}"

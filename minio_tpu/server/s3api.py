@@ -429,6 +429,21 @@ class _S3Handler(BaseHTTPRequestHandler):
         if self.url_path.startswith("/minio/admin/"):
             from .admin import handle_admin
             return handle_admin(self)
+        # web console plane (reference cmd/web-router.go: /minio/webrpc
+        # JSON-RPC + JWT-authenticated upload/download routes)
+        if self.url_path == "/minio/webrpc":
+            from .webrpc import handle_webrpc
+            return handle_webrpc(self)
+        if self.url_path.startswith("/minio/upload/"):
+            from .webrpc import handle_upload
+            rest = self.url_path[len("/minio/upload/"):]
+            bucket, _, obj = rest.partition("/")
+            return handle_upload(self, bucket, obj)
+        if self.url_path.startswith("/minio/download/"):
+            from .webrpc import handle_download
+            rest = self.url_path[len("/minio/download/"):]
+            bucket, _, obj = rest.partition("/")
+            return handle_download(self, bucket, obj)
         # STS endpoint: POST / with form-encoded Action (cmd/sts-handlers.go)
         # — AssumeRoleWithWebIdentity carries no Authorization header (the
         # JWT is the credential), so the gate is the Action itself

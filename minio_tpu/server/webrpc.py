@@ -1,0 +1,301 @@
+"""Web console plane: JSON-RPC 2.0 endpoint + upload/download routes
+(reference cmd/web-handlers.go, 2,445 LoC, and cmd/web-router.go: the
+browser UI's backend — Login issues a JWT, the webrpc methods mirror a
+subset of the S3 surface for the console, and /minio/upload|download
+move object data with the JWT as credential).
+
+Methods (reference web.* names): Login, ServerInfo, StorageInfo,
+MakeBucket, DeleteBucket, ListBuckets, ListObjects, RemoveObject,
+SetAuth, CreateURLToken, PresignedGet. The JWT is HMAC-SHA256 over
+header.payload (the reference signs HS512 with the credential secret;
+same construction, one algorithm)."""
+from __future__ import annotations
+
+import base64
+import hashlib
+import hmac
+import json
+import time
+
+from ..objectlayer import datatypes as dt
+
+TOKEN_TTL_S = 24 * 3600
+URL_TOKEN_TTL_S = 60
+
+
+def _b64url(b: bytes) -> str:
+    return base64.urlsafe_b64encode(b).rstrip(b"=").decode()
+
+
+def _b64url_dec(s: str) -> bytes:
+    return base64.urlsafe_b64decode(s + "=" * (-len(s) % 4))
+
+
+def make_jwt(access_key: str, secret: str, ttl_s: int = TOKEN_TTL_S) -> str:
+    header = _b64url(json.dumps({"alg": "HS256", "typ": "JWT"}).encode())
+    claims = _b64url(json.dumps({
+        "sub": access_key, "iss": "web",
+        "exp": int(time.time()) + ttl_s}).encode())
+    msg = f"{header}.{claims}".encode()
+    sig = _b64url(hmac.new(secret.encode(), msg, hashlib.sha256).digest())
+    return f"{header}.{claims}.{sig}"
+
+
+def check_jwt(token: str, lookup_secret) -> str:
+    """Validate signature + expiry; returns the access key or ''."""
+    try:
+        header, claims, sig = token.split(".")
+        payload = json.loads(_b64url_dec(claims))
+        ak = payload.get("sub", "")
+        secret = lookup_secret(ak)
+        if not secret:
+            return ""
+        msg = f"{header}.{claims}".encode()
+        want = _b64url(hmac.new(secret.encode(), msg,
+                                hashlib.sha256).digest())
+        if not hmac.compare_digest(want, sig):
+            return ""
+        if payload.get("exp", 0) < time.time():
+            return ""
+        return ak
+    except (ValueError, AttributeError):
+        return ""
+
+
+def _auth(h, params: dict) -> str:
+    """JWT from the Authorization header or rpc params; returns access
+    key or '' (reference isAuthTokenValid)."""
+    token = ""
+    auth = h.hdr.get("authorization", "")
+    if auth.startswith("Bearer "):
+        token = auth[7:]
+    token = params.get("token", token)
+    return check_jwt(token, h.s3.lookup_secret)
+
+
+def _check(h, ak: str, action: str, bucket: str = "", obj: str = ""):
+    """Run the same policy gate the S3 path uses: a scoped IAM user's
+    JWT must not grant more through the console than through S3
+    (reference web-handlers.go checks each action the same way)."""
+    gate = getattr(h.s3, "authorize", None)
+    if gate is None:
+        return  # single-credential server: any valid JWT is root
+    if not gate(ak, action, bucket, obj):
+        raise dt.AccessDenied(bucket, obj, extra=f"not allowed {action}")
+
+
+def handle_webrpc(h) -> None:
+    """POST /minio/webrpc — JSON-RPC 2.0 (one call per request, like the
+    reference's gorilla/rpc v2 JSON codec)."""
+    if h.command != "POST":
+        return h._error("MethodNotAllowed", "webrpc is POST-only", 405)
+    try:
+        req = json.loads(h._read_body() or b"{}")
+        if not isinstance(req, dict):
+            raise ValueError("request must be a JSON object")
+        method = req.get("method", "")
+        params = req.get("params") or {}
+        if isinstance(params, list):
+            params = params[0] if params else {}
+        if not isinstance(params, dict):
+            raise ValueError("params must be an object")
+        rpc_id = req.get("id", 1)
+    except ValueError as e:
+        return _reply(h, 1, error=f"parse error: {e}")
+    name = method.split(".", 1)[-1].lower()
+    fn = _METHODS.get(name)
+    if fn is None:
+        return _reply(h, rpc_id, error=f"unknown method {method}")
+    ak = ""
+    if name != "login":
+        ak = _auth(h, params)
+        if not ak:
+            return _reply(h, rpc_id, error="authentication failed",
+                          code=401)
+    try:
+        return _reply(h, rpc_id, result=fn(h, params, ak))
+    except dt.ObjectAPIError as e:
+        return _reply(h, rpc_id, error=str(e))
+    except Exception as e:  # noqa: BLE001
+        return _reply(h, rpc_id, error=f"internal error: {e}")
+
+
+def _reply(h, rpc_id, result=None, error=None, code: int = 200):
+    body: dict = {"jsonrpc": "2.0", "id": rpc_id}
+    if error is not None:
+        body["error"] = {"message": error}
+    else:
+        body["result"] = result
+    h._send(code, json.dumps(body).encode(), "application/json")
+
+
+# -- methods ------------------------------------------------------------------
+
+
+def _m_login(h, p: dict, ak: str):
+    user = p.get("username", "")
+    sk = h.s3.lookup_secret(user)
+    if not sk or not hmac.compare_digest(sk, p.get("password", "")):
+        raise dt.AccessDenied(extra="invalid credentials")
+    return {"token": make_jwt(user, sk), "uiVersion": "minio-tpu"}
+
+
+def _m_server_info(h, p: dict, ak: str):
+    import platform
+    return {"MinioVersion": "minio-tpu/0.1",
+            "MinioPlatform": platform.platform(),
+            "MinioRuntime": platform.python_version(),
+            "MinioRegion": h.s3.region}
+
+
+def _m_storage_info(h, p: dict, ak: str):
+    return h.s3.obj.storage_info()
+
+
+def _m_make_bucket(h, p: dict, ak: str):
+    bucket = p.get("bucketName", "")
+    _check(h, ak, "s3:CreateBucket", bucket)
+    h.s3.obj.make_bucket(bucket)
+    return True
+
+
+def _m_delete_bucket(h, p: dict, ak: str):
+    bucket = p.get("bucketName", "")
+    _check(h, ak, "s3:DeleteBucket", bucket)
+    h.s3.obj.delete_bucket(bucket)
+    return True
+
+
+def _m_list_buckets(h, p: dict, ak: str):
+    _check(h, ak, "s3:ListAllMyBuckets")
+    return {"buckets": [{"name": b.name, "creationDate": b.created}
+                        for b in h.s3.obj.list_buckets()]}
+
+
+def _m_list_objects(h, p: dict, ak: str):
+    bucket = p.get("bucketName", "")
+    prefix = p.get("prefix", "")
+    _check(h, ak, "s3:ListBucket", bucket)
+    res = h.s3.obj.list_objects(bucket, prefix=prefix, delimiter="/",
+                                max_keys=1000,
+                                marker=p.get("marker", ""))
+    return {"objects": [
+        {"name": oi.name, "size": oi.size, "lastModified": oi.mod_time,
+         "contentType": oi.content_type, "etag": oi.etag}
+        for oi in res.objects],
+        "prefixes": list(res.prefixes),
+        "istruncated": res.is_truncated,
+        "nextmarker": res.next_marker}
+
+
+def _m_remove_object(h, p: dict, ak: str):
+    bucket = p.get("bucketName", "")
+    for obj in p.get("objects", []) or [p.get("objectName", "")]:
+        if obj:
+            _check(h, ak, "s3:DeleteObject", bucket, obj)
+            h.s3.obj.delete_object(bucket, obj)
+    return True
+
+
+def _m_set_auth(h, p: dict, ak: str):
+    # the reference rotates root credentials; here credentials live in
+    # IAM/env, so guide the operator there instead of silently no-oping
+    raise dt.NotImplemented(
+        extra="use the admin IAM API to manage credentials")
+
+
+def _m_create_url_token(h, p: dict, ak: str):
+    """Short-lived token for download links (reference CreateURLToken)."""
+    return {"token": make_jwt(ak, h.s3.lookup_secret(ak),
+                              ttl_s=URL_TOKEN_TTL_S)}
+
+
+def _m_presigned_get(h, p: dict, ak: str):
+    """Presigned GET URL for the console's share dialog."""
+    from .auth import presign_v4
+    bucket, obj = p.get("bucket", ""), p.get("object", "")
+    _check(h, ak, "s3:GetObject", bucket, obj)
+    expiry = min(int(p.get("expiry", 3600) or 3600), 7 * 24 * 3600)
+    scheme = "https" if getattr(h.s3, "tls", False) else "http"
+    url = presign_v4(
+        "GET", scheme, h.hdr.get("host", ""), f"/{bucket}/{obj}",
+        ak, h.s3.lookup_secret(ak), h.s3.region, expiry)
+    return {"url": url}
+
+
+_METHODS = {
+    "login": _m_login,
+    "serverinfo": _m_server_info,
+    "storageinfo": _m_storage_info,
+    "makebucket": _m_make_bucket,
+    "deletebucket": _m_delete_bucket,
+    "listbuckets": _m_list_buckets,
+    "listobjects": _m_list_objects,
+    "removeobject": _m_remove_object,
+    "setauth": _m_set_auth,
+    "createurltoken": _m_create_url_token,
+    "presignedget": _m_presigned_get,
+}
+
+
+# -- upload / download routes -------------------------------------------------
+
+
+def handle_upload(h, bucket: str, object: str) -> None:
+    """PUT /minio/upload/<bucket>/<object> with Bearer JWT (reference
+    web-handlers.go Upload; the router binds it to PUT only)."""
+    if h.command != "PUT":
+        return h._error("MethodNotAllowed", "upload is PUT-only", 405)
+    ak = _auth(h, {})
+    if not ak:
+        return h._error("AccessDenied", "invalid token", 401)
+    try:
+        _check(h, ak, "s3:PutObject", bucket, object)
+        size = int(h.hdr.get("content-length", "0") or "0")
+        from ..utils.hashreader import HashReader
+        # _body_stream bounds the socket read to Content-Length
+        # (keep-alive sockets never EOF) and handles aws-chunked bodies
+        hr = HashReader(h._body_stream(size), size)
+        oi = h.s3.obj.put_object(
+            bucket, object, hr, size,
+            dt.ObjectOptions(user_defined={
+                "content-type": h.hdr.get("content-type",
+                                          "application/octet-stream")}))
+    except dt.ObjectAPIError as e:
+        return h._api_error(e)
+    h._send(200, json.dumps({"etag": oi.etag}).encode(),
+            "application/json")
+
+
+def _disposition_name(object: str) -> str:
+    """Filename for Content-Disposition: the key's last segment with
+    header-breaking characters stripped (CR/LF would split the response;
+    a double quote would escape the parameter)."""
+    name = object.rsplit("/", 1)[-1]
+    return "".join(c for c in name
+                   if c not in '"\\\r\n' and ord(c) >= 0x20) or "download"
+
+
+def handle_download(h, bucket: str, object: str) -> None:
+    """GET /minio/download/<bucket>/<object>?token=... (reference
+    web-handlers.go Download: the token rides the query string because
+    browser downloads can't set headers)."""
+    if h.command != "GET":
+        return h._error("MethodNotAllowed", "download is GET-only", 405)
+    q = {k: v[0] for k, v in h.query.items()}
+    ak = check_jwt(q.get("token", ""), h.s3.lookup_secret)
+    if not ak:
+        return h._error("AccessDenied", "invalid token", 401)
+    try:
+        _check(h, ak, "s3:GetObject", bucket, object)
+        oi = h.s3.obj.get_object_info(bucket, object)
+    except dt.ObjectAPIError as e:
+        return h._api_error(e)
+    h.send_response(200)
+    h.send_header("Content-Type",
+                  oi.content_type or "application/octet-stream")
+    h.send_header("Content-Length", str(oi.size))
+    h.send_header("Content-Disposition",
+                  f'attachment; filename="{_disposition_name(object)}"')
+    h.end_headers()
+    h.s3.obj.get_object(bucket, object, h.wfile)

@@ -385,3 +385,40 @@ def test_metacache_cluster_reuse(cluster, monkeypatch):
     assert r1.status_code == 200
     assert all(f"k{i:03d}" in r1.text for i in range(25))
     assert walked["n"] == 0, "node B walked despite node A's cache"
+
+
+def test_peer_control_plane_breadth(cluster):
+    """The peer RPC observability fan-out (reference peer-rest-common.go:
+    CPULoadInfo/Log/GetLocks/GetBandwidth/BackgroundHealStatus/metrics):
+    each node can interrogate the other."""
+    n0, n1 = cluster
+    peer = n0.peers[0]  # n0's client for n1
+    info = peer.proc_info()
+    assert info["cpu"]["count"] >= 1
+    assert info["process"]["pid"] > 0
+    m = peer.metrics()
+    assert isinstance(m, dict)
+    assert peer.get_locks() == []
+    bw = peer.get_bandwidth()
+    assert "bucketStats" in bw
+    logs = peer.console_log(10)
+    assert isinstance(logs, list)
+    assert peer.background_heal_status() == {}
+    # profiling fan-out: start on the peer, download a sampler report
+    peer.start_profiling("cpu")
+    time.sleep(0.1)
+    data = peer.download_profiling()
+    assert b"# samples:" in data
+
+
+def test_admin_peer_aggregation(cluster):
+    """Admin bandwidth/top-locks with ?peers=1 merge every node's view."""
+    n0, _ = cluster
+    from minio_tpu.madmin import AdminClient
+    adm = AdminClient(f"http://127.0.0.1:{n0.server.port}", AK, SK)
+    rep = adm._json("GET", "bandwidth", {"peers": "1"})
+    assert "bucketStats" in rep
+    locks = adm._json("GET", "top/locks", {"peers": "1"})
+    assert "locks" in locks
+    heal = adm._json("GET", "bg-heal-status")
+    assert isinstance(heal, dict)
