@@ -1,0 +1,89 @@
+"""Bucket-DNS federation (reference cmd/etcd.go +
+cmd/config/dns/etcd_dns.go + the forwarding middleware
+cmd/routers.go:73 setBucketForwardingHandler): several independent
+clusters share one namespace by registering every bucket in etcd under
+the CoreDNS/SkyDNS key scheme; a request for a bucket another cluster
+owns is proxied there.
+
+Key layout (etcd_dns.go Put): ``/skydns/<domain reversed>/<bucket>/``
+entries, one per cluster endpoint, value ``{"host": ..., "port": ...,
+"ttl": ...}``."""
+from __future__ import annotations
+
+import json
+
+from .etcd import EtcdClient, EtcdError
+
+DEFAULT_DOMAIN = "cluster.local"
+
+
+class BucketDNS:
+    def __init__(self, etcd: EtcdClient, host: str, port: int,
+                 domain: str = DEFAULT_DOMAIN):
+        self.etcd = etcd
+        self.host = host
+        self.port = port
+        self.domain = domain
+        rev = "/".join(reversed(domain.split(".")))
+        self._prefix = f"/skydns/{rev}/"
+
+    def _key(self, bucket: str) -> str:
+        return f"{self._prefix}{bucket}/{self.host}:{self.port}"
+
+    def put(self, bucket: str) -> None:
+        """Register this cluster as the bucket's owner."""
+        self.etcd.put(self._key(bucket), json.dumps(
+            {"host": self.host, "port": self.port, "ttl": 30}))
+
+    def delete(self, bucket: str) -> None:
+        self.etcd.delete(self._key(bucket))
+
+    def lookup(self, bucket: str) -> list[tuple[str, int]]:
+        """Endpoints owning ``bucket`` (empty when unregistered)."""
+        out = []
+        try:
+            entries = self.etcd.get_prefix(f"{self._prefix}{bucket}/")
+        except EtcdError:
+            return []
+        for _, raw in sorted(entries.items()):
+            try:
+                doc = json.loads(raw)
+                out.append((doc["host"], int(doc["port"])))
+            except (ValueError, KeyError):
+                continue
+        return out
+
+    def list_buckets(self) -> dict[str, list[tuple[str, int]]]:
+        """bucket -> owning endpoints for the whole federation."""
+        out: dict[str, list[tuple[str, int]]] = {}
+        try:
+            entries = self.etcd.get_prefix(self._prefix)
+        except EtcdError:
+            return {}
+        for key, raw in sorted(entries.items()):
+            rest = key[len(self._prefix):]
+            bucket = rest.split("/", 1)[0]
+            try:
+                doc = json.loads(raw)
+                out.setdefault(bucket, []).append(
+                    (doc["host"], int(doc["port"])))
+            except (ValueError, KeyError):
+                continue
+        return out
+
+    def is_mine(self, endpoints: list[tuple[str, int]]) -> bool:
+        return (self.host, self.port) in endpoints
+
+
+def federation_from_env(host: str, port: int):
+    """BucketDNS from MINIO_TPU_ETCD_ENDPOINTS (comma-separated) +
+    MINIO_TPU_DOMAIN, or None when federation is not configured
+    (reference config/dns lookup from MINIO_ETCD_ENDPOINTS /
+    MINIO_DOMAIN)."""
+    import os
+    eps = os.environ.get("MINIO_TPU_ETCD_ENDPOINTS", "")
+    if not eps:
+        return None
+    return BucketDNS(
+        EtcdClient(eps.split(",")), host, port,
+        os.environ.get("MINIO_TPU_DOMAIN", DEFAULT_DOMAIN))

@@ -153,9 +153,8 @@ class PeerRESTService:
                 return b"{}"
             return json.dumps(health_info(srv)).encode()
         if method == "metrics":
-            from ..obs import metrics as mx
-            with mx._lock:
-                return json.dumps(dict(mx._counters)).encode()
+            from ..obs.metrics import counters_snapshot
+            return json.dumps(counters_snapshot()).encode()
         if method == "getlocks":
             srv = getattr(self.node, "server", None)
             locker = getattr(srv, "local_locker", None)

@@ -46,6 +46,13 @@ def observe(name: str, seconds: float, **labels):
             _histograms[key] = _histograms[key][-5_000:]
 
 
+def counters_snapshot() -> dict[str, float]:
+    """Point-in-time copy of the counter store (peer RPC aggregation,
+    tests)."""
+    with _lock:
+        return dict(_counters)
+
+
 def _key(name: str, labels: dict) -> str:
     if not labels:
         return name

@@ -75,6 +75,17 @@ def main(argv=None):
     from . import S3Server
     srv = S3Server(obj, host or "0.0.0.0", int(port), args.region,
                    access_key=ak, secret_key=sk)
+    if os.environ.get("MINIO_TPU_ETCD_ENDPOINTS"):
+        # resolve the advertise address only when federation is actually
+        # configured — gethostbyname can fail on minimal containers
+        from ..dist.federation import federation_from_env
+        import socket as _socket
+        adv = host if host not in ("", "0.0.0.0") else \
+            _socket.gethostbyname(_socket.gethostname())
+        fed = federation_from_env(adv, int(port))
+        if fed is not None:
+            srv.enable_federation(fed)
+            banner += f"; federated via etcd (domain {fed.domain})"
     print(f"{banner}; listening on {args.address}", file=sys.stderr)
     try:
         srv.serve_forever()

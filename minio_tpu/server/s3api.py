@@ -53,6 +53,9 @@ class S3Server:
         #: optional event notifier: fn(event_name, bucket, object_info)
         self.notify = None
         self._notifier = None
+        #: federation bucket DNS (dist.federation.BucketDNS) — None when
+        #: the deployment is not federated
+        self.federation = None
         self.verifier = SigV4Verifier(lambda ak: self.lookup_secret(ak),
                                       region)
         self.address = address
@@ -78,6 +81,60 @@ class S3Server:
         self.lookup_secret = self.iam.lookup_secret
         self.authorize = self._iam_authorize
         return self.iam
+
+    def create_bucket(self, bucket: str, object_lock: bool = False):
+        """Bucket creation shared by the S3 and console paths: federation
+        namespace check + metadata record + DNS registration, with a
+        symmetric rollback when registration fails."""
+        dns = self.federation
+        if dns is not None:
+            owners = dns.lookup(bucket)
+            if owners and not dns.is_mine(owners):
+                raise dt.BucketExists(bucket)
+        self.obj.make_bucket(bucket)
+        from ..bucket.metadata import BucketMetadata
+        meta = BucketMetadata(name=bucket)
+        if object_lock:
+            meta.object_lock_enabled = True
+            meta.versioning_enabled = True
+        self.bucket_meta.set(bucket, meta)
+        if dns is not None:
+            try:
+                dns.put(bucket)
+            except Exception as e:  # noqa: BLE001 — unregistered bucket
+                # would be invisible to the federation: undo everything
+                self.obj.delete_bucket(bucket, force=True)
+                self.bucket_meta.remove(bucket)
+                if self._notifier is not None:
+                    self._notifier.invalidate(bucket)
+                raise dt.InvalidRequest(
+                    bucket, "", f"federation DNS: {e}") from None
+
+    def remove_bucket(self, bucket: str, force: bool = False):
+        """Bucket deletion shared by the S3 and console paths."""
+        if force and self.bucket_meta.get(bucket).object_lock_enabled:
+            # force delete would bypass WORM retention (the reference
+            # refuses force-delete on lock buckets the same way)
+            raise dt.InvalidRequest(
+                bucket, "",
+                "force delete not allowed on object-lock buckets")
+        self.obj.delete_bucket(bucket, force=force)
+        self.bucket_meta.remove(bucket)
+        if self._notifier is not None:
+            # a recreated bucket must not inherit the old routing rules
+            self._notifier.invalidate(bucket)
+        if self.federation is not None:
+            try:
+                self.federation.delete(bucket)
+            except Exception:  # noqa: BLE001 — stale DNS entries expire
+                pass           # via TTL; deletion must not fail the op
+
+    def enable_federation(self, dns):
+        """Attach a federation BucketDNS (dist.federation): bucket
+        create/delete register in etcd, foreign-bucket requests proxy to
+        the owning cluster, ListBuckets shows the federated namespace."""
+        self.federation = dns
+        return dns
 
     def enable_replication(self, pool):
         """Attach a ReplicationPool: object events feed it (chained with
@@ -473,6 +530,8 @@ class _S3Handler(BaseHTTPRequestHandler):
             else:
                 return self._error(e.code, e.message, e.status)
         try:
+            if self._maybe_forward_federated(access_key):
+                return
             self._dispatch(access_key)
         except dt.ObjectAPIError as e:
             self._api_error(e)
@@ -486,6 +545,87 @@ class _S3Handler(BaseHTTPRequestHandler):
             import traceback
             traceback.print_exc()
             self._error("InternalError", str(e), 500)
+
+    #: federation forwarding: S3 action to enforce locally before the
+    #: request is re-signed with cluster credentials — without this gate
+    #: a scoped IAM user could escalate to root on the remote cluster
+    _FWD_ACTIONS = {"GET": ("s3:GetObject", "s3:ListBucket"),
+                    "HEAD": ("s3:GetObject", "s3:ListBucket"),
+                    "PUT": ("s3:PutObject", "s3:CreateBucket"),
+                    "POST": ("s3:PutObject", "s3:PutObject"),
+                    "DELETE": ("s3:DeleteObject", "s3:DeleteBucket")}
+
+    def _maybe_forward_federated(self, access_key: str) -> bool:
+        """Federation forwarding (reference setBucketForwardingHandler,
+        cmd/routers.go:73 + cmd/bucket-handlers.go DNS lookups): when the
+        requested bucket is not local but the federation DNS says another
+        cluster owns it, proxy the request there re-signed with this
+        cluster's credentials (federated clusters share root creds).
+        The caller's OWN policy gate runs first. Returns True when the
+        response was served by the remote."""
+        dns = self.s3.federation
+        if dns is None or not self.bucket:
+            return False
+        if self.command == "PUT" and not self.key and \
+                not self.query:
+            return False  # bucket create: handled by put_bucket
+        from ..utils import errors as st_errors
+        try:
+            self.s3.obj.get_bucket_info(self.bucket)
+            return False  # local bucket: serve it here
+        except (dt.BucketNotFound, st_errors.StorageError):
+            pass
+        owners = dns.lookup(self.bucket)
+        if not owners or dns.is_mine(owners):
+            return False  # unknown everywhere -> local NoSuchBucket
+        obj_action, bkt_action = self._FWD_ACTIONS.get(
+            self.command, ("s3:PutObject", "s3:PutObject"))
+        self._authorize(access_key,
+                        obj_action if self.key else bkt_action)
+        host, port = owners[0]
+        import requests as rq
+        size = int(self.hdr.get("content-length", "0") or "0")
+        body = _LenReader(self._body_stream(size), size) if size else b""
+        headers = {"host": f"{host}:{port}"}
+        passthrough = ("content-type", "range", "if-match",
+                       "if-none-match", "if-modified-since",
+                       "if-unmodified-since", "content-md5")
+        for k, v in self.hdr.items():
+            if k in passthrough or k.startswith("x-amz-meta-"):
+                headers[k] = v
+        auth = self.s3.verifier.sign_request(
+            self.s3.access_key, self.s3.secret_key, self.command,
+            self.url_path, self.query, headers, UNSIGNED_PAYLOAD)
+        headers["authorization"] = auth
+        qs = urllib.parse.urlencode(
+            [(k, v) for k, vs in self.query.items() for v in vs])
+        url = f"http://{host}:{port}" \
+              f"{urllib.parse.quote(self.url_path)}" + \
+              (f"?{qs}" if qs else "")
+        try:
+            resp = rq.request(self.command, url, data=body,
+                              headers=headers, timeout=30, stream=True)
+        except Exception as e:  # noqa: BLE001 — owning cluster down
+            self._error("ServiceUnavailable",
+                        f"federated cluster unreachable: {e}", 503)
+            return True
+        self.send_response(resp.status_code)
+        hop = {"connection", "transfer-encoding", "keep-alive"}
+        length = resp.headers.get("Content-Length")
+        for k, v in resp.headers.items():
+            if k.lower() not in hop:
+                self.send_header(k, v)
+        if length is None:
+            body_bytes = resp.content
+            self.send_header("Content-Length", str(len(body_bytes)))
+            self.end_headers()
+            self.wfile.write(body_bytes)
+        else:
+            self.end_headers()
+            for chunk in resp.iter_content(1 << 20):
+                self.wfile.write(chunk)
+        resp.close()
+        return True
 
     def _internal_rpc(self, service: str, method: str):
         """Dispatch an internal RPC call (bearer-token auth, typed errors
@@ -911,19 +1051,24 @@ class _S3Handler(BaseHTTPRequestHandler):
 
     def list_buckets(self, ak):
         self._authorize(ak, "s3:ListAllMyBuckets")
-        self._send(200, xu.list_buckets_xml(self.s3.obj.list_buckets()))
+        buckets = self.s3.obj.list_buckets()
+        if self.s3.federation is not None:
+            # the federated namespace is the union of every cluster's
+            # buckets (cmd/bucket-handlers.go ListBuckets with etcd)
+            have = {b.name for b in buckets}
+            for name in sorted(self.s3.federation.list_buckets()):
+                if name not in have:
+                    buckets.append(dt.BucketInfo(name=name))
+        self._send(200, xu.list_buckets_xml(buckets))
 
     # --- bucket -------------------------------------------------------------
 
     def put_bucket(self, ak):
         self._authorize(ak, "s3:CreateBucket")
-        self.s3.obj.make_bucket(self.bucket)
-        from ..bucket.metadata import BucketMetadata
-        meta = BucketMetadata(name=self.bucket)
-        if self.hdr.get("x-amz-bucket-object-lock-enabled", "") == "true":
-            meta.object_lock_enabled = True
-            meta.versioning_enabled = True
-        self.s3.bucket_meta.set(self.bucket, meta)
+        self.s3.create_bucket(
+            self.bucket,
+            object_lock=self.hdr.get(
+                "x-amz-bucket-object-lock-enabled", "") == "true")
         self._send(200, headers={"Location": f"/{self.bucket}"})
 
     def head_bucket(self, ak):
@@ -934,18 +1079,7 @@ class _S3Handler(BaseHTTPRequestHandler):
     def delete_bucket(self, ak):
         self._authorize(ak, "s3:DeleteBucket")
         force = self.hdr.get("x-minio-force-delete", "") == "true"
-        if force and self.s3.bucket_meta.get(
-                self.bucket).object_lock_enabled:
-            # force delete would bypass WORM retention (the reference
-            # refuses force-delete on lock buckets the same way)
-            raise dt.InvalidRequest(
-                self.bucket, "",
-                "force delete not allowed on object-lock buckets")
-        self.s3.obj.delete_bucket(self.bucket, force=force)
-        self.s3.bucket_meta.remove(self.bucket)
-        if self.s3._notifier is not None:
-            # a recreated bucket must not inherit the old routing rules
-            self.s3._notifier.invalidate(self.bucket)
+        self.s3.remove_bucket(self.bucket, force=force)
         self._send(204)
 
     @staticmethod
@@ -1924,6 +2058,21 @@ class _S3Handler(BaseHTTPRequestHandler):
             self.bucket, self.key, oi.etag),
             headers={"x-amz-version-id": oi.version_id or None})
         self._notify("s3:ObjectCreated:CompleteMultipartUpload", oi)
+
+
+class _LenReader:
+    """File-like with a known length: lets requests stream a proxied
+    body at constant memory while still sending Content-Length."""
+
+    def __init__(self, stream, size: int):
+        self.stream = stream
+        self._size = size
+
+    def read(self, n: int = -1) -> bytes:
+        return self.stream.read(n)
+
+    def __len__(self):
+        return self._size
 
 
 class _CappedReader:

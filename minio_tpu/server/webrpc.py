@@ -155,14 +155,16 @@ def _m_storage_info(h, p: dict, ak: str):
 def _m_make_bucket(h, p: dict, ak: str):
     bucket = p.get("bucketName", "")
     _check(h, ak, "s3:CreateBucket", bucket)
-    h.s3.obj.make_bucket(bucket)
+    # same core as the S3 path: metadata record, federation namespace
+    # check + DNS registration
+    h.s3.create_bucket(bucket)
     return True
 
 
 def _m_delete_bucket(h, p: dict, ak: str):
     bucket = p.get("bucketName", "")
     _check(h, ak, "s3:DeleteBucket", bucket)
-    h.s3.obj.delete_bucket(bucket)
+    h.s3.remove_bucket(bucket)
     return True
 
 
