@@ -72,5 +72,16 @@ class EtcdClient:
             result[k] = base64.b64decode(kv.get("value", ""))
         return result
 
+    def put_if_absent(self, key: str, value: str) -> bool:
+        """Atomic create: txn comparing create_revision == 0 (the etcd
+        idiom for claim-if-unowned). Returns False when the key already
+        exists."""
+        out = self._post("/v3/kv/txn", {
+            "compare": [{"key": self._b64(key), "target": "CREATE",
+                         "create_revision": "0"}],
+            "success": [{"request_put": {"key": self._b64(key),
+                                         "value": self._b64(value)}}]})
+        return bool(out.get("succeeded"))
+
     def delete(self, key: str) -> None:
         self._post("/v3/kv/deleterange", {"key": self._b64(key)})

@@ -17,6 +17,10 @@ from .etcd import EtcdClient, EtcdError
 DEFAULT_DOMAIN = "cluster.local"
 
 
+class FederationConflict(Exception):
+    """Another cluster holds the bucket name."""
+
+
 class BucketDNS:
     def __init__(self, etcd: EtcdClient, host: str, port: int,
                  domain: str = DEFAULT_DOMAIN):
@@ -30,13 +34,29 @@ class BucketDNS:
     def _key(self, bucket: str) -> str:
         return f"{self._prefix}{bucket}/{self.host}:{self.port}"
 
+    def _claim_key(self, bucket: str) -> str:
+        # the atomic ownership claim lives on one canonical key; the
+        # per-endpoint records under it are plain SkyDNS entries
+        return f"{self._prefix}{bucket}/@owner"
+
     def put(self, bucket: str) -> None:
-        """Register this cluster as the bucket's owner."""
+        """Register this cluster as the bucket's owner. The claim is an
+        etcd create-txn, so two clusters racing the same name cannot
+        both win (the check-then-put in the caller is only a fast
+        path)."""
+        me = f"{self.host}:{self.port}"
+        if not self.etcd.put_if_absent(self._claim_key(bucket), me):
+            current = self.etcd.get(self._claim_key(bucket))
+            if current is not None and current.decode() != me:
+                raise FederationConflict(
+                    f"bucket {bucket!r} is owned by "
+                    f"{current.decode()}")
         self.etcd.put(self._key(bucket), json.dumps(
             {"host": self.host, "port": self.port, "ttl": 30}))
 
     def delete(self, bucket: str) -> None:
         self.etcd.delete(self._key(bucket))
+        self.etcd.delete(self._claim_key(bucket))
 
     def lookup(self, bucket: str) -> list[tuple[str, int]]:
         """Endpoints owning ``bucket`` (empty when unregistered)."""

@@ -49,6 +49,24 @@ MAX_DELAY_S = float(os.environ.get("MINIO_TPU_DISPATCH_DELAY_MS", "1.0")) / 1e3
 #: probe would pin the device/CPU routing decision to one possibly-transient
 #: measurement forever).
 PROBE_TTL_S = float(os.environ.get("MINIO_TPU_PROBE_TTL_S", "60"))
+
+#: device flushes allowed in flight before the loop HOLDS further
+#: device-bound buckets so arrivals coalesce into larger batches.
+#: Without any cap, forced-device mode at high concurrency fragments
+#: into hundreds of small flushes whose queue builds without bound
+#: (r03: p50 9.5 s / p99 12.5 s at conc 128 — the tail kept growing).
+#: With the cap the queue is fair and bounded (p50 ~= p99). The depth
+#: trades per-flush batching against transfer overlap in the tunnel;
+#: 16 measured best at conc 128 on the axon link (8.5 s p99, down from
+#: 12.5 s) while leaving low-concurrency latency alone (the pipeline
+#: never fills there). Absolute forced-device latency remains
+#: link-bandwidth-bound — the auto route exists precisely to carry
+#: this load on the CPU when the link loses.
+DEVICE_PIPELINE = int(os.environ.get("MINIO_TPU_DEVICE_PIPELINE", "16"))
+#: safety cap on how long a held bucket may coalesce (model drift must
+#: not stall requests)
+MAX_HOLD_S = float(os.environ.get("MINIO_TPU_DISPATCH_HOLD_MS",
+                                  "2000")) / 1e3
 #: CPU-route completer threads; sized to the host so the CPU fallback's
 #: aggregate is not capped below the per-core kernel rate.
 COMPLETERS = int(os.environ.get(
@@ -180,9 +198,6 @@ class DispatchQueue:
         self._probe_failed_at = 0.0
         self._probe_running = False
         self._profile_lock = threading.Lock()
-        self._thread = threading.Thread(
-            target=self._loop, name="minio-tpu-dispatch", daemon=True)
-        self._thread.start()
         # telemetry
         self.batches = 0
         self.items = 0
@@ -193,11 +208,17 @@ class DispatchQueue:
         # the deadline resets to now
         self._dev_busy_until = 0.0
         self._dev_inflight = 0
+        # every attribute the loop reads must exist before it starts
+        self._thread = threading.Thread(
+            target=self._loop, name="minio-tpu-dispatch", daemon=True)
+        self._thread.start()
         # warm the profile off the request path: in auto mode the first
         # flush would otherwise absorb the full probe cost (device
-        # transfers + 8 CPU encodes) inside its latency
+        # transfers + 8 CPU encodes) inside its latency. Forced-device
+        # mode needs the profile too — the in-flight accounting behind
+        # the hold/coalesce cap only runs when a profile exists.
         if dispatch_enabled() and os.environ.get(
-                "MINIO_TPU_DISPATCH_MODE", "auto") == "auto":
+                "MINIO_TPU_DISPATCH_MODE", "auto") in ("auto", "device"):
             self._kick_probe()
 
     # --- submission ---------------------------------------------------------
@@ -253,6 +274,7 @@ class DispatchQueue:
                 while not self._stop:
                     now = time.monotonic()
                     deadline = None
+                    saturated = self._device_saturated()
                     for key in list(self._buckets):
                         b = self._buckets[key]
                         if not b.items:
@@ -261,6 +283,18 @@ class DispatchQueue:
                             del self._buckets[key]
                             continue
                         age = now - b.items[0].t
+                        if len(b.items) < self.max_batch and \
+                                age >= self.max_delay and \
+                                age < MAX_HOLD_S and saturated and \
+                                self._device_bound(b):
+                            # device pipeline full: HOLD this bucket so
+                            # later arrivals coalesce into one big flush
+                            # instead of queueing many tiny ones behind
+                            # the link; completion notifies the cv
+                            d = b.items[0].t + MAX_HOLD_S
+                            deadline = d if deadline is None \
+                                else min(deadline, d)
+                            continue
                         if len(b.items) >= self.max_batch or \
                                 age >= self.max_delay:
                             items, b.items = b.items[:self.max_batch], \
@@ -413,6 +447,30 @@ class DispatchQueue:
         for p in items:
             self._completers.submit(one, p)
 
+    def _device_saturated(self) -> bool:
+        with self._profile_lock:
+            return self._dev_inflight >= DEVICE_PIPELINE
+
+    def _device_bound(self, b: _Bucket) -> bool:
+        """Would this bucket's flush take the device route? Forced-cpu
+        never holds; forced-device always does; auto holds only when the
+        profile currently favors the device (a saturated link makes auto
+        pick CPU via the backlog term anyway)."""
+        mode = os.environ.get("MINIO_TPU_DISPATCH_MODE", "auto")
+        if mode == "cpu":
+            return False
+        if mode == "device":
+            return True
+        prof = self._profile
+        if prof is None:
+            return False
+        bytes_in, bytes_out = self._flush_bytes(b, b.items)
+        with self._profile_lock:
+            backlog = max(0.0, self._dev_busy_until - time.monotonic())
+        return prof.device_wins(bytes_in, bytes_out, len(b.items),
+                                cpu_workers=self.completer_count,
+                                backlog_s=backlog)
+
     def _flush(self, b: _Bucket, items: list[_Pending]):
         if self._route(b, items) == "cpu":
             self._flush_cpu(b, items)
@@ -512,6 +570,10 @@ class DispatchQueue:
                     if self._dev_inflight == 0:
                         # drained ahead of (or behind) the model: resync
                         self._dev_busy_until = time.monotonic()
+                # a pipeline slot freed: wake the loop so held buckets
+                # flush their coalesced batch now
+                with self._cv:
+                    self._cv.notify()
 
     def _finish_readback(self, b: _Bucket, out_dev, items: list[_Pending]):
         try:

@@ -99,6 +99,7 @@ class S3Server:
             meta.versioning_enabled = True
         self.bucket_meta.set(bucket, meta)
         if dns is not None:
+            from ..dist.federation import FederationConflict
             try:
                 dns.put(bucket)
             except Exception as e:  # noqa: BLE001 — unregistered bucket
@@ -107,6 +108,9 @@ class S3Server:
                 self.bucket_meta.remove(bucket)
                 if self._notifier is not None:
                     self._notifier.invalidate(bucket)
+                if isinstance(e, FederationConflict):
+                    # lost the atomic claim race to another cluster
+                    raise dt.BucketExists(bucket) from None
                 raise dt.InvalidRequest(
                     bucket, "", f"federation DNS: {e}") from None
 
@@ -118,16 +122,29 @@ class S3Server:
             raise dt.InvalidRequest(
                 bucket, "",
                 "force delete not allowed on object-lock buckets")
-        self.obj.delete_bucket(bucket, force=force)
+        if self.federation is not None:
+            # unregister FIRST and fail the request when etcd is down:
+            # entries take no lease, so a silently-skipped delete would
+            # poison the name federation-wide forever (the reference
+            # DeleteBucketHandler errors out the same way)
+            try:
+                self.federation.delete(bucket)
+            except Exception as e:  # noqa: BLE001
+                raise dt.InvalidRequest(
+                    bucket, "", f"federation DNS: {e}") from None
+        try:
+            self.obj.delete_bucket(bucket, force=force)
+        except BaseException:
+            if self.federation is not None:
+                try:  # local delete failed: restore the DNS record
+                    self.federation.put(bucket)
+                except Exception:  # noqa: BLE001 — best effort
+                    pass
+            raise
         self.bucket_meta.remove(bucket)
         if self._notifier is not None:
             # a recreated bucket must not inherit the old routing rules
             self._notifier.invalidate(bucket)
-        if self.federation is not None:
-            try:
-                self.federation.delete(bucket)
-            except Exception:  # noqa: BLE001 — stale DNS entries expire
-                pass           # via TTL; deletion must not fail the op
 
     def enable_federation(self, dns):
         """Attach a federation BucketDNS (dist.federation): bucket
@@ -575,17 +592,35 @@ class _S3Handler(BaseHTTPRequestHandler):
             return False  # local bucket: serve it here
         except (dt.BucketNotFound, st_errors.StorageError):
             pass
+        if self.hdr.get("x-minio-tpu-forwarded"):
+            # loop guard: a forwarded request that still isn't local here
+            # (stale DNS pointing back at us) must fail, not re-forward
+            return False
         owners = dns.lookup(self.bucket)
         if not owners or dns.is_mine(owners):
             return False  # unknown everywhere -> local NoSuchBucket
         obj_action, bkt_action = self._FWD_ACTIONS.get(
             self.command, ("s3:PutObject", "s3:PutObject"))
+        if self.command == "POST" and "delete" in self.query:
+            # multi-object delete rides POST: enforce the delete action,
+            # not PutObject
+            obj_action = bkt_action = "s3:DeleteObject"
         self._authorize(access_key,
                         obj_action if self.key else bkt_action)
         host, port = owners[0]
         import requests as rq
-        size = int(self.hdr.get("content-length", "0") or "0")
-        body = _LenReader(self._body_stream(size), size) if size else b""
+        # aws-chunked bodies: the wire length includes chunk framing; the
+        # proxied body is the DECODED payload (the local handlers use the
+        # same header, s3api _hash_reader)
+        if self.hdr.get("x-amz-content-sha256", "") == STREAMING_PAYLOAD:
+            size = int(self.hdr.get("x-amz-decoded-content-length",
+                                    "0") or "0")
+            body = _LenReader(self._body_stream(size), size) if size \
+                else b""
+        else:
+            size = int(self.hdr.get("content-length", "0") or "0")
+            body = _LenReader(self._body_stream(size), size) if size \
+                else b""
         headers = {"host": f"{host}:{port}"}
         passthrough = ("content-type", "range", "if-match",
                        "if-none-match", "if-modified-since",
@@ -593,6 +628,7 @@ class _S3Handler(BaseHTTPRequestHandler):
         for k, v in self.hdr.items():
             if k in passthrough or k.startswith("x-amz-meta-"):
                 headers[k] = v
+        headers["x-minio-tpu-forwarded"] = "1"
         auth = self.s3.verifier.sign_request(
             self.s3.access_key, self.s3.secret_key, self.command,
             self.url_path, self.query, headers, UNSIGNED_PAYLOAD)

@@ -184,3 +184,44 @@ def test_cpu_route_fused_detects_corruption(monkeypatch):
         assert not valid[2] and valid[[0, 1, 3]].all()
     finally:
         q.stop()
+
+
+def test_device_hold_coalesces_and_releases(monkeypatch):
+    """With the device pipeline saturated, sub-batch buckets are held to
+    coalesce; they must still flush (a) when the pipeline drains and (b)
+    by the MAX_HOLD_S safety valve even if accounting wedges."""
+    from minio_tpu.runtime import dispatch as dp
+    monkeypatch.setenv("MINIO_TPU_DISPATCH_MODE", "device")
+    monkeypatch.setattr(dp, "MAX_HOLD_S", 0.2)
+    q = DispatchQueue(max_batch=64, max_delay=0.001)
+    codec = get_codec(4, 2)
+    # wedge the accounting: pipeline looks permanently saturated
+    with q._profile_lock:
+        q._dev_inflight = dp.DEVICE_PIPELINE + 1
+    d = rng_shards(4, 1024, seed=7)
+    futs = [q.encode(codec, pack_shards(d)) for _ in range(5)]
+    # released by the safety valve despite "saturation"
+    for f in futs:
+        got = unpack_shards(f.result(timeout=10))
+        np.testing.assert_array_equal(got, codec.encode(d))
+    # all five coalesced into one flush while held
+    assert q.batches == 1, q.batches
+    q.stop()
+
+
+def test_device_bound_mode_gates():
+    from minio_tpu.runtime import dispatch as dp
+    q = DispatchQueue(max_batch=8, max_delay=0.001)
+    codec = get_codec(4, 2)
+    b = dp._Bucket(codec, "encode")
+    b.items.append(dp._Pending(words=pack_shards(rng_shards(4, 256)),
+                               masks=None))
+    import os
+    os.environ["MINIO_TPU_DISPATCH_MODE"] = "cpu"
+    try:
+        assert q._device_bound(b) is False
+        os.environ["MINIO_TPU_DISPATCH_MODE"] = "device"
+        assert q._device_bound(b) is True
+    finally:
+        os.environ.pop("MINIO_TPU_DISPATCH_MODE", None)
+    q.stop()

@@ -56,6 +56,18 @@ class _StubEtcd(BaseHTTPRequestHandler):
         if self.path.endswith("/kv/deleterange"):
             self.store.pop(key, None)
             return self._reply({})
+        if self.path.endswith("/kv/txn"):
+            # create-if-absent txn: compare create_revision == 0
+            cmp = (body.get("compare") or [{}])[0]
+            ckey = base64.b64decode(cmp.get("key", "")).decode()
+            absent = ckey not in self.store
+            if absent:
+                for op in body.get("success", []):
+                    putreq = op.get("request_put") or {}
+                    k = base64.b64decode(putreq.get("key", "")).decode()
+                    self.store[k] = base64.b64decode(
+                        putreq.get("value", ""))
+            return self._reply({"succeeded": absent})
         self._reply({}, 404)
 
     def _reply(self, obj, status=200):
@@ -219,3 +231,35 @@ def test_console_bucket_ops_join_federation(clusters):
     assert cb.request("PUT", "/console-bkt").status_code == 409
     assert cb.request("PUT", "/console-bkt/x", body=b"y").status_code == 200
     assert a.obj.get_object_bytes("console-bkt", "x") == b"y"
+
+
+def test_atomic_claim_prevents_split_brain(etcd):
+    """Two clusters racing the same name: exactly one claim wins."""
+    a = BucketDNS(etcd, "10.0.0.1", 9000, "race.test")
+    b = BucketDNS(etcd, "10.0.0.2", 9000, "race.test")
+    a.put("contested")
+    from minio_tpu.dist.federation import FederationConflict
+    with pytest.raises(FederationConflict):
+        b.put("contested")
+    # idempotent re-put by the owner is fine
+    a.put("contested")
+    a.delete("contested")
+    b.put("contested")  # freed name claimable
+    b.delete("contested")
+
+
+def test_stale_dns_does_not_loop(clusters, etcd):
+    """A DNS record pointing at a cluster that no longer holds the
+    bucket must 404, not proxy to itself forever."""
+    a, b = clusters
+    dns_b = b.federation
+    # forge a record claiming cluster B owns 'ghost' (but B has no data)
+    etcd.put(f"{dns_b._prefix}ghost/@owner", "127.0.0.1:1")
+    etcd.put(f"{dns_b._prefix}ghost/127.0.0.1:1",
+             json.dumps({"host": "127.0.0.1", "port": b.port, "ttl": 30}))
+    cb = S3Client(b.endpoint(), AK, SK)
+    r = cb.request("GET", "/ghost/x")
+    # one forward hop max: the guarded retry 404s instead of recursing
+    assert r.status_code in (404, 503)
+    etcd.delete(f"{dns_b._prefix}ghost/@owner")
+    etcd.delete(f"{dns_b._prefix}ghost/127.0.0.1:1")
