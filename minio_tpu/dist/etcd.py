@@ -85,3 +85,14 @@ class EtcdClient:
 
     def delete(self, key: str) -> None:
         self._post("/v3/kv/deleterange", {"key": self._b64(key)})
+
+    def delete_if_value(self, key: str, value: str) -> bool:
+        """Atomic guarded delete: remove the key only when it still
+        holds ``value`` (txn compare VALUE). Returns False when someone
+        else owns the key."""
+        out = self._post("/v3/kv/txn", {
+            "compare": [{"key": self._b64(key), "target": "VALUE",
+                         "value": self._b64(value)}],
+            "success": [{"request_delete_range":
+                         {"key": self._b64(key)}}]})
+        return bool(out.get("succeeded"))

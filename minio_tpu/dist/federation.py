@@ -43,20 +43,32 @@ class BucketDNS:
         """Register this cluster as the bucket's owner. The claim is an
         etcd create-txn, so two clusters racing the same name cannot
         both win (the check-then-put in the caller is only a fast
-        path)."""
+        path). Endpoint records are only ever written under a held
+        claim — a freed claim between attempts retries rather than
+        registering unclaimed."""
         me = f"{self.host}:{self.port}"
-        if not self.etcd.put_if_absent(self._claim_key(bucket), me):
+        for _ in range(8):
+            if self.etcd.put_if_absent(self._claim_key(bucket), me):
+                break
             current = self.etcd.get(self._claim_key(bucket))
-            if current is not None and current.decode() != me:
+            if current is None:
+                continue  # freed between txn and get: retry the claim
+            if current.decode() != me:
                 raise FederationConflict(
-                    f"bucket {bucket!r} is owned by "
-                    f"{current.decode()}")
+                    f"bucket {bucket!r} is owned by {current.decode()}")
+            break  # already mine (idempotent re-put)
+        else:
+            raise EtcdError("etcd: claim churn, giving up")
         self.etcd.put(self._key(bucket), json.dumps(
             {"host": self.host, "port": self.port, "ttl": 30}))
 
     def delete(self, bucket: str) -> None:
         self.etcd.delete(self._key(bucket))
-        self.etcd.delete(self._claim_key(bucket))
+        # guarded: only the claim's holder may release it — an
+        # unconditional delete would let a cluster with a same-named
+        # LOCAL bucket destroy another cluster's federation claim
+        self.etcd.delete_if_value(self._claim_key(bucket),
+                                  f"{self.host}:{self.port}")
 
     def lookup(self, bucket: str) -> list[tuple[str, int]]:
         """Endpoints owning ``bucket`` (empty when unregistered)."""

@@ -369,6 +369,17 @@ class StreamingBitrotReader:
         pass. offset must be chunk-aligned."""
         return self._read_phys_span(offset, length)
 
+    def fileno(self) -> int:
+        """Underlying fd when the source is a local file (fused pread
+        path); raises AttributeError for RPC sources."""
+        return self.src.fileno()
+
+    def phys_offset(self, offset: int) -> int:
+        """Physical file offset of chunk-aligned logical ``offset``
+        (the [digest][chunk] interleaving stride)."""
+        return (offset // self.shard_size) * (
+            self.shard_size + self.algo.digest_size)
+
     def read_at(self, offset: int, length: int) -> bytes:
         if length == 0:
             return b""

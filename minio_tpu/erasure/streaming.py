@@ -554,6 +554,17 @@ def erasure_decode(erasure: Erasure, writer, readers: list, offset: int,
         get_algo_id = native_algo_id(readers[0].algo)
         pool = global_pool()
 
+    def pread_block(fds, offs, shard_len):
+        """One native call: pread k framed spans + verify + assemble."""
+        scratch = pool.get(k * native.framed_len(shard_len, fuse_chunk))
+        try:
+            return native.get_block_pread(
+                fds, offs, k, shard_len, fuse_chunk, HIGHWAY_KEY,
+                get_algo_id, scratch=scratch,
+                out=pool.get(k * shard_len))
+        finally:
+            pool.put(scratch)
+
     def read_framed_k(shard_offset: int, shard_len: int):
         """Concurrently read the k data shards' framed spans; on any read
         failure mark the reader dead and return None (the caller falls back
@@ -591,10 +602,23 @@ def erasure_decode(erasure: Erasure, writer, readers: list, offset: int,
         shard_len = ceil_div(block_data_len, k)
         shard_offset = b * erasure.shard_size()
         # Healthy stream + native library -> fused verify+assemble: one
-        # GIL-releasing mt_get_block call checks every chunk digest and
-        # scatters payloads (replaces the numpy per-chunk verify)
+        # GIL-releasing call checks every chunk digest and scatters
+        # payloads (replaces the numpy per-chunk verify). When every
+        # data-shard source is a local file, the k span reads fuse into
+        # the same call (pread in C, mt_get_block_pread) — zero Python
+        # reads per block; RPC sources keep the pooled-read form.
         if native_get and all(preader.readers[i] is not None
                               for i in range(k)):
+            try:
+                fds = [preader.readers[i].fileno() for i in range(k)]
+                offs = [preader.readers[i].phys_offset(shard_offset)
+                        for i in range(k)]
+            except (AttributeError, OSError):
+                fds = None
+            if fds is not None:
+                fut = encode_pool().submit(pread_block, fds, offs,
+                                           shard_len)
+                return ["native", fut, b, block_data_len, boff, blen]
             framed = read_framed_k(shard_offset, shard_len)
             if framed is not None:
                 fut = encode_pool().submit(
@@ -630,6 +654,9 @@ def erasure_decode(erasure: Erasure, writer, readers: list, offset: int,
         instead of stalling block by block (the reference's
         readTriggerCh-on-bitrot behavior)."""
         preader.drop_corrupt(corrupt)
+        return _redo_block(b, block_data_len)
+
+    def _redo_block(b: int, block_data_len: int) -> list:
         blocks = erasure.decode_data_blocks(preader.read_block(
             b * erasure.shard_size(), ceil_div(block_data_len, k)))
         pending = list(window)
@@ -643,13 +670,22 @@ def erasure_decode(erasure: Erasure, writer, readers: list, offset: int,
         res = fut.result()
         if kind == "native":
             out_arr, bad = res
-            if bad < 0:
+            if bad == -1:
                 writer.write(out_arr[boff: boff + blen].tobytes())
                 pool.put(out_arr)
                 stats.bytes_written += blen
                 return
             pool.put(out_arr)
-            blocks = recover_block((bad,), b, block_data_len)
+            if bad <= -10:
+                # a fused pread failed on shard -(bad+10): mark the
+                # source dead (a vote, like any disk read error) and
+                # redo via replacement reads
+                i = -(bad + 10)
+                preader.errs[i] = errors.FaultyDisk("pread failed")
+                preader.readers[i] = None
+                blocks = _redo_block(b, block_data_len)
+            else:
+                blocks = recover_block((bad,), b, block_data_len)
         elif kind == "fused":
             blocks, corrupt = res
             if corrupt:

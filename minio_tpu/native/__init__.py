@@ -130,6 +130,11 @@ def _load_native_locked() -> ctypes.CDLL:
         lib.mt_verify_framed.argtypes = [c_u8p, ctypes.c_long, ctypes.c_long,
                                          ctypes.c_char_p, ctypes.c_int]
         lib.mt_verify_framed.restype = ctypes.c_long
+        lib.mt_get_block_pread.argtypes = [
+            ctypes.POINTER(ctypes.c_int), ctypes.POINTER(ctypes.c_long),
+            ctypes.c_int, ctypes.c_long, ctypes.c_long, ctypes.c_char_p,
+            c_u8p, c_u8p, ctypes.c_int]
+        lib.mt_get_block_pread.restype = ctypes.c_long
         lib.mur3x256.argtypes = [ctypes.c_char_p, ctypes.c_char_p,
                                  ctypes.c_long, ctypes.c_char_p]
         lib.mur3x256.restype = None
@@ -277,6 +282,38 @@ def get_block(framed: list, k: int, plen: int, chunk: int, key: bytes,
     bad = lib.mt_get_block(ptrs, k, plen, chunk, key,
                            out.ctypes.data_as(_u8p), algo)
     return out, bad
+
+
+def get_block_pread(fds: list[int], offsets: list[int], k: int, plen: int,
+                    chunk: int, key: bytes, algo: int = ALGO_HIGHWAY,
+                    scratch: np.ndarray | None = None,
+                    out: np.ndarray | None = None
+                    ) -> tuple[np.ndarray, int]:
+    """Fused pread+verify+assemble for one healthy-read block: shard i's
+    framed span is read from fds[i] at offsets[i]. Returns (block uint8
+    [k*plen], code) with code -1 ok, >=0 first corrupt shard, <=-10 a
+    failed read on shard -(code+10). ``scratch``/``out`` recycle through
+    the bufpool."""
+    lib = load_native()
+    if k <= 0 or k > 256 or chunk <= 0:
+        raise ValueError(f"unsupported geometry k={k} chunk={chunk}")
+    if len(fds) != k or len(offsets) != k:
+        raise ValueError("get_block_pread: need one fd+offset per shard")
+    fl = framed_len(plen, chunk)
+    if scratch is None:
+        scratch = np.empty(k * fl, dtype=np.uint8)
+    elif scratch.nbytes != k * fl:
+        raise ValueError("get_block_pread: scratch size mismatch")
+    if out is None:
+        out = np.empty(k * plen, dtype=np.uint8)
+    elif out.nbytes != k * plen:
+        raise ValueError("get_block_pread: out size mismatch")
+    cfds = (ctypes.c_int * k)(*fds)
+    coffs = (ctypes.c_long * k)(*offsets)
+    code = lib.mt_get_block_pread(
+        cfds, coffs, k, plen, chunk, key, scratch.ctypes.data_as(_u8p),
+        out.ctypes.data_as(_u8p), algo)
+    return out, int(code)
 
 
 def verify_framed(framed, plen: int, chunk: int, key: bytes,

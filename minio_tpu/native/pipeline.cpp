@@ -223,6 +223,37 @@ int mt_get_block(const uint8_t* const* framed, int k, long plen, long chunk,
   return -1;
 }
 
+// mt_get_block + the shard-file reads in the same GIL-released call:
+// pread each of the k framed spans (offsets[i] bytes into fds[i]) into
+// `scratch` (k consecutive spans of mt_framed_len(plen, chunk) bytes),
+// then verify+assemble into `out`. Returns -1 on success, the index of
+// the first corrupt shard, or -(10+i) when shard i's read failed/came
+// up short. Replaces k Python-side reads + buffer handoffs per block
+// with zero Python work (the read-side mirror of mt_put_block_fds).
+long mt_get_block_pread(const int* fds, const long* offsets, int k,
+                        long plen, long chunk, const uint64_t key[4],
+                        uint8_t* scratch, uint8_t* out, int algo) {
+  if (k <= 0 || k > 256 || chunk <= 0) return -2;
+  const long framed_len = mt_framed_len(plen, chunk);
+  const uint8_t* ptrs[256];
+  for (int i = 0; i < k; i++) {
+    uint8_t* dst = scratch + (size_t)i * framed_len;
+    long done = 0;
+    while (done < framed_len) {
+      ssize_t r = pread(fds[i], dst + done, (size_t)(framed_len - done),
+                        offsets[i] + done);
+      if (r < 0) {
+        if (errno == EINTR) continue;
+        return -(10 + i);
+      }
+      if (r == 0) return -(10 + i);  // short file
+      done += r;
+    }
+    ptrs[i] = dst;
+  }
+  return mt_get_block(ptrs, k, plen, chunk, key, out, algo);
+}
+
 // Verify-only over one framed span (deep scan / VerifyFile): returns -1 ok,
 // else the index of the first corrupt chunk.
 long mt_verify_framed(const uint8_t* framed, long plen, long chunk,

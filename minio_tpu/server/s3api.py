@@ -122,6 +122,10 @@ class S3Server:
             raise dt.InvalidRequest(
                 bucket, "",
                 "force delete not allowed on object-lock buckets")
+        # the bucket must exist locally before DNS is touched: deleting
+        # a bucket we don't hold must not strip (or, via the restore
+        # below, resurrect) another cluster's registration
+        self.obj.get_bucket_info(bucket)
         if self.federation is not None:
             # unregister FIRST and fail the request when etcd is down:
             # entries take no lease, so a silently-skipped delete would
@@ -134,6 +138,8 @@ class S3Server:
                     bucket, "", f"federation DNS: {e}") from None
         try:
             self.obj.delete_bucket(bucket, force=force)
+        except dt.BucketNotFound:
+            raise  # lost a delete race: nothing to restore
         except BaseException:
             if self.federation is not None:
                 try:  # local delete failed: restore the DNS record
@@ -615,12 +621,9 @@ class _S3Handler(BaseHTTPRequestHandler):
         if self.hdr.get("x-amz-content-sha256", "") == STREAMING_PAYLOAD:
             size = int(self.hdr.get("x-amz-decoded-content-length",
                                     "0") or "0")
-            body = _LenReader(self._body_stream(size), size) if size \
-                else b""
         else:
             size = int(self.hdr.get("content-length", "0") or "0")
-            body = _LenReader(self._body_stream(size), size) if size \
-                else b""
+        body = _LenReader(self._body_stream(size), size) if size else b""
         headers = {"host": f"{host}:{port}"}
         passthrough = ("content-type", "range", "if-match",
                        "if-none-match", "if-modified-since",

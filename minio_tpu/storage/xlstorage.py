@@ -82,6 +82,11 @@ class _FileReadAt:
     def read_at(self, offset: int, length: int) -> bytes:
         return os.pread(self._f.fileno(), length, offset)
 
+    def fileno(self) -> int:
+        """Expose the fd for the fused native read path (pread from
+        C++, native/pipeline.cpp mt_get_block_pread)."""
+        return self._f.fileno()
+
     def close(self):
         self._f.close()
 

@@ -57,17 +57,27 @@ class _StubEtcd(BaseHTTPRequestHandler):
             self.store.pop(key, None)
             return self._reply({})
         if self.path.endswith("/kv/txn"):
-            # create-if-absent txn: compare create_revision == 0
             cmp = (body.get("compare") or [{}])[0]
             ckey = base64.b64decode(cmp.get("key", "")).decode()
-            absent = ckey not in self.store
-            if absent:
+            if cmp.get("target") == "VALUE":
+                want = base64.b64decode(cmp.get("value", ""))
+                ok = self.store.get(ckey) == want
+            else:  # CREATE: create_revision == 0 -> key absent
+                ok = ckey not in self.store
+            if ok:
                 for op in body.get("success", []):
-                    putreq = op.get("request_put") or {}
-                    k = base64.b64decode(putreq.get("key", "")).decode()
-                    self.store[k] = base64.b64decode(
-                        putreq.get("value", ""))
-            return self._reply({"succeeded": absent})
+                    putreq = op.get("request_put")
+                    if putreq:
+                        k = base64.b64decode(
+                            putreq.get("key", "")).decode()
+                        self.store[k] = base64.b64decode(
+                            putreq.get("value", ""))
+                    delreq = op.get("request_delete_range")
+                    if delreq:
+                        k = base64.b64decode(
+                            delreq.get("key", "")).decode()
+                        self.store.pop(k, None)
+            return self._reply({"succeeded": ok})
         self._reply({}, 404)
 
     def _reply(self, obj, status=200):
@@ -263,3 +273,37 @@ def test_stale_dns_does_not_loop(clusters, etcd):
     assert r.status_code in (404, 503)
     etcd.delete(f"{dns_b._prefix}ghost/@owner")
     etcd.delete(f"{dns_b._prefix}ghost/127.0.0.1:1")
+
+
+def test_non_owner_delete_cannot_strip_claim(clusters, etcd):
+    """DELETE of a local-only bucket on one cluster must not destroy
+    another cluster's federation claim for the same name, and deleting
+    a bucket a cluster doesn't hold locally must not touch DNS."""
+    a, b = clusters
+    ca = S3Client(a.endpoint(), AK, SK)
+    cb = S3Client(b.endpoint(), AK, SK)
+    assert ca.request("PUT", "/claimed").status_code == 200
+    # B somehow holds a same-named LOCAL bucket (pre-federation data)
+    b.obj.make_bucket("claimed")
+    r = cb.request("DELETE", "/claimed")
+    assert r.status_code == 204  # B's local copy is gone...
+    # ...but A's claim + record survive: B still can't take the name
+    r = cb.request("PUT", "/claimed")
+    assert r.status_code == 409, r.text
+    owners = a.federation.lookup("claimed")
+    assert ("127.0.0.1", a.port) in owners
+    ca.request("DELETE", "/claimed")
+
+
+def test_delete_of_foreign_bucket_preserves_dns(clusters):
+    a, b = clusters
+    ca = S3Client(a.endpoint(), AK, SK)
+    cb = S3Client(b.endpoint(), AK, SK)
+    assert ca.request("PUT", "/keepdns").status_code == 200
+    # DELETE via B forwards to A (owner) and really deletes there;
+    # a second delete 404s without corrupting anything
+    r = cb.request("DELETE", "/keepdns")
+    assert r.status_code == 204
+    assert cb.request("DELETE", "/keepdns").status_code == 404
+    assert ca.request("PUT", "/keepdns").status_code == 200
+    ca.request("DELETE", "/keepdns")

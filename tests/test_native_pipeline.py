@@ -186,3 +186,45 @@ def test_fd_path_survives_one_dead_writer_mid_stream(tmp_path):
     finally:
         ol.disks[5].create_file_writer = orig
     assert ol.get_object_bytes("b", "o") == body
+
+
+def test_get_block_pread_roundtrip_and_errors(tmp_path):
+    """mt_get_block_pread: reads+verifies+assembles from shard files;
+    bad fds surface as -(10+i) codes, corruption as the shard index."""
+    from minio_tpu.erasure.bitrot import HIGHWAY_KEY
+    from minio_tpu.ops import gf256
+    k, m, chunk = 4, 2, 16384
+    data = np.random.default_rng(9).integers(
+        0, 256, 1 << 20, dtype=np.uint8).tobytes()
+    shard_len = len(data) // k
+    pmat = gf256.build_matrix(k, m)[k:]
+    framed = native.put_block(data, len(data), pmat, k, m, shard_len,
+                              chunk, HIGHWAY_KEY)
+    fl = native.framed_len(shard_len, chunk)
+    paths = []
+    for i in range(k):
+        p = os.path.join(tmp_path, f"s{i}")
+        with open(p, "wb") as f:
+            f.write(framed[i * fl:(i + 1) * fl].tobytes())
+        paths.append(p)
+    fds = [os.open(p, os.O_RDONLY) for p in paths]
+    out, code = native.get_block_pread(fds, [0] * k, k, shard_len, chunk,
+                                       HIGHWAY_KEY)
+    assert code == -1
+    assert out.tobytes() == data
+    # corrupt shard 2's payload
+    with open(paths[2], "r+b") as f:
+        f.seek(40)
+        f.write(b"\xff")
+    _, code = native.get_block_pread(fds, [0] * k, k, shard_len, chunk,
+                                     HIGHWAY_KEY)
+    assert code == 2
+    # bad fd on shard 1
+    os.close(fds[1])
+    bad = fds[1]
+    _, code = native.get_block_pread([fds[0], bad, fds[2], fds[3]],
+                                     [0] * k, k, shard_len, chunk,
+                                     HIGHWAY_KEY)
+    assert code == -(10 + 1)
+    for i in (0, 2, 3):
+        os.close(fds[i])
