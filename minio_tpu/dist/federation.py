@@ -67,8 +67,19 @@ class BucketDNS:
         # guarded: only the claim's holder may release it — an
         # unconditional delete would let a cluster with a same-named
         # LOCAL bucket destroy another cluster's federation claim
-        self.etcd.delete_if_value(self._claim_key(bucket),
-                                  f"{self.host}:{self.port}")
+        if not self.etcd.delete_if_value(self._claim_key(bucket),
+                                         f"{self.host}:{self.port}"):
+            # identity drift (advertise address changed since the claim
+            # was written): claims take no lease, so an orphaned claim
+            # with NO endpoint records left would poison the name
+            # forever — reap it; when records remain, another cluster
+            # genuinely owns the name and the claim must stand
+            records = {
+                k: v for k, v in self.etcd.get_prefix(
+                    f"{self._prefix}{bucket}/").items()
+                if not k.endswith("/@owner")}
+            if not records:
+                self.etcd.delete(self._claim_key(bucket))
 
     def lookup(self, bucket: str) -> list[tuple[str, int]]:
         """Endpoints owning ``bucket`` (empty when unregistered)."""

@@ -29,7 +29,9 @@ def main(argv=None):
     ap.add_argument("dirs", nargs="+", help="disk directories or "
                     "ellipses patterns like /data/disk{1...8}; "
                     "http://host:port/path endpoints = distributed mode")
-    ap.add_argument("--address", default="0.0.0.0:9000")
+    ap.add_argument("--address", default="0.0.0.0:9000",
+                    help="host:port to listen on; comma-separate for "
+                         "additional bindings (multi-addr listener)")
     ap.add_argument("--region", default="us-east-1")
     ap.add_argument("--parity", type=int, default=None,
                     help="parity drives per set (default: drives/2)")
@@ -38,6 +40,10 @@ def main(argv=None):
                          "(nas: shared mount path; s3: upstream endpoint)")
     args = ap.parse_args(argv)
     ak, sk = _root_creds()
+    if "," in args.address and any(
+            d.startswith(("http://", "https://")) for d in args.dirs):
+        ap.error("multi-addr --address is not supported in distributed "
+                 "mode; pass the single URL this node serves")
 
     if args.gateway:
         from ..gateway import new_gateway_layer
@@ -71,10 +77,17 @@ def main(argv=None):
                                   default_parity=args.parity)
             banner = f"erasure: {set_count} set(s) x {per_set} drives"
 
-    host, _, port = args.address.rpartition(":")
+    addrs = args.address.split(",")
+    host, _, port = addrs[0].rpartition(":")
+    extra = []
+    for a in addrs[1:]:
+        h, _, p = a.rpartition(":")
+        extra.append((h or "0.0.0.0", int(p)))
     from . import S3Server
     srv = S3Server(obj, host or "0.0.0.0", int(port), args.region,
-                   access_key=ak, secret_key=sk)
+                   access_key=ak, secret_key=sk, extra_addresses=extra)
+    if extra:
+        banner += f"; +{len(extra)} extra listener(s)"
     if os.environ.get("MINIO_TPU_ETCD_ENDPOINTS"):
         # resolve the advertise address only when federation is actually
         # configured — gethostbyname can fail on minimal containers

@@ -36,7 +36,12 @@ class S3Server:
     def __init__(self, objlayer: ObjectLayer, address: str = "0.0.0.0",
                  port: int = 9000, region: str = "us-east-1",
                  access_key: str = "", secret_key: str = "",
-                 max_requests: int = 256):
+                 max_requests: int = 256,
+                 extra_addresses: list[tuple[str, int]] | None = None):
+        #: additional (host, port) bindings served alongside the main
+        #: one (reference multi-addr xhttp.Listener)
+        self.extra_addresses = list(extra_addresses or [])
+        self._extra_httpds: list[ThreadingHTTPServer] = []
         self.obj = objlayer
         self.region = region
         self.access_key = access_key or os.environ.get(
@@ -248,21 +253,41 @@ class S3Server:
         httpd = TunedServer((self.address, self.port), Handler)
         self._httpd = httpd
         self.port = httpd.server_address[1]
+        # multi-address listening (reference xhttp.Listener,
+        # cmd/http/listener.go: one logical server accepting on several
+        # host:port bindings): each extra address gets its own accept
+        # loop feeding the same handler/server state
+        for host, port in self.extra_addresses:
+            extra = TunedServer((host, port), Handler)
+            self._extra_httpds.append(extra)
+        self.extra_ports = [s.server_address[1]
+                            for s in self._extra_httpds]
         return httpd
 
     def serve_forever(self):
-        self.build().serve_forever()
+        httpd = self.build()
+        for extra in self._extra_httpds:
+            threading.Thread(target=extra.serve_forever,
+                             name="minio-tpu-http-extra",
+                             daemon=True).start()
+        httpd.serve_forever()
 
     def start_background(self) -> threading.Thread:
         httpd = self.build()
         t = threading.Thread(target=httpd.serve_forever,
                              name="minio-tpu-http", daemon=True)
         t.start()
+        for extra in self._extra_httpds:
+            threading.Thread(target=extra.serve_forever,
+                             name="minio-tpu-http-extra",
+                             daemon=True).start()
         return t
 
     def shutdown(self):
         if self._httpd is not None:
             self._httpd.shutdown()
+        for extra in self._extra_httpds:
+            extra.shutdown()
 
     def endpoint(self) -> str:
         return f"http://127.0.0.1:{self.port}"

@@ -442,3 +442,27 @@ def test_presigned_future_date_rejected(srv):
     qs = "&".join(f"{k}={v[0]}" for k, v in q.items())
     r = requests.get(srv.endpoint() + "/?" + qs)
     assert r.status_code == 403, r.content
+
+
+def test_multi_address_listener(tmp_path):
+    """Extra (host, port) bindings serve the same S3 state (reference
+    multi-addr xhttp.Listener, cmd/http/listener.go)."""
+    from minio_tpu.objectlayer import ErasureObjects
+    from minio_tpu.server import S3Server
+    from minio_tpu.storage import XLStorage
+    obj = ErasureObjects([XLStorage(str(tmp_path / f"d{i}"))
+                          for i in range(4)], default_parity=1)
+    srv = S3Server(obj, "127.0.0.1", 0, access_key="ma", secret_key="masec",
+                   extra_addresses=[("127.0.0.1", 0)])
+    srv.start_background()
+    extra_port = srv.extra_ports[0]
+    try:
+        c_main = S3Client(srv.endpoint(), "ma", "masec")
+        c_extra = S3Client(f"http://127.0.0.1:{extra_port}", "ma", "masec")
+        assert c_main.request("PUT", "/mab").status_code == 200
+        assert c_extra.request("PUT", "/mab/o", body=b"x" * 100
+                               ).status_code == 200
+        r = c_main.request("GET", "/mab/o")
+        assert r.status_code == 200 and r.content == b"x" * 100
+    finally:
+        srv.shutdown()
