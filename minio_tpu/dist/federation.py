@@ -79,7 +79,14 @@ class BucketDNS:
                     f"{self._prefix}{bucket}/").items()
                 if not k.endswith("/@owner")}
             if not records:
-                self.etcd.delete(self._claim_key(bucket))
+                # reap with a guarded delete against the OBSERVED value:
+                # an unconditional delete here could destroy a claim a
+                # racing put() just won (it writes the claim before its
+                # endpoint record)
+                current = self.etcd.get(self._claim_key(bucket))
+                if current is not None:
+                    self.etcd.delete_if_value(self._claim_key(bucket),
+                                              current.decode())
 
     def lookup(self, bucket: str) -> list[tuple[str, int]]:
         """Endpoints owning ``bucket`` (empty when unregistered)."""

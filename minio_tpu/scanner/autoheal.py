@@ -48,36 +48,63 @@ class GlobalHealer:
         self.objects_healed = 0
         self.objects_failed = 0
 
-    def heal_all(self, scan_mode: str = "normal") -> dict:
+    def heal_all(self, scan_mode: str = "normal",
+                 resume_from: tuple[str, str] | None = None,
+                 progress_cb=None, progress_every: int = 64) -> dict:
+        """Full-namespace heal pass. ``resume_from`` = (bucket, object)
+        marker from a previous interrupted pass: earlier buckets and
+        already-covered objects are skipped (the namespace walk is
+        sorted, so the skip is a plain comparison). ``progress_cb``
+        fires every ``progress_every`` objects with (bucket, object,
+        results) — the healing tracker persists it so a restarted node
+        resumes instead of re-walking (reference
+        cmd/background-newdisks-heal-ops.go healingTracker)."""
         from collections import deque
         results = {"buckets": 0, "objects_healed": 0, "objects_failed": 0}
         pool = ThreadPoolExecutor(max_workers=self.concurrency,
                                   thread_name_prefix="global-heal")
         # bounded in-flight window: memory stays O(concurrency) even on
         # namespaces with millions of objects
-        futs: deque = deque()
+        futs: deque = deque()  # (future, bucket, object)
         max_inflight = self.concurrency * 4
+        rb, ro = resume_from if resume_from else ("", "")
+        state = {"since": 0}
 
-        def reap(f):
+        def reap():
+            # reap order == submit order == walk order, so the marker
+            # only ever advances past objects whose heal COMPLETED — a
+            # resume can't skip work that was merely in flight
+            f, bkt, name = futs.popleft()
             if f.result():
                 results["objects_healed"] += 1
             else:
                 results["objects_failed"] += 1
+            state["since"] += 1
+            if progress_cb is not None and \
+                    state["since"] >= progress_every:
+                state["since"] = 0
+                progress_cb(bkt, name, dict(results))
 
         try:
-            for b in self.obj.list_buckets():
+            for b in sorted(self.obj.list_buckets(),
+                            key=lambda x: x.name):
+                if rb and b.name < rb:
+                    continue  # healed before the interruption
                 self.obj.heal_bucket(b.name)
                 results["buckets"] += 1
                 # streaming metacache pass: O(concurrency) memory and no
                 # per-page namespace restarts (cmd/global-heal.go:123 walks
                 # the erasure set's disks the same way)
                 for oi in self.obj.iter_objects(b.name):
-                    futs.append(pool.submit(
-                        self._heal_one, b.name, oi.name, scan_mode))
+                    if rb == b.name and ro and oi.name <= ro:
+                        continue
+                    futs.append((pool.submit(
+                        self._heal_one, b.name, oi.name, scan_mode),
+                        b.name, oi.name))
                     if len(futs) >= max_inflight:
-                        reap(futs.popleft())
+                        reap()
             while futs:
-                reap(futs.popleft())
+                reap()
         finally:
             pool.shutdown(wait=True)
         self.objects_healed += results["objects_healed"]
@@ -124,17 +151,59 @@ class AutoHealMonitor:
                 pass
 
     def check_and_heal(self) -> bool:
-        pending = [d for d in self.local_disks
-                   if get_healing_tracker(d) is not None]
-        if not pending:
+        tracked = [(d, t) for d in self.local_disks
+                   if (t := get_healing_tracker(d)) is not None]
+        if not tracked:
             return False
-        res = self.healer.heal_all()
+        pending = [d for d, _ in tracked]
+        # resume from the most conservative persisted marker (a restart
+        # mid-pass continues instead of re-walking the whole namespace;
+        # reference healingTracker Bucket/Object resume)
+        markers = [(t.get("bucket", ""), t.get("object", ""))
+                   for _, t in tracked if isinstance(t, dict)]
+        resume = min(markers) if markers and all(
+            m != ("", "") for m in markers) else None
+        # failures recorded BEFORE the interruption: the pre-marker part
+        # of a resumed pass skipped them, so a clean remainder must not
+        # declare the disk healed
+        prior_failed = max((t.get("objects_failed", 0)
+                            for _, t in tracked if isinstance(t, dict)),
+                           default=0) if resume else 0
+
+        def save_progress(bucket, obj, res):
+            for d in pending:
+                try:
+                    t = get_healing_tracker(d) or {}
+                    t.update({"bucket": bucket, "object": obj,
+                              "objects_healed": res["objects_healed"],
+                              "objects_failed": res["objects_failed"]
+                              + prior_failed})
+                    set_healing_tracker(d, t)
+                except errors.StorageError:
+                    continue  # a flaky tracker disk (they're the fresh
+                    # ones!) must not abort the whole heal pass
+
+        res = self.healer.heal_all(resume_from=resume,
+                                   progress_cb=save_progress)
         self.heal_passes += 1
-        if res["objects_failed"] == 0:
+        if res["objects_failed"] + prior_failed == 0:
             # only a clean pass clears the trackers — a partial pass must
             # resume on the next cycle (the tracker's whole purpose)
             for d in pending:
                 clear_healing_tracker(d)
+        else:
+            # failures mean skipped objects: reset the marker so the
+            # NEXT pass re-walks from the start (the marker only serves
+            # interrupted passes, not failed ones)
+            for d in pending:
+                try:
+                    t = get_healing_tracker(d) or {}
+                    t.pop("bucket", None)
+                    t.pop("object", None)
+                    t.pop("objects_failed", None)
+                    set_healing_tracker(d, t)
+                except errors.StorageError:
+                    continue
         return True
 
     def stop(self):

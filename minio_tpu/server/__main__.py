@@ -82,7 +82,11 @@ def main(argv=None):
     extra = []
     for a in addrs[1:]:
         h, _, p = a.rpartition(":")
-        extra.append((h or "0.0.0.0", int(p)))
+        try:
+            extra.append((h or "0.0.0.0", int(p)))
+        except ValueError:
+            ap.error(f"invalid --address entry {a!r} "
+                     "(expected host:port)")
     from . import S3Server
     srv = S3Server(obj, host or "0.0.0.0", int(port), args.region,
                    access_key=ak, secret_key=sk, extra_addresses=extra)

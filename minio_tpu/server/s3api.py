@@ -257,9 +257,20 @@ class S3Server:
         # cmd/http/listener.go: one logical server accepting on several
         # host:port bindings): each extra address gets its own accept
         # loop feeding the same handler/server state
-        for host, port in self.extra_addresses:
-            extra = TunedServer((host, port), Handler)
-            self._extra_httpds.append(extra)
+        try:
+            for host, port in self.extra_addresses:
+                extra = TunedServer((host, port), Handler)
+                self._extra_httpds.append(extra)
+        except OSError:
+            # a failed extra bind must not leak the sockets already
+            # bound (or leave a shutdown() that would wait forever on
+            # servers whose serve_forever never ran)
+            for s in self._extra_httpds:
+                s.server_close()
+            self._extra_httpds = []
+            httpd.server_close()
+            self._httpd = None
+            raise
         self.extra_ports = [s.server_address[1]
                             for s in self._extra_httpds]
         return httpd
