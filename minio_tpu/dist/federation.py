@@ -74,19 +74,19 @@ class BucketDNS:
             # with NO endpoint records left would poison the name
             # forever — reap it; when records remain, another cluster
             # genuinely owns the name and the claim must stand
+            # observe the claim value BEFORE the records check: a racing
+            # put() that wins the claim after this read changes the
+            # value, so the guarded delete below misses and the winner's
+            # claim survives (reading after the check would let the reap
+            # destroy a freshly-won claim whose record isn't written yet)
+            current = self.etcd.get(self._claim_key(bucket))
             records = {
                 k: v for k, v in self.etcd.get_prefix(
                     f"{self._prefix}{bucket}/").items()
                 if not k.endswith("/@owner")}
-            if not records:
-                # reap with a guarded delete against the OBSERVED value:
-                # an unconditional delete here could destroy a claim a
-                # racing put() just won (it writes the claim before its
-                # endpoint record)
-                current = self.etcd.get(self._claim_key(bucket))
-                if current is not None:
-                    self.etcd.delete_if_value(self._claim_key(bucket),
-                                              current.decode())
+            if not records and current is not None:
+                self.etcd.delete_if_value(self._claim_key(bucket),
+                                          current.decode())
 
     def lookup(self, bucket: str) -> list[tuple[str, int]]:
         """Endpoints owning ``bucket`` (empty when unregistered)."""

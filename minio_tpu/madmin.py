@@ -177,6 +177,12 @@ class AdminClient:
         q = {"buckets": ",".join(buckets)} if buckets else None
         return self._json("GET", "bandwidth", q)
 
+    def service_restart(self) -> None:
+        self._json("POST", "service", {"action": "restart"})
+
+    def service_stop(self) -> None:
+        self._json("POST", "service", {"action": "stop"})
+
     # -- kms ------------------------------------------------------------------
 
     def kms_status(self) -> dict:

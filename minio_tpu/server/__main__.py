@@ -78,15 +78,15 @@ def main(argv=None):
             banner = f"erasure: {set_count} set(s) x {per_set} drives"
 
     addrs = args.address.split(",")
-    host, _, port = addrs[0].rpartition(":")
-    extra = []
-    for a in addrs[1:]:
+    parsed = []
+    for a in addrs:
         h, _, p = a.rpartition(":")
         try:
-            extra.append((h or "0.0.0.0", int(p)))
+            parsed.append((h or "0.0.0.0", int(p)))
         except ValueError:
             ap.error(f"invalid --address entry {a!r} "
                      "(expected host:port)")
+    (host, port), extra = parsed[0], parsed[1:]
     from . import S3Server
     srv = S3Server(obj, host or "0.0.0.0", int(port), args.region,
                    access_key=ak, secret_key=sk, extra_addresses=extra)
@@ -103,11 +103,28 @@ def main(argv=None):
         if fed is not None:
             srv.enable_federation(fed)
             banner += f"; federated via etcd (domain {fed.domain})"
+    _install_service_hook(srv)
     print(f"{banner}; listening on {args.address}", file=sys.stderr)
     try:
         srv.serve_forever()
     except KeyboardInterrupt:
         pass
+
+
+def _install_service_hook(srv) -> None:
+    """mc admin service restart/stop (reference cmd/service.go: restart
+    re-execs the same argv so config/env changes load; stop exits
+    cleanly). Installed for every CLI mode — single node, gateway AND
+    distributed — so the admin endpoint acts instead of silently
+    acking."""
+    def service_signal(action: str):
+        if action == "restart":
+            os.execv(sys.executable, [sys.executable, "-m",
+                                      "minio_tpu.server",
+                                      *sys.argv[1:]])
+        os._exit(0)
+
+    srv.on_service_signal = service_signal
 
 
 def _serve_distributed(args, ak: str, sk: str):
@@ -146,6 +163,8 @@ def _serve_distributed(args, ak: str, sk: str):
                  f"URL; pass the URL this node serves (endpoints: "
                  f"{sorted({str(e.url) for e in node.endpoints})})")
     node.start()
+    if getattr(node, "server", None) is not None:
+        _install_service_hook(node.server)
     print(f"distributed node listening on {args.address} "
           f"({len(node.endpoints)} endpoints)", file=sys.stderr)
     try:

@@ -52,8 +52,22 @@ def _dispatch_admin(h, op: str) -> None:
                                                   depth)).encode(),
                        "application/json")
     if op.startswith("service"):
-        # restart/stop accepted; process supervisor owns actual signals
-        return h._send(200, b"{}", "application/json")
+        # reference cmd/service.go: restart re-execs the process, stop
+        # exits; the CLI entry installs the hook (library embedders may
+        # install their own or leave it None = acknowledged no-op)
+        q = {k: v[0] for k, v in h.query.items()}
+        action = q.get("action", "restart")
+        if action not in ("restart", "stop"):
+            return h._error("InvalidArgument",
+                            f"unknown service action {action!r}", 400)
+        hook = getattr(h.s3, "on_service_signal", None)
+        h._send(200, b"{}", "application/json")
+        if hook is not None:
+            import threading as _t
+            # after the response is on the wire; a tiny delay lets the
+            # socket flush before the process replaces/ends itself
+            _t.Timer(0.2, hook, args=(action,)).start()
+        return
     if op == "set-bucket-quota":
         q = {k: v[0] for k, v in h.query.items()}
         body = json.loads(h._read_body() or b"{}")

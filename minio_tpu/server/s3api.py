@@ -1578,6 +1578,11 @@ class _S3Handler(BaseHTTPRequestHandler):
     def _user_meta(self) -> dict[str, str]:
         out = {}
         ct = self.hdr.get("content-type")
+        if not ct and self.key:
+            # extension-based detection (reference mimedb, a 4,632-line
+            # generated table; the stdlib registry covers the same role)
+            import mimetypes
+            ct = mimetypes.guess_type(self.key, strict=False)[0]
         if ct:
             out["content-type"] = ct
         for k, v in self.hdr.items():

@@ -152,3 +152,46 @@ def test_three_process_wipe_and_heal(tmp_path):
                 pass
         # surface subprocess stderr on failure for debuggability
         sys.stderr.write("\n".join(e[-2000:] for e in errs if e))
+
+
+def test_service_restart_and_stop(tmp_path):
+    """mc admin service restart re-execs the server in place (same pid,
+    data preserved, fresh process state); stop exits it."""
+    import sys as _sys
+    import time as _time
+
+    import requests as rq
+
+    from minio_tpu.madmin import AdminClient
+    port = free_port()
+    env = dict(os.environ, MINIO_TPU_ROOT_USER="svc",
+               MINIO_TPU_ROOT_PASSWORD="svcsecret1",
+               JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
+    proc = subprocess.Popen(
+        [_sys.executable, "-m", "minio_tpu.server",
+         "--address", f"127.0.0.1:{port}"] +
+        [str(tmp_path / f"d{i}") for i in range(4)],
+        env=env, cwd=REPO, stdout=subprocess.DEVNULL,
+        stderr=subprocess.DEVNULL)
+    try:
+        base = f"http://127.0.0.1:{port}"
+        c = S3Client(base, "svc", "svcsecret1")
+        wait_ready(c, proc)
+        assert c.request("PUT", "/svcb").status_code == 200
+        assert c.request("PUT", "/svcb/o", body=b"keep").status_code == 200
+        adm = AdminClient(base, "svc", "svcsecret1")
+        adm.service_restart()
+        _time.sleep(1.0)
+        wait_ready(c, proc, timeout=30)
+        # same process (execv), data survived the restart
+        assert proc.poll() is None
+        r = c.request("GET", "/svcb/o")
+        assert r.status_code == 200 and r.content == b"keep"
+        adm.service_stop()
+        deadline = _time.time() + 15
+        while proc.poll() is None and _time.time() < deadline:
+            _time.sleep(0.2)
+        assert proc.poll() is not None  # exited on stop
+    finally:
+        if proc.poll() is None:
+            proc.kill()

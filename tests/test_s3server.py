@@ -466,3 +466,31 @@ def test_multi_address_listener(tmp_path):
         assert r.status_code == 200 and r.content == b"x" * 100
     finally:
         srv.shutdown()
+
+
+def test_content_type_detection(tmp_path):
+    """PUT without Content-Type detects it from the key's extension
+    (reference mimedb)."""
+    from minio_tpu.objectlayer import ErasureObjects
+    from minio_tpu.server import S3Server
+    from minio_tpu.storage import XLStorage
+    obj = ErasureObjects([XLStorage(str(tmp_path / f"d{i}"))
+                          for i in range(4)], default_parity=1)
+    srv = S3Server(obj, "127.0.0.1", 0, access_key="ct", secret_key="ctsec")
+    srv.start_background()
+    try:
+        c = S3Client(srv.endpoint(), "ct", "ctsec")
+        c.request("PUT", "/ctb")
+        for key, want in (("doc.json", "application/json"),
+                          ("page.html", "text/html"),
+                          ("img.png", "image/png")):
+            c.request("PUT", f"/ctb/{key}", body=b"x")
+            r = c.request("HEAD", f"/ctb/{key}")
+            assert r.headers["Content-Type"] == want, (key, r.headers)
+        # explicit Content-Type always wins
+        c.request("PUT", "/ctb/custom.json", body=b"x",
+                  headers={"Content-Type": "application/x-custom"})
+        r = c.request("HEAD", "/ctb/custom.json")
+        assert r.headers["Content-Type"] == "application/x-custom"
+    finally:
+        srv.shutdown()
