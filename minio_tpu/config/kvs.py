@@ -150,6 +150,18 @@ SUB_SYSTEMS: dict[str, dict[str, KV]] = {
         "nsqd_address": KV("", env="MINIO_TPU_NOTIFY_NSQ_NSQD_ADDRESS"),
         "topic": KV("minio", env="MINIO_TPU_NOTIFY_NSQ_TOPIC"),
     },
+    "notify_postgres": {
+        "enable": KV("off", env="MINIO_TPU_NOTIFY_POSTGRES_ENABLE"),
+        "address": KV("", env="MINIO_TPU_NOTIFY_POSTGRES_ADDRESS",
+                      help="host:port of the PostgreSQL server"),
+        "database": KV("minio", env="MINIO_TPU_NOTIFY_POSTGRES_DATABASE"),
+        "table": KV("minio_events",
+                    env="MINIO_TPU_NOTIFY_POSTGRES_TABLE"),
+        "user": KV("postgres", env="MINIO_TPU_NOTIFY_POSTGRES_USER"),
+        "password": KV("", env="MINIO_TPU_NOTIFY_POSTGRES_PASSWORD"),
+        "format": KV("namespace", env="MINIO_TPU_NOTIFY_POSTGRES_FORMAT",
+                     help="namespace|access"),
+    },
 }
 
 #: Subsystems whose set() takes effect without restart (SubSystemsDynamic,

@@ -52,7 +52,7 @@ def targets_from_config(cfg, region: str = "us-east-1") -> list:
         "notify_kafka": "brokers", "notify_amqp": "url",
         "notify_mqtt": "broker", "notify_redis": "address",
         "notify_elasticsearch": "url", "notify_nats": "address",
-        "notify_nsq": "nsqd_address",
+        "notify_nsq": "nsqd_address", "notify_postgres": "address",
     }
     builders = [
         ("notify_kafka", lambda: T.KafkaTarget(
@@ -88,6 +88,13 @@ def targets_from_config(cfg, region: str = "us-east-1") -> list:
         ("notify_nsq", lambda: T.NSQTarget(
             "1", cfg.get("notify_nsq", "nsqd_address"),
             cfg.get("notify_nsq", "topic"), region)),
+        ("notify_postgres", lambda: T.PostgresTarget(
+            "1", cfg.get("notify_postgres", "address"),
+            cfg.get("notify_postgres", "database"),
+            cfg.get("notify_postgres", "table"),
+            cfg.get("notify_postgres", "user"),
+            cfg.get("notify_postgres", "password"),
+            cfg.get("notify_postgres", "format"), region)),
     ]
     for subsys, build in builders:
         try:

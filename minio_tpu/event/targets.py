@@ -255,3 +255,66 @@ class NSQTarget:
     def send(self, record: dict) -> None:
         self.client.publish(json.dumps(
             _envelope(record), separators=(",", ":")).encode())
+
+
+class PostgresTarget:
+    """PostgreSQL event target (reference pkg/event/target/postgresql.go,
+    lib/pq replaced by the in-tree wire client): namespace format
+    upserts/deletes one row per object key, access format appends an
+    event log row. Tables are created on first use."""
+
+    KIND = "postgresql"
+
+    def __init__(self, target_id: str, addr: str, database: str,
+                 table: str = "minio_events", user: str = "postgres",
+                 password: str = "", fmt: str = "namespace",
+                 region: str = "us-east-1", timeout_s: float = 5.0):
+        import re
+
+        from .wire import PostgresClient, pg_quote
+        self.id = target_id
+        host, _, port = addr.partition(":")
+        self.client = PostgresClient(host, int(port or 5432), user,
+                                     database, password, timeout_s)
+        if not re.fullmatch(r"[A-Za-z_][A-Za-z0-9_]*", table):
+            raise ValueError(f"invalid postgres table name {table!r}")
+        if fmt not in ("namespace", "access"):
+            raise ValueError(f"invalid postgres format {fmt!r} "
+                             "(namespace|access)")
+        self.table = table
+        self.fmt = fmt
+        self._quote = pg_quote
+        self._ready = False
+        self.arn = f"arn:minio:sqs:{region}:{target_id}:postgresql"
+
+    def _ensure_table(self) -> None:
+        if self._ready:
+            return
+        if self.fmt == "namespace":
+            self.client.execute(
+                f"CREATE TABLE IF NOT EXISTS {self.table} "
+                "(key TEXT PRIMARY KEY, value JSONB)")
+        else:
+            self.client.execute(
+                f"CREATE TABLE IF NOT EXISTS {self.table} "
+                "(event_time TIMESTAMPTZ DEFAULT now(), value JSONB)")
+        self._ready = True
+
+    def send(self, record: dict) -> None:
+        q = self._quote
+        self._ensure_table()
+        if self.fmt == "namespace":
+            key = _event_key(record)
+            if _is_removal(record):
+                self.client.execute(
+                    f"DELETE FROM {self.table} WHERE key = {q(key)}")
+            else:
+                val = q(json.dumps(record, separators=(",", ":")))
+                self.client.execute(
+                    f"INSERT INTO {self.table} (key, value) VALUES "
+                    f"({q(key)}, {val}) ON CONFLICT (key) "
+                    f"DO UPDATE SET value = {val}")
+        else:
+            val = q(json.dumps(_envelope(record), separators=(",", ":")))
+            self.client.execute(
+                f"INSERT INTO {self.table} (value) VALUES ({val})")

@@ -512,3 +512,159 @@ class NSQClient(_SocketClient):
 
 __all__ = ["WireError", "RESPClient", "MQTTClient", "KafkaProducer",
            "AMQPPublisher", "NATSClient", "NSQClient"]
+
+
+# --- PostgreSQL (frontend/backend protocol v3) -----------------------------
+
+
+class PGServerError(RuntimeError):
+    """Server-reported SQL error on a healthy connection — retrying or
+    reconnecting cannot fix it, so it must NOT trip the transport-level
+    retry path."""
+
+
+class PostgresClient(_SocketClient):
+    """Simple-query PostgreSQL client (startup; trust, cleartext, md5
+    and SCRAM-SHA-256 auth; 'Q' simple queries) — enough for the event
+    target's INSERT/UPDATE/DELETE statements, with no driver dependency
+    (reference pkg/event/target/postgresql.go uses lib/pq)."""
+
+    def __init__(self, host: str, port: int, user: str, database: str,
+                 password: str = "", timeout_s: float = 5.0):
+        super().__init__(host, port, timeout_s)
+        self.user = user
+        self.database = database
+        self.password = password
+
+    def _handshake(self, s: socket.socket) -> None:
+        # standard_conforming_strings is pinned ON so pg_quote's
+        # ''-doubling is injection-safe regardless of server defaults
+        # (with it off, a backslash could escape the closing quote)
+        params = (f"user\0{self.user}\0database\0{self.database}\0"
+                  "options\0-c standard_conforming_strings=on\0\0"
+                  ).encode()
+        body = struct.pack(">i", 196608) + params  # protocol 3.0
+        s.sendall(struct.pack(">i", len(body) + 4) + body)
+        while True:
+            mtype, payload = self._read_msg(s)
+            if mtype == b"R":
+                code = struct.unpack(">i", payload[:4])[0]
+                if code == 0:
+                    continue  # AuthenticationOk
+                if code == 3:  # cleartext password
+                    self._send_msg(s, b"p", self.password.encode() + b"\0")
+                    continue
+                if code == 5:  # md5: md5(md5(password+user)+salt)
+                    import hashlib
+                    salt = payload[4:8]
+                    inner = hashlib.md5(
+                        (self.password + self.user).encode()).hexdigest()
+                    outer = hashlib.md5(
+                        inner.encode() + salt).hexdigest()
+                    self._send_msg(s, b"p",
+                                   b"md5" + outer.encode() + b"\0")
+                    continue
+                if code == 10:  # SASL (modern default: SCRAM-SHA-256)
+                    mechs = payload[4:].split(b"\0")
+                    if b"SCRAM-SHA-256" not in mechs:
+                        raise WireError(
+                            f"postgres SASL mechanisms {mechs} "
+                            "not supported")
+                    self._scram(s)
+                    continue
+                raise WireError(f"postgres auth method {code} "
+                                "not supported")
+            if mtype == b"E":
+                raise WireError(f"postgres: {_pg_error(payload)}")
+            if mtype == b"Z":  # ReadyForQuery
+                return
+            # 'S' ParameterStatus / 'K' BackendKeyData / 'N' notices
+
+    def _scram(self, s: socket.socket) -> None:
+        """SCRAM-SHA-256 (RFC 5802/7677) — PostgreSQL 14+'s default
+        password_encryption."""
+        import base64
+        import hashlib
+        import hmac as _hmac
+        import secrets
+        nonce = base64.b64encode(secrets.token_bytes(18)).decode()
+        client_first_bare = f"n={self.user},r={nonce}"
+        initial = b"n,," + client_first_bare.encode()
+        self._send_msg(s, b"p", b"SCRAM-SHA-256\0" +
+                       struct.pack(">i", len(initial)) + initial)
+        mtype, payload = self._read_msg(s)
+        if mtype == b"E":
+            raise WireError(f"postgres: {_pg_error(payload)}")
+        if mtype != b"R" or struct.unpack(">i", payload[:4])[0] != 11:
+            raise WireError("postgres: unexpected SASL continue")
+        server_first = payload[4:].decode()
+        attrs = dict(p.split("=", 1) for p in server_first.split(","))
+        r, salt_b64, iters = attrs["r"], attrs["s"], int(attrs["i"])
+        if not r.startswith(nonce):
+            raise WireError("postgres: SCRAM nonce mismatch")
+        salted = hashlib.pbkdf2_hmac("sha256", self.password.encode(),
+                                     base64.b64decode(salt_b64), iters)
+        client_key = _hmac.new(salted, b"Client Key",
+                               hashlib.sha256).digest()
+        stored_key = hashlib.sha256(client_key).digest()
+        without_proof = f"c=biws,r={r}"
+        auth_msg = ",".join([client_first_bare, server_first,
+                             without_proof]).encode()
+        sig = _hmac.new(stored_key, auth_msg, hashlib.sha256).digest()
+        proof = bytes(a ^ b for a, b in zip(client_key, sig))
+        final = (without_proof + ",p=" +
+                 base64.b64encode(proof).decode()).encode()
+        self._send_msg(s, b"p", final)
+        mtype, payload = self._read_msg(s)
+        if mtype == b"E":
+            raise WireError(f"postgres: {_pg_error(payload)}")
+        if mtype != b"R" or struct.unpack(">i", payload[:4])[0] != 12:
+            raise WireError("postgres: unexpected SASL final")
+        server_final = payload[4:].decode()
+        server_key = _hmac.new(salted, b"Server Key",
+                               hashlib.sha256).digest()
+        want = base64.b64encode(_hmac.new(
+            server_key, auth_msg, hashlib.sha256).digest()).decode()
+        if dict(p.split("=", 1) for p in
+                server_final.split(",")).get("v") != want:
+            raise WireError("postgres: server signature mismatch")
+
+    def _send_msg(self, s: socket.socket, mtype: bytes, payload: bytes):
+        s.sendall(mtype + struct.pack(">i", len(payload) + 4) + payload)
+
+    def _read_msg(self, s: socket.socket) -> tuple[bytes, bytes]:
+        head = self._recv_exact(s, 5)
+        ln = struct.unpack(">i", head[1:])[0]
+        return head[:1], self._recv_exact(s, ln - 4)
+
+    def execute(self, sql: str) -> None:
+        """Run one simple query. Transport failures reconnect-and-retry
+        once; a server-reported SQL error arrives on a HEALTHY
+        connection (ReadyForQuery follows it) and raises PGServerError
+        without the pointless reconnect/re-execute."""
+        def op(s):
+            self._send_msg(s, b"Q", sql.encode() + b"\0")
+            err = None
+            while True:
+                mtype, payload = self._read_msg(s)
+                if mtype == b"E":
+                    err = _pg_error(payload)
+                elif mtype == b"Z":
+                    if err:
+                        raise PGServerError(f"postgres: {err}")
+                    return
+                # 'C' CommandComplete, 'T'/'D' row data, 'N' notices
+        self._retry_once(lambda s: op(s))
+
+
+def _pg_error(payload: bytes) -> str:
+    fields = {}
+    for part in payload.split(b"\0"):
+        if len(part) >= 2:
+            fields[chr(part[0])] = part[1:].decode("utf-8", "replace")
+    return fields.get("M", "unknown error")
+
+
+def pg_quote(s: str) -> str:
+    """Standard-conforming string literal ('' doubling)."""
+    return "'" + s.replace("'", "''") + "'"

@@ -1580,9 +1580,14 @@ class _S3Handler(BaseHTTPRequestHandler):
         ct = self.hdr.get("content-type")
         if not ct and self.key:
             # extension-based detection (reference mimedb, a 4,632-line
-            # generated table; the stdlib registry covers the same role)
+            # generated table; the stdlib registry covers the same
+            # role). Compressed extensions report an encoding — there
+            # the inner type would mislead clients (.tar.gz is not a
+            # plain tar), so fall back to octet-stream.
             import mimetypes
-            ct = mimetypes.guess_type(self.key, strict=False)[0]
+            guess, encoding = mimetypes.guess_type(self.key, strict=False)
+            if encoding is None:
+                ct = guess
         if ct:
             out["content-type"] = ct
         for k, v in self.hdr.items():

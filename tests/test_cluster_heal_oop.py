@@ -160,8 +160,6 @@ def test_service_restart_and_stop(tmp_path):
     import sys as _sys
     import time as _time
 
-    import requests as rq
-
     from minio_tpu.madmin import AdminClient
     port = free_port()
     env = dict(os.environ, MINIO_TPU_ROOT_USER="svc",

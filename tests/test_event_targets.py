@@ -477,3 +477,195 @@ def test_e2e_s3_put_to_mqtt_broker(tmp_path):
     finally:
         s3.shutdown()
         srv_b.close()
+
+
+# --- postgresql ------------------------------------------------------------
+
+
+def pg_handler(c, got):
+    """Stub PostgreSQL v3 backend: cleartext-password auth, simple
+    queries recorded; replies CommandComplete + ReadyForQuery."""
+    def send_msg(mtype, payload):
+        c.sendall(mtype + struct.pack(">i", len(payload) + 4) + payload)
+
+    # startup message (no type byte)
+    ln = struct.unpack(">i", recv_exact(c, 4))[0]
+    startup = recv_exact(c, ln - 4)
+    assert struct.unpack(">i", startup[:4])[0] == 196608
+    params = dict(zip(*[iter(startup[4:].decode().split("\0")[:-2])] * 2))
+    got.append(("startup", params))
+    send_msg(b"R", struct.pack(">i", 3))              # cleartext password
+    head = recv_exact(c, 5)
+    assert head[:1] == b"p"
+    pwd = recv_exact(c, struct.unpack(">i", head[1:])[0] - 4)
+    got.append(("password", pwd.rstrip(b"\0").decode()))
+    send_msg(b"R", struct.pack(">i", 0))              # AuthenticationOk
+    send_msg(b"S", b"server_version\x0016.0\x00")
+    send_msg(b"Z", b"I")                              # ReadyForQuery
+    while True:
+        head = recv_exact(c, 5)
+        if head[:1] != b"Q":
+            return
+        sql = recv_exact(c, struct.unpack(">i", head[1:])[0] - 4)
+        got.append(("query", sql.rstrip(b"\0").decode()))
+        send_msg(b"C", b"INSERT 0 1\x00")
+        send_msg(b"Z", b"I")
+
+
+def test_postgres_target_namespace():
+    from minio_tpu.event import PostgresTarget
+    srv = MockServer(pg_handler)
+    t = PostgresTarget("1", f"127.0.0.1:{srv.port}", "minio",
+                       user="mu", password="mp")
+    t.send(RECORD)
+    t.send(DEL_RECORD)
+    startups = [v for k, v in srv.got if k == "startup"]
+    assert startups and startups[0]["user"] == "mu"
+    assert startups[0]["database"] == "minio"
+    # injection safety does not depend on server defaults
+    assert "standard_conforming_strings=on" in startups[0].get(
+        "options", "")
+    assert ("password", "mp") in srv.got
+    queries = [q for kind, q in srv.got if kind == "query"]
+    assert any(q.startswith("CREATE TABLE IF NOT EXISTS minio_events")
+               for q in queries)
+    assert any("ON CONFLICT (key) DO UPDATE" in q and "b/k.txt" in q
+               for q in queries)
+    assert any(q.startswith("DELETE FROM minio_events") for q in queries)
+    assert srv.error is None
+    srv.close()
+
+
+def test_postgres_target_access_log():
+    from minio_tpu.event import PostgresTarget
+    srv = MockServer(pg_handler)
+    t = PostgresTarget("1", f"127.0.0.1:{srv.port}", "minio",
+                       fmt="access", user="u")
+    t.send(RECORD)
+    queries = [q for kind, q in srv.got if kind == "query"]
+    assert any("event_time" in q for q in queries)  # access-log schema
+    assert any(q.startswith("INSERT INTO minio_events (value)")
+               for q in queries)
+    assert srv.error is None
+    srv.close()
+
+
+def test_postgres_quote_injection_safe():
+    from minio_tpu.event.wire import pg_quote
+    assert pg_quote("o'; DROP TABLE x; --") == "'o''; DROP TABLE x; --'"
+
+
+def test_postgres_rejects_bad_table():
+    from minio_tpu.event import PostgresTarget
+    with pytest.raises(ValueError):
+        PostgresTarget("1", "127.0.0.1:5432", "db",
+                       table="evil; DROP TABLE x")
+
+
+def pg_scram_handler(c, got):
+    """Stub PG backend requiring SCRAM-SHA-256 (the PostgreSQL 14+
+    default), verifying the client proof for password 'scrampass'."""
+    import base64
+    import hashlib
+    import hmac as hm
+    import secrets as sec
+
+    def send_msg(mtype, payload):
+        c.sendall(mtype + struct.pack(">i", len(payload) + 4) + payload)
+
+    ln = struct.unpack(">i", recv_exact(c, 4))[0]
+    recv_exact(c, ln - 4)  # startup
+    send_msg(b"R", struct.pack(">i", 10) + b"SCRAM-SHA-256\x00\x00")
+    head = recv_exact(c, 5)
+    body = recv_exact(c, struct.unpack(">i", head[1:])[0] - 4)
+    mech, rest = body.split(b"\x00", 1)
+    assert mech == b"SCRAM-SHA-256"
+    initial = rest[4:].decode()
+    client_first_bare = initial.split(",", 2)[2]
+    cnonce = dict(p.split("=", 1)
+                  for p in client_first_bare.split(","))["r"]
+    snonce = cnonce + base64.b64encode(sec.token_bytes(9)).decode()
+    salt = sec.token_bytes(16)
+    iters = 4096
+    server_first = (f"r={snonce},s={base64.b64encode(salt).decode()},"
+                    f"i={iters}")
+    send_msg(b"R", struct.pack(">i", 11) + server_first.encode())
+    head = recv_exact(c, 5)
+    final = recv_exact(c, struct.unpack(">i", head[1:])[0] - 4).decode()
+    fattrs = dict(p.split("=", 1) for p in final.split(","))
+    salted = hashlib.pbkdf2_hmac("sha256", b"scrampass", salt, iters)
+    client_key = hm.new(salted, b"Client Key", hashlib.sha256).digest()
+    stored = hashlib.sha256(client_key).digest()
+    without_proof = final.rsplit(",p=", 1)[0]
+    auth_msg = ",".join([client_first_bare, server_first,
+                         without_proof]).encode()
+    sig = hm.new(stored, auth_msg, hashlib.sha256).digest()
+    want = bytes(a ^ b for a, b in zip(client_key, sig))
+    assert base64.b64decode(fattrs["p"]) == want, "bad client proof"
+    got.append(("scram", "verified"))
+    server_key = hm.new(salted, b"Server Key", hashlib.sha256).digest()
+    v = base64.b64encode(
+        hm.new(server_key, auth_msg, hashlib.sha256).digest()).decode()
+    send_msg(b"R", struct.pack(">i", 12) + f"v={v}".encode())
+    send_msg(b"R", struct.pack(">i", 0))
+    send_msg(b"Z", b"I")
+    while True:
+        head = recv_exact(c, 5)
+        if head[:1] != b"Q":
+            return
+        sql = recv_exact(c, struct.unpack(">i", head[1:])[0] - 4)
+        got.append(("query", sql.rstrip(b"\x00").decode()))
+        send_msg(b"C", b"INSERT 0 1\x00")
+        send_msg(b"Z", b"I")
+
+
+def test_postgres_scram_auth():
+    from minio_tpu.event import PostgresTarget
+    srv = MockServer(pg_scram_handler)
+    t = PostgresTarget("1", f"127.0.0.1:{srv.port}", "minio",
+                       user="su", password="scrampass")
+    t.send(RECORD)
+    assert ("scram", "verified") in srv.got
+    assert any(k == "query" for k, _ in srv.got)
+    assert srv.error is None
+    srv.close()
+
+
+def test_postgres_sql_error_no_retry():
+    """A server SQL error must surface once — not re-execute the
+    statement through the transport retry."""
+    attempts = []
+
+    def err_handler(c, got):
+        def send_msg(mtype, payload):
+            c.sendall(mtype + struct.pack(">i", len(payload) + 4)
+                      + payload)
+        ln = struct.unpack(">i", recv_exact(c, 4))[0]
+        recv_exact(c, ln - 4)
+        send_msg(b"R", struct.pack(">i", 0))
+        send_msg(b"Z", b"I")
+        while True:
+            head = recv_exact(c, 5)
+            if head[:1] != b"Q":
+                return
+            recv_exact(c, struct.unpack(">i", head[1:])[0] - 4)
+            attempts.append(1)
+            send_msg(b"E", b"SMERROR\x00Mpermission denied\x00\x00")
+            send_msg(b"Z", b"I")
+
+    from minio_tpu.event import PostgresTarget
+    from minio_tpu.event.wire import PGServerError
+    srv = MockServer(err_handler)
+    t = PostgresTarget("1", f"127.0.0.1:{srv.port}", "minio")
+    with pytest.raises(PGServerError, match="permission denied"):
+        t.send(RECORD)
+    assert len(attempts) == 1  # executed once, no transport retry
+    srv.close()
+
+
+def test_postgres_fmt_validated():
+    from minio_tpu.event import PostgresTarget
+    with pytest.raises(ValueError):
+        PostgresTarget("1", "127.0.0.1:5432", "db", fmt="Namespace")
+    with pytest.raises(ValueError):
+        PostgresTarget("1", "127.0.0.1:5432", "db", table="1starts")
