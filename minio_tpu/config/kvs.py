@@ -150,6 +150,17 @@ SUB_SYSTEMS: dict[str, dict[str, KV]] = {
         "nsqd_address": KV("", env="MINIO_TPU_NOTIFY_NSQ_NSQD_ADDRESS"),
         "topic": KV("minio", env="MINIO_TPU_NOTIFY_NSQ_TOPIC"),
     },
+    "notify_mysql": {
+        "enable": KV("off", env="MINIO_TPU_NOTIFY_MYSQL_ENABLE"),
+        "address": KV("", env="MINIO_TPU_NOTIFY_MYSQL_ADDRESS",
+                      help="host:port of the MySQL server"),
+        "database": KV("minio", env="MINIO_TPU_NOTIFY_MYSQL_DATABASE"),
+        "table": KV("minio_events", env="MINIO_TPU_NOTIFY_MYSQL_TABLE"),
+        "user": KV("root", env="MINIO_TPU_NOTIFY_MYSQL_USER"),
+        "password": KV("", env="MINIO_TPU_NOTIFY_MYSQL_PASSWORD"),
+        "format": KV("namespace", env="MINIO_TPU_NOTIFY_MYSQL_FORMAT",
+                     help="namespace|access"),
+    },
     "notify_postgres": {
         "enable": KV("off", env="MINIO_TPU_NOTIFY_POSTGRES_ENABLE"),
         "address": KV("", env="MINIO_TPU_NOTIFY_POSTGRES_ADDRESS",

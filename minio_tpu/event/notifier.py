@@ -53,6 +53,7 @@ def targets_from_config(cfg, region: str = "us-east-1") -> list:
         "notify_mqtt": "broker", "notify_redis": "address",
         "notify_elasticsearch": "url", "notify_nats": "address",
         "notify_nsq": "nsqd_address", "notify_postgres": "address",
+        "notify_mysql": "address",
     }
     builders = [
         ("notify_kafka", lambda: T.KafkaTarget(
@@ -88,6 +89,13 @@ def targets_from_config(cfg, region: str = "us-east-1") -> list:
         ("notify_nsq", lambda: T.NSQTarget(
             "1", cfg.get("notify_nsq", "nsqd_address"),
             cfg.get("notify_nsq", "topic"), region)),
+        ("notify_mysql", lambda: T.MySQLTarget(
+            "1", cfg.get("notify_mysql", "address"),
+            cfg.get("notify_mysql", "database"),
+            cfg.get("notify_mysql", "table"),
+            cfg.get("notify_mysql", "user"),
+            cfg.get("notify_mysql", "password"),
+            cfg.get("notify_mysql", "format"), region)),
         ("notify_postgres", lambda: T.PostgresTarget(
             "1", cfg.get("notify_postgres", "address"),
             cfg.get("notify_postgres", "database"),

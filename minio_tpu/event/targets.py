@@ -1,5 +1,5 @@
 """Delivery targets (reference pkg/event/target/: webhook, kafka, amqp,
-mqtt, redis, elasticsearch, nats, nsq — each the same contract: send one
+mqtt, redis, elasticsearch, nats, nsq, postgresql, mysql — each the same contract: send one
 event envelope, raise on failure, the queue store retries).
 
 Broker-backed targets ride the minimal wire-protocol publishers in
@@ -257,47 +257,50 @@ class NSQTarget:
             _envelope(record), separators=(",", ":")).encode())
 
 
-class PostgresTarget:
-    """PostgreSQL event target (reference pkg/event/target/postgresql.go,
-    lib/pq replaced by the in-tree wire client): namespace format
-    upserts/deletes one row per object key, access format appends an
-    event log row. Tables are created on first use."""
+class _SQLEventTarget:
+    """Shared machinery of the SQL-mirroring targets (postgresql,
+    mysql): table-name/format validation, lazy table creation, and the
+    namespace-upsert / namespace-delete / access-append statement shape.
+    Subclasses supply the wire client, quoting, DDL and upsert syntax."""
 
-    KIND = "postgresql"
+    KIND = ""
 
-    def __init__(self, target_id: str, addr: str, database: str,
-                 table: str = "minio_events", user: str = "postgres",
-                 password: str = "", fmt: str = "namespace",
-                 region: str = "us-east-1", timeout_s: float = 5.0):
+    def __init__(self, target_id: str, table: str, fmt: str,
+                 region: str):
         import re
-
-        from .wire import PostgresClient, pg_quote
-        self.id = target_id
-        host, _, port = addr.partition(":")
-        self.client = PostgresClient(host, int(port or 5432), user,
-                                     database, password, timeout_s)
         if not re.fullmatch(r"[A-Za-z_][A-Za-z0-9_]*", table):
-            raise ValueError(f"invalid postgres table name {table!r}")
+            raise ValueError(f"invalid {self.KIND} table name {table!r}")
         if fmt not in ("namespace", "access"):
-            raise ValueError(f"invalid postgres format {fmt!r} "
+            raise ValueError(f"invalid {self.KIND} format {fmt!r} "
                              "(namespace|access)")
+        self.id = target_id
         self.table = table
         self.fmt = fmt
-        self._quote = pg_quote
         self._ready = False
-        self.arn = f"arn:minio:sqs:{region}:{target_id}:postgresql"
+        self.arn = f"arn:minio:sqs:{region}:{target_id}:{self.KIND}"
 
+    # subclass hooks -------------------------------------------------------
+    def _quote(self, s: str) -> str:
+        raise NotImplementedError
+
+    def _ddl_namespace(self) -> str:
+        raise NotImplementedError
+
+    def _ddl_access(self) -> str:
+        raise NotImplementedError
+
+    def _upsert(self, key: str, val: str) -> str:
+        raise NotImplementedError
+
+    KEY_COLUMN = "obj_key"
+
+    # shared ---------------------------------------------------------------
     def _ensure_table(self) -> None:
         if self._ready:
             return
-        if self.fmt == "namespace":
-            self.client.execute(
-                f"CREATE TABLE IF NOT EXISTS {self.table} "
-                "(key TEXT PRIMARY KEY, value JSONB)")
-        else:
-            self.client.execute(
-                f"CREATE TABLE IF NOT EXISTS {self.table} "
-                "(event_time TIMESTAMPTZ DEFAULT now(), value JSONB)")
+        self.client.execute(self._ddl_namespace()
+                            if self.fmt == "namespace"
+                            else self._ddl_access())
         self._ready = True
 
     def send(self, record: dict) -> None:
@@ -307,14 +310,83 @@ class PostgresTarget:
             key = _event_key(record)
             if _is_removal(record):
                 self.client.execute(
-                    f"DELETE FROM {self.table} WHERE key = {q(key)}")
+                    f"DELETE FROM {self.table} "
+                    f"WHERE {self.KEY_COLUMN} = {q(key)}")
             else:
-                val = q(json.dumps(record, separators=(",", ":")))
-                self.client.execute(
-                    f"INSERT INTO {self.table} (key, value) VALUES "
-                    f"({q(key)}, {val}) ON CONFLICT (key) "
-                    f"DO UPDATE SET value = {val}")
+                self.client.execute(self._upsert(
+                    q(key),
+                    q(json.dumps(record, separators=(",", ":")))))
         else:
             val = q(json.dumps(_envelope(record), separators=(",", ":")))
             self.client.execute(
                 f"INSERT INTO {self.table} (value) VALUES ({val})")
+
+
+class PostgresTarget(_SQLEventTarget):
+    """PostgreSQL event target (reference pkg/event/target/postgresql.go,
+    lib/pq replaced by the in-tree wire client)."""
+
+    KIND = "postgresql"
+    KEY_COLUMN = "key"
+
+    def __init__(self, target_id: str, addr: str, database: str,
+                 table: str = "minio_events", user: str = "postgres",
+                 password: str = "", fmt: str = "namespace",
+                 region: str = "us-east-1", timeout_s: float = 5.0):
+        from .wire import PostgresClient
+        super().__init__(target_id, table, fmt, region)
+        host, _, port = addr.partition(":")
+        self.client = PostgresClient(host, int(port or 5432), user,
+                                     database, password, timeout_s)
+
+    def _quote(self, s: str) -> str:
+        from .wire import pg_quote
+        return pg_quote(s)
+
+    def _ddl_namespace(self) -> str:
+        return (f"CREATE TABLE IF NOT EXISTS {self.table} "
+                "(key TEXT PRIMARY KEY, value JSONB)")
+
+    def _ddl_access(self) -> str:
+        return (f"CREATE TABLE IF NOT EXISTS {self.table} "
+                "(event_time TIMESTAMPTZ DEFAULT now(), value JSONB)")
+
+    def _upsert(self, key: str, val: str) -> str:
+        return (f"INSERT INTO {self.table} (key, value) VALUES "
+                f"({key}, {val}) ON CONFLICT (key) "
+                f"DO UPDATE SET value = {val}")
+
+
+class MySQLTarget(_SQLEventTarget):
+    """MySQL event target (reference pkg/event/target/mysql.go)."""
+
+    KIND = "mysql"
+
+    def __init__(self, target_id: str, addr: str, database: str,
+                 table: str = "minio_events", user: str = "root",
+                 password: str = "", fmt: str = "namespace",
+                 region: str = "us-east-1", timeout_s: float = 5.0):
+        from .wire import MySQLClient
+        super().__init__(target_id, table, fmt, region)
+        host, _, port = addr.partition(":")
+        self.client = MySQLClient(host, int(port or 3306), user,
+                                  database, password, timeout_s)
+
+    def _quote(self, s: str) -> str:
+        from .wire import mysql_quote
+        return mysql_quote(s)
+
+    def _ddl_namespace(self) -> str:
+        # VARCHAR(768): utf8mb4 (4 B/char) keeps the PK under InnoDB's
+        # 3072-byte index-key limit; S3 keys cap at 1024 bytes anyway
+        return (f"CREATE TABLE IF NOT EXISTS {self.table} "
+                "(obj_key VARCHAR(768) PRIMARY KEY, value JSON)")
+
+    def _ddl_access(self) -> str:
+        return (f"CREATE TABLE IF NOT EXISTS {self.table} "
+                "(event_time TIMESTAMP DEFAULT CURRENT_TIMESTAMP, "
+                "value JSON)")
+
+    def _upsert(self, key: str, val: str) -> str:
+        return (f"INSERT INTO {self.table} (obj_key, value) VALUES "
+                f"({key}, {val}) ON DUPLICATE KEY UPDATE value = {val}")

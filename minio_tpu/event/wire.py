@@ -668,3 +668,118 @@ def _pg_error(payload: bytes) -> str:
 def pg_quote(s: str) -> str:
     """Standard-conforming string literal ('' doubling)."""
     return "'" + s.replace("'", "''") + "'"
+
+
+# --- MySQL (client/server protocol) ----------------------------------------
+
+
+class MySQLServerError(RuntimeError):
+    """Server-reported SQL error on a healthy connection (ERR packet
+    after the command) — not a transport failure, never retried."""
+
+
+class MySQLClient(_SocketClient):
+    """Minimal MySQL client: handshake v10 with mysql_native_password
+    auth, COM_QUERY text protocol — what the event target needs
+    (reference pkg/event/target/mysql.go uses go-sql-driver). The
+    caching_sha2_password full-auth path needs TLS or RSA key exchange;
+    servers wanting this target over plain TCP enable
+    mysql_native_password for the event user."""
+
+    #: LONG_PASSWORD(0x1) | CONNECT_WITH_DB(0x8) | PROTOCOL_41(0x200) |
+    #: TRANSACTIONS(0x2000) | SECURE_CONNECTION(0x8000) |
+    #: PLUGIN_AUTH(0x80000) — the response appends database and
+    #: auth-plugin fields, so those capabilities MUST be announced or a
+    #: strict server misparses the packet
+    CLIENT_FLAGS = 0x0008_A209
+
+    def __init__(self, host: str, port: int, user: str, database: str,
+                 password: str = "", timeout_s: float = 5.0):
+        super().__init__(host, port, timeout_s)
+        self.user = user
+        self.database = database
+        self.password = password
+
+    # -- packet framing: 3-byte little-endian length + sequence id ----------
+
+    def _read_packet(self, s: socket.socket) -> tuple[int, bytes]:
+        head = self._recv_exact(s, 4)
+        ln = head[0] | (head[1] << 8) | (head[2] << 16)
+        return head[3], self._recv_exact(s, ln)
+
+    def _send_packet(self, s: socket.socket, seq: int, payload: bytes):
+        ln = len(payload)
+        s.sendall(bytes((ln & 0xFF, (ln >> 8) & 0xFF, (ln >> 16) & 0xFF,
+                         seq)) + payload)
+
+    def _handshake(self, s: socket.socket) -> None:
+        import hashlib
+        seq, pkt = self._read_packet(s)
+        if pkt[:1] == b"\xff":
+            raise WireError(f"mysql: {pkt[3:].decode('utf-8', 'replace')}")
+        if pkt[0] != 10:
+            raise WireError(f"mysql protocol version {pkt[0]}")
+        i = pkt.index(b"\0", 1) + 1    # skip server version
+        i += 4                          # thread id
+        auth1 = pkt[i:i + 8]
+        i += 8 + 1                      # filler
+        i += 2 + 1 + 2 + 2              # caps low, charset, status, caps hi
+        auth_len = pkt[i]
+        i += 1 + 10                     # reserved
+        auth2 = pkt[i:i + max(13, auth_len - 8)]
+        salt = (auth1 + auth2).rstrip(b"\0")[:20]
+        plugin = pkt[i + max(13, auth_len - 8):].split(b"\0")[0]
+        if plugin and plugin != b"mysql_native_password":
+            raise WireError(
+                f"mysql auth plugin {plugin.decode()} not supported; "
+                "enable mysql_native_password for this user")
+        if self.password:
+            sha_pwd = hashlib.sha1(self.password.encode()).digest()
+            rehash = hashlib.sha1(salt + hashlib.sha1(
+                sha_pwd).digest()).digest()
+            token = bytes(a ^ b for a, b in zip(sha_pwd, rehash))
+        else:
+            token = b""
+        resp = struct.pack("<IIB23x", self.CLIENT_FLAGS, 1 << 24, 45)
+        resp += self.user.encode() + b"\0"
+        resp += bytes([len(token)]) + token
+        resp += self.database.encode() + b"\0"
+        resp += b"mysql_native_password\0"
+        self._send_packet(s, seq + 1, resp)
+        _, pkt = self._read_packet(s)
+        if pkt[:1] == b"\xff":
+            raise WireError(
+                f"mysql auth: {pkt[3:].decode('utf-8', 'replace')}")
+        if pkt[:1] == b"\xfe":
+            raise WireError("mysql: server requested auth method switch; "
+                            "enable mysql_native_password")
+        # pin escaping semantics for this session: mysql_quote doubles
+        # backslashes, which is only correct while NO_BACKSLASH_ESCAPES
+        # is off (the Postgres client pins its equivalent GUC the same
+        # way)
+        self._send_packet(s, 0, b"\x03SET SESSION sql_mode=(SELECT "
+                          b"REPLACE(@@SESSION.sql_mode,"
+                          b"'NO_BACKSLASH_ESCAPES',''))")
+        _, pkt = self._read_packet(s)
+        if pkt[:1] == b"\xff":
+            raise WireError(
+                f"mysql sql_mode: {pkt[3:].decode('utf-8', 'replace')}")
+
+    def execute(self, sql: str) -> None:
+        def op(s):
+            self._send_packet(s, 0, b"\x03" + sql.encode())
+            _, pkt = self._read_packet(s)
+            if pkt[:1] == b"\xff":
+                code = struct.unpack("<H", pkt[1:3])[0]
+                raise MySQLServerError(
+                    f"mysql error {code}: "
+                    f"{pkt[3:].decode('utf-8', 'replace')}")
+            # OK packet (or result set header for SELECTs, unused here)
+        self._retry_once(lambda s: op(s))
+
+
+def mysql_quote(s: str) -> str:
+    """String literal with backslash AND quote escaping — correct under
+    the backslash-escapes semantics the client pins at handshake (the
+    session's NO_BACKSLASH_ESCAPES mode is stripped)."""
+    return "'" + s.replace("\\", "\\\\").replace("'", "''") + "'"

@@ -669,3 +669,89 @@ def test_postgres_fmt_validated():
         PostgresTarget("1", "127.0.0.1:5432", "db", fmt="Namespace")
     with pytest.raises(ValueError):
         PostgresTarget("1", "127.0.0.1:5432", "db", table="1starts")
+
+
+# --- mysql -----------------------------------------------------------------
+
+
+def mysql_handler(c, got):
+    """Stub MySQL server: handshake v10 + mysql_native_password auth
+    verification for password 'mypass', COM_QUERY recorded."""
+    import hashlib
+
+    def send_packet(seq, payload):
+        ln = len(payload)
+        c.sendall(bytes((ln & 0xFF, (ln >> 8) & 0xFF, (ln >> 16) & 0xFF,
+                         seq)) + payload)
+
+    def read_packet():
+        head = recv_exact(c, 4)
+        ln = head[0] | (head[1] << 8) | (head[2] << 16)
+        return head[3], recv_exact(c, ln)
+
+    salt = bytes(range(1, 21))
+    greet = (bytes([10]) + b"8.0.0-stub\x00" +
+             struct.pack("<I", 7) + salt[:8] + b"\x00" +
+             b"\xff\xff" + bytes([45]) + b"\x02\x00" + b"\x08\x00" +
+             bytes([21]) + b"\x00" * 10 + salt[8:] + b"\x00" +
+             b"mysql_native_password\x00")
+    send_packet(0, greet)
+    seq, resp = read_packet()
+    # HandshakeResponse41: flags(4) maxpkt(4) charset(1) filler(23)
+    user_end = resp.index(b"\x00", 32)
+    user = resp[32:user_end].decode()
+    tok_len = resp[user_end + 1]
+    token = resp[user_end + 2:user_end + 2 + tok_len]
+    sha_pwd = hashlib.sha1(b"mypass").digest()
+    want = bytes(a ^ b for a, b in zip(
+        sha_pwd, hashlib.sha1(salt + hashlib.sha1(
+            sha_pwd).digest()).digest()))
+    assert token == want, "bad native-password token"
+    got.append(("auth", user))
+    send_packet(seq + 1, b"\x00\x00\x00\x02\x00\x00\x00")  # OK
+    while True:
+        seq, pkt = read_packet()
+        if not pkt or pkt[:1] != b"\x03":
+            return
+        got.append(("query", pkt[1:].decode()))
+        if b"boom" in pkt:
+            send_packet(seq + 1, b"\xff\x28\x04#42000denied")
+        else:
+            send_packet(seq + 1, b"\x00\x00\x00\x02\x00\x00\x00")
+
+
+def test_mysql_target_namespace():
+    from minio_tpu.event import MySQLTarget
+    srv = MockServer(mysql_handler)
+    t = MySQLTarget("1", f"127.0.0.1:{srv.port}", "minio",
+                    user="muser", password="mypass")
+    t.send(RECORD)
+    t.send(DEL_RECORD)
+    assert ("auth", "muser") in srv.got
+    queries = [q for k, q in srv.got if k == "query"]
+    assert any(q.startswith("CREATE TABLE IF NOT EXISTS minio_events")
+               for q in queries)
+    assert any("ON DUPLICATE KEY UPDATE" in q and "b/k.txt" in q
+               for q in queries)
+    assert any(q.startswith("DELETE FROM minio_events") for q in queries)
+    assert srv.error is None
+    srv.close()
+
+
+def test_mysql_sql_error_no_retry():
+    from minio_tpu.event import MySQLTarget
+    from minio_tpu.event.wire import MySQLServerError
+    srv = MockServer(mysql_handler)
+    t = MySQLTarget("1", f"127.0.0.1:{srv.port}", "minio",
+                    user="muser", password="mypass", table="boom_tbl")
+    t._ready = True  # skip CREATE so the first statement errors
+    with pytest.raises(MySQLServerError, match="denied"):
+        t.client.execute("INSERT INTO boom")
+    queries = [q for k, q in srv.got if k == "query"]
+    assert queries.count("INSERT INTO boom") == 1  # no transport retry
+    srv.close()
+
+
+def test_mysql_quote_escapes_backslash():
+    from minio_tpu.event.wire import mysql_quote
+    assert mysql_quote("a\\'; DROP") == "'a\\\\''; DROP'"
