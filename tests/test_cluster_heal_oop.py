@@ -121,7 +121,9 @@ def test_three_process_wipe_and_heal(tmp_path):
         # way: heal attempts until the set reports healthy) -------------
         from minio_tpu.madmin import AdminClient
         admin = AdminClient(f"http://127.0.0.1:{ports[0]}", AK, SK)
-        deadline = time.time() + 120
+        # generous: under full-suite load the 3 subprocess nodes share
+        # one core with the test runner
+        deadline = time.time() + 240
         while time.time() < deadline:
             seq = admin.heal("hb")
             token = seq.get("clientToken", "")
