@@ -282,11 +282,32 @@ def e2e_put(rng) -> dict:
             for t in threads:
                 t.join()
             par = 8 * obj_size / (time.perf_counter() - t0) / (1 << 30)
+
+            read_errs: list = []
+
+            def reader(j):
+                try:
+                    if ol.get_object_bytes("b", f"p{j}") != body:
+                        raise AssertionError(f"p{j} bytes mismatch")
+                except BaseException as e:  # noqa: BLE001
+                    read_errs.append(e)
+
+            threads = [threading.Thread(target=reader, args=(j,))
+                       for j in range(8)]
+            t0 = time.perf_counter()
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            if read_errs:  # a thread failure must not inflate the number
+                raise read_errs[0]
+            gpar = 8 * obj_size / (time.perf_counter() - t0) / (1 << 30)
             log(f"e2e {k}+{m} 64MiB: put {gibs:.2f} get {get_gibs:.2f} "
-                f"par8 {par:.2f} GiB/s")
+                f"par8 {par:.2f} get_par8 {gpar:.2f} GiB/s")
             out[f"{k}p{m}"] = {"put": round(gibs, 2),
                                "get": round(get_gibs, 2),
-                               "put_par8": round(par, 2)}
+                               "put_par8": round(par, 2),
+                               "get_par8": round(gpar, 2)}
         finally:
             shutil.rmtree(root, ignore_errors=True)
     return out

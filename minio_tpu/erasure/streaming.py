@@ -166,6 +166,17 @@ ENCODE_WINDOW = int(os.environ.get("MINIO_TPU_ENCODE_WINDOW", "16"))
 #: 8-way-parallel PUT at window 16 vs 4 on one core.
 NATIVE_WINDOW = min(ENCODE_WINDOW, max(4, 2 * (os.cpu_count() or 1)))
 
+#: cap on per-stream in-flight payload BYTES for the native window —
+#: the window is denominated in blocks, so a bigger default block must
+#: not silently multiply peak memory per hot stream
+NATIVE_WINDOW_BYTES = int(os.environ.get(
+    "MINIO_TPU_NATIVE_WINDOW_BYTES", str(16 << 20)))
+
+
+def native_window_for(block_size: int) -> int:
+    return max(2, min(NATIVE_WINDOW,
+                      NATIVE_WINDOW_BYTES // max(1, block_size)))
+
 
 class _OrderedWriter:
     """Serializes one shard writer's writes while letting different
@@ -359,7 +370,8 @@ def erasure_encode(erasure: Erasure, stream, writers: list,
         if err is not None:
             raise err
 
-    win = NATIVE_WINDOW if native_path else ENCODE_WINDOW
+    win = native_window_for(erasure.block_size) if native_path \
+        else ENCODE_WINDOW
     eof = False
     try:
         while not eof or enc_window or write_window:
@@ -696,7 +708,8 @@ def erasure_decode(erasure: Erasure, writer, readers: list, offset: int,
         writer.write(block[boff: boff + blen])
         stats.bytes_written += blen
 
-    win = NATIVE_WINDOW if native_get else ENCODE_WINDOW
+    win = native_window_for(erasure.block_size) if native_get \
+        else ENCODE_WINDOW
     for b in range(start_block, end_block + 1):
         entry = submit(b)
         if entry is None:

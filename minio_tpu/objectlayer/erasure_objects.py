@@ -4,9 +4,9 @@ for one erasure set with the reference's quorum rules, disk shuffling by
 distribution, and heal-on-read signalling.
 
 TPU-first deltas from the reference (SURVEY.md §7): default erasure block is
-1 MiB (north-star geometry; the reference's 10 MiB suits SIMD-per-core,
-smaller blocks batch better across concurrent requests on one device), and
-all GF(256) math lands on the accelerator via minio_tpu.erasure.
+4 MiB (the reference's 10 MiB suits SIMD-per-core; see DEFAULT_BLOCK_SIZE
+below for the measured trade-off), and all GF(256) math lands on the
+accelerator via minio_tpu.erasure.
 """
 from __future__ import annotations
 
