@@ -10,6 +10,9 @@ external-cloud dependency:
   single-disk FS ObjectLayer over the mount.
 - ``s3``   — an upstream S3-compatible endpoint (reference
   cmd/gateway/s3): every call proxies over SigV4-signed HTTP.
+- ``hdfs`` — a Hadoop filesystem over the WebHDFS REST API (reference
+  cmd/gateway/hdfs uses the native protocol; the REST surface carries
+  the same operations with no Hadoop client dependency).
 """
 from __future__ import annotations
 
@@ -28,7 +31,7 @@ def new_gateway_layer(kind: str, target: str, access_key: str = "",
                       secret_key: str = "", region: str = "us-east-1"):
     """Instantiate the ObjectLayer for gateway ``kind`` over ``target``
     (a path for nas, an endpoint URL for s3)."""
-    from . import nas, s3  # noqa: F401 — populate REGISTRY
+    from . import hdfs, nas, s3  # noqa: F401 — populate REGISTRY
     cls = REGISTRY.get(kind)
     if cls is None:
         raise ValueError(

@@ -1,0 +1,518 @@
+"""HDFS gateway (reference cmd/gateway/hdfs/gateway-hdfs.go, which uses
+the colinmarc/hdfs native-protocol client; here the WebHDFS REST API —
+op=MKDIRS/CREATE/APPEND/OPEN/LISTSTATUS/GETFILESTATUS/DELETE/RENAME —
+so no Hadoop client library is needed).
+
+Layout: ``<base>/<bucket>/<object path>``. Buckets are top-level
+directories; nested object keys become directories the way the
+reference gateway stores them. Multipart staging lives under
+``<base>/.minio-tpu.sys/multipart/<upload-id>/`` and completion appends
+the parts in order into the final file (WebHDFS op=APPEND)."""
+from __future__ import annotations
+
+import hashlib
+import json
+import time
+import urllib.error
+import urllib.parse
+import urllib.request
+import uuid
+
+from ..objectlayer import datatypes as dt
+from ..objectlayer.erasure_objects import check_names
+from ..objectlayer.interface import ObjectLayer
+from . import register
+
+SYS_DIR = ".minio-tpu.sys"
+
+
+class _WebHDFS:
+    """Thin WebHDFS client. The two-step CREATE/APPEND/OPEN redirect
+    dance is followed manually so the datanode URL a namenode returns is
+    honored (urllib would re-send to the same host on 307)."""
+
+    def __init__(self, endpoint: str, user: str = "", timeout: float = 30.0):
+        self.base = endpoint.rstrip("/")
+        self.user = user
+        self.timeout = timeout
+
+    def _url(self, path: str, op: str, **params) -> str:
+        q = {"op": op, **{k: str(v) for k, v in params.items()}}
+        if self.user:
+            q["user.name"] = self.user
+        return (f"{self.base}/webhdfs/v1"
+                f"{urllib.parse.quote(path)}?"
+                f"{urllib.parse.urlencode(q)}")
+
+    def _request(self, method: str, url: str, data: bytes | None = None,
+                 follow_redirect_with_body: bool = False):
+        # the body rides the FIRST request too: HttpFS and proxied
+        # namenodes answer data ops directly (no redirect), and a
+        # bodyless first request would be acknowledged as a 0-byte
+        # write — silent data loss
+        req = urllib.request.Request(url, data=data, method=method)
+        if data is not None:
+            req.add_header("Content-Type", "application/octet-stream")
+
+        def resend(location):
+            req2 = urllib.request.Request(location, data=data,
+                                          method=method)
+            req2.add_header("Content-Type", "application/octet-stream")
+            return urllib.request.urlopen(req2, timeout=self.timeout)
+
+        try:
+            # redirects are handled manually: the body goes to the
+            # DATANODE url a namenode names, not back to the namenode
+            opener = urllib.request.build_opener(_NoRedirect)
+            resp = opener.open(req, timeout=self.timeout)
+            if resp.status == 307 and follow_redirect_with_body:
+                loc = resp.headers.get("Location")
+                resp.close()
+                if loc:
+                    return resend(loc)
+                raise dt.InvalidRequest(
+                    "", url, "webhdfs redirect without Location")
+            return resp
+        except urllib.error.HTTPError as e:
+            if e.code == 307 and follow_redirect_with_body:
+                loc = e.headers.get("Location")
+                e.close()
+                if loc:
+                    return resend(loc)
+            raise
+
+    def _json(self, method: str, path: str, op: str, **params) -> dict:
+        with self._request(method, self._url(path, op, **params)) as r:
+            body = r.read()
+            return json.loads(body) if body else {}
+
+    def mkdirs(self, path: str) -> None:
+        self._json("PUT", path, "MKDIRS")
+
+    def create(self, path: str, data: bytes, overwrite: bool = True):
+        with self._request("PUT",
+                           self._url(path, "CREATE",
+                                     overwrite="true" if overwrite
+                                     else "false"),
+                           data=data, follow_redirect_with_body=True) as r:
+            if r.status not in (200, 201):
+                raise dt.InvalidRequest("", path,
+                                        f"hdfs create: {r.status}")
+
+    def append(self, path: str, data: bytes) -> None:
+        with self._request("POST", self._url(path, "APPEND"), data=data,
+                           follow_redirect_with_body=True) as r:
+            if r.status not in (200,):
+                raise dt.InvalidRequest("", path,
+                                        f"hdfs append: {r.status}")
+
+    def open(self, path: str, offset: int = 0, length: int = -1) -> bytes:
+        params: dict = {"offset": offset}
+        if length >= 0:
+            params["length"] = length
+        with self._request("GET", self._url(path, "OPEN", **params),
+                           follow_redirect_with_body=True) as r:
+            return r.read()
+
+    def status(self, path: str) -> dict | None:
+        try:
+            return self._json("GET", path,
+                              "GETFILESTATUS")["FileStatus"]
+        except urllib.error.HTTPError as e:
+            if e.code == 404:
+                return None
+            raise
+
+    def list_status(self, path: str) -> list[dict]:
+        try:
+            return self._json("GET", path, "LISTSTATUS")[
+                "FileStatuses"]["FileStatus"]
+        except urllib.error.HTTPError as e:
+            if e.code == 404:
+                return []
+            raise
+
+    def rename(self, src: str, dst: str) -> None:
+        # atomic move (namenode metadata op); destination is replaced
+        self.delete(dst)
+        out = self._json("PUT", src, "RENAME", destination=dst)
+        if not out.get("boolean"):
+            raise dt.InvalidRequest("", src, f"hdfs rename to {dst}")
+
+    def delete(self, path: str, recursive: bool = False) -> bool:
+        return bool(self._json(
+            "DELETE", path, "DELETE",
+            recursive="true" if recursive else "false").get("boolean"))
+
+
+class _NoRedirect(urllib.request.HTTPRedirectHandler):
+    def redirect_request(self, *a, **kw):  # noqa: D102
+        return None
+
+
+def _etag_of(st: dict) -> str:
+    # deterministic pseudo-etag from mtime+length (the reference hdfs
+    # gateway likewise has no stored MD5)
+    return hashlib.md5(
+        f"{st.get('modificationTime', 0)}-{st.get('length', 0)}".encode()
+    ).hexdigest()
+
+
+def _oi(bucket: str, name: str, st: dict) -> dt.ObjectInfo:
+    return dt.ObjectInfo(
+        bucket=bucket, name=name, size=st.get("length", 0),
+        mod_time=st.get("modificationTime", 0) / 1000.0,
+        etag=_etag_of(st), is_dir=st.get("type") == "DIRECTORY",
+        content_type="application/octet-stream")
+
+
+def _read_body(bucket: str, object: str, stream, size: int) -> bytes:
+    """Read the full body, driving the stream one read past the end so
+    a HashReader verifies its Content-MD5/SHA256 (its check fires on the
+    EOF read); short bodies surface as IncompleteBody."""
+    chunks = []
+    got = 0
+    while size < 0 or got < size:
+        b = stream.read((size - got) if size >= 0 else (1 << 20))
+        if not b:
+            break
+        chunks.append(b)
+        got += len(b)
+    if size >= 0 and got < size:
+        raise dt.IncompleteBody(bucket, object)
+    stream.read(0 if size < 0 else 1)  # EOF read -> digest verification
+    return b"".join(chunks)
+
+
+@register("hdfs")
+class HDFSGateway:
+    NAME = "hdfs"
+
+    @staticmethod
+    def new_layer(target: str, access_key: str = "", secret_key: str = "",
+                  region: str = "us-east-1"):
+        """target: http(s)://namenode:9870[/base/path]; the WebHDFS user
+        defaults to the gateway access key."""
+        split = urllib.parse.urlsplit(target)
+        endpoint = f"{split.scheme}://{split.netloc}"
+        base = split.path.rstrip("/") or "/user/minio-tpu"
+        return HDFSObjects(_WebHDFS(endpoint, user=access_key), base)
+
+
+class HDFSObjects(ObjectLayer):
+    def __init__(self, client: _WebHDFS, base: str):
+        self.client = client
+        self.base = base
+        client.mkdirs(base)
+        client.mkdirs(f"{base}/{SYS_DIR}/multipart")
+
+    def backend_type(self) -> str:
+        return "Gateway:hdfs"
+
+    def _bpath(self, bucket: str) -> str:
+        return f"{self.base}/{bucket}"
+
+    def _opath(self, bucket: str, object: str) -> str:
+        # '..' traversal in a key must never escape the bucket (the
+        # erasure layer enforces the same via check_names)
+        check_names(bucket, object)
+        return f"{self.base}/{bucket}/{object}"
+
+    # --- buckets ------------------------------------------------------------
+
+    def make_bucket(self, bucket: str, opts=None) -> None:
+        check_names(bucket)
+        if self.client.status(self._bpath(bucket)) is not None:
+            raise dt.BucketExists(bucket)
+        self.client.mkdirs(self._bpath(bucket))
+
+    def get_bucket_info(self, bucket: str) -> dt.BucketInfo:
+        check_names(bucket)
+        st = self.client.status(self._bpath(bucket))
+        if st is None or st.get("type") != "DIRECTORY":
+            raise dt.BucketNotFound(bucket)
+        return dt.BucketInfo(name=bucket,
+                             created=st.get("modificationTime", 0) / 1000)
+
+    def list_buckets(self) -> list[dt.BucketInfo]:
+        out = []
+        for st in self.client.list_status(self.base):
+            name = st.get("pathSuffix", "")
+            if st.get("type") == "DIRECTORY" and name != SYS_DIR:
+                out.append(dt.BucketInfo(
+                    name=name,
+                    created=st.get("modificationTime", 0) / 1000))
+        return sorted(out, key=lambda b: b.name)
+
+    def delete_bucket(self, bucket: str, force: bool = False) -> None:
+        self.get_bucket_info(bucket)
+        if not force and any(
+                st.get("pathSuffix") for st in
+                self.client.list_status(self._bpath(bucket))):
+            raise dt.BucketNotEmpty(bucket)
+        self.client.delete(self._bpath(bucket), recursive=True)
+
+    # --- objects ------------------------------------------------------------
+
+    def put_object(self, bucket: str, object: str, stream, size: int,
+                   opts=None) -> dt.ObjectInfo:
+        self.get_bucket_info(bucket)
+        data = _read_body(bucket, object, stream, size)
+        if "/" in object:
+            parent = self._opath(bucket, object).rsplit("/", 1)[0]
+            self.client.mkdirs(parent)
+        self.client.create(self._opath(bucket, object), data)
+        etag = getattr(stream, "etag", None)
+        st = self.client.status(self._opath(bucket, object)) or {}
+        oi = _oi(bucket, object, st)
+        if callable(etag):
+            oi.etag = etag()
+        return oi
+
+    def get_object_info(self, bucket: str, object: str,
+                        opts=None) -> dt.ObjectInfo:
+        self.get_bucket_info(bucket)
+        st = self.client.status(self._opath(bucket, object))
+        if st is None or st.get("type") == "DIRECTORY":
+            raise dt.ObjectNotFound(bucket, object)
+        return _oi(bucket, object, st)
+
+    def get_object(self, bucket: str, object: str, writer, offset: int = 0,
+                   length: int = -1, opts=None) -> dt.ObjectInfo:
+        oi = self.get_object_info(bucket, object)
+        writer.write(self.client.open(self._opath(bucket, object),
+                                      offset, length))
+        return oi
+
+    def delete_object(self, bucket: str, object: str,
+                      opts=None) -> dt.ObjectInfo:
+        self.get_bucket_info(bucket)
+        self.client.delete(self._opath(bucket, object))
+        return dt.ObjectInfo(bucket=bucket, name=object,
+                             delete_marker=False)
+
+    def delete_objects(self, bucket: str, objects: list, opts=None):
+        deleted, errs = [], []
+        for o in objects:
+            name = o if isinstance(o, str) else o.get("object", "")
+            try:
+                self.delete_object(bucket, name)
+                deleted.append(dt.DeletedObject(object_name=name))
+                errs.append(None)
+            except Exception as e:  # noqa: BLE001
+                errs.append(e)
+        return deleted, errs
+
+    def list_objects(self, bucket: str, prefix: str = "", marker: str = "",
+                     delimiter: str = "", max_keys: int = 1000
+                     ) -> dt.ListObjectsInfo:
+        self.get_bucket_info(bucket)
+        names: list[tuple[str, dict]] = []
+        prefixes: set[str] = set()
+
+        def walk(dirpath: str, keybase: str):
+            for st in self.client.list_status(dirpath):
+                name = st.get("pathSuffix", "")
+                key = f"{keybase}{name}"
+                if st.get("type") == "DIRECTORY":
+                    if delimiter == "/":
+                        if (key + "/").startswith(prefix) or \
+                                prefix.startswith(key + "/"):
+                            if prefix.startswith(key + "/"):
+                                walk(f"{dirpath}/{name}", key + "/")
+                            else:
+                                prefixes.add(key + "/")
+                        continue
+                    walk(f"{dirpath}/{name}", key + "/")
+                elif key.startswith(prefix):
+                    names.append((key, st))
+
+        walk(self._bpath(bucket), "")
+        names.sort(key=lambda kv: kv[0])
+        out = dt.ListObjectsInfo()
+        for key, st in names:
+            if marker and key <= marker:
+                continue
+            if len(out.objects) >= max_keys:
+                if out.objects:
+                    out.is_truncated = True
+                    out.next_marker = out.objects[-1].name
+                break
+            out.objects.append(_oi(bucket, key, st))
+        out.prefixes = sorted(p for p in prefixes
+                              if not marker or p > marker)
+        return out
+
+    def list_object_versions(self, bucket: str, prefix: str = "",
+                             marker: str = "", version_marker: str = "",
+                             delimiter: str = "", max_keys: int = 1000):
+        listed = self.list_objects(bucket, prefix, marker, delimiter,
+                                   max_keys)
+        out = dt.ListObjectVersionsInfo()
+        out.objects = listed.objects
+        out.prefixes = listed.prefixes
+        out.is_truncated = listed.is_truncated
+        out.next_marker = listed.next_marker
+        return out
+
+    def copy_object(self, src_bucket, src_object, dst_bucket, dst_object,
+                    src_info, src_opts, dst_opts) -> dt.ObjectInfo:
+        data = self.client.open(self._opath(src_bucket, src_object))
+        import io
+        return self.put_object(dst_bucket, dst_object, io.BytesIO(data),
+                               len(data))
+
+    # --- multipart (staged parts + ordered APPEND on complete) -------------
+
+    def _mp_dir(self, upload_id: str) -> str:
+        return f"{self.base}/{SYS_DIR}/multipart/{upload_id}"
+
+    def new_multipart_upload(self, bucket: str, object: str,
+                             opts=None) -> str:
+        self.get_bucket_info(bucket)
+        upload_id = uuid.uuid4().hex
+        self.client.mkdirs(self._mp_dir(upload_id))
+        self.client.create(f"{self._mp_dir(upload_id)}/meta.json",
+                           json.dumps({"bucket": bucket,
+                                       "object": object,
+                                       "started": time.time()}).encode())
+        return upload_id
+
+    def _mp_meta(self, upload_id: str) -> dict:
+        st = self.client.status(f"{self._mp_dir(upload_id)}/meta.json")
+        if st is None:
+            raise dt.NoSuchUpload("", "", upload_id)
+        return json.loads(self.client.open(
+            f"{self._mp_dir(upload_id)}/meta.json"))
+
+    def put_object_part(self, bucket: str, object: str, upload_id: str,
+                        part_id: int, stream, size: int,
+                        opts=None) -> dt.PartInfo:
+        self._mp_meta(upload_id)
+        data = _read_body(bucket, object, stream, size)
+        self.client.create(f"{self._mp_dir(upload_id)}/part.{part_id}",
+                           data)
+        etag = getattr(stream, "etag", None)
+        etag = etag() if callable(etag) else hashlib.md5(data).hexdigest()
+        return dt.PartInfo(part_number=part_id, etag=etag, size=len(data),
+                           actual_size=len(data))
+
+    def list_object_parts(self, bucket: str, object: str, upload_id: str,
+                          part_marker: int = 0, max_parts: int = 1000
+                          ) -> dt.ListPartsInfo:
+        self._mp_meta(upload_id)
+        parts = []
+        for st in self.client.list_status(self._mp_dir(upload_id)):
+            name = st.get("pathSuffix", "")
+            if name.startswith("part."):
+                pid = int(name.split(".", 1)[1])
+                if pid > part_marker:
+                    parts.append(dt.PartInfo(
+                        part_number=pid, etag=_etag_of(st),
+                        size=st.get("length", 0),
+                        actual_size=st.get("length", 0)))
+        parts.sort(key=lambda p: p.part_number)
+        return dt.ListPartsInfo(bucket=bucket, object=object,
+                                upload_id=upload_id,
+                                parts=parts[:max_parts])
+
+    def list_multipart_uploads(self, bucket: str, prefix: str = "",
+                               max_uploads: int = 1000
+                               ) -> dt.ListMultipartsInfo:
+        out = dt.ListMultipartsInfo()
+        for st in self.client.list_status(
+                f"{self.base}/{SYS_DIR}/multipart"):
+            upload_id = st.get("pathSuffix", "")
+            try:
+                meta = self._mp_meta(upload_id)
+            except dt.NoSuchUpload:
+                continue
+            if meta.get("bucket") == bucket and \
+                    meta.get("object", "").startswith(prefix):
+                out.uploads.append(dt.MultipartInfo(
+                    object=meta["object"], upload_id=upload_id,
+                    initiated=meta.get("started", 0)))
+        out.uploads = out.uploads[:max_uploads]
+        return out
+
+    def abort_multipart_upload(self, bucket: str, object: str,
+                               upload_id: str) -> None:
+        self._mp_meta(upload_id)
+        self.client.delete(self._mp_dir(upload_id), recursive=True)
+
+    def complete_multipart_upload(self, bucket: str, object: str,
+                                  upload_id: str, parts, opts=None
+                                  ) -> dt.ObjectInfo:
+        from ..utils.hashreader import etag_from_parts
+        meta = self._mp_meta(upload_id)
+        pids = [p.part_number if hasattr(p, "part_number") else p
+                for p in parts]
+        # every named part must exist BEFORE the destination is touched:
+        # truncate-then-discover would destroy a pre-existing object
+        for pid in pids:
+            if self.client.status(
+                    f"{self._mp_dir(upload_id)}/part.{pid}") is None:
+                raise dt.InvalidPart(meta["bucket"], meta["object"],
+                                     str(pid))
+        path = self._opath(meta["bucket"], meta["object"])
+        staging = f"{self._mp_dir(upload_id)}/assembled"
+        etags = []
+        self.client.create(staging, b"")
+        for pid in pids:
+            blob = self.client.open(
+                f"{self._mp_dir(upload_id)}/part.{pid}")
+            self.client.append(staging, blob)
+            etags.append(hashlib.md5(blob).hexdigest())
+        if "/" in meta["object"]:
+            self.client.mkdirs(path.rsplit("/", 1)[0])
+        self.client.rename(staging, path)
+        self.client.delete(self._mp_dir(upload_id), recursive=True)
+        st = self.client.status(path) or {}
+        oi = _oi(bucket, meta["object"], st)
+        oi.etag = etag_from_parts(etags)
+        return oi
+
+    # --- internal config blobs (bucket metadata, IAM, usage) ---------------
+
+    def _cpath(self, path: str) -> str:
+        return f"{self.base}/{SYS_DIR}/config/{path}"
+
+    def put_config(self, path: str, data: bytes) -> None:
+        full = self._cpath(path)
+        self.client.mkdirs(full.rsplit("/", 1)[0])
+        self.client.create(full, data)
+
+    def get_config(self, path: str) -> bytes:
+        from ..utils import errors
+        if self.client.status(self._cpath(path)) is None:
+            raise errors.FileNotFound(path)
+        return self.client.open(self._cpath(path))
+
+    def delete_config(self, path: str) -> None:
+        self.client.delete(self._cpath(path))
+
+    def list_config(self, prefix: str) -> list[str]:
+        return sorted(
+            st.get("pathSuffix", "") for st in
+            self.client.list_status(self._cpath(prefix).rstrip("/"))
+            if st.get("type") == "FILE")
+
+    # --- heal / misc --------------------------------------------------------
+
+    def heal_object(self, bucket, object, version_id="", dry_run=False,
+                    remove_dangling=False, scan_mode="normal"):
+        return dt.HealResultItem()
+
+    def heal_bucket(self, bucket, dry_run=False):
+        return dt.HealResultItem()
+
+    def is_ready(self) -> bool:
+        try:
+            return self.client.status(self.base) is not None
+        except Exception:  # noqa: BLE001
+            return False
+
+    def storage_info(self) -> dict:
+        return {"backend": "hdfs", "endpoint": self.client.base,
+                "disks_online": 1 if self.is_ready() else 0,
+                "disks_offline": 0 if self.is_ready() else 1}

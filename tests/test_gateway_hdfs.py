@@ -1,0 +1,308 @@
+"""HDFS gateway over a stub WebHDFS namenode+datanode (reference
+cmd/gateway/hdfs): object CRUD, nested keys, delimiter listing,
+multipart via staged parts + APPEND, and the full S3 server stack in
+front."""
+import io
+import json
+import os
+import sys
+import threading
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(__file__))
+from s3client import S3Client  # noqa: E402
+
+from minio_tpu.gateway import new_gateway_layer  # noqa: E402
+from minio_tpu.objectlayer import datatypes as dt  # noqa: E402
+from minio_tpu.server import S3Server  # noqa: E402
+
+
+class _StubHDFS(BaseHTTPRequestHandler):
+    """In-memory WebHDFS: files {path: bytes}, dirs {path}. Data ops
+    (CREATE/APPEND/OPEN) answer with a 307 redirect to the same server
+    (?datanode=1) the way a real namenode hands off to a datanode."""
+
+    files: dict = {}
+    dirs: set = set()
+    port = 0
+
+    def log_message(self, *a):  # noqa: D102
+        pass
+
+    def _q(self):
+        split = urllib.parse.urlsplit(self.path)
+        return (urllib.parse.unquote(
+            split.path[len("/webhdfs/v1"):]) or "/",
+            dict(urllib.parse.parse_qsl(split.query)))
+
+    def _reply(self, obj=None, status=200):
+        body = json.dumps(obj).encode() if obj is not None else b""
+        self.send_response(status)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _redirect(self):
+        self.send_response(307)
+        self.send_header("Location",
+                         f"http://127.0.0.1:{self.port}{self.path}"
+                         "&datanode=1")
+        self.send_header("Content-Length", "0")
+        self.end_headers()
+
+    def _status_of(self, path):
+        if path in self.files:
+            return {"pathSuffix": path.rsplit("/", 1)[-1], "type": "FILE",
+                    "length": len(self.files[path]),
+                    "modificationTime": 1700000000000}
+        if path in self.dirs:
+            return {"pathSuffix": path.rsplit("/", 1)[-1],
+                    "type": "DIRECTORY", "length": 0,
+                    "modificationTime": 1700000000000}
+        return None
+
+    def do_PUT(self):  # noqa: N802
+        path, q = self._q()
+        op = q.get("op", "")
+        if op == "MKDIRS":
+            parts = path.strip("/").split("/")
+            for i in range(1, len(parts) + 1):
+                self.dirs.add("/" + "/".join(parts[:i]))
+            return self._reply({"boolean": True})
+        if op == "RENAME":
+            dst = q.get("destination", "")
+            ok = False
+            if path in self.files:
+                self.files[dst] = self.files.pop(path)
+                parent = dst.rsplit("/", 1)[0]
+                if parent:
+                    self.dirs.add(parent)
+                ok = True
+            return self._reply({"boolean": ok})
+        if op == "CREATE":
+            if "datanode" not in q:
+                return self._redirect()
+            ln = int(self.headers.get("Content-Length", 0) or 0)
+            self.files[path] = self.rfile.read(ln)
+            parent = path.rsplit("/", 1)[0]
+            if parent:
+                self.dirs.add(parent)
+            return self._reply(status=201)
+        self._reply({"RemoteException": {"message": "bad op"}}, 400)
+
+    def do_POST(self):  # noqa: N802
+        path, q = self._q()
+        if q.get("op") == "APPEND":
+            if "datanode" not in q:
+                return self._redirect()
+            ln = int(self.headers.get("Content-Length", 0) or 0)
+            self.files[path] = self.files.get(path, b"") + \
+                self.rfile.read(ln)
+            return self._reply(status=200)
+        self._reply(None, 400)
+
+    def do_GET(self):  # noqa: N802
+        path, q = self._q()
+        op = q.get("op", "")
+        if op == "GETFILESTATUS":
+            st = self._status_of(path)
+            if st is None:
+                return self._reply({"RemoteException":
+                                    {"message": "not found"}}, 404)
+            return self._reply({"FileStatus": st})
+        if op == "LISTSTATUS":
+            if path not in self.dirs:
+                return self._reply({"RemoteException":
+                                    {"message": "not found"}}, 404)
+            children = []
+            seen = set()
+            for p in list(self.files) + list(self.dirs):
+                if p != path and p.startswith(path.rstrip("/") + "/"):
+                    child = p[len(path.rstrip("/")) + 1:].split("/")[0]
+                    full = path.rstrip("/") + "/" + child
+                    if child and full not in seen:
+                        seen.add(full)
+                        children.append(self._status_of(full))
+            return self._reply(
+                {"FileStatuses": {"FileStatus": children}})
+        if op == "OPEN":
+            if "datanode" not in q:
+                return self._redirect()
+            data = self.files.get(path)
+            if data is None:
+                return self._reply(None, 404)
+            off = int(q.get("offset", "0"))
+            ln = int(q["length"]) if "length" in q else len(data) - off
+            blob = data[off:off + ln]
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(blob)))
+            self.end_headers()
+            self.wfile.write(blob)
+            return
+        self._reply(None, 400)
+
+    def do_DELETE(self):  # noqa: N802
+        path, q = self._q()
+        recursive = q.get("recursive") == "true"
+        hit = False
+        if path in self.files:
+            del self.files[path]
+            hit = True
+        if path in self.dirs:
+            for p in [p for p in list(self.files)
+                      if p.startswith(path + "/")]:
+                if recursive:
+                    del self.files[p]
+                    hit = True
+            for d in [d for d in list(self.dirs)
+                      if d == path or d.startswith(path + "/")]:
+                self.dirs.discard(d)
+                hit = True
+        self._reply({"boolean": hit})
+
+
+@pytest.fixture()
+def hdfs():
+    _StubHDFS.files = {}
+    _StubHDFS.dirs = set()
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), _StubHDFS)
+    _StubHDFS.port = httpd.server_address[1]
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    yield f"http://127.0.0.1:{httpd.server_address[1]}/warehouse"
+    httpd.shutdown()
+
+
+@pytest.fixture()
+def layer(hdfs):
+    return new_gateway_layer("hdfs", hdfs, "hadoopuser")
+
+
+def test_bucket_and_object_crud(layer):
+    layer.make_bucket("hb")
+    with pytest.raises(dt.BucketExists):
+        layer.make_bucket("hb")
+    assert [b.name for b in layer.list_buckets()] == ["hb"]
+    body = os.urandom(128 << 10)
+    layer.put_object("hb", "dir/sub/data.bin", io.BytesIO(body),
+                     len(body))
+    oi = layer.get_object_info("hb", "dir/sub/data.bin")
+    assert oi.size == len(body)
+    sink = io.BytesIO()
+    layer.get_object("hb", "dir/sub/data.bin", sink)
+    assert sink.getvalue() == body
+    sink = io.BytesIO()
+    layer.get_object("hb", "dir/sub/data.bin", sink, offset=100,
+                     length=50)
+    assert sink.getvalue() == body[100:150]
+    with pytest.raises(dt.BucketNotEmpty):
+        layer.delete_bucket("hb")
+    layer.delete_object("hb", "dir/sub/data.bin")
+    layer.delete_bucket("hb", force=True)
+    assert layer.list_buckets() == []
+
+
+def test_listing_with_delimiter(layer):
+    layer.make_bucket("lb")
+    for key in ("a/1.txt", "a/2.txt", "b.txt", "c/d/e.txt"):
+        layer.put_object("lb", key, io.BytesIO(b"x"), 1)
+    res = layer.list_objects("lb", delimiter="/")
+    assert [o.name for o in res.objects] == ["b.txt"]
+    assert sorted(res.prefixes) == ["a/", "c/"]
+    res = layer.list_objects("lb", prefix="a/", delimiter="/")
+    assert [o.name for o in res.objects] == ["a/1.txt", "a/2.txt"]
+    res = layer.list_objects("lb")  # flat
+    assert [o.name for o in res.objects] == [
+        "a/1.txt", "a/2.txt", "b.txt", "c/d/e.txt"]
+
+
+def test_multipart_via_append(layer):
+    layer.make_bucket("mb")
+    uid = layer.new_multipart_upload("mb", "big.bin")
+    p1 = os.urandom(64 << 10)
+    p2 = os.urandom(32 << 10)
+    layer.put_object_part("mb", "big.bin", uid, 1, io.BytesIO(p1),
+                          len(p1))
+    layer.put_object_part("mb", "big.bin", uid, 2, io.BytesIO(p2),
+                          len(p2))
+    parts = layer.list_object_parts("mb", "big.bin", uid)
+    assert [p.part_number for p in parts.parts] == [1, 2]
+    ups = layer.list_multipart_uploads("mb")
+    assert [u.upload_id for u in ups.uploads] == [uid]
+    oi = layer.complete_multipart_upload(
+        "mb", "big.bin", uid,
+        [dt.CompletePart(part_number=1, etag=""),
+         dt.CompletePart(part_number=2, etag="")])
+    assert oi.etag.endswith("-2")
+    sink = io.BytesIO()
+    layer.get_object("mb", "big.bin", sink)
+    assert sink.getvalue() == p1 + p2
+    with pytest.raises(dt.NoSuchUpload):
+        layer.list_object_parts("mb", "big.bin", uid)
+
+
+def test_full_server_stack_over_hdfs(hdfs):
+    """The regular S3 surface (SigV4, XML) in front of the gateway."""
+    layer = new_gateway_layer("hdfs", hdfs, "hadoopuser")
+    srv = S3Server(layer, "127.0.0.1", 0, access_key="hk",
+                   secret_key="hsec")
+    srv.start_background()
+    try:
+        c = S3Client(srv.endpoint(), "hk", "hsec")
+        assert c.request("PUT", "/sb").status_code == 200
+        body = os.urandom(96 << 10)
+        r = c.request("PUT", "/sb/files/x.bin", body=body)
+        assert r.status_code == 200, r.text
+        r = c.request("GET", "/sb/files/x.bin")
+        assert r.status_code == 200 and r.content == body
+        r = c.request("GET", "/sb", query={"list-type": "2"})
+        assert "files/x.bin" in r.text
+        assert c.request("DELETE", "/sb/files/x.bin").status_code == 204
+        assert layer.backend_type() == "Gateway:hdfs"
+    finally:
+        srv.shutdown()
+
+
+def test_key_traversal_rejected(layer):
+    layer.make_bucket("tb")
+    with pytest.raises(dt.ObjectNameInvalid):
+        layer.put_object("tb", "../escape.txt", io.BytesIO(b"x"), 1)
+    with pytest.raises(dt.ObjectNameInvalid):
+        layer.get_object_info("tb", "a/../../../etc/passwd")
+    with pytest.raises(dt.BucketNameInvalid):
+        layer.make_bucket("..")
+
+
+def test_bad_digest_rejected(layer):
+    from minio_tpu.utils.hashreader import HashReader
+    layer.make_bucket("db")
+    with pytest.raises(Exception):  # BadDigestError from the HashReader
+        layer.put_object("db", "o", HashReader(
+            io.BytesIO(b"hello"), 5, md5_hex="0" * 32), 5)
+    with pytest.raises(dt.IncompleteBody):
+        layer.put_object("db", "short", io.BytesIO(b"abc"), 10)
+
+
+def test_complete_with_missing_part_is_safe(layer):
+    layer.make_bucket("cb")
+    layer.put_object("cb", "keep.bin", io.BytesIO(b"original"), 8)
+    uid = layer.new_multipart_upload("cb", "keep.bin")
+    layer.put_object_part("cb", "keep.bin", uid, 1, io.BytesIO(b"p1"), 2)
+    with pytest.raises(dt.InvalidPart):
+        layer.complete_multipart_upload(
+            "cb", "keep.bin", uid,
+            [dt.CompletePart(part_number=7, etag="")])
+    # the pre-existing object is untouched
+    sink = io.BytesIO()
+    layer.get_object("cb", "keep.bin", sink)
+    assert sink.getvalue() == b"original"
+    layer.abort_multipart_upload("cb", "keep.bin", uid)
+
+
+def test_max_keys_zero(layer):
+    layer.make_bucket("zb")
+    layer.put_object("zb", "o", io.BytesIO(b"x"), 1)
+    res = layer.list_objects("zb", max_keys=0)
+    assert res.objects == [] and not res.is_truncated
