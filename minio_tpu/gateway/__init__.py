@@ -13,6 +13,9 @@ external-cloud dependency:
 - ``hdfs`` — a Hadoop filesystem over the WebHDFS REST API (reference
   cmd/gateway/hdfs uses the native protocol; the REST surface carries
   the same operations with no Hadoop client dependency).
+- ``azure`` — Azure Blob Storage over the Blob REST API with SharedKey
+  authorization (reference cmd/gateway/azure uses the Azure SDK);
+  multipart rides native block blobs.
 """
 from __future__ import annotations
 
@@ -31,9 +34,29 @@ def new_gateway_layer(kind: str, target: str, access_key: str = "",
                       secret_key: str = "", region: str = "us-east-1"):
     """Instantiate the ObjectLayer for gateway ``kind`` over ``target``
     (a path for nas, an endpoint URL for s3)."""
-    from . import hdfs, nas, s3  # noqa: F401 — populate REGISTRY
+    from . import azure, hdfs, nas, s3  # noqa: F401 — populate REGISTRY
     cls = REGISTRY.get(kind)
     if cls is None:
         raise ValueError(
             f"unknown gateway {kind!r}; available: {sorted(REGISTRY)}")
     return cls.new_layer(target, access_key, secret_key, region)
+
+
+def read_body(bucket: str, object: str, stream, size: int) -> bytes:
+    """Read a full request body for adapters that upload whole buffers,
+    driving the stream one read past the end so a HashReader verifies
+    its Content-MD5/SHA256 (the check fires on the EOF read); short
+    bodies surface as IncompleteBody."""
+    from ..objectlayer import datatypes as dt
+    chunks = []
+    got = 0
+    while size < 0 or got < size:
+        b = stream.read((size - got) if size >= 0 else (1 << 20))
+        if not b:
+            break
+        chunks.append(b)
+        got += len(b)
+    if size >= 0 and got < size:
+        raise dt.IncompleteBody(bucket, object)
+    stream.read(0 if size < 0 else 1)  # EOF read -> digest verification
+    return b"".join(chunks)

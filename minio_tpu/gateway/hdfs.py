@@ -21,7 +21,7 @@ import uuid
 from ..objectlayer import datatypes as dt
 from ..objectlayer.erasure_objects import check_names
 from ..objectlayer.interface import ObjectLayer
-from . import register
+from . import read_body, register
 
 SYS_DIR = ".minio-tpu.sys"
 
@@ -133,7 +133,13 @@ class _WebHDFS:
             raise
 
     def rename(self, src: str, dst: str) -> None:
-        # atomic move (namenode metadata op); destination is replaced
+        """Move src over dst. When dst does not exist this is one atomic
+        namenode op; replacing an existing dst needs delete+rename, so
+        only that (overwrite) case has a small non-atomic window —
+        never the common new-object path."""
+        out = self._json("PUT", src, "RENAME", destination=dst)
+        if out.get("boolean"):
+            return
         self.delete(dst)
         out = self._json("PUT", src, "RENAME", destination=dst)
         if not out.get("boolean"):
@@ -164,24 +170,6 @@ def _oi(bucket: str, name: str, st: dict) -> dt.ObjectInfo:
         mod_time=st.get("modificationTime", 0) / 1000.0,
         etag=_etag_of(st), is_dir=st.get("type") == "DIRECTORY",
         content_type="application/octet-stream")
-
-
-def _read_body(bucket: str, object: str, stream, size: int) -> bytes:
-    """Read the full body, driving the stream one read past the end so
-    a HashReader verifies its Content-MD5/SHA256 (its check fires on the
-    EOF read); short bodies surface as IncompleteBody."""
-    chunks = []
-    got = 0
-    while size < 0 or got < size:
-        b = stream.read((size - got) if size >= 0 else (1 << 20))
-        if not b:
-            break
-        chunks.append(b)
-        got += len(b)
-    if size >= 0 and got < size:
-        raise dt.IncompleteBody(bucket, object)
-    stream.read(0 if size < 0 else 1)  # EOF read -> digest verification
-    return b"".join(chunks)
 
 
 @register("hdfs")
@@ -257,7 +245,7 @@ class HDFSObjects(ObjectLayer):
     def put_object(self, bucket: str, object: str, stream, size: int,
                    opts=None) -> dt.ObjectInfo:
         self.get_bucket_info(bucket)
-        data = _read_body(bucket, object, stream, size)
+        data = read_body(bucket, object, stream, size)
         if "/" in object:
             parent = self._opath(bucket, object).rsplit("/", 1)[0]
             self.client.mkdirs(parent)
@@ -315,13 +303,16 @@ class HDFSObjects(ObjectLayer):
                 name = st.get("pathSuffix", "")
                 key = f"{keybase}{name}"
                 if st.get("type") == "DIRECTORY":
-                    if delimiter == "/":
-                        if (key + "/").startswith(prefix) or \
-                                prefix.startswith(key + "/"):
-                            if prefix.startswith(key + "/"):
-                                walk(f"{dirpath}/{name}", key + "/")
-                            else:
-                                prefixes.add(key + "/")
+                    # descend only into directories consistent with the
+                    # prefix — a flat list with prefix='a/' must not
+                    # LISTSTATUS every other subtree in the bucket
+                    consistent = (key + "/").startswith(prefix) or \
+                        prefix.startswith(key + "/")
+                    if not consistent:
+                        continue
+                    if delimiter == "/" and not prefix.startswith(
+                            key + "/"):
+                        prefixes.add(key + "/")
                         continue
                     walk(f"{dirpath}/{name}", key + "/")
                 elif key.startswith(prefix):
@@ -389,7 +380,7 @@ class HDFSObjects(ObjectLayer):
                         part_id: int, stream, size: int,
                         opts=None) -> dt.PartInfo:
         self._mp_meta(upload_id)
-        data = _read_body(bucket, object, stream, size)
+        data = read_body(bucket, object, stream, size)
         self.client.create(f"{self._mp_dir(upload_id)}/part.{part_id}",
                            data)
         etag = getattr(stream, "etag", None)

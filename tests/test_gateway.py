@@ -165,7 +165,7 @@ def test_nas_gateway_crud(tmp_path):
 
 def test_unknown_gateway_kind():
     with pytest.raises(ValueError):
-        new_gateway_layer("azure", "whatever")
+        new_gateway_layer("oraclecloud", "whatever")
 
 
 def test_s3_gateway_edge_cases(gateway):
