@@ -16,6 +16,9 @@ external-cloud dependency:
 - ``azure`` — Azure Blob Storage over the Blob REST API with SharedKey
   authorization (reference cmd/gateway/azure uses the Azure SDK);
   multipart rides native block blobs.
+- ``gcs``  — Google Cloud Storage over the JSON API with the OAuth2
+  service-account flow (RS256 JWT bearer); multipart rides the native
+  compose model. All five reference gateway kinds are covered.
 """
 from __future__ import annotations
 
@@ -34,7 +37,7 @@ def new_gateway_layer(kind: str, target: str, access_key: str = "",
                       secret_key: str = "", region: str = "us-east-1"):
     """Instantiate the ObjectLayer for gateway ``kind`` over ``target``
     (a path for nas, an endpoint URL for s3)."""
-    from . import azure, hdfs, nas, s3  # noqa: F401 — populate REGISTRY
+    from . import azure, gcs, hdfs, nas, s3  # noqa: F401 — populate REGISTRY
     cls = REGISTRY.get(kind)
     if cls is None:
         raise ValueError(

@@ -23,6 +23,7 @@ from ..objectlayer import datatypes as dt
 from ..objectlayer.erasure_objects import check_names
 from ..objectlayer.interface import ObjectLayer
 from . import read_body, register
+from .common import GatewayAdapterMixin, ObjectConfigMixin
 
 API_VERSION = "2020-10-02"
 
@@ -139,7 +140,8 @@ def _wrap(e: urllib.error.HTTPError, bucket: str, object: str = ""):
                              f"azure: {e.code} {body}")
 
 
-class AzureObjects(ObjectLayer):
+class AzureObjects(GatewayAdapterMixin, ObjectConfigMixin,
+                   ObjectLayer):
     def __init__(self, client: _AzureClient):
         self.client = client
 
@@ -234,13 +236,13 @@ class AzureObjects(ObjectLayer):
     def get_object(self, bucket: str, object: str, writer, offset: int = 0,
                    length: int = -1, opts=None) -> dt.ObjectInfo:
         oi = self.get_object_info(bucket, object)
+        if length == 0:
+            return oi  # zero-byte request: nothing to transfer
         headers = {}
         if length > 0:
             headers["Range"] = f"bytes={offset}-{offset + length - 1}"
         elif offset > 0:
             headers["Range"] = f"bytes={offset}-"
-        elif length == 0:
-            return oi  # zero-byte request: nothing to transfer
         try:
             with self.client.request("GET", f"/{bucket}/{object}",
                                      headers=headers) as r:
@@ -260,63 +262,60 @@ class AzureObjects(ObjectLayer):
                 raise _wrap(e, bucket, object) from None
         return dt.ObjectInfo(bucket=bucket, name=object)
 
-    def delete_objects(self, bucket: str, objects: list, opts=None):
-        deleted, errs = [], []
-        for o in objects:
-            name = o if isinstance(o, str) else o.get("object", "")
-            try:
-                self.delete_object(bucket, name)
-                deleted.append(dt.DeletedObject(object_name=name))
-                errs.append(None)
-            except Exception as e:  # noqa: BLE001
-                errs.append(e)
-        return deleted, errs
-
     def list_objects(self, bucket: str, prefix: str = "", marker: str = "",
                      delimiter: str = "", max_keys: int = 1000
                      ) -> dt.ListObjectsInfo:
         check_names(bucket)
-        q = {"restype": "container", "comp": "list",
-             "maxresults": str(max(1, max_keys))}
-        if prefix:
-            q["prefix"] = prefix
-        if marker:
-            q["marker"] = marker
-        if delimiter:
-            q["delimiter"] = delimiter
-        try:
-            root = self.client.xml("GET", f"/{bucket}", q)
-        except urllib.error.HTTPError as e:
-            raise _wrap(e, bucket) from None
         out = dt.ListObjectsInfo()
         if max_keys <= 0:
             return out
-        for b in root.iter("Blob"):
-            out.objects.append(dt.ObjectInfo(
-                bucket=bucket, name=b.findtext("Name", ""),
-                size=int(b.findtext("Properties/Content-Length", "0")),
-                etag=b.findtext("Properties/Etag", "").strip('"'),
-                mod_time=_parse_http_date(
-                    b.findtext("Properties/Last-Modified", ""))))
-        out.prefixes = [p.findtext("Name", "")
-                        for p in root.iter("BlobPrefix")]
-        nm = root.findtext("NextMarker", "")
-        if nm:
-            out.is_truncated = True
-            out.next_marker = nm
-        return out
-
-    def list_object_versions(self, bucket: str, prefix: str = "",
-                             marker: str = "", version_marker: str = "",
-                             delimiter: str = "", max_keys: int = 1000):
-        listed = self.list_objects(bucket, prefix, marker, delimiter,
-                                   max_keys)
-        out = dt.ListObjectVersionsInfo()
-        out.objects = listed.objects
-        out.prefixes = listed.prefixes
-        out.is_truncated = listed.is_truncated
-        out.next_marker = listed.next_marker
-        return out
+        # S3 markers are KEY NAMES; Azure's marker is an opaque
+        # continuation token. Page with Azure's tokens internally and
+        # skip keys <= the S3 marker client-side.
+        azure_token = ""
+        prefixes: list[str] = []
+        while True:
+            q = {"restype": "container", "comp": "list",
+                 "maxresults": str(max(1, max_keys))}
+            if prefix:
+                q["prefix"] = prefix
+            if delimiter:
+                q["delimiter"] = delimiter
+            if azure_token:
+                q["marker"] = azure_token
+            try:
+                root = self.client.xml("GET", f"/{bucket}", q)
+            except urllib.error.HTTPError as e:
+                raise _wrap(e, bucket) from None
+            for b in root.iter("Blob"):
+                name = b.findtext("Name", "")
+                if marker and name <= marker:
+                    continue
+                if len(out.objects) >= max_keys:
+                    out.is_truncated = True
+                    out.next_marker = out.objects[-1].name
+                    out.prefixes = prefixes
+                    return out
+                out.objects.append(dt.ObjectInfo(
+                    bucket=bucket, name=name,
+                    size=int(b.findtext(
+                        "Properties/Content-Length", "0")),
+                    etag=b.findtext("Properties/Etag", "").strip('"'),
+                    mod_time=_parse_http_date(
+                        b.findtext("Properties/Last-Modified", ""))))
+            for pfx in root.iter("BlobPrefix"):
+                name = pfx.findtext("Name", "")
+                if name not in prefixes and (not marker or name > marker):
+                    prefixes.append(name)
+            azure_token = root.findtext("NextMarker", "")
+            if not azure_token:
+                out.prefixes = prefixes
+                return out
+            if len(out.objects) >= max_keys:
+                out.is_truncated = True
+                out.next_marker = out.objects[-1].name
+                out.prefixes = prefixes
+                return out
 
     def copy_object(self, src_bucket, src_object, dst_bucket, dst_object,
                     src_info, src_opts, dst_opts) -> dt.ObjectInfo:
@@ -404,7 +403,7 @@ class AzureObjects(ObjectLayer):
         pids = [p.part_number if hasattr(p, "part_number") else p
                 for p in parts]
         staged = {p.part_number for p in self.list_object_parts(
-            bucket, object, upload_id).parts}
+            bucket, object, upload_id, max_parts=10000).parts}
         for pid in pids:
             if pid not in staged:
                 raise dt.InvalidPart(bucket, object, str(pid))
@@ -423,47 +422,6 @@ class AzureObjects(ObjectLayer):
         etags = [getattr(p, "etag", "") or "0" * 32 for p in parts]
         oi.etag = etag_from_parts(etags)
         return oi
-
-    # --- heal / misc --------------------------------------------------------
-
-    def heal_object(self, bucket, object, version_id="", dry_run=False,
-                    remove_dangling=False, scan_mode="normal"):
-        return dt.HealResultItem()
-
-    def heal_bucket(self, bucket, dry_run=False):
-        return dt.HealResultItem()
-
-    def put_config(self, path: str, data: bytes) -> None:
-        import io
-        try:
-            self.make_bucket("minio-tpu-sys")
-        except dt.BucketExists:
-            pass
-        self.put_object("minio-tpu-sys", path, io.BytesIO(data),
-                        len(data))
-
-    def get_config(self, path: str) -> bytes:
-        import io
-        from ..utils import errors
-        buf = io.BytesIO()
-        try:
-            self.get_object("minio-tpu-sys", path, buf)
-        except (dt.ObjectNotFound, dt.BucketNotFound):
-            raise errors.FileNotFound(path) from None
-        return buf.getvalue()
-
-    def delete_config(self, path: str) -> None:
-        try:
-            self.delete_object("minio-tpu-sys", path)
-        except dt.BucketNotFound:
-            pass
-
-    def list_config(self, prefix: str) -> list[str]:
-        try:
-            res = self.list_objects("minio-tpu-sys", prefix=prefix)
-        except dt.BucketNotFound:
-            return []
-        return sorted(o.name.rsplit("/", 1)[-1] for o in res.objects)
 
     def is_ready(self) -> bool:
         try:

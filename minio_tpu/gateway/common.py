@@ -1,0 +1,82 @@
+"""Shared adapter machinery for the cloud gateways (azure/gcs/hdfs):
+the per-object delete loop, the versions shim (cloud backends expose
+latest-only here), heal no-ops, and — for object-store backends — config
+blobs persisted into a hidden system bucket. One copy instead of three
+drifting ones."""
+from __future__ import annotations
+
+from ..objectlayer import datatypes as dt
+
+CONFIG_BUCKET = "minio-tpu-sys"
+
+
+class GatewayAdapterMixin:
+    """Methods every gateway adapter shares regardless of backend."""
+
+    def delete_objects(self, bucket: str, objects: list, opts=None):
+        deleted, errs = [], []
+        for o in objects:
+            name = o if isinstance(o, str) else o.get("object", "")
+            try:
+                self.delete_object(bucket, name)
+                deleted.append(dt.DeletedObject(object_name=name))
+                errs.append(None)
+            except Exception as e:  # noqa: BLE001
+                errs.append(e)
+        return deleted, errs
+
+    def list_object_versions(self, bucket: str, prefix: str = "",
+                             marker: str = "", version_marker: str = "",
+                             delimiter: str = "", max_keys: int = 1000):
+        listed = self.list_objects(bucket, prefix, marker, delimiter,
+                                   max_keys)
+        out = dt.ListObjectVersionsInfo()
+        out.objects = listed.objects
+        out.prefixes = listed.prefixes
+        out.is_truncated = listed.is_truncated
+        out.next_marker = listed.next_marker
+        return out
+
+    def heal_object(self, bucket, object, version_id="", dry_run=False,
+                    remove_dangling=False, scan_mode="normal"):
+        return dt.HealResultItem()
+
+    def heal_bucket(self, bucket, dry_run=False):
+        return dt.HealResultItem()
+
+
+class ObjectConfigMixin:
+    """Config blobs stored as objects in a hidden system bucket — for
+    backends that have no separate filesystem surface (azure, gcs)."""
+
+    def put_config(self, path: str, data: bytes) -> None:
+        import io
+        try:
+            self.make_bucket(CONFIG_BUCKET)
+        except dt.BucketExists:
+            pass
+        self.put_object(CONFIG_BUCKET, path, io.BytesIO(data), len(data))
+
+    def get_config(self, path: str) -> bytes:
+        import io
+
+        from ..utils import errors
+        buf = io.BytesIO()
+        try:
+            self.get_object(CONFIG_BUCKET, path, buf)
+        except (dt.ObjectNotFound, dt.BucketNotFound):
+            raise errors.FileNotFound(path) from None
+        return buf.getvalue()
+
+    def delete_config(self, path: str) -> None:
+        try:
+            self.delete_object(CONFIG_BUCKET, path)
+        except dt.BucketNotFound:
+            pass
+
+    def list_config(self, prefix: str) -> list[str]:
+        try:
+            res = self.list_objects(CONFIG_BUCKET, prefix=prefix)
+        except dt.BucketNotFound:
+            return []
+        return sorted(o.name.rsplit("/", 1)[-1] for o in res.objects)

@@ -22,6 +22,7 @@ from ..objectlayer import datatypes as dt
 from ..objectlayer.erasure_objects import check_names
 from ..objectlayer.interface import ObjectLayer
 from . import read_body, register
+from .common import GatewayAdapterMixin
 
 SYS_DIR = ".minio-tpu.sys"
 
@@ -136,10 +137,15 @@ class _WebHDFS:
         """Move src over dst. When dst does not exist this is one atomic
         namenode op; replacing an existing dst needs delete+rename, so
         only that (overwrite) case has a small non-atomic window —
-        never the common new-object path."""
+        never the common new-object path. A first failure with a
+        MISSING src is reported as such — deleting dst then would
+        destroy committed data over an unrelated error."""
         out = self._json("PUT", src, "RENAME", destination=dst)
         if out.get("boolean"):
             return
+        if self.status(src) is None:
+            from ..utils import errors
+            raise errors.FileNotFound(src)
         self.delete(dst)
         out = self._json("PUT", src, "RENAME", destination=dst)
         if not out.get("boolean"):
@@ -187,7 +193,7 @@ class HDFSGateway:
         return HDFSObjects(_WebHDFS(endpoint, user=access_key), base)
 
 
-class HDFSObjects(ObjectLayer):
+class HDFSObjects(GatewayAdapterMixin, ObjectLayer):
     def __init__(self, client: _WebHDFS, base: str):
         self.client = client
         self.base = base
@@ -279,18 +285,6 @@ class HDFSObjects(ObjectLayer):
         return dt.ObjectInfo(bucket=bucket, name=object,
                              delete_marker=False)
 
-    def delete_objects(self, bucket: str, objects: list, opts=None):
-        deleted, errs = [], []
-        for o in objects:
-            name = o if isinstance(o, str) else o.get("object", "")
-            try:
-                self.delete_object(bucket, name)
-                deleted.append(dt.DeletedObject(object_name=name))
-                errs.append(None)
-            except Exception as e:  # noqa: BLE001
-                errs.append(e)
-        return deleted, errs
-
     def list_objects(self, bucket: str, prefix: str = "", marker: str = "",
                      delimiter: str = "", max_keys: int = 1000
                      ) -> dt.ListObjectsInfo:
@@ -332,18 +326,6 @@ class HDFSObjects(ObjectLayer):
             out.objects.append(_oi(bucket, key, st))
         out.prefixes = sorted(p for p in prefixes
                               if not marker or p > marker)
-        return out
-
-    def list_object_versions(self, bucket: str, prefix: str = "",
-                             marker: str = "", version_marker: str = "",
-                             delimiter: str = "", max_keys: int = 1000):
-        listed = self.list_objects(bucket, prefix, marker, delimiter,
-                                   max_keys)
-        out = dt.ListObjectVersionsInfo()
-        out.objects = listed.objects
-        out.prefixes = listed.prefixes
-        out.is_truncated = listed.is_truncated
-        out.next_marker = listed.next_marker
         return out
 
     def copy_object(self, src_bucket, src_object, dst_bucket, dst_object,
@@ -489,13 +471,6 @@ class HDFSObjects(ObjectLayer):
             if st.get("type") == "FILE")
 
     # --- heal / misc --------------------------------------------------------
-
-    def heal_object(self, bucket, object, version_id="", dry_run=False,
-                    remove_dangling=False, scan_mode="normal"):
-        return dt.HealResultItem()
-
-    def heal_bucket(self, bucket, dry_run=False):
-        return dt.HealResultItem()
 
     def is_ready(self) -> bool:
         try:

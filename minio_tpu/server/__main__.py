@@ -36,7 +36,7 @@ def main(argv=None):
     ap.add_argument("--parity", type=int, default=None,
                     help="parity drives per set (default: drives/2)")
     ap.add_argument("--gateway",
-                    choices=["nas", "s3", "hdfs", "azure"],
+                    choices=["nas", "s3", "hdfs", "azure", "gcs"],
                     default=None,
                     help="gateway mode: serve the S3 API over a backend "
                          "(nas: shared mount path; s3: upstream endpoint)")
