@@ -6,6 +6,7 @@ from __future__ import annotations
 
 import logging
 import os
+import queue
 import threading
 
 from .queuestore import QueueStore
@@ -119,6 +120,29 @@ def targets_from_config(cfg, region: str = "us-east-1") -> list:
     return out
 
 
+class _ListenSub:
+    """One live listener: bucket + key filters + a bounded queue."""
+
+    __slots__ = ("bucket", "prefix", "suffix", "events", "q")
+
+    def __init__(self, bucket, prefix, suffix, events, q):
+        self.bucket = bucket
+        self.prefix = prefix
+        self.suffix = suffix
+        self.events = events
+        self.q = q
+
+    def matches(self, event_name: str, bucket: str, key: str) -> bool:
+        import fnmatch
+        if bucket != self.bucket:
+            return False
+        # event names arrive s3:-prefixed ("s3:ObjectCreated:Put")
+        if not any(fnmatch.fnmatchcase(event_name, pat)
+                   for pat in self.events):
+            return False
+        return key.startswith(self.prefix) and key.endswith(self.suffix)
+
+
 class EventNotifier:
     def __init__(self, bucket_meta, targets: list, queue_root: str,
                  region: str = "us-east-1", queue_limit: int = 10000):
@@ -126,6 +150,8 @@ class EventNotifier:
         self.region = region
         self._rules: dict[str, NotificationRules] = {}
         self._rules_lock = threading.Lock()
+        self._listeners: list[_ListenSub] = []
+        self._listen_lock = threading.Lock()
         self.stores: dict[str, QueueStore] = {}
         self.targets: dict[str, object] = {}
         for t in targets:
@@ -171,14 +197,49 @@ class EventNotifier:
         rules = self.rules_for(bucket)
         key = getattr(oi, "name", "")
         arns = rules.route(event_name, key)
-        if not arns:
-            return
-        record = new_event_record(event_name, bucket, oi, self.region,
-                                  request_params)
-        for arn in arns:
-            store = self.stores.get(arn)
-            if store is not None and not store.put(record):
-                log.warning("event queue full for %s; dropping event", arn)
+        record = None
+        if arns:
+            record = new_event_record(event_name, bucket, oi,
+                                      self.region, request_params)
+            for arn in arns:
+                store = self.stores.get(arn)
+                if store is not None and not store.put(record):
+                    log.warning("event queue full for %s; dropping event",
+                                arn)
+        # live listeners (ListenBucketNotification): independent of any
+        # stored config — the filters came with the listening request
+        with self._listen_lock:
+            subs = list(self._listeners)
+        for sub in subs:
+            if not sub.matches(event_name, bucket, key):
+                continue
+            if record is None:
+                record = new_event_record(event_name, bucket, oi,
+                                          self.region, request_params)
+            try:
+                sub.q.put_nowait(record)
+            except queue.Full:  # slow consumer: drop, never block PUTs
+                pass
+
+    # -- live listen channels (reference ListenBucketNotificationHandler,
+    # cmd/bucket-notification-handlers.go: an HTTP stream fed straight
+    # from the event path) ---------------------------------------------------
+
+    def listen(self, bucket: str, prefix: str = "", suffix: str = "",
+               events: tuple = ("s3:*",), depth: int = 256
+               ) -> "_ListenSub":
+        sub = _ListenSub(bucket, prefix, suffix, tuple(events),
+                         queue.Queue(maxsize=depth))
+        with self._listen_lock:
+            self._listeners.append(sub)
+        return sub
+
+    def unlisten(self, sub: "_ListenSub") -> None:
+        with self._listen_lock:
+            try:
+                self._listeners.remove(sub)
+            except ValueError:
+                pass
 
     def stop(self):
         for s in self.stores.values():

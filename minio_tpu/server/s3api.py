@@ -61,6 +61,7 @@ class S3Server:
         #: federation bucket DNS (dist.federation.BucketDNS) — None when
         #: the deployment is not federated
         self.federation = None
+        self._notifier_lock = threading.Lock()
         self.verifier = SigV4Verifier(lambda ak: self.lookup_secret(ak),
                                       region)
         self.address = address
@@ -163,6 +164,27 @@ class S3Server:
         the owning cluster, ListBuckets shows the federated namespace."""
         self.federation = dns
         return dns
+
+    def ensure_notifier(self):
+        """The event notifier, created lazily when a live listener needs
+        it before any target configuration. Chains with (never replaces)
+        an existing notify hook — a replication chain attached earlier
+        must keep firing — and the lock closes the concurrent-first-
+        listener race that would orphan one notifier."""
+        with self._notifier_lock:
+            if self._notifier is None:
+                from ..event import EventNotifier
+                n = EventNotifier(self.bucket_meta, [], "", self.region)
+                prev = self.notify
+                if prev is None:
+                    self.notify = n
+                else:
+                    def chained(event, bucket, oi, *a):
+                        n(event, bucket, oi, *a)
+                        prev(event, bucket, oi, *a)
+                    self.notify = chained
+                self._notifier = n
+            return self._notifier
 
     def enable_replication(self, pool):
         """Attach a ReplicationPool: object events feed it (chained with
@@ -763,6 +785,8 @@ class _S3Handler(BaseHTTPRequestHandler):
                 return s.list_uploads(ak)
             if s.has_q("versions"):
                 return s.list_versions(ak)
+            if s.has_q("events") and m == "GET":
+                return s.listen_bucket_notification(ak)
             if m == "HEAD":
                 return s.head_bucket(ak)
             return s.list_objects(ak)
@@ -1266,6 +1290,61 @@ class _S3Handler(BaseHTTPRequestHandler):
         self._authorize(ak, "s3:DeleteBucketPolicy")
         self.s3.bucket_meta.update(self.bucket, policy_json=b"")
         self._send(204)
+
+    def listen_bucket_notification(self, ak):
+        """Live event stream (the reference's ListenBucketNotification
+        minio extension, cmd/bucket-notification-handlers.go): GET
+        /bucket?events=<pattern>&prefix=&suffix= streams matching events
+        as JSON lines over chunked encoding; blank keep-alive lines mark
+        liveness. Needs no stored notification config — the filters ride
+        the request. ?timeout bounds the stream (tests; clients normally
+        hold it open)."""
+        self._authorize(ak, "s3:ListenBucketNotification")
+        self.s3.obj.get_bucket_info(self.bucket)
+        # listening needs the event plane; attach it lazily with no
+        # targets (queues only exist per target, listeners are free)
+        notifier = self.s3.ensure_notifier()
+        import json as _json
+        import queue as qmod
+        import time as _time
+        events = tuple(v for vs in self.query.get("events", [])
+                       for v in (vs.split(",") if vs else [])) or ("s3:*",)
+        prefix = (self.query.get("prefix") or [""])[0]
+        suffix = (self.query.get("suffix") or [""])[0]
+        try:
+            timeout = float((self.query.get("timeout") or ["86400"])[0])
+        except ValueError:
+            timeout = -1.0
+        if not timeout > 0:  # rejects 0, negatives AND NaN
+            raise dt.InvalidRequest(self.bucket, "",
+                                    "invalid listen timeout")
+        sub = notifier.listen(self.bucket, prefix, suffix, events)
+        try:  # from here every exit must unlisten, or the dead
+            # subscription keeps collecting events forever
+            self.send_response(200)
+            self.send_header("Content-Type", "application/x-ndjson")
+            self.send_header("Transfer-Encoding", "chunked")
+            self.end_headers()
+            out = _ChunkedWriter(self.wfile)
+            deadline = _time.monotonic() + timeout
+            while _time.monotonic() < deadline:
+                try:
+                    rec = sub.q.get(timeout=min(
+                        5.0, max(0.0, deadline - _time.monotonic())))
+                except qmod.Empty:
+                    out.write(b" \n")  # keep-alive (reference sends one)
+                    self.wfile.flush()
+                    continue
+                out.write((_json.dumps(
+                    {"Records": [rec]},
+                    separators=(",", ":")) + "\n").encode())
+                self.wfile.flush()
+            out.close()
+        except (BrokenPipeError, ConnectionResetError, OSError):
+            pass  # client went away: normal end of a listen stream
+        finally:
+            notifier.unlisten(sub)
+            self.close_connection = True
 
     def put_bucket_notification(self, ak):
         self._authorize(ak, "s3:PutBucketNotification")

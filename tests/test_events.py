@@ -185,3 +185,97 @@ def test_queue_limit(tmp_path):
     assert all(store.put({"i": i}) for i in range(3))
     assert not store.put({"i": 99})
     assert store.failed_puts == 1
+
+
+def test_listen_bucket_notification(tmp_path):
+    """Live event stream (minio ListenBucketNotification extension):
+    events stream as JSON lines with prefix/suffix/event filtering and
+    no stored notification config."""
+    import json
+    import threading
+
+    import requests
+
+    from minio_tpu.objectlayer import ErasureObjects
+    from minio_tpu.server import S3Server
+    from minio_tpu.storage import XLStorage
+    obj = ErasureObjects([XLStorage(str(tmp_path / f"d{i}"))
+                          for i in range(4)], default_parity=1)
+    srv = S3Server(obj, "127.0.0.1", 0, access_key="lk", secret_key="lsec")
+    srv.start_background()
+    try:
+        from s3client import S3Client
+        c = S3Client(srv.endpoint(), "lk", "lsec")
+        assert c.request("PUT", "/lb").status_code == 200
+        got: list = []
+        ready = threading.Event()
+
+        def listener():
+            r = c.request("GET", "/lb", query={
+                "events": "s3:ObjectCreated:*", "prefix": "logs/",
+                "timeout": "15"})
+            ready.set()  # headers received implies subscription is live
+            for ln in r.iter_lines():
+                if ln and ln.strip():
+                    got.append(json.loads(ln))
+                    if len(got) >= 2:
+                        break
+            r.close()
+
+        t = threading.Thread(target=listener, daemon=True)
+        t.start()
+        # the subscription registers before the body streams; give the
+        # request a moment to reach the handler
+        deadline = time.time() + 10
+        while not srv._notifier or not srv._notifier._listeners:
+            assert time.time() < deadline, "listener never registered"
+            time.sleep(0.05)
+        c.request("PUT", "/lb/other/skip.txt", body=b"x")   # filtered out
+        c.request("PUT", "/lb/logs/a.txt", body=b"1")
+        c.request("DELETE", "/lb/logs/a.txt")               # wrong event
+        c.request("PUT", "/lb/logs/b.txt", body=b"2")
+        t.join(timeout=20)
+        assert len(got) == 2, got
+        keys = [g["Records"][0]["s3"]["object"]["key"] for g in got]
+        assert keys == ["logs/a.txt", "logs/b.txt"]
+        assert got[0]["Records"][0]["eventName"].startswith(
+            "ObjectCreated")
+    finally:
+        srv.shutdown()
+
+
+def test_listen_preserves_replication_chain(tmp_path):
+    """Lazily attaching the listen notifier must CHAIN with an existing
+    notify hook (replication), not replace it."""
+    from minio_tpu.objectlayer import ErasureObjects
+    from minio_tpu.server import S3Server
+    from minio_tpu.storage import XLStorage
+    obj = ErasureObjects([XLStorage(str(tmp_path / f"d{i}"))
+                          for i in range(4)], default_parity=1)
+    srv = S3Server(obj, "127.0.0.1", 0, access_key="ck", secret_key="csec")
+    seen = []
+
+    class _FakePool:
+        def on_event(self, event, bucket, oi):
+            seen.append((event, getattr(oi, "name", "")))
+
+    srv.enable_replication(_FakePool())
+    srv.start_background()
+    try:
+        from s3client import S3Client
+        c = S3Client(srv.endpoint(), "ck", "csec")
+        c.request("PUT", "/rb")
+        notifier = srv.ensure_notifier()  # what a listen request does
+        sub = notifier.listen("rb")
+        c.request("PUT", "/rb/o", body=b"x")
+        deadline = time.time() + 10
+        while not seen and time.time() < deadline:
+            time.sleep(0.05)
+        # the replication hook STILL fires...
+        assert ("s3:ObjectCreated:Put", "o") in seen
+        # ...and the listener got the same event
+        rec = sub.q.get(timeout=5)
+        assert rec["s3"]["object"]["key"] == "o"
+        notifier.unlisten(sub)
+    finally:
+        srv.shutdown()
