@@ -154,11 +154,24 @@ class EventNotifier:
         self._listen_lock = threading.Lock()
         self.stores: dict[str, QueueStore] = {}
         self.targets: dict[str, object] = {}
+        self.queue_limit = queue_limit
         for t in targets:
             self.targets[t.arn] = t
             self.stores[t.arn] = QueueStore(
                 os.path.join(queue_root, t.KIND, t.id), t.send,
                 limit=queue_limit).start()
+
+    def add_targets(self, targets: list, queue_root: str) -> None:
+        """Attach targets (with their persistent queues) to a running
+        notifier — used when the event plane was created lazily for
+        listeners before any target configuration arrived."""
+        for t in targets:
+            if t.arn in self.targets:
+                continue
+            self.targets[t.arn] = t
+            self.stores[t.arn] = QueueStore(
+                os.path.join(queue_root, t.KIND, t.id), t.send,
+                limit=self.queue_limit).start()
 
     # -- config ---------------------------------------------------------------
 

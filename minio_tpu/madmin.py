@@ -200,6 +200,10 @@ class AdminClient:
     def top_locks(self) -> dict:
         return self._json("GET", "top/locks")
 
+    def top_api(self) -> dict:
+        """Per-API call counts + latency percentiles."""
+        return self._json("GET", "top/api")
+
     def trace(self, count: int = 50, timeout: float = 5.0) -> list[dict]:
         raw = self._request("GET", "trace", {"count": str(count),
                                              "timeout": str(timeout)})

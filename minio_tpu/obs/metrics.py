@@ -53,6 +53,12 @@ def counters_snapshot() -> dict[str, float]:
         return dict(_counters)
 
 
+def histograms_snapshot() -> dict[str, list[float]]:
+    """Point-in-time copy of the raw histogram samples (admin top-api)."""
+    with _lock:
+        return {k: list(v) for k, v in _histograms.items()}
+
+
 def _key(name: str, labels: dict) -> str:
     if not labels:
         return name

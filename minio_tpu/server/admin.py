@@ -85,6 +85,8 @@ def _dispatch_admin(h, op: str) -> None:
         return _trace(h)
     if op == "top/locks":
         return _top_locks(h)
+    if op == "top/api":
+        return _top_api(h)
     if op == "logs":
         # recent structured log entries (reference console-log history)
         from ..obs.logger import log_sys
@@ -382,6 +384,49 @@ def _trace(h) -> None:
     finally:
         trace_pubsub.unsubscribe(sub)
     out.close()
+
+
+def _top_api(h) -> None:
+    """`mc admin top api` analogue: per-API call counts and latency
+    percentiles from the request histograms the handler plane already
+    records (reference TopAPIHandler over the http stats)."""
+    from ..obs.metrics import counters_snapshot, histograms_snapshot
+    out: dict = {}
+    counters = counters_snapshot()
+    hists = histograms_snapshot()
+    for key, v in counters.items():
+        if not key.startswith("minio_tpu_requests_total"):
+            continue
+        api = status = ""
+        if "{" in key:
+            for part in key[key.index("{") + 1:-1].split(","):
+                name, _, val = part.partition("=")
+                if name == "api":
+                    api = val.strip('"')
+                elif name == "code":  # the label the handler records
+                    status = val.strip('"')
+        entry = out.setdefault(api or "unknown",
+                               {"calls": 0, "errors": 0})
+        entry["calls"] += int(v)
+        if status and not status.startswith("2"):
+            entry["errors"] += int(v)
+    for key, vals in hists.items():
+        if not key.startswith("minio_tpu_request_duration_seconds") or \
+                not vals:
+            continue
+        api = "unknown"
+        if "{" in key:
+            for part in key[key.index("{") + 1:-1].split(","):
+                name, _, val = part.partition("=")
+                if name == "api":
+                    api = val.strip('"')
+        vals.sort()
+        entry = out.setdefault(api, {"calls": len(vals), "errors": 0})
+        entry["p50_ms"] = round(vals[len(vals) // 2] * 1e3, 2)
+        entry["p99_ms"] = round(vals[min(len(vals) - 1,
+                                         int(len(vals) * 0.99))] * 1e3, 2)
+        entry["max_ms"] = round(vals[-1] * 1e3, 2)
+    h._send(200, json.dumps(out).encode(), "application/json")
 
 
 def _top_locks(h) -> None:

@@ -222,10 +222,29 @@ class S3Server:
             queue_root = os.environ.get(
                 "MINIO_TPU_NOTIFY_QUEUE_DIR",
                 os.path.join(os.getcwd(), ".minio-tpu-events"))
-        self._notifier = EventNotifier(self.bucket_meta, targets,
-                                       queue_root, self.region)
-        self.notify = self._notifier
-        return self._notifier
+        with self._notifier_lock:
+            if self._notifier is not None:
+                # a lazily created (listener-only) notifier already
+                # exists and live streams hold subscriptions on it —
+                # attach the targets to THAT instance instead of
+                # replacing it (which would orphan every open listen
+                # stream and drop any chained notify hook)
+                self._notifier.add_targets(targets, queue_root)
+                return self._notifier
+            self._notifier = EventNotifier(self.bucket_meta, targets,
+                                           queue_root, self.region)
+            prev = self.notify
+            if prev is None:
+                self.notify = self._notifier
+            else:
+                n = self._notifier
+
+                def chained(event, bucket, oi, *a):
+                    n(event, bucket, oi, *a)
+                    prev(event, bucket, oi, *a)
+
+                self.notify = chained
+            return self._notifier
 
     def _iam_authorize(self, access_key: str, action: str, bucket: str,
                        object: str) -> bool:
@@ -1309,10 +1328,10 @@ class _S3Handler(BaseHTTPRequestHandler):
         import time as _time
         events = tuple(v for vs in self.query.get("events", [])
                        for v in (vs.split(",") if vs else [])) or ("s3:*",)
-        prefix = (self.query.get("prefix") or [""])[0]
-        suffix = (self.query.get("suffix") or [""])[0]
+        prefix = self.q("prefix")
+        suffix = self.q("suffix")
         try:
-            timeout = float((self.query.get("timeout") or ["86400"])[0])
+            timeout = float(self.q("timeout", "86400") or "86400")
         except ValueError:
             timeout = -1.0
         if not timeout > 0:  # rejects 0, negatives AND NaN
@@ -1356,7 +1375,10 @@ class _S3Handler(BaseHTTPRequestHandler):
         except Exception:  # noqa: BLE001 — malformed XML
             return self._error("MalformedXML",
                                "invalid notification configuration", 400)
-        if self.s3._notifier is not None:
+        if self.s3._notifier is not None and self.s3._notifier.targets:
+            # a listener-only notifier (no configured targets) must not
+            # reject every ARN — matching the pre-notifier behavior of
+            # accepting and persisting the config
             unknown = self.s3._notifier.unknown_arns(parsed)
             if unknown:
                 return self._error(

@@ -100,3 +100,14 @@ def test_config_history_cycle(adm):
     assert adm.list_config_history() == []
     with pytest.raises(AdminError):
         adm.restore_config_history("nope")
+
+
+def test_top_api(adm, srv):
+    adm.server_info()
+    adm.server_info()
+    out = adm.top_api()
+    assert out, out
+    admin = out.get("admin", {})
+    assert admin.get("calls", 0) >= 2
+    # latency percentiles ride the duration histograms
+    assert any("p50_ms" in v for v in out.values())
