@@ -22,9 +22,14 @@ uses via klauspost/reedsolomon; see minio_tpu/native/gf256_simd.cpp).
 
 Timing note (recorded in .claude/skills/verify/SKILL.md): on the axon TPU
 platform block_until_ready() returns immediately and any device_get costs a
-~30-70 ms tunnel round-trip, so device time is measured as the slope of
-N-dispatch chains with a single final sync. Latency percentiles are
-wall-clock through the dispatch queue and therefore INCLUDE the tunnel
+~60-120 ms tunnel round-trip whose run-to-run variance swamps short
+dispatch chains (the r03->r04 "24% encode regression" and the wandering
+sweep dip were exactly this noise). Device kernel time is therefore
+measured DEVICE-RESIDENT: one jitted lax.fori_loop dispatch runs the kernel
+N times with a carried scalar dependency (so XLA can't hoist the
+loop-invariant call), and the per-iteration time is the slope between N=1
+and N=1025 — tunnel round-trip noise divides by 1024. Latency percentiles
+are wall-clock through the dispatch queue and therefore INCLUDE the tunnel
 round-trip — they are what a caller of this deployment actually observes.
 """
 from __future__ import annotations
@@ -44,10 +49,10 @@ def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
 
 
-def measure_slope(fn, n_hi: int = 101, reps: int = 3) -> float:
-    """Per-call device seconds: slope between 1-call and n_hi-call chains.
-
-    fn(n) must dispatch n times and hard-sync once at the end.
+def measure_slope(fn, n_hi: int = 1025, reps: int = 3) -> float:
+    """Per-iteration device seconds: slope between a 1-iteration and an
+    n_hi-iteration run. fn(n) runs the kernel n times (device-resident
+    loop) and hard-syncs; the slope cancels dispatch + tunnel round-trip.
     """
     t1 = min(fn(1) for _ in range(reps))
     tn = min(fn(n_hi) for _ in range(max(1, reps - 1)))
@@ -74,7 +79,16 @@ def cpu_baseline(rng) -> float:
 
 
 def device_configs(rng) -> dict:
-    """Device-kernel configs 2/3/4/5 via the batched jit kernels."""
+    """Device-kernel configs 2/3/4/5 via the production kernels: encode
+    rides the static-specialized pallas kernel (what encode_words_batch /
+    the dispatch queue run), reconstruct/heal/fused the dynamic-mask one.
+
+    Each config is timed as ONE jitted lax.fori_loop whose body re-runs the
+    kernel with a carried scalar folded into its inputs (masks ^ c, or the
+    static kernel's c hook) — a data dependency XLA cannot hoist, so N
+    iterations really execute on device and the tunnel round-trip appears
+    once, not N times.
+    """
     import jax
     import jax.numpy as jnp
     from minio_tpu.native import highwayhash as hhn
@@ -84,55 +98,64 @@ def device_configs(rng) -> dict:
     _, mm_batch, mm_batch_per = rs_jax._resolve_backend("auto")
     out: dict = {}
 
-    def bench_op(label, nbytes_per_elem, timed, *args):
-        _ = jax.device_get(timed(*args))  # compile + warm
+    def bench_loop(label, nbytes_per_elem, body, *args):
+        """body(c, *args) -> output array; carried scalar c = out[...0]."""
+        @jax.jit
+        def loop(n, *a):
+            def it(_, c):
+                return body(c, *a).reshape(-1)[0]
+            return jax.lax.fori_loop(0, n, it, jnp.uint32(0))
 
-        def chain(n):
+        # sync via device_get ONLY: on axon block_until_ready can return
+        # before execution (enqueue-only), which times the dispatch, not
+        # the kernel; the fetch round-trip cancels in the N=1 vs N=1025
+        # slope
+        _ = jax.device_get(loop(1, *args))  # compile + warm
+
+        def run(n):
             t0 = time.perf_counter()
-            s = None
-            for _ in range(n):
-                s = timed(*args)
-            _ = jax.device_get(s)
+            _ = jax.device_get(loop(n, *args))
             return time.perf_counter() - t0
 
-        per = measure_slope(chain)
+        per = measure_slope(run)
         gibs = nbytes_per_elem / per / (1 << 30)
         log(f"{label}: {per*1e6:.0f} us/batch -> {gibs:.1f} GiB/s")
         return gibs
 
     K, M, BLOCK, B = 16, 4, 1 << 20, 128
     shard = BLOCK // K
-    pmat = gf256.build_matrix(K, M)[K:]
     data = rng.integers(0, 256, (B, K, shard), dtype=np.uint8)
     w = jnp.asarray(rs_jax.pack_shards(data))
-
-    # headline + config 3 use one jitted sum-reduced wrapper per op so the
-    # chain never moves batch outputs to host
-    enc_masks = jnp.asarray(gf256.coeff_masks(pmat))
-    timed_enc = jax.jit(lambda ms, xs: jnp.sum(mm_batch(ms, xs)[..., :2]))
-    out["encode_16p4_1MiB_b128"] = bench_op(
-        f"tpu encode 16+4 @1MiB x{B}", B * BLOCK, timed_enc, enc_masks, w)
-
     codec = rs_jax.get_codec(K, M)
+
+    def enc_body(codec):
+        if codec._static_encode:
+            from minio_tpu.ops import rs_pallas
+            return lambda c, xs: rs_pallas.gf_matmul_static_batch(
+                codec.parity_rows, xs, c)
+        masks = jnp.asarray(gf256.coeff_masks(codec.parity_rows))
+        return lambda c, xs: mm_batch(masks ^ c, xs)
+
+    out["encode_16p4_1MiB_b128"] = bench_loop(
+        f"tpu encode 16+4 @1MiB x{B}", B * BLOCK, enc_body(codec), w)
+
     present = tuple(i for i in range(K + M) if i not in (2, 9))[:K]
     rec_masks = jnp.asarray(codec.target_masks_np(present, (2, 9)))
-    out["reconstruct_2loss_16p4_b128"] = bench_op(
+    out["reconstruct_2loss_16p4_b128"] = bench_loop(
         f"tpu reconstruct 16+4 2-loss @1MiB x{B}", B * BLOCK,
-        timed_enc, rec_masks, w)
+        lambda c, ms, xs: mm_batch(ms ^ c, xs), rec_masks, w)
 
     # config 2: 8+4 encode sweep 64 KiB - 4 MiB (batch sized to keep ~128
-    # MiB of source data per launch)
+    # MiB of source data per launch), through the production encode kernel
     sweep = {}
-    pmat84 = gf256.build_matrix(8, 4)[8:]
-    masks84 = jnp.asarray(gf256.coeff_masks(pmat84))
-    timed84 = jax.jit(lambda ms, xs: jnp.sum(mm_batch(ms, xs)[..., :2]))
+    codec84 = rs_jax.get_codec(8, 4)
     for bs in (1 << 16, 1 << 18, 1 << 20, 1 << 22):
         bsz = max(1, (128 << 20) // bs)
         d = rng.integers(0, 256, (bsz, 8, bs // 8), dtype=np.uint8)
         ws = jnp.asarray(rs_jax.pack_shards(d))
-        sweep[f"{bs >> 10}KiB"] = round(bench_op(
+        sweep[f"{bs >> 10}KiB"] = round(bench_loop(
             f"tpu encode 8+4 @{bs >> 10}KiB x{bsz}", bsz * bs,
-            timed84, masks84, ws), 2)
+            enc_body(codec84), ws), 2)
     out["encode_sweep_8p4"] = sweep
 
     # config 4: fused bitrot verify + 2-loss reconstruct, 16 KiB chunks —
@@ -157,14 +180,20 @@ def device_configs(rng) -> dict:
         fused_fn = fused_mod._jitted(key_fn(HIGHWAY_KEY), C,
                                      mm_batch_per, algo_id)
 
-        def timed_fused(ms, xs, dg, fused_fn=fused_fn):
-            o, v = fused_fn(ms, xs, dg)
-            return o[..., :2].sum() + v.sum()
+        def body_fused(c, ms, xs, dg, fused_fn=fused_fn):
+            # the hash verify is jnp (not pallas), and xs/dg are loop
+            # constants: unless the DATA depends on the carry, XLA hoists
+            # the whole verify subgraph out of the loop and times only the
+            # rebuild (this made HH read 174 GiB/s, 17x its real rate).
+            # xs ^ c forces a re-hash per iteration (~0.3 ms of extra
+            # elementwise traffic, <10% of the fused time); summing v
+            # keeps every verdict lane live
+            o, v = fused_fn(ms, xs ^ c, dg)
+            return o.reshape(-1)[0] + jnp.sum(v.astype(jnp.uint32))
 
-        timed_fused_j = jax.jit(timed_fused)
-        out[f"fused_verify_reconstruct_16p4_b128_{algo_name}"] = bench_op(
+        out[f"fused_verify_reconstruct_16p4_b128_{algo_name}"] = bench_loop(
             f"tpu FUSED {algo_name}-verify+reconstruct 16+4 x{B}",
-            B * BLOCK, timed_fused_j, rec_masks_b, w, digs)
+            B * BLOCK, body_fused, rec_masks_b, w, digs)
     out["fused_verify_reconstruct_16p4_b128"] = \
         out["fused_verify_reconstruct_16p4_b128_mur3"]
 
@@ -174,10 +203,10 @@ def device_configs(rng) -> dict:
             tuple(j for j in range(K + M) if j not in (i % K, K + i % M))[:K],
             (i % K, K + i % M))
         for i in range(B)])
-    timed_heal = jax.jit(lambda ms, xs: jnp.sum(mm_batch_per(ms, xs)[..., :2]))
-    out["batched_heal_rebuild_b128"] = bench_op(
+    out["batched_heal_rebuild_b128"] = bench_loop(
         f"tpu batched heal rebuild 16+4 x{B} mixed-loss", B * BLOCK,
-        timed_heal, jnp.asarray(heal_masks), w)
+        lambda c, ms, xs: mm_batch_per(ms ^ c, xs),
+        jnp.asarray(heal_masks), w)
     return out
 
 
@@ -392,6 +421,14 @@ def heal_latency(rng) -> dict:
     prof = q._get_profile()
     out["dispatch"] = {
         "batches": st["batches"], "cpu_batches": st["cpu_batches"],
+        "device_batches": st["device_batches"],
+        "cpu_items": st["cpu_items"], "device_items": st["device_items"],
+        "hold_events": st["hold_events"],
+        "hold_seconds": st["hold_seconds"],
+        "avg_batch": round(st["avg_batch"], 2),
+        "device_pipeline": __import__(
+            "minio_tpu.runtime.dispatch",
+            fromlist=["DEVICE_PIPELINE"]).DEVICE_PIPELINE,
         "completers": q.completer_count,
         "link_rt_ms": round(prof.rt_s * 1e3, 1) if prof else None,
         "link_up_gibs": round(prof.up_gibs, 3) if prof else None,

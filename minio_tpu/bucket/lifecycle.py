@@ -11,6 +11,8 @@ import urllib.parse
 import xml.etree.ElementTree as ET
 from dataclasses import dataclass, field
 
+from ..obs import metrics
+
 
 @dataclass
 class Rule:
@@ -125,6 +127,7 @@ class LifecycleSys:
                 self.obj.delete_object(bucket, oi.name, ObjectOptions(
                     version_id=oi.version_id or "null", versioned=True))
                 self.expired += 1
+                metrics.inc("minio_tpu_ilm_expired_total")
                 return True
             # noncurrent version expiry
             if r.noncurrent_days and not oi.is_latest and \
@@ -132,6 +135,7 @@ class LifecycleSys:
                 self.obj.delete_object(bucket, oi.name, ObjectOptions(
                     version_id=oi.version_id or "null", versioned=True))
                 self.expired += 1
+                metrics.inc("minio_tpu_ilm_expired_total")
                 return True
             expired = False
             if r.expiration_days and \
@@ -152,6 +156,7 @@ class LifecycleSys:
                 self.obj.delete_object(bucket, oi.name,
                                        ObjectOptions(versioned=versioned))
                 self.expired += 1
+                metrics.inc("minio_tpu_ilm_expired_total")
                 return True
             # transition to tier (cmd/bucket-lifecycle.go:365)
             if self.transition_sys is not None:

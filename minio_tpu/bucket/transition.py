@@ -15,6 +15,7 @@ import uuid
 
 from ..objectlayer import datatypes as dt
 from ..objectlayer.datatypes import ObjectOptions
+from ..obs import metrics
 from ..utils import errors
 
 META_TIER = "x-minio-internal-transition-tier"
@@ -90,6 +91,7 @@ class TransitionSys:
             tier.remove(key)  # stub write failed: don't leak tier data
             raise
         self.transitioned += 1
+        metrics.inc("minio_tpu_ilm_transitioned_total", tier=tier_name)
         return True
 
     def read(self, oi) -> bytes:
@@ -120,6 +122,7 @@ class TransitionSys:
         self.obj.put_object(bucket, oi.name, io.BytesIO(data), len(data),
                             ObjectOptions(user_defined=meta))
         self.restored += 1
+        metrics.inc("minio_tpu_ilm_restored_total")
 
     def extend_restore(self, bucket: str, oi, days: int) -> None:
         """An already-restored copy only needs its expiry metadata bumped

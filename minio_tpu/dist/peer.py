@@ -61,10 +61,33 @@ class PeerRESTClient:
         self.load_iam("service-account", access_key)
 
     def trace_recent(self, n: int = 256) -> list[dict]:
-        """The peer's recent trace ring (one-shot fan-out for admin trace,
-        reference peerRESTMethodTrace streaming)."""
+        """The peer's recent trace ring (one-shot history dump)."""
         import json as _json
         return _json.loads(self.rpc.call("tracerecent", {"n": str(n)}))
+
+    def trace_stream(self, timeout_s: float = 10.0, count: int = 1000):
+        """LIVE trace events from the peer as they happen (reference
+        peerRESTMethodTrace streaming, cmd/peer-rest-common.go:54):
+        yields dicts; keepalive newlines are filtered out here."""
+        yield from self._stream("tracestream", timeout_s, count)
+
+    def console_stream(self, timeout_s: float = 10.0, count: int = 1000):
+        """LIVE console log entries from the peer (reference
+        cmd/consolelogger.go peer streaming)."""
+        yield from self._stream("consolestream", timeout_s, count)
+
+    def _stream(self, method: str, timeout_s: float, count: int):
+        import json as _json
+        r = self.rpc.call(method,
+                          {"timeout": str(timeout_s), "count": str(count)},
+                          stream=True, timeout=timeout_s + 10)
+        try:
+            for line in r.iter_lines():
+                if not line:
+                    continue  # keepalive
+                yield _json.loads(line)
+        finally:
+            r.close()
 
     # --- observability / OBD fan-out (reference peer-rest-common.go:
     # CPULoadInfo, MemUsageInfo, DriveOBDInfo, Log, GetBandwidth,
@@ -98,6 +121,36 @@ class PeerRESTClient:
 
     def background_heal_status(self) -> dict:
         return json.loads(self.rpc.call("backgroundhealstatus"))
+
+
+def _stream_pubsub(pubsub, timeout_s: float, count: int, to_dict=None):
+    """Generator of NDJSON event lines from a live pubsub subscription,
+    with bare-newline keepalives while idle (SURVEY.md A.7 / reference
+    cmd/storage-rest-server.go:740-760 keepalive-byte framing): events
+    stream to the peer AS THEY HAPPEN instead of via ring polling."""
+    import queue as qmod
+    import time as _t
+
+    def gen():
+        sub = pubsub.subscribe()
+        sent = 0
+        deadline = _t.monotonic() + timeout_s
+        try:
+            while sent < count:
+                left = deadline - _t.monotonic()
+                if left <= 0:
+                    return
+                try:
+                    item = sub.get(timeout=min(1.0, left))
+                except qmod.Empty:
+                    yield b"\n"  # keepalive: connection alive, no event
+                    continue
+                rec = to_dict(item) if to_dict is not None else item
+                yield json.dumps(rec).encode() + b"\n"
+                sent += 1
+        finally:
+            pubsub.unsubscribe(sub)
+    return gen()
 
 
 class PeerRESTService:
@@ -146,6 +199,19 @@ class PeerRESTService:
             n = int(params.get("n", "256"))
             return json.dumps(
                 [t.to_dict() for t in recent(n)]).encode()
+        if method == "tracestream":
+            from ..obs.trace import trace_pubsub
+            return _stream_pubsub(
+                trace_pubsub,
+                float(params.get("timeout", "10")),
+                int(params.get("count", "1000")),
+                to_dict=lambda t: t.to_dict())
+        if method == "consolestream":
+            from ..obs.logger import log_sys
+            return _stream_pubsub(
+                log_sys().pubsub,
+                float(params.get("timeout", "10")),
+                int(params.get("count", "1000")))
         if method == "procinfo":
             from ..obs.profiling import health_info
             srv = getattr(self.node, "server", None)

@@ -129,6 +129,9 @@ class RPCClient:
                    service=self.service)
             raise errors.DiskNotFound(f"{self.base}: {e}") from e
         if r.status_code == 200:
+            if not stream:
+                mx.inc("minio_tpu_inter_node_received_bytes_total",
+                       len(r.content), service=self.service)
             return r if stream else r.content
         err_name = r.headers.get("x-minio-tpu-error", "")
         msg = r.content.decode("utf-8", "replace")[:200]

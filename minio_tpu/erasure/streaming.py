@@ -683,7 +683,11 @@ def erasure_decode(erasure: Erasure, writer, readers: list, offset: int,
         if kind == "native":
             out_arr, bad = res
             if bad == -1:
-                writer.write(out_arr[boff: boff + blen].tobytes())
+                # memoryview, not .tobytes(): the sink (BytesIO / socket)
+                # copies once anyway — a bytes() here doubled the GIL-held
+                # memcpy work per block, the main cost of 8-way reads on
+                # few cores
+                writer.write(memoryview(out_arr)[boff: boff + blen])
                 pool.put(out_arr)
                 stats.bytes_written += blen
                 return
@@ -704,8 +708,8 @@ def erasure_decode(erasure: Erasure, writer, readers: list, offset: int,
                 blocks = recover_block(corrupt, b, block_data_len)
         else:
             blocks = res
-        block = np.concatenate(blocks[:k]).tobytes()[:block_data_len]
-        writer.write(block[boff: boff + blen])
+        block = np.concatenate(blocks[:k])
+        writer.write(memoryview(block)[boff: boff + blen])
         stats.bytes_written += blen
 
     win = native_window_for(erasure.block_size) if native_get \

@@ -32,6 +32,9 @@ class QueueStore:
         self._thread: threading.Thread | None = None
         self.delivered = 0
         self.failed_puts = 0
+        #: delivery attempts that raised (target down / wire error) —
+        #: surfaced by the notification metrics group
+        self.send_failures = 0
         # pending counter kept in memory so put() never scans the
         # directory on the request path (initialized from one listdir;
         # the sender decrements as it drains)
@@ -109,6 +112,7 @@ class QueueStore:
                 try:
                     self.send(record)
                 except Exception as e:  # noqa: BLE001 — target down: retry
+                    self.send_failures += 1
                     log.warning("event delivery failed (%s); retrying in "
                                 "%.1fs", e, delay)
                     break

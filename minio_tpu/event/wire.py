@@ -413,15 +413,35 @@ class AMQPPublisher(_SocketClient):
         # Channel.Close on the next read — probe opportunistically
         s.setblocking(False)
         try:
-            peek = s.recv(1, socket.MSG_PEEK)
-            if peek:
+            # drain any already-arrived async frames: only Channel.Close
+            # (20.40) / Connection.Close (10.50) mean the publish failed;
+            # heartbeats and e.g. Basic.Return are legitimate and must not
+            # trigger the reconnect+republish path (duplicate delivery)
+            while s.recv(1, socket.MSG_PEEK):
                 s.settimeout(self.timeout)
-                self._read_frame(s)  # will raise via close sequence
-                raise WireError("amqp broker pushed a frame after publish")
+                try:
+                    ftype, _chan, payload = self._read_frame(s)
+                except socket.timeout:
+                    # a PARTIAL frame was consumed: the connection is
+                    # desynced — drop it so the next publish reconnects
+                    # cleanly (the publish itself already succeeded, so
+                    # no republish here)
+                    self._reset()
+                    return
+                finally:
+                    if self._sock is not None:
+                        s.setblocking(False)
+                if ftype != 1 or len(payload) < 4:
+                    continue  # heartbeat / content frame — ignore
+                cls, meth = struct.unpack(">HH", payload[:4])
+                if (cls, meth) in ((20, 40), (10, 50)):
+                    raise WireError(
+                        f"amqp broker closed after publish: {cls}.{meth}")
         except (BlockingIOError, InterruptedError):
             pass
         finally:
-            s.settimeout(self.timeout)
+            if self._sock is not None:
+                s.settimeout(self.timeout)
 
 
 # --- NATS ------------------------------------------------------------------

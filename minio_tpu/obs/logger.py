@@ -72,9 +72,14 @@ class LogSys:
 
     def __init__(self):
         from collections import deque
+
+        from .pubsub import PubSub
         self.log_target: HTTPLogTarget | None = None
         self.audit_target: HTTPLogTarget | None = None
         self.ring: deque = deque(maxlen=512)
+        #: live subscribers (admin console streaming across peers —
+        #: reference cmd/consolelogger.go:66-126 pubsub)
+        self.pubsub = PubSub()
         self._once: set[str] = set()
         ep = os.environ.get("MINIO_TPU_LOGGER_WEBHOOK_ENDPOINT", "")
         if ep:
@@ -91,6 +96,7 @@ class LogSys:
         rec = {"level": level, "subsystem": subsystem, "message": message,
                "time": time.time(), **fields}
         self.ring.append(rec)
+        self.pubsub.publish(rec)
         getattr(_console, level if level != "fatal" else "critical",
                 _console.info)("%s: %s", subsystem, message)
         if self.log_target is not None:

@@ -298,6 +298,89 @@ def _g_process(server) -> list[str]:
     return lines
 
 
+def _g_notification(server) -> list[str]:
+    """Event-target queue depth / deliveries / failures per ARN
+    (reference getNotificationMetrics: queue store state)."""
+    notifier = getattr(server, "_notifier", None)
+    stores = getattr(notifier, "stores", None)
+    if not stores:
+        return []
+    lines = [
+        "# TYPE minio_tpu_notify_events_queued gauge",
+        "# TYPE minio_tpu_notify_events_sent_total counter",
+        "# TYPE minio_tpu_notify_events_send_failures_total counter",
+        "# TYPE minio_tpu_notify_events_skipped_total counter",
+    ]
+    for arn, st in sorted(stores.items()):
+        lab = f'{{target="{arn}"}}'
+        lines += [
+            f"minio_tpu_notify_events_queued{lab} {st._count}",
+            f"minio_tpu_notify_events_sent_total{lab} {st.delivered}",
+            f"minio_tpu_notify_events_send_failures_total{lab} "
+            f"{st.send_failures}",
+            f"minio_tpu_notify_events_skipped_total{lab} "
+            f"{st.failed_puts}",
+        ]
+    return lines
+
+
+def _g_ilm(server) -> list[str]:
+    """ILM/transition state (reference getILMNodeMetrics): tier registry
+    + transition/restore totals; expiry counters ride the store
+    (minio_tpu_ilm_expired_total)."""
+    lines = []
+    tiers = getattr(server, "_tiers", None)
+    if tiers is not None:
+        lines += ["# TYPE minio_tpu_ilm_tiers_configured gauge",
+                  "minio_tpu_ilm_tiers_configured "
+                  f"{len(getattr(tiers, 'tiers', {}))}"]
+    # transition/restore/expiry TOTALS ride the store as labeled inc()
+    # counters (minio_tpu_ilm_transitioned_total{tier=...},
+    # minio_tpu_ilm_restored_total, minio_tpu_ilm_expired_total) — one
+    # canonical family, no duplicate names here
+    return lines
+
+
+def _g_heal(server) -> list[str]:
+    """Heal detail (reference getHealingMetrics): per-disk healing
+    trackers + MRF queue; heal-op counters ride the store."""
+    from ..scanner.autoheal import get_healing_tracker
+    lines = []
+    healing = 0
+    objects_healed = items_failed = 0
+    for d in _all_disks(server.obj):
+        t = None
+        try:
+            t = get_healing_tracker(d)
+        except Exception:  # noqa: BLE001
+            pass
+        if t is not None:
+            healing += 1
+            objects_healed += t.get("objects_healed", 0)
+            items_failed += t.get("objects_failed", 0)
+    lines += ["# TYPE minio_tpu_heal_disks_healing gauge",
+              f"minio_tpu_heal_disks_healing {healing}"]
+    if healing:
+        lines += [
+            "# TYPE minio_tpu_heal_tracker_objects_healed gauge",
+            f"minio_tpu_heal_tracker_objects_healed {objects_healed}",
+            "# TYPE minio_tpu_heal_tracker_items_failed gauge",
+            f"minio_tpu_heal_tracker_items_failed {items_failed}",
+        ]
+    mrf = getattr(server, "mrf", None)
+    if mrf is not None:
+        st = mrf.stats()
+        lines += [
+            "# TYPE minio_tpu_heal_mrf_queued gauge",
+            f"minio_tpu_heal_mrf_queued {st['queued']}",
+            "# TYPE minio_tpu_heal_mrf_healed_total counter",
+            f"minio_tpu_heal_mrf_healed_total {st['healed']}",
+            "# TYPE minio_tpu_heal_mrf_failed_total counter",
+            f"minio_tpu_heal_mrf_failed_total {st['failed']}",
+        ]
+    return lines
+
+
 def _g_locks(server) -> list[str]:
     locker = getattr(server, "local_locker", None)
     if locker is None:
@@ -319,6 +402,9 @@ _GROUPS = [
     MetricsGroup("dispatch", "node", _g_dispatch),
     MetricsGroup("process", "node", _g_process),
     MetricsGroup("locks", "node", _g_locks),
+    MetricsGroup("notification", "cluster", _g_notification),
+    MetricsGroup("ilm", "cluster", _g_ilm),
+    MetricsGroup("heal", "cluster", _g_heal),
 ]
 
 

@@ -78,16 +78,21 @@ _matmul_batch_shared = jax.jit(jax.vmap(gf_matmul_packed, in_axes=(None, 0)))
 _matmul_batch_per = jax.jit(jax.vmap(gf_matmul_packed, in_axes=(0, 0)))
 
 
-def _resolve_backend(backend: str):
-    """Pick the device kernels: 'pallas' (hand-tiled, default on TPU),
-    'xla' (pure jnp, default elsewhere), or 'auto'. Overridable via the
-    MINIO_TPU_RS_BACKEND env knob — the analogue of the reference gating its
-    accelerated codec behind config (cmd/config/, MINIO_ERASURE_*)."""
+def _backend_name(backend: str) -> str:
     import os
     if backend == "auto":
         backend = os.environ.get("MINIO_TPU_RS_BACKEND", "auto")
     if backend == "auto":
         backend = "pallas" if jax.default_backend() == "tpu" else "xla"
+    return backend
+
+
+def _resolve_backend(backend: str):
+    """Pick the device kernels: 'pallas' (hand-tiled, default on TPU),
+    'xla' (pure jnp, default elsewhere), or 'auto'. Overridable via the
+    MINIO_TPU_RS_BACKEND env knob — the analogue of the reference gating its
+    accelerated codec behind config (cmd/config/, MINIO_ERASURE_*)."""
+    backend = _backend_name(backend)
     if backend == "pallas":
         from . import rs_pallas
         return rs_pallas.gf_matmul, rs_pallas.gf_matmul_batch, \
@@ -123,20 +128,37 @@ class ReedSolomon:
         self._mask_cache: dict[tuple, jnp.ndarray] = {}
         self._np_mask_cache: dict[tuple, np.ndarray] = {}
         self._mm, self._mm_batch, self._mm_batch_per = _resolve_backend(backend)
+        #: pallas backend: encode runs the static-specialized kernel (the
+        #: matrix is fixed per (k, m) — coefficients become compile-time
+        #: constants, ~1.4x the dynamic-mask kernel; see rs_pallas.py)
+        self._static_encode = _backend_name(backend) == "pallas"
 
     # -- encode --------------------------------------------------------------
+
+    def encode_words(self, w: jnp.ndarray) -> jnp.ndarray:
+        """Device-level encode: uint32 words [k, W] -> [m, W] (no host
+        round-trip; dispatch/bench building block)."""
+        if self._static_encode:
+            from . import rs_pallas
+            return rs_pallas.gf_matmul_static(self.parity_rows, w)
+        return self._mm(self._enc_masks, w)
+
+    def encode_words_batch(self, w: jnp.ndarray) -> jnp.ndarray:
+        """Batched device-level encode: uint32 [B, k, W] -> [B, m, W]."""
+        if self._static_encode:
+            from . import rs_pallas
+            return rs_pallas.gf_matmul_static_batch(self.parity_rows, w)
+        return self._mm_batch(self._enc_masks, w)
 
     def encode(self, data: np.ndarray) -> np.ndarray:
         """data uint8 [k, S] -> parity uint8 [m, S]."""
         w = jnp.asarray(pack_shards(data))
-        out = self._mm(self._enc_masks, w)
-        return unpack_shards(np.asarray(out))
+        return unpack_shards(np.asarray(self.encode_words(w)))
 
     def encode_batch(self, data: np.ndarray) -> np.ndarray:
         """data uint8 [B, k, S] -> parity uint8 [B, m, S] in one dispatch."""
         w = jnp.asarray(pack_shards(data))
-        out = self._mm_batch(self._enc_masks, w)
-        return unpack_shards(np.asarray(out))
+        return unpack_shards(np.asarray(self.encode_words_batch(w)))
 
     # -- reconstruct ---------------------------------------------------------
 
@@ -270,7 +292,7 @@ class ReedSolomon:
         """shards uint8 [k+m, S] -> True iff parity matches data."""
         shards = np.asarray(shards, dtype=np.uint8)
         w = jnp.asarray(pack_shards(shards[: self.k]))
-        par = self._mm(self._enc_masks, w)
+        par = self.encode_words(w)
         want = jnp.asarray(pack_shards(shards[self.k:]))
         return bool(jnp.all(par == want))
 

@@ -52,16 +52,17 @@ PROBE_TTL_S = float(os.environ.get("MINIO_TPU_PROBE_TTL_S", "60"))
 
 #: device flushes allowed in flight before the loop HOLDS further
 #: device-bound buckets so arrivals coalesce into larger batches.
-#: Without any cap, forced-device mode at high concurrency fragments
-#: into hundreds of small flushes whose queue builds without bound
-#: (r03: p50 9.5 s / p99 12.5 s at conc 128 — the tail kept growing).
-#: With the cap the queue is fair and bounded (p50 ~= p99). The depth
-#: trades per-flush batching against transfer overlap in the tunnel;
-#: 16 measured best at conc 128 on the axon link (8.5 s p99, down from
-#: 12.5 s) while leaving low-concurrency latency alone (the pipeline
-#: never fills there). Absolute forced-device latency remains
-#: link-bandwidth-bound — the auto route exists precisely to carry
-#: this load on the CPU when the link loses.
+#: Round-5 re-measurement (forced-device, conc 128, 16+4/1 MiB): through
+#: the CURRENT axon link the flush cadence never outpaces the drain —
+#: in-flight stays at 1-2, the hold never engages (hold_events=0 in the
+#: new telemetry), and p50/p99 is link-bandwidth-bound at ~13-15 s for
+#: every DEVICE_PIPELINE in {4, 8, 16, 32, 64}. The r03/r04 numbers
+#: previously quoted here (8.5-19.7 s) were tunnel-state variance, not
+#: this knob. The cap still matters on a fast link (PCIe-attached chip:
+#: many small flushes CAN outpace the drain there); keep 16 as a
+#: reasonable bound and watch hold_events/hold_seconds in stats() — the
+#: auto route exists precisely to carry this load on the CPU when the
+#: link loses.
 DEVICE_PIPELINE = int(os.environ.get("MINIO_TPU_DEVICE_PIPELINE", "16"))
 #: safety cap on how long a held bucket may coalesce (model drift must
 #: not stall requests)
@@ -171,6 +172,9 @@ class _Bucket:
         self.chunk_size = chunk_size
         self.hash_algo = hash_algo  # native ALGO_* id for 'fused'
         self.items: list[_Pending] = []
+        #: set while the loop holds this bucket for coalescing (device
+        #: pipeline saturated); cleared at flush — feeds hold telemetry
+        self.held_since: float | None = None
 
 
 def _pad_batch(n: int) -> int:
@@ -198,10 +202,17 @@ class DispatchQueue:
         self._probe_failed_at = 0.0
         self._probe_running = False
         self._profile_lock = threading.Lock()
-        # telemetry
+        # telemetry (route decisions surface in the dispatch metrics
+        # group and in BENCH extras — regressions in the routing model
+        # must be visible, not inferred)
         self.batches = 0
         self.items = 0
         self.cpu_batches = 0
+        self.device_batches = 0
+        self.cpu_items = 0
+        self.device_items = 0
+        self.hold_events = 0
+        self.hold_seconds = 0.0
         # predicted drain deadline for device flushes already dispatched
         # and their in-flight count (under _profile_lock); the estimate
         # self-corrects — when the last in-flight flush completes early
@@ -291,10 +302,16 @@ class DispatchQueue:
                             # later arrivals coalesce into one big flush
                             # instead of queueing many tiny ones behind
                             # the link; completion notifies the cv
+                            if b.held_since is None:
+                                b.held_since = now
+                                self.hold_events += 1
                             d = b.items[0].t + MAX_HOLD_S
                             deadline = d if deadline is None \
                                 else min(deadline, d)
                             continue
+                        if b.held_since is not None:
+                            self.hold_seconds += now - b.held_since
+                            b.held_since = None
                         if len(b.items) >= self.max_batch or \
                                 age >= self.max_delay:
                             items, b.items = b.items[:self.max_batch], \
@@ -416,6 +433,7 @@ class DispatchQueue:
         self.batches += 1
         self.cpu_batches += 1
         self.items += len(items)
+        self.cpu_items += len(items)
 
         def one(p: _Pending):
             try:
@@ -507,12 +525,13 @@ class DispatchQueue:
         # count first so the fallback's decrement is always balanced
         self.batches += 1
         self.items += n
+        self.device_batches += 1
+        self.device_items += n
         stack = np.stack([p.words for p in items] +
                          [items[0].words] * (bsz - n))
         if b.op == "encode":
             if mesh is None:
-                out_dev = b.codec._mm_batch(b.codec._enc_masks,
-                                            jnp.asarray(stack))
+                out_dev = b.codec.encode_words_batch(jnp.asarray(stack))
             else:
                 fn = sharded_batched(b.codec._mm_batch, mesh, (False, True))
                 out_dev = fn(replicated_for(
@@ -612,6 +631,11 @@ class DispatchQueue:
     def stats(self) -> dict:
         return {"batches": self.batches, "items": self.items,
                 "cpu_batches": self.cpu_batches,
+                "device_batches": self.device_batches,
+                "cpu_items": self.cpu_items,
+                "device_items": self.device_items,
+                "hold_events": self.hold_events,
+                "hold_seconds": round(self.hold_seconds, 3),
                 "avg_batch": self.items / self.batches if self.batches else 0}
 
 

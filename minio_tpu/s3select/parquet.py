@@ -335,7 +335,15 @@ class ParquetReader:
             ptype = el.get(1, BYTE_ARRAY)
             optional = el.get(3, 0) == 1
             conv = el.get(6)
-            to_str = ptype == BYTE_ARRAY and (conv is None or conv == _UTF8)
+            # string-annotated byte arrays decode to str — either the
+            # legacy ConvertedType UTF8 (field 6) or the modern
+            # LogicalType union's STRING member (field 10, union field 1);
+            # unannotated columns stay bytes (base64'd at the Select
+            # output layer)
+            logical = el.get(10)
+            is_str = conv == _UTF8 or (
+                isinstance(logical, dict) and 1 in logical)
+            to_str = ptype == BYTE_ARRAY and is_str
             self.columns.append(_Column(name, ptype, optional,
                                         el.get(2, 0), to_str))
         self.row_groups = fmeta.get(4, [])

@@ -3,6 +3,7 @@ NewS3Select/Open/Evaluate): parse the request XML, stream records from the
 CSV/JSON reader, filter + project, and emit event-stream frames."""
 from __future__ import annotations
 
+import base64
 import csv
 import gzip
 import io
@@ -154,6 +155,10 @@ def _records(req: S3SelectRequest, raw: bytes, alias: str):
 
 
 def _serialize(req: S3SelectRequest, fields: list, names: list[str]) -> str:
+    # raw binary values (unannotated parquet BYTE_ARRAY) are not valid
+    # JSON/CSV text: base64 them rather than mangling with a lossy decode
+    fields = [base64.b64encode(v).decode() if isinstance(v, (bytes,
+              bytearray)) else v for v in fields]
     if req.out_format == "json":
         obj = {}
         for name, v in zip(names, fields):

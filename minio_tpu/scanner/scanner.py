@@ -49,9 +49,12 @@ class DataScanner:
         untouched since the last sweep (per the update tracker) reuse their
         previous stats instead of re-walking — the bloom-filter skip of
         cmd/data-update-tracker.go. Deep-scan cycles always walk."""
+        from ..obs import metrics as mx
         from .tracker import global_tracker
         self.cycle += 1
         deep = (self.cycle % DEEP_SCAN_EVERY == 0)
+        mx.inc("minio_tpu_scanner_cycles_total",
+               deep=str(deep).lower())
         tracker = global_tracker()
         gen = tracker.begin_cycle()
         prev_buckets = self.last_usage.get("buckets", {}) \
@@ -86,6 +89,8 @@ class DataScanner:
                 # hierarchical per-folder tree (cmd/data-usage-cache.go),
                 # compacted + persisted below
                 tree.add(oi.name, oi.size, nv)
+                mx.inc("minio_tpu_scanner_objects_scanned_total")
+                mx.inc("minio_tpu_scanner_bytes_scanned_total", oi.size)
                 self._check_object(b.name, oi, deep)
                 if self.sleep_per_object:
                     time.sleep(self.sleep_per_object)

@@ -341,11 +341,16 @@ def _iam_op(h, op: str) -> bool:
 
 def _trace(h) -> None:
     """`mc admin trace` analogue (reference peerRESTMethodTrace fan-out):
-    streams JSON-line trace events. ?peers=1 first dumps every peer's
-    recent ring buffer (one-shot over RPC), then follows live local
-    events; bounded by ?count / ?timeout so clients and tests terminate.
+    streams JSON-line trace events. ?peers=1 dumps every peer's recent
+    ring (history), then follows LIVE events cluster-wide — each peer's
+    tracestream RPC is pumped on its own thread into the merged output
+    as events happen (reference cmd/peer-rest-common.go:54 streaming;
+    replaced the round-4 ring polling). Bounded by ?count / ?timeout so
+    clients and tests terminate.
     """
     import queue as qmod
+    import threading
+    import time as _t
 
     from ..obs.trace import recent, trace_pubsub
     q = {k: v[0] for k, v in h.query.items()}
@@ -358,29 +363,62 @@ def _trace(h) -> None:
     from .s3api import _ChunkedWriter
     out = _ChunkedWriter(h.wfile)
     sent = 0
-    if q.get("peers") == "1":
-        for peer in getattr(h.s3, "peers", lambda: [])():
-            try:
-                for t in peer.trace_recent():
-                    out.write((json.dumps(t) + "\n").encode())
-                    sent += 1
-            except Exception:  # noqa: BLE001 — peer down: skip
-                continue
+    merged: qmod.Queue = qmod.Queue(maxsize=2048)
+    peers = list(getattr(h.s3, "peers", lambda: [])()) \
+        if q.get("peers") == "1" else []
+    for peer in peers:
+        try:
+            for t in peer.trace_recent():
+                out.write((json.dumps(t) + "\n").encode())
+                sent += 1
+        except Exception:  # noqa: BLE001 — peer down: skip
+            continue
     for t in recent(count):
         out.write((json.dumps(t.to_dict()) + "\n").encode())
         sent += 1
+    if sent < count:
+        # live phase only if the history dumps left budget: each pump
+        # holds a streaming RPC to its peer for up to `timeout` seconds
+        for peer in peers:
+            def pump(p=peer, budget=count - sent):
+                try:
+                    for t in p.trace_stream(timeout_s=timeout,
+                                            count=budget):
+                        try:
+                            # never block: if the consumer is gone or
+                            # slow, drop (trace is lossy by design —
+                            # pubsub drops on slow subscribers too); a
+                            # blocking put would pin this thread and its
+                            # peer connection for the process lifetime
+                            merged.put_nowait(t)
+                        except qmod.Full:
+                            pass
+                except Exception:  # noqa: BLE001 — peer died mid-stream
+                    pass
+
+            threading.Thread(target=pump, daemon=True,
+                             name="admin-trace-pump").start()
     sub = trace_pubsub.subscribe()
-    import time as _t
     deadline = _t.monotonic() + timeout
     try:
         while sent < count and _t.monotonic() < deadline:
+            wrote = False
             try:
-                info = sub.get(timeout=min(0.5, max(
-                    0.0, deadline - _t.monotonic())))
+                while sent < count:
+                    out.write((json.dumps(merged.get_nowait())
+                               + "\n").encode())
+                    sent += 1
+                    wrote = True
+            except qmod.Empty:
+                pass
+            if sent >= count:
+                break
+            try:
+                info = sub.get(timeout=0.01 if wrote else 0.2)
+                out.write((json.dumps(info.to_dict()) + "\n").encode())
+                sent += 1
             except qmod.Empty:
                 continue
-            out.write((json.dumps(info.to_dict()) + "\n").encode())
-            sent += 1
     finally:
         trace_pubsub.unsubscribe(sub)
     out.close()

@@ -406,6 +406,77 @@ class _S3Handler(BaseHTTPRequestHandler):
     def has_q(self, key: str) -> bool:
         return key in self.query
 
+    def _api_name(self) -> str:
+        """S3 API name for the per-API metric labels (the reference tags
+        minio_s3_requests_total / minio_s3_ttfb_seconds_distribution with
+        api="getobject"-style names, cmd/metrics-v2.go:147-154)."""
+        m, b, k = self.command, self.bucket, self.key
+        if not b:
+            return "listbuckets" if m == "GET" else "sts"
+        if k:
+            if m == "GET":
+                if self.has_q("uploadId"):
+                    return "listobjectparts"
+                for sub in ("tagging", "retention", "legal-hold", "acl"):
+                    if self.has_q(sub):
+                        return f"getobject{sub.replace('-', '')}"
+                return "getobject"
+            if m == "HEAD":
+                return "headobject"
+            if m == "PUT":
+                if self.has_q("partNumber"):
+                    return "putobjectpart"
+                if "x-amz-copy-source" in self.hdr:
+                    return "copyobject"
+                for sub in ("tagging", "retention", "legal-hold", "acl"):
+                    if self.has_q(sub):
+                        return f"putobject{sub.replace('-', '')}"
+                return "putobject"
+            if m == "POST":
+                if self.has_q("uploads"):
+                    return "newmultipartupload"
+                if self.has_q("uploadId"):
+                    return "completemultipartupload"
+                if self.has_q("select") or self.q("select-type"):
+                    return "selectobjectcontent"
+                if self.has_q("restore"):
+                    return "restoreobject"
+                return "postobject"
+            if m == "DELETE":
+                if self.has_q("uploadId"):
+                    return "abortmultipartupload"
+                if self.has_q("tagging"):
+                    return "deleteobjecttagging"
+                return "deleteobject"
+            return m.lower()
+        # bucket-level
+        subs = ("policy", "lifecycle", "versioning", "notification",
+                "tagging", "object-lock", "replication", "encryption",
+                "quota", "versions", "uploads", "location")
+        sub = next((s for s in subs if self.has_q(s)), "")
+        if m == "GET":
+            if sub == "versions":
+                return "listobjectversions"
+            if sub == "uploads":
+                return "listmultipartuploads"
+            if sub:
+                return f"getbucket{sub.replace('-', '')}"
+            return "listobjectsv2" if self.q("list-type") == "2" \
+                else "listobjectsv1"
+        if m == "HEAD":
+            return "headbucket"
+        if m == "PUT":
+            return f"putbucket{sub.replace('-', '')}" if sub \
+                else "putbucket"
+        if m == "DELETE":
+            return f"deletebucket{sub.replace('-', '')}" if sub \
+                else "deletebucket"
+        if m == "POST":
+            if self.has_q("delete"):
+                return "deletemultipleobjects"
+            return "postpolicybucket"
+        return m.lower()
+
     def _send(self, status: int, body: bytes = b"",
               content_type: str = "application/xml",
               headers: dict | None = None):
@@ -587,7 +658,11 @@ class _S3Handler(BaseHTTPRequestHandler):
             from .admin import handle_admin
             return handle_admin(self)
         # web console plane (reference cmd/web-router.go: /minio/webrpc
-        # JSON-RPC + JWT-authenticated upload/download routes)
+        # JSON-RPC + JWT-authenticated upload/download routes + the static
+        # single-file SPA at /minio/)
+        if self.url_path in ("/minio", "/minio/", "/minio/index.html"):
+            from .webrpc import handle_console
+            return handle_console(self)
         if self.url_path == "/minio/webrpc":
             from .webrpc import handle_webrpc
             return handle_webrpc(self)
@@ -757,6 +832,23 @@ class _S3Handler(BaseHTTPRequestHandler):
             out = self.s3.internal[service].handle(method, params, body)
         except Exception as e:  # noqa: BLE001
             return rpc_error_response(self, e)
+        if out is not None and not isinstance(out, (bytes, bytearray)):
+            # streaming method (live trace/console): chunked NDJSON with
+            # keepalive newlines (A.7 framing)
+            self.send_response(200)
+            self.send_header("Content-Type", "application/x-ndjson")
+            self.send_header("Transfer-Encoding", "chunked")
+            self.end_headers()
+            w = _ChunkedWriter(self.wfile)
+            try:
+                for chunk in out:
+                    if chunk:
+                        w.write(chunk)
+            except Exception:  # noqa: BLE001 — client went away mid-stream
+                self.close_connection = True
+                return
+            w.close()
+            return
         self._send(200, out, "application/octet-stream")
 
     def _dispatch(self, access_key: str):
@@ -1103,6 +1195,9 @@ class _S3Handler(BaseHTTPRequestHandler):
 
     def send_response(self, code, message=None):  # noqa: N802
         self._last_status = code
+        if getattr(self, "_t_first", None) is None:
+            import time as _time
+            self._t_first = _time.perf_counter()  # TTFB anchor
         super().send_response(code, message)
 
     def _handle(self):
@@ -1115,6 +1210,7 @@ class _S3Handler(BaseHTTPRequestHandler):
         from ..obs import trace as trc
         from ..obs.logger import log_sys
         self._last_status = 0
+        self._t_first = None
         t0 = _time.perf_counter()
         try:
             self._route()
@@ -1136,12 +1232,22 @@ class _S3Handler(BaseHTTPRequestHandler):
                        code=str(status))
                 mx.observe("minio_tpu_request_duration_seconds", dur,
                            api=api)
+                ttfb = (self._t_first or _time.perf_counter()) - t0
+                if api.startswith("s3."):
+                    # per-API-name family (reference metrics-v2 label
+                    # scheme: api="getobject"-style)
+                    name = self._api_name()
+                    mx.inc("minio_tpu_s3_requests_total", api=name)
+                    if status >= 400:
+                        mx.inc("minio_tpu_s3_requests_errors_total",
+                               api=name)
+                    mx.observe("minio_tpu_s3_ttfb_seconds", ttfb, api=name)
                 if api != "internal":
                     info = trc.TraceInfo(
                         node=f"{self.s3.address}:{self.s3.port}",
                         func=api, method=self.command,
                         path=path, query=getattr(self, "raw_query", ""),
-                        status=status, duration_s=dur,
+                        status=status, duration_s=dur, ttfb_s=ttfb,
                         input_bytes=int(getattr(self, "hdr", {}).get(
                             "content-length", "0") or 0),
                         remote=self.client_address[0])

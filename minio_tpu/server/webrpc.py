@@ -135,7 +135,8 @@ def _reply(h, rpc_id, result=None, error=None, code: int = 200):
 def _m_login(h, p: dict, ak: str):
     user = p.get("username", "")
     sk = h.s3.lookup_secret(user)
-    if not sk or not hmac.compare_digest(sk, p.get("password", "")):
+    if not sk or not hmac.compare_digest(
+            sk.encode(), str(p.get("password", "")).encode()):
         raise dt.AccessDenied(extra="invalid credentials")
     return {"token": make_jwt(user, sk), "uiVersion": "minio-tpu"}
 
@@ -240,6 +241,28 @@ _METHODS = {
 }
 
 
+# -- static console -----------------------------------------------------------
+
+
+_CONSOLE_CACHE: bytes | None = None
+
+
+def handle_console(h) -> None:
+    """GET /minio/ — the embedded single-file console SPA (reference
+    cmd/web-router.go:1 serves the compiled browser/ React app from an
+    in-binary asset FS; here the app is one static HTML file beside this
+    module, no build step)."""
+    global _CONSOLE_CACHE
+    if h.command != "GET":
+        return h._error("MethodNotAllowed", "console is GET-only", 405)
+    if _CONSOLE_CACHE is None:
+        import os
+        path = os.path.join(os.path.dirname(__file__), "console.html")
+        with open(path, "rb") as f:
+            _CONSOLE_CACHE = f.read()
+    h._send(200, _CONSOLE_CACHE, "text/html; charset=utf-8")
+
+
 # -- upload / download routes -------------------------------------------------
 
 
@@ -291,13 +314,36 @@ def handle_download(h, bucket: str, object: str) -> None:
     try:
         _check(h, ak, "s3:GetObject", bucket, object)
         oi = h.s3.obj.get_object_info(bucket, object)
+        # same read context as the S3 GET path: decrypt SSE-S3/KMS with
+        # the unsealed OEK, inflate compressed objects (SSE-C correctly
+        # errors here — a browser download can't carry the customer key)
+        h.bucket, h.key = bucket, object
+        sse = h._sse_read_ctx(oi)
     except dt.ObjectAPIError as e:
         return h._api_error(e)
+    from ..utils import compress as cz
+    compressed = oi.internal.get(cz.META_COMPRESSION, "")
+    plain_size = sse[2] if sse else (
+        oi.actual_size if compressed else oi.size)
     h.send_response(200)
     h.send_header("Content-Type",
                   oi.content_type or "application/octet-stream")
-    h.send_header("Content-Length", str(oi.size))
+    h.send_header("Content-Length", str(plain_size))
     h.send_header("Content-Disposition",
                   f'attachment; filename="{_disposition_name(object)}"')
     h.end_headers()
-    h.s3.obj.get_object(bucket, object, h.wfile)
+    if plain_size <= 0:
+        return
+    if sse:
+        from ..crypto import DecryptWriter
+        oek, base_iv, psize, _ = sse
+        dw = DecryptWriter(h.wfile, oek, base_iv, 0, 0, psize,
+                           bucket, object)
+        h.s3.obj.get_object(bucket, object, dw)
+        dw.finish()
+    elif compressed:
+        dz = cz.DecompressWriter(h.wfile)
+        h.s3.obj.get_object(bucket, object, dz)
+        dz.finish()
+    else:
+        h.s3.obj.get_object(bucket, object, h.wfile)

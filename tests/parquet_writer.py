@@ -205,7 +205,14 @@ def write_parquet(columns: list[dict], num_rows: int,
                   (3, CT_I32, 1 if col.get("optional") else 0),
                   (4, CT_BINARY, col["name"])]
         if col["type"] == BYTE_ARRAY and not col.get("raw_bytes"):
-            fields.append((6, CT_I32, 0))  # ConvertedType UTF8
+            if col.get("logical_string"):
+                # modern LogicalType union, STRING member (field 10.1),
+                # WITHOUT the legacy ConvertedType — some writers emit
+                # only this form
+                fields.append((10, CT_STRUCT,
+                               _struct([(1, CT_STRUCT, _struct([]))])))
+            else:
+                fields.append((6, CT_I32, 0))  # ConvertedType UTF8
         schema.append(_struct(fields))
     rg = _struct([
         (1, CT_LIST, (CT_STRUCT, chunk_metas)),

@@ -21,7 +21,8 @@ class S3Client:
 
     def request(self, method: str, path: str, query: dict | None = None,
                 body: bytes = b"", headers: dict | None = None,
-                sign_payload: bool = False) -> requests.Response:
+                sign_payload: bool = False,
+                stream: bool = False) -> requests.Response:
         query = {k: [v] if isinstance(v, str) else v
                  for k, v in (query or {}).items()}
         host = self.endpoint.split("//", 1)[1]
@@ -37,7 +38,8 @@ class S3Client:
         qs = urllib.parse.urlencode(
             [(k, v) for k, vs in query.items() for v in vs])
         url = f"{self.endpoint}{path_enc}" + (f"?{qs}" if qs else "")
-        return self.http.request(method, url, data=body, headers=h)
+        return self.http.request(method, url, data=body, headers=h,
+                                 stream=stream)
 
     # convenience wrappers
     def put_bucket(self, bucket, **kw):

@@ -232,15 +232,27 @@ def test_highwayhash_test_vectors():
                               hhn.hash256_batch(hhn.TEST_KEY, chunks))
 
 
-def test_default_algo_is_streaming_mur3():
-    from minio_tpu.erasure.bitrot import DEFAULT_BITROT_ALGO, BitrotAlgorithm
+def test_default_algo_is_route_aware(monkeypatch):
+    """CPU-routed deployments default to HighwayHash256S (AVX2 ingest +
+    reference parity, cmd/bitrot.go:51); forced-device deployments to
+    MUR3X256S (u32-native on the VPU). See BASELINE.md."""
     from minio_tpu import native
+    from minio_tpu.erasure.bitrot import (DEFAULT_BITROT_ALGO,
+                                          BitrotAlgorithm,
+                                          default_bitrot_algo)
     if native.available():
-        assert DEFAULT_BITROT_ALGO is BitrotAlgorithm.MUR3X256S
+        monkeypatch.delenv("MINIO_TPU_DISPATCH_MODE", raising=False)
+        monkeypatch.delenv("MINIO_TPU_BITROT_ALGO", raising=False)
+        assert default_bitrot_algo() is BitrotAlgorithm.HIGHWAYHASH256S
+        monkeypatch.setenv("MINIO_TPU_DISPATCH_MODE", "device")
+        assert default_bitrot_algo() is BitrotAlgorithm.MUR3X256S
+        monkeypatch.setenv("MINIO_TPU_BITROT_ALGO", "mur3x256S")
+        monkeypatch.delenv("MINIO_TPU_DISPATCH_MODE", raising=False)
+        assert default_bitrot_algo() is BitrotAlgorithm.MUR3X256S
     assert DEFAULT_BITROT_ALGO.streaming
     assert DEFAULT_BITROT_ALGO.available
     assert DEFAULT_BITROT_ALGO.digest_size == 32
-    # HighwayHash stays available for objects written with it
+    # both streaming algorithms stay available for recorded parts
     assert HH.streaming and HH.available and HH.digest_size == 32
 
 

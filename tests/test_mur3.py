@@ -66,14 +66,16 @@ def test_batch_entries_match():
 
 @pytest.mark.skipif(not native.available(), reason="no native build")
 def test_mur3_objects_roundtrip_and_heal(tmp_path):
-    """End-to-end with the new default: put (native pipeline frames with
-    mur3), healthy get (native verify), degraded get (fused device/CPU
-    verify+reconstruct)."""
+    """End-to-end with the explicit mur3 algo (the device-route default —
+    see BASELINE.md route-aware default): put (native pipeline frames
+    with mur3), healthy get (native verify), degraded get (fused
+    device/CPU verify+reconstruct)."""
     from minio_tpu.erasure.bitrot import BitrotAlgorithm
     from minio_tpu.objectlayer import ErasureObjects
     from minio_tpu.storage import XLStorage
     disks = [XLStorage(os.path.join(tmp_path, f"d{i}")) for i in range(6)]
-    ol = ErasureObjects(disks, default_parity=2)
+    ol = ErasureObjects(disks, default_parity=2,
+                        bitrot_algo=BitrotAlgorithm.MUR3X256S)
     assert ol.bitrot_algo is BitrotAlgorithm.MUR3X256S
     body = np.random.default_rng(3).integers(
         0, 256, (3 << 20) + 17, dtype=np.uint8).tobytes()

@@ -184,6 +184,129 @@ def test_metrics_group_failure_isolated(srv):
     assert g.lines(srv) == []
 
 
+def test_per_api_request_metrics(c, srv):
+    """Per-API-name request/error counters + TTFB histogram (reference
+    metrics-v2 api=\"getobject\"-style label scheme,
+    cmd/metrics-v2.go:147-154)."""
+    c.request("PUT", "/papi")
+    c.request("PUT", "/papi/k", body=b"z" * 500)
+    c.request("GET", "/papi/k")
+    c.request("GET", "/papi", query={"list-type": "2"})
+    c.request("GET", "/papi/absent")  # 404 -> error counter
+    text = c.http.get(srv.endpoint() + "/minio/v2/metrics/cluster").text
+    for api in ("putbucket", "putobject", "getobject", "listobjectsv2"):
+        assert f'minio_tpu_s3_requests_total{{api="{api}"}}' in text, api
+    assert 'minio_tpu_s3_requests_errors_total{api="getobject"}' in text
+    assert 'minio_tpu_s3_ttfb_seconds_bucket' in text
+    assert 'api="getobject"' in text
+
+
+def test_scanner_and_ilm_metrics(c, srv, tmp_path):
+    """Scanner cycle/object counters and ILM expiry driven by a real
+    lifecycle rule through a real scan (VERDICT r04 missing groups)."""
+    from minio_tpu.bucket.lifecycle import LifecycleSys
+    from minio_tpu.scanner.scanner import DataScanner
+    c.request("PUT", "/ilmb")
+    c.request("PUT", "/ilmb/doomed.txt", body=b"bye")
+    c.request("PUT", "/ilmb/keep.txt", body=b"stay")
+    # an already-passed <Date> expires every matching object
+    xml = (b"<LifecycleConfiguration><Rule><ID>x</ID>"
+           b"<Status>Enabled</Status><Filter><Prefix>doomed</Prefix>"
+           b"</Filter><Expiration><Date>2000-01-01T00:00:00Z</Date>"
+           b"</Expiration></Rule></LifecycleConfiguration>")
+    r = c.request("PUT", "/ilmb", query={"lifecycle": ""}, body=xml)
+    assert r.status_code == 200, r.text
+    lc = LifecycleSys(srv.obj, srv.bucket_meta)
+    DataScanner(srv.obj, lifecycle=lc, sleep_per_object=0).scan_cycle()
+    text = c.http.get(srv.endpoint() + "/minio/v2/metrics/cluster").text
+    assert "minio_tpu_scanner_cycles_total" in text
+    assert "minio_tpu_scanner_objects_scanned_total" in text
+    assert "minio_tpu_ilm_expired_total" in text
+    # the rule really ran: the matching object is gone, the other stays
+    assert c.request("GET", "/ilmb/doomed.txt").status_code == 404
+    assert c.request("GET", "/ilmb/keep.txt").status_code == 200
+
+
+def test_notification_metrics(c, srv, tmp_path):
+    """Per-target queue depth / send-failure counters from a real queue
+    store pointed at a dead target."""
+    from minio_tpu.event.notifier import EventNotifier
+    from minio_tpu.event.targets import WebhookTarget
+    t = WebhookTarget("1", "http://127.0.0.1:1/hook", timeout_s=0.2)
+    srv._notifier = EventNotifier(srv.bucket_meta, [t],
+                                  str(tmp_path / "events"))
+    try:
+        c.request("PUT", "/nb")
+        xml = (b'<NotificationConfiguration><QueueConfiguration>'
+               b'<Id>q1</Id><Queue>' + t.arn.encode() + b'</Queue>'
+               b'<Event>s3:ObjectCreated:*</Event>'
+               b'</QueueConfiguration></NotificationConfiguration>')
+        # route events to the dead target, then fire one
+        meta = srv.bucket_meta.get("nb")
+        meta.notification_xml = xml
+        srv.bucket_meta.set("nb", meta)
+        srv._notifier.invalidate("nb")
+        c.request("PUT", "/nb/evt.txt", body=b"fire")
+        srv._notifier("s3:ObjectCreated:Put", "nb",
+                      type("O", (), {"name": "evt.txt", "size": 4,
+                                     "etag": "e", "version_id": ""})())
+        store = srv._notifier.stores[t.arn]
+        deadline = time.time() + 8
+        while time.time() < deadline and store.send_failures == 0:
+            time.sleep(0.1)
+        text = c.http.get(
+            srv.endpoint() + "/minio/v2/metrics/cluster").text
+        assert "minio_tpu_notify_events_queued{" in text
+        assert "minio_tpu_notify_events_send_failures_total{" in text
+        assert store.send_failures >= 1
+    finally:
+        srv._notifier.stop()
+        srv._notifier = None
+
+
+def test_heal_detail_metrics(c, srv):
+    """Healing-tracker gauge reflects a disk marked under-heal."""
+    from minio_tpu.scanner.autoheal import (clear_healing_tracker,
+                                            set_healing_tracker)
+    d = srv.obj.disks[0]
+    set_healing_tracker(d, {"objects_healed": 3, "objects_failed": 1})
+    try:
+        # bypass the group cache: a fresh scrape after cache expiry
+        from minio_tpu.obs import metrics as mxmod
+        for g in mxmod._GROUPS:
+            g._cached.clear()
+        text = c.http.get(
+            srv.endpoint() + "/minio/v2/metrics/cluster").text
+        assert "minio_tpu_heal_disks_healing 1" in text
+        assert "minio_tpu_heal_tracker_objects_healed 3" in text
+    finally:
+        clear_healing_tracker(d)
+
+
+def test_stream_pubsub_events_and_keepalive():
+    """The peer streaming primitive: NDJSON events as they are published,
+    bare-newline keepalives while idle, bounded by count/timeout."""
+    from minio_tpu.dist.peer import _stream_pubsub
+    from minio_tpu.obs.pubsub import PubSub
+    ps = PubSub()
+    gen = _stream_pubsub(ps, timeout_s=5.0, count=2)
+
+    def pub():
+        time.sleep(0.2)
+        ps.publish({"a": 1})
+        ps.publish({"a": 2})
+
+    threading.Thread(target=pub, daemon=True).start()
+    chunks = list(gen)
+    events = [json.loads(c) for c in chunks if c.strip()]
+    assert events == [{"a": 1}, {"a": 2}]
+    # timeout path emits only keepalives then ends
+    t0 = time.time()
+    chunks = list(_stream_pubsub(PubSub(), timeout_s=1.2, count=5))
+    assert time.time() - t0 < 5
+    assert all(not c.strip() for c in chunks)
+
+
 def test_inter_node_rpc_metrics():
     from minio_tpu.obs import metrics as mx
     before = {k: v for k, v in mx._counters.items()

@@ -51,6 +51,30 @@ def test_parquet_dictionary_encoding():
     assert rows == ROWS
 
 
+def test_parquet_string_annotations_and_raw_bytes():
+    """BYTE_ARRAY decode rules (round-4 advisor + review): str for the
+    legacy ConvertedType UTF8 OR the modern LogicalType STRING (some
+    writers emit only the latter); unannotated columns stay bytes."""
+    blob = write_parquet([
+        {"name": "legacy", "type": BYTE_ARRAY, "values": ["a", "b"]},
+        {"name": "modern", "type": BYTE_ARRAY, "values": ["c", "d"],
+         "logical_string": True},
+        {"name": "raw", "type": BYTE_ARRAY, "raw_bytes": True,
+         "values": [b"\x00\xff", b"\x01\x02"]},
+    ], num_rows=2)
+    rows = list(iter_parquet_rows(blob))
+    assert rows[0]["legacy"] == "a" and rows[1]["legacy"] == "b"
+    assert rows[0]["modern"] == "c" and rows[1]["modern"] == "d"
+    assert rows[0]["raw"] == b"\x00\xff" and rows[1]["raw"] == b"\x01\x02"
+    # the Select output layer base64s binary values instead of mangling
+    from minio_tpu.s3select.select import _serialize, S3SelectRequest
+    req = S3SelectRequest(expression="", input_format="parquet",
+                          out_format="json")
+    out = _serialize(req, [b"\x00\xff"], ["raw"])
+    import base64 as b64
+    assert b64.b64encode(b"\x00\xff").decode() in out
+
+
 def test_parquet_rejects_garbage():
     with pytest.raises(ParquetError):
         list(iter_parquet_rows(b"PAR1 not really a parquet file PAR1"))

@@ -13,8 +13,10 @@ def rand(k, size, seed=0):
 
 @pytest.mark.parametrize("k,m,size", [
     (4, 2, 128),          # sub-tile (heavy padding path)
-    (16, 4, 8192),        # exactly one tile (8192 B = 2048 words)
+    (16, 4, 8192),        # one 2048-word tile ((8, 256) layout)
+    (16, 4, 65536),       # 16384 words: the (16, 512) sublane layout
     (8, 4, 8192 * 2 + 4),  # multi-tile + ragged tail
+    (8, 4, 32768 + 2048),  # 8192-multiple + partial quantum
 ])
 def test_pallas_matmul_matches_reference(k, m, size):
     rs = rs_jax.ReedSolomon(k, m)
@@ -46,3 +48,32 @@ def test_pallas_batched():
     ref = rs_jax.ReedSolomon(4, 2, backend="xla")
     for b in range(3):
         assert np.array_equal(got[b], ref.encode(batch[b]))
+
+
+@pytest.mark.parametrize("k,m,size", [
+    (4, 2, 1024),          # padded sub-tile
+    (16, 4, 65536),        # north-star shard: (16, 512) layout
+    (8, 4, 8192 * 2 + 4),  # ragged tail
+])
+def test_pallas_static_encode_matches_reference(k, m, size):
+    """The compile-time-specialized encode kernel (coefficients baked in)
+    is bit-identical to the table reference, including the c hook."""
+    import jax.numpy as jnp
+    rs = rs_jax.ReedSolomon(k, m)
+    data = rand(k, size, seed=k * m)
+    aligned = np.ascontiguousarray(data[:, :size - size % 4])
+    w = jnp.asarray(rs_jax.pack_shards(aligned))
+    got = rs_jax.unpack_shards(np.asarray(
+        rs_pallas.gf_matmul_static(rs.parity_rows, w)))
+    want = gf256.gf_matmul_ref(rs.parity_rows, aligned)
+    assert np.array_equal(got, want)
+    # batch form: element 0 matches the reference, element 1 the single call
+    wb = jnp.stack([w, w ^ np.uint32(0x01010101)])
+    got_b = np.asarray(rs_pallas.gf_matmul_static_batch(rs.parity_rows, wb))
+    assert np.array_equal(got_b[0], rs_jax.pack_shards(want))
+    assert np.array_equal(got_b[1], np.asarray(
+        rs_pallas.gf_matmul_static(rs.parity_rows, wb[1])))
+    # the c dependency hook only perturbs word 0's row
+    got_c = np.asarray(rs_pallas.gf_matmul_static(
+        rs.parity_rows, w, c=np.uint32(0xDEADBEEF)))
+    assert np.array_equal(got_c[1:], rs_jax.pack_shards(want)[1:])
