@@ -140,9 +140,9 @@ class ReplicationPool:
         for k, v in oi.user_defined.items():
             if k.startswith("x-amz-meta-"):
                 headers[k] = v
-        from ..utils.compress import META_COMPRESSION, DecompressWriter
+        from ..utils.compress import META_COMPRESSION, decompress_writer
         from .bandwidth import MonitoredReader, global_monitor
-        compressed = bool(oi.internal.get(META_COMPRESSION))
+        compressed = oi.internal.get(META_COMPRESSION, "")
         if not compressed and oi.size <= self.SPOOL_THRESHOLD:
             from ..erasure.streaming import BufferSink
             sink = BufferSink()
@@ -158,7 +158,7 @@ class ReplicationPool:
             # through the inflater on the way to the spool
             with tempfile.TemporaryFile() as spool:
                 if compressed:
-                    dz = DecompressWriter(spool)
+                    dz = decompress_writer(compressed, spool)
                     self.obj.get_object(bucket, key, dz)
                     dz.finish()
                 else:

@@ -5,6 +5,7 @@ per-object key (OEK) sealed by the request key (SSE-C) or a KMS data key
 numbers bound into nonce+AAD) that supports ranged reads by package
 alignment."""
 from .kms import (KESClient, KMS, KMSError, KMSUnreachable, LocalKMS,
+                  VaultClient,
                   get_kms, set_kms)
 from .sse import (META_SCHEME, PKG_SIZE, DecryptWriter, EncryptReader,
                   SSEInfo, decrypt_range_bounds, enc_size,
@@ -13,6 +14,7 @@ from .sse import (META_SCHEME, PKG_SIZE, DecryptWriter, EncryptReader,
 
 __all__ = [
     "KESClient", "KMS", "KMSError", "KMSUnreachable", "LocalKMS",
+    "VaultClient",
     "get_kms", "set_kms",
     "META_SCHEME", "PKG_SIZE", "DecryptWriter", "EncryptReader", "SSEInfo",
     "decrypt_range_bounds", "enc_size", "parse_sse_headers",

@@ -12,6 +12,9 @@ and a Vault client (cmd/crypto/vault.go). Here:
 * ``KESClient`` — the reference's KES wire protocol
   (``POST /v1/key/create|generate|decrypt/{name}``, base64 JSON bodies,
   mTLS client certs), over urllib so no extra dependency is needed.
+* ``VaultClient`` — HashiCorp Vault transit engine (AppRole or token
+  auth, ``/v1/transit/datakey|decrypt|rewrap``), matching
+  cmd/crypto/vault.go's request/blob shapes.
 
 ``generate_key`` returns (plaintext data key, sealed blob); the sealed
 blob is stored in object metadata and unsealed on read. Context binds
@@ -207,6 +210,151 @@ class KESClient(KMS):
                 "default_key_id": self.key_id, "status": "online"}
 
 
+class VaultClient(KMS):
+    """HashiCorp Vault transit-engine KMS (reference cmd/crypto/vault.go):
+
+    * AppRole login ``POST /v1/auth/approle/login`` → client token, sent
+      as ``X-Vault-Token`` on every call (vault.go:159-194); a 403 mid-
+      stream re-authenticates once (the reference renews on a timer).
+    * data keys: ``POST /v1/transit/datakey/plaintext/{key}`` with the
+      b64 context → ``data.plaintext`` (b64 32-byte key) +
+      ``data.ciphertext`` (vault.go:225-251).
+    * unseal: ``POST /v1/transit/decrypt/{key}`` (vault.go:260-285);
+      rewrap after key rotation: ``POST /v1/transit/rewrap/{key}``
+      (vault.go:293-310).
+
+    Sealed blobs are Vault's ASCII ``vault:v1:...`` ciphertext, stored
+    as bytes — exactly what the reference persists in object metadata.
+    """
+
+    def __init__(self, endpoint: str, default_key_id: str,
+                 role_id: str = "", secret_id: str = "", token: str = "",
+                 namespace: str = "", timeout: float = 5.0,
+                 ca_path: str = "", insecure: bool = False):
+        if not endpoint:
+            raise KMSError("vault: missing endpoint")
+        self.endpoint = endpoint.rstrip("/")
+        self.key_id = default_key_id
+        self.role_id = role_id
+        self.secret_id = secret_id
+        self.namespace = namespace
+        self.timeout = timeout
+        self._token = token
+        self._ctx = None
+        if self.endpoint.startswith("https"):
+            self._ctx = ssl.create_default_context(cafile=ca_path or None)
+            if insecure:
+                self._ctx.check_hostname = False
+                self._ctx.verify_mode = ssl.CERT_NONE
+
+    def _login(self) -> None:
+        if not self.role_id:
+            raise KMSError("vault: no token and no AppRole credentials")
+        resp = self._raw_post("/v1/auth/approle/login",
+                              {"role_id": self.role_id,
+                               "secret_id": self.secret_id}, auth=False)
+        try:
+            self._token = resp["auth"]["client_token"]
+        except (KeyError, TypeError) as e:
+            raise KMSError(f"vault: malformed login response: {e!r}") \
+                from None
+
+    def _raw_post(self, path: str, body: dict, auth: bool = True) -> dict:
+        headers = {"Content-Type": "application/json"}
+        if auth:
+            headers["X-Vault-Token"] = self._token
+        if self.namespace:
+            headers["X-Vault-Namespace"] = self.namespace
+        req = urllib.request.Request(
+            self.endpoint + path, data=json.dumps(body).encode(),
+            method="POST", headers=headers)
+        try:
+            with urllib.request.urlopen(
+                    req, timeout=self.timeout, context=self._ctx) as r:
+                payload = r.read()
+                return json.loads(payload) if payload else {}
+        except urllib.error.HTTPError as e:
+            detail = e.read().decode(errors="replace")[:200]
+            raise _VaultHTTPError(e.code,
+                                  f"vault: {e.code} {detail}") from None
+        except Exception as e:  # noqa: BLE001 — connectivity
+            raise KMSUnreachable(f"vault: {self.endpoint}: {e}") from None
+
+    def _post(self, path: str, body: dict) -> dict:
+        if not self._token:
+            self._login()
+        try:
+            return self._raw_post(path, body)
+        except _VaultHTTPError as e:
+            if e.code == 403 and self.role_id:
+                # token expired: one re-login, then surface failures
+                self._login()
+                return self._raw_post(path, body)
+            raise
+
+    def create_key(self, key_id: str) -> None:
+        self._post(
+            f"/v1/transit/keys/{urllib.parse.quote(key_id, safe='')}", {})
+
+    def generate_key(self, context: str, key_id: str = ""
+                     ) -> tuple[bytes, bytes]:
+        kid = key_id or self.key_id
+        resp = self._post(
+            "/v1/transit/datakey/plaintext/"
+            f"{urllib.parse.quote(kid, safe='')}",
+            {"context": base64.b64encode(context.encode()).decode()})
+        data = resp.get("data") or {}
+        try:
+            key = base64.b64decode(data["plaintext"])
+            blob = data["ciphertext"].encode()
+        except (KeyError, TypeError, ValueError, AttributeError) as e:
+            raise KMSError(
+                f"vault: malformed datakey response: {e!r}") from None
+        if len(key) != 32:
+            raise KMSError("vault: invalid plaintext key size from KMS")
+        return key, blob
+
+    def unseal(self, blob: bytes, context: str, key_id: str = "") -> bytes:
+        kid = key_id or self.key_id
+        resp = self._post(
+            f"/v1/transit/decrypt/{urllib.parse.quote(kid, safe='')}",
+            {"ciphertext": blob.decode("ascii", "replace"),
+             "context": base64.b64encode(context.encode()).decode()})
+        data = resp.get("data") or {}
+        try:
+            key = base64.b64decode(data["plaintext"])
+        except (KeyError, TypeError, ValueError) as e:
+            raise KMSError(
+                f"vault: malformed decrypt response: {e!r}") from None
+        if len(key) != 32:
+            raise KMSError("vault: invalid plaintext key size from KMS")
+        return key
+
+    def rewrap(self, blob: bytes, context: str, key_id: str = "") -> bytes:
+        """Re-seal a blob under the current master key version after a
+        Vault-side rotation (reference UpdateKey, vault.go:293)."""
+        kid = key_id or self.key_id
+        resp = self._post(
+            f"/v1/transit/rewrap/{urllib.parse.quote(kid, safe='')}",
+            {"ciphertext": blob.decode("ascii", "replace"),
+             "context": base64.b64encode(context.encode()).decode()})
+        data = resp.get("data") or {}
+        ct = data.get("ciphertext")
+        if not isinstance(ct, str):
+            raise KMSError("vault: rewrap response missing ciphertext")
+        return ct.encode()
+
+    def info(self) -> dict:
+        return {"name": "Vault", "endpoints": [self.endpoint],
+                "default_key_id": self.key_id, "status": "online"}
+
+
+class _VaultHTTPError(KMSError):
+    def __init__(self, code: int, msg: str):
+        self.code = code
+        super().__init__(msg)
+
+
 _kms: KMS | None = None
 _seed_secret = ""
 
@@ -243,6 +391,23 @@ def get_kms() -> KMS:
                 ca_path=os.environ.get("MINIO_TPU_KMS_KES_CAPATH", ""),
                 insecure=os.environ.get(
                     "MINIO_TPU_KMS_KES_INSECURE", "") == "1")
+            return _kms
+        vault_ep = os.environ.get("MINIO_TPU_KMS_VAULT_ENDPOINT", "")
+        if vault_ep:
+            _kms = VaultClient(
+                vault_ep,
+                os.environ.get("MINIO_TPU_KMS_VAULT_KEY_NAME",
+                               "minio-tpu-default"),
+                role_id=os.environ.get(
+                    "MINIO_TPU_KMS_VAULT_APPROLE_ID", ""),
+                secret_id=os.environ.get(
+                    "MINIO_TPU_KMS_VAULT_APPROLE_SECRET", ""),
+                token=os.environ.get("MINIO_TPU_KMS_VAULT_TOKEN", ""),
+                namespace=os.environ.get(
+                    "MINIO_TPU_KMS_VAULT_NAMESPACE", ""),
+                ca_path=os.environ.get("MINIO_TPU_KMS_VAULT_CAPATH", ""),
+                insecure=os.environ.get(
+                    "MINIO_TPU_KMS_VAULT_INSECURE", "") == "1")
             return _kms
         hexkey = os.environ.get("MINIO_TPU_KMS_MASTER_KEY", "")
         if hexkey:

@@ -117,6 +117,9 @@ class Node:
         server.peers = lambda: self.peers
         server.local_locker = self.local_locker
         self.bootstrap_verify()
+        # background plane (scanner/MRF/auto-heal — reference
+        # cmd/server-main.go:508-514) once the object layer is live
+        server.start_background_services()
         return server
 
     def _broadcast_iam_update(self):

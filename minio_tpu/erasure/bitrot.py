@@ -176,24 +176,19 @@ def native_batch_hasher(algo_id: int):
 
 
 def default_bitrot_algo() -> BitrotAlgorithm:
-    """Route-aware default, overridable with MINIO_TPU_BITROT_ALGO.
+    """HighwayHash256S when the native library is built — the reference's
+    own default (cmd/bitrot.go:51), so digest-level parity comes free —
+    else blake2b. Overridable with MINIO_TPU_BITROT_ALGO.
 
-    The two streaming algorithms trade places depending on WHERE shard
-    digests get verified (both are recorded per part, so mixed objects
-    always verify with what they were written with):
-
-    * CPU-routed data plane (the common case — hot PUT/GET blocks run the
-      fused native pipeline): **HighwayHash256S**. Its AVX2 asm ingests
-      ~1.5x faster than the u32 MUR3 kernel inside mt_put_block (measured
-      1.08 vs 0.73 GiB/s e2e block rate), and it is the reference's
-      default algorithm (cmd/bitrot.go:51) — digest-level parity for
-      free.
-    * Forced-device dispatch (MINIO_TPU_DISPATCH_MODE=device — a
-      PCIe/ICI-attached chip doing fused verify+reconstruct):
-      **MUR3X256S**. On the TPU VPU (no u64, no mulhi) HighwayHash is
-      architecturally ~3.5x slower than the u32-native MUR3 design
-      (BASELINE.md: 36 vs 10.4 GiB/s fused verify+reconstruct).
-    """
+    Round-5 measurements settled the algorithm question in HighwayHash's
+    favor on BOTH routes: its AVX2 asm ingests ~1.5x faster than the u32
+    MUR3 kernel inside mt_put_block (1.08 vs 0.73 GiB/s e2e block rate),
+    and on the TPU the r03/r04 '10 GiB/s fused ceiling' turned out to be
+    a batch-flattening layout artifact in the device hash, not u64
+    emulation cost — with the packet transpose built on the natural batch
+    dims the fused verify+reconstruct runs 31.9 GiB/s (HH) vs 32.9
+    (MUR3), a wash (BASELINE.md). MUR3X256S remains fully supported for
+    parts recorded under it."""
     env = os.environ.get("MINIO_TPU_BITROT_ALGO", "")
     if env:
         try:
@@ -204,8 +199,6 @@ def default_bitrot_algo() -> BitrotAlgorithm:
             pass
     from .. import native
     if native.available():
-        if os.environ.get("MINIO_TPU_DISPATCH_MODE", "") == "device":
-            return BitrotAlgorithm.MUR3X256S
         return BitrotAlgorithm.HIGHWAYHASH256S
     return BitrotAlgorithm.BLAKE2B256S
 

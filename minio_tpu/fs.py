@@ -228,6 +228,18 @@ class FSObjects(ObjectLayer):
 
     # --- listing (shares the erasure implementation's shape) ---------------
 
+    def _iter_resolved(self, bucket, prefix="", marker="", build=True):
+        # the borrowed erasure listing walks through the metacache store,
+        # which FSObjects also carries — borrow the resolver too
+        from .objectlayer.erasure_objects import ErasureObjects
+        return ErasureObjects._iter_resolved(self, bucket, prefix, marker,
+                                             build)
+
+    def iter_objects(self, bucket, prefix=""):
+        # streaming namespace walk for the scanner (borrowed likewise)
+        from .objectlayer.erasure_objects import ErasureObjects
+        return ErasureObjects.iter_objects(self, bucket, prefix)
+
     def list_objects(self, bucket, prefix="", marker="", delimiter="",
                      max_keys=1000) -> ListObjectsInfo:
         from .objectlayer.erasure_objects import ErasureObjects

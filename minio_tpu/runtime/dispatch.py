@@ -501,6 +501,8 @@ class DispatchQueue:
             self._mark_device_failed()
             self.batches -= 1  # _flush_cpu re-counts this flush
             self.items -= len(items)
+            self.device_batches -= 1  # the device flush never completed
+            self.device_items -= len(items)
             self._flush_cpu(b, items)
 
     def _mark_device_failed(self):
@@ -613,6 +615,8 @@ class DispatchQueue:
             if pending:
                 self.batches -= 1
                 self.items -= len(pending)
+                self.device_batches -= 1  # readback never delivered
+                self.device_items -= len(pending)
                 self._flush_cpu(b, pending)
 
     def stop(self):

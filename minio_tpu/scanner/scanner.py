@@ -30,6 +30,21 @@ class DataScanner:
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
         self.last_usage: dict = {}
+        # persist the update tracker beside the first local disk's system
+        # state so skip-state survives restarts (reference
+        # cmd/data-update-tracker.go periodic save + load); _all_disks
+        # resolves every layer shape (single set, sets, pools, FS)
+        from ..obs.metrics import _all_disks
+        from .tracker import global_tracker
+        try:
+            import os as _os
+            disk = next(d for d in _all_disks(objlayer)
+                        if getattr(d, "base", ""))
+            from ..storage.xlstorage import META_BUCKET
+            global_tracker().attach_persistence(
+                _os.path.join(disk.base, META_BUCKET, "tracker.bin"))
+        except StopIteration:
+            pass
 
     def start(self):
         self._thread = threading.Thread(target=self._loop, daemon=True,

@@ -58,6 +58,23 @@ def main(argv=None):
         banner = f"gateway {args.gateway} -> {args.dirs[0]}"
     elif any(d.startswith(("http://", "https://")) for d in args.dirs):
         return _serve_distributed(args, ak, sk)
+    elif len(args.dirs) > 1 and all("{" in d for d in args.dirs):
+        # multiple ellipses args = one POOL per arg (reference server
+        # pool expansion: `minio server dir{1...4} dir{5...8}` is two
+        # pools, cmd/endpoint-ellipses.go / erasure-server-pool.go)
+        from ..dist.ellipses import expand_endpoints
+        from ..dist.topology import pick_set_layout
+        from ..objectlayer import ErasureSets, ServerPools
+        from ..storage import XLStorage
+        pools = []
+        for spec in args.dirs:
+            dirs = expand_endpoints([spec])
+            set_count, per_set = pick_set_layout(len(dirs))
+            pools.append(ErasureSets([XLStorage(d) for d in dirs],
+                                     set_count, per_set,
+                                     default_parity=args.parity))
+        obj = ServerPools(pools)
+        banner = f"erasure: {len(pools)} pools"
     else:
         from ..dist.ellipses import expand_endpoints
         dirs = expand_endpoints(args.dirs)
@@ -106,6 +123,11 @@ def main(argv=None):
             srv.enable_federation(fed)
             banner += f"; federated via etcd (domain {fed.domain})"
     _install_service_hook(srv)
+    if not args.gateway:
+        # background plane (scanner / MRF / auto-heal) runs on real
+        # object layers; gateways proxy a backend that owns its own
+        # durability (the reference skips these in gateway mode too)
+        srv.start_background_services()
     print(f"{banner}; listening on {args.address}", file=sys.stderr)
     try:
         srv.serve_forever()

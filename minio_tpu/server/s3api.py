@@ -335,7 +335,37 @@ class S3Server:
                              daemon=True).start()
         return t
 
+    def start_background_services(self, scan_interval_s: float = 300.0):
+        """Attach and start the background plane (reference
+        cmd/server-main.go:508-514 initAutoHeal / initDataScanner + MRF):
+        MRF healer, data scanner with lifecycle+transition hooks, fresh-
+        disk auto-heal monitor. Idempotent; services land on self.mrf /
+        self.scanner / self.autoheal, where the admin bg-heal-status op,
+        peer RPC and the heal metrics group already look for them."""
+        if getattr(self, "mrf", None) is not None:
+            return
+        from ..bucket.lifecycle import LifecycleSys
+        from ..obs.metrics import _all_disks
+        from ..scanner.autoheal import AutoHealMonitor
+        from ..scanner.mrf import MRFHealer
+        from ..scanner.scanner import DataScanner
+        self.mrf = MRFHealer(self.obj).start()
+        lc = LifecycleSys(self.obj, self.bucket_meta, self.transition)
+        self.scanner = DataScanner(
+            self.obj, interval_s=float(os.environ.get(
+                "MINIO_TPU_SCANNER_INTERVAL_S", str(scan_interval_s))),
+            mrf=self.mrf, lifecycle=lc).start()
+        self.autoheal = AutoHealMonitor(
+            self.obj, _all_disks(self.obj)).start()
+
     def shutdown(self):
+        for svc_name in ("scanner", "autoheal", "mrf"):
+            svc = getattr(self, svc_name, None)
+            if svc is not None:
+                try:
+                    svc.stop()
+                except Exception:  # noqa: BLE001 — teardown best-effort
+                    pass
         if self._httpd is not None:
             self._httpd.shutdown()
         for extra in self._extra_httpds:
@@ -1081,9 +1111,9 @@ class _S3Handler(BaseHTTPRequestHandler):
         stream, put_size = hr, len(file_bytes)
         from ..utils import compress as cz
         if cz.should_compress(key_field, ct):
-            meta[cz.META_COMPRESSION] = cz.ALGO
+            meta[cz.META_COMPRESSION] = cz.algo()
             meta[cz.META_ACTUAL_SIZE] = str(len(file_bytes))
-            stream, put_size = cz.CompressReader(hr), -1
+            stream, put_size = cz.compress_reader(hr), -1
             opts.etag_source = hr
         opts.user_defined = meta
         oi = self.s3.obj.put_object(self.bucket, key_field, stream,
@@ -1171,8 +1201,9 @@ class _S3Handler(BaseHTTPRequestHandler):
             self.s3.obj.get_object(self.bucket, self.key, dw, 0, -1, opts)
             dw.finish()
         elif oi.internal.get(cz.META_COMPRESSION):
-            # stored bytes are deflate: the SQL engine needs plaintext
-            dz = cz.DecompressWriter(sink)
+            # stored bytes are compressed: the SQL engine needs plaintext
+            dz = cz.decompress_writer(
+                oi.internal[cz.META_COMPRESSION], sink)
             self.s3.obj.get_object(self.bucket, self.key, dz, 0, -1, opts)
             dz.finish()
         else:
@@ -1620,9 +1651,9 @@ class _S3Handler(BaseHTTPRequestHandler):
                 # compressed length is unknown up front: the object layer
                 # streams to EOF (size=-1) and records the stored length;
                 # ETag stays the PLAINTEXT md5 via etag_source
-                user_defined[cz.META_COMPRESSION] = cz.ALGO
+                user_defined[cz.META_COMPRESSION] = cz.algo()
                 user_defined[cz.META_ACTUAL_SIZE] = str(size)
-                stream, put_size = cz.CompressReader(hr), -1
+                stream, put_size = cz.compress_reader(hr), -1
                 opts.etag_source = hr
         opts.user_defined = user_defined
         oi = self.s3.obj.put_object(self.bucket, self.key, stream, put_size,
@@ -1916,8 +1947,8 @@ class _S3Handler(BaseHTTPRequestHandler):
             elif compressed:
                 # inflate the whole stored stream, trim to the requested
                 # plaintext range (reference compressed-range behavior)
-                dz = cz.DecompressWriter(self.wfile, skip=offset,
-                                         limit=length)
+                dz = cz.decompress_writer(compressed, self.wfile,
+                                          skip=offset, limit=length)
                 self.s3.obj.get_object(self.bucket, self.key, dz, 0, -1,
                                        opts)
                 dz.finish()

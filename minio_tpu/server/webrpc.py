@@ -342,7 +342,7 @@ def handle_download(h, bucket: str, object: str) -> None:
         h.s3.obj.get_object(bucket, object, dw)
         dw.finish()
     elif compressed:
-        dz = cz.DecompressWriter(h.wfile)
+        dz = cz.decompress_writer(compressed, h.wfile)
         h.s3.obj.get_object(bucket, object, dz)
         dz.finish()
     else:

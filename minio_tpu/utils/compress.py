@@ -1,17 +1,43 @@
 """Transparent object compression (reference cmd/object-api-utils.go:920
 newS2CompressReader + compression config): opt-in via config/env, applied
 on PUT for compressible content (extension/MIME filters), recorded in
-internal metadata, undone on GET. The reference streams snappy/S2; zlib
-level 1 plays the same role here (pure-Python deployment, off by default
-exactly like the reference)."""
+internal metadata, undone on GET.
+
+Formats. The default stored stream is the **S2/snappy frame format**
+(chunked, CRC32C-checked, snappy-block payloads) recorded under the
+reference's own metadata value ``klauspost/compress/s2``
+(cmd/object-handlers.go:74) — a stream this writer produces is a valid
+S2 stream, so a reference deployment reads our compressed objects and
+vice versa for any stream made of standard snappy blocks. The round-1..4
+``zlib/1`` scheme stays readable (algo is recorded per object) and
+selectable via ``MINIO_TPU_COMPRESSION_FORMAT=zlib``. Limitations are
+explicit: blocks using S2's non-snappy extension tags (repeat offsets,
+as produced by the Go encoder at higher compression settings for some
+inputs) fail decode with a clear error instead of corrupting output.
+"""
 from __future__ import annotations
 
 import os
+import struct
 import zlib
+
+from .snappy import SnappyError, compress as snappy_compress
+from .snappy import decompress as snappy_decompress
 
 META_COMPRESSION = "x-minio-internal-compression"
 META_ACTUAL_SIZE = "x-minio-internal-actual-size"
-ALGO = "zlib/1"
+
+ALGO_ZLIB = "zlib/1"
+#: reference compressionAlgorithmV2 (cmd/object-handlers.go:74)
+ALGO_S2 = "klauspost/compress/s2"
+#: reference compressionAlgorithmV1 — same frame format, snappy blocks
+ALGO_SNAPPY_V1 = "golang/snappy/LZ77"
+
+_STREAM_ID = b"\xff\x06\x00\x00sNaPpY"
+_CHUNK_COMPRESSED = 0x00
+_CHUNK_UNCOMPRESSED = 0x01
+_CHUNK_PADDING = 0xFE
+_BLOCK = 1 << 16  # max uncompressed bytes per frame chunk (snappy spec)
 
 DEFAULT_EXTENSIONS = (".txt", ".log", ".csv", ".json", ".tar", ".xml",
                       ".bin")
@@ -22,6 +48,17 @@ DEFAULT_MIME = ("text/", "application/json", "application/xml",
 def enabled() -> bool:
     return os.environ.get("MINIO_TPU_COMPRESSION", "") in ("1", "on",
                                                            "true")
+
+
+def algo() -> str:
+    """The algorithm recorded on NEW compressed objects."""
+    fmt = os.environ.get("MINIO_TPU_COMPRESSION_FORMAT", "s2").lower()
+    return ALGO_ZLIB if fmt == "zlib" else ALGO_S2
+
+
+#: backward-compat name: round-1..4 call sites tagged objects with
+#: ``cz.ALGO`` — keep it pointing at the zlib marker those objects carry
+ALGO = ALGO_ZLIB
 
 
 def should_compress(key: str, content_type: str) -> bool:
@@ -38,18 +75,18 @@ def should_compress(key: str, content_type: str) -> bool:
     return any((content_type or "").lower().startswith(m) for m in mimes)
 
 
-def logical_bytes(oi, stored: bytes) -> bytes:
-    """The object's plaintext given its STORED bytes: inflate when the
-    compression marker is present. Subsystems that move object data out
-    of this deployment (replication, tiering) must ship plaintext — the
-    destination doesn't know our markers."""
-    if getattr(oi, "internal", {}).get(META_COMPRESSION):
-        return zlib.decompress(stored)
-    return stored
+def _crc32c_masked(data: bytes) -> int:
+    from ..event.wire import _crc32c
+    c = _crc32c(data)
+    return (((c >> 15) | (c << 17)) + 0xA282EAD8) & 0xFFFFFFFF
+
+
+# -- writers (PUT path) -------------------------------------------------------
 
 
 class CompressReader:
-    """Wraps a plaintext stream, yields the raw-deflate stream."""
+    """Wraps a plaintext stream, yields the raw-deflate stream
+    (``zlib/1`` scheme)."""
 
     def __init__(self, stream, level: int = 1):
         self.stream = stream
@@ -77,20 +114,67 @@ class CompressReader:
         return out
 
 
-class DecompressWriter:
-    """Writer wrapper inflating the stored stream and emitting the
-    plaintext sub-range [skip, skip+limit) — ranged GETs decompress from
-    the start and trim (the reference does the same for compressed
-    ranges)."""
+class S2CompressReader:
+    """Wraps a plaintext stream, yields an S2/snappy framed stream
+    (reference newS2CompressReader, cmd/object-api-utils.go:920-935):
+    stream identifier, then one CRC32C-checked chunk per 64 KiB block,
+    stored compressed only when snappy actually wins."""
+
+    def __init__(self, stream):
+        self.stream = stream
+        self._buf = bytearray(_STREAM_ID)
+        self._eof = False
+
+    def _pump(self):
+        raw = self.stream.read(_BLOCK)
+        if not raw:
+            self._eof = True
+            return
+        crc = struct.pack("<I", _crc32c_masked(raw))
+        comp = snappy_compress(raw)
+        if len(comp) < len(raw):
+            payload = crc + comp
+            kind = _CHUNK_COMPRESSED
+        else:
+            payload = crc + raw
+            kind = _CHUNK_UNCOMPRESSED
+        self._buf += bytes([kind]) + len(payload).to_bytes(3, "little")
+        self._buf += payload
+
+    def read(self, n: int = -1) -> bytes:
+        if n < 0:
+            out = bytearray()
+            while True:
+                b = self.read(1 << 20)
+                if not b:
+                    return bytes(out)
+                out += b
+        while not self._eof and len(self._buf) < n:
+            self._pump()
+        out = bytes(self._buf[:n])
+        del self._buf[:n]
+        return out
+
+
+def compress_reader(stream):
+    """The PUT-side wrapper for the configured format; pair with
+    ``algo()`` for the metadata marker."""
+    return CompressReader(stream) if algo() == ALGO_ZLIB \
+        else S2CompressReader(stream)
+
+
+# -- readers (GET path) -------------------------------------------------------
+
+
+class _RangeEmitter:
+    """Shared [skip, skip+limit) plaintext windowing for ranged GETs —
+    decompress from the start and trim (the reference does the same for
+    compressed ranges)."""
 
     def __init__(self, writer, skip: int = 0, limit: int = -1):
         self.writer = writer
-        self._d = zlib.decompressobj()
         self._skip = skip
         self._left = limit
-
-    def write(self, b: bytes):
-        self._emit(self._d.decompress(b))
 
     def _emit(self, plain: bytes):
         if not plain:
@@ -105,10 +189,102 @@ class DecompressWriter:
         if plain:
             self.writer.write(plain)
 
-    def finish(self):
-        self._emit(self._d.flush())
-
     def close(self):
         self.finish()
         if hasattr(self.writer, "close"):
             self.writer.close()
+
+    def finish(self):  # overridden where flushing applies
+        pass
+
+
+class DecompressWriter(_RangeEmitter):
+    """Writer wrapper inflating a ``zlib/1`` stored stream."""
+
+    def __init__(self, writer, skip: int = 0, limit: int = -1):
+        super().__init__(writer, skip, limit)
+        self._d = zlib.decompressobj()
+
+    def write(self, b: bytes):
+        self._emit(self._d.decompress(b))
+
+    def finish(self):
+        self._emit(self._d.flush())
+
+
+class S2DecompressWriter(_RangeEmitter):
+    """Writer wrapper inflating an S2/snappy framed stream: compressed,
+    uncompressed, padding and skippable chunks; CRC32C verified per
+    chunk. Unknown unskippable chunk types and S2 extension blocks the
+    snappy decoder cannot parse raise SnappyError."""
+
+    def __init__(self, writer, skip: int = 0, limit: int = -1):
+        super().__init__(writer, skip, limit)
+        self._pend = bytearray()
+
+    def write(self, b: bytes):
+        self._pend += b
+        while True:
+            if len(self._pend) < 4:
+                return
+            kind = self._pend[0]
+            ln = int.from_bytes(self._pend[1:4], "little")
+            if len(self._pend) < 4 + ln:
+                return
+            payload = bytes(self._pend[4: 4 + ln])
+            del self._pend[: 4 + ln]
+            if kind == 0xFF:
+                if payload != _STREAM_ID[4:]:
+                    raise SnappyError("bad s2 stream identifier")
+                continue
+            if kind in (_CHUNK_COMPRESSED, _CHUNK_UNCOMPRESSED):
+                if ln < 4:
+                    raise SnappyError("truncated s2 chunk")
+                (want_crc,) = struct.unpack_from("<I", payload)
+                raw = snappy_decompress(payload[4:]) \
+                    if kind == _CHUNK_COMPRESSED else payload[4:]
+                if _crc32c_masked(raw) != want_crc:
+                    raise SnappyError("s2 chunk crc mismatch")
+                self._emit(raw)
+                continue
+            if kind == _CHUNK_PADDING or 0x80 <= kind <= 0xFD:
+                continue  # padding / skippable
+            raise SnappyError(f"unskippable s2 chunk type {kind:#x}")
+
+    def finish(self):
+        if self._pend:
+            raise SnappyError("truncated s2 frame stream")
+
+
+def decompress_writer(algo_name: str, writer, skip: int = 0,
+                      limit: int = -1):
+    """Reader-side wrapper for a stored object's recorded algorithm."""
+    if algo_name in (ALGO_S2, ALGO_SNAPPY_V1):
+        return S2DecompressWriter(writer, skip, limit)
+    return DecompressWriter(writer, skip, limit)
+
+
+def logical_bytes(oi, stored: bytes) -> bytes:
+    """The object's plaintext given its STORED bytes: inflate when the
+    compression marker is present. Subsystems that move object data out
+    of this deployment (replication, tiering) must ship plaintext — the
+    destination doesn't know our markers."""
+    marker = getattr(oi, "internal", {}).get(META_COMPRESSION)
+    if not marker:
+        return stored
+    if marker in (ALGO_S2, ALGO_SNAPPY_V1):
+        import io
+
+        class _Sink:
+            def __init__(self):
+                self.buf = io.BytesIO()
+
+            def write(self, b):
+                self.buf.write(b)
+
+        s = _Sink()
+        d = S2DecompressWriter(s)
+        d.write(stored)
+        d.finish()
+        return s.buf.getvalue()
+    return zlib.decompress(stored)

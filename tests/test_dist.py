@@ -403,7 +403,10 @@ def test_peer_control_plane_breadth(cluster):
     assert "bucketStats" in bw
     logs = peer.console_log(10)
     assert isinstance(logs, list)
-    assert peer.background_heal_status() == {}
+    # Node startup attaches the background plane (scanner/MRF/autoheal)
+    st = peer.background_heal_status()
+    assert "mrf" in st and "autoheal" in st
+    assert st["mrf"]["queued"] == 0
     # profiling fan-out: start on the peer, download a sampler report
     peer.start_profiling("cpu")
     time.sleep(0.1)

@@ -217,6 +217,28 @@ def test_streaming_bitrot_layout():
 HH = BitrotAlgorithm.HIGHWAYHASH256S
 
 
+def test_highwayhash_batched_dims_match_flat():
+    """The multi-dim device path (natural-dims packet transpose — the
+    fused pipeline's shape) is bit-identical to the flat 2-D path,
+    including a non-32-multiple chunk size (tail packet)."""
+    import jax.numpy as jnp
+
+    from minio_tpu.native import highwayhash as hhn
+    from minio_tpu.ops import hh_jax
+    rng = np.random.default_rng(9)
+    for nbytes in (128, 84):  # 4 packets / 2 packets + 20-byte tail
+        data = rng.integers(0, 256, (2, 3, 2, nbytes), dtype=np.uint8)
+        d32 = jnp.asarray(np.ascontiguousarray(data).view(np.uint32))
+        kw = hh_jax._key_words(hhn.TEST_KEY)
+        got = np.asarray(hh_jax.hash256_device_words(kw, nbytes, d32))
+        flat = np.asarray(hh_jax.hash256_device_words(
+            kw, nbytes, d32.reshape(12, nbytes // 4)))
+        assert np.array_equal(got.reshape(12, 8), flat), nbytes
+        want = hhn.hash256_batch(hhn.TEST_KEY, data.reshape(12, nbytes))
+        digs = np.ascontiguousarray(got.reshape(12, 8)).view(np.uint8)
+        assert np.array_equal(digs, want), nbytes
+
+
 def test_highwayhash_test_vectors():
     """Native HighwayHash pinned to the published 64-bit vectors, and the
     device (JAX) kernel bit-identical to it across packet/remainder paths."""
@@ -232,22 +254,18 @@ def test_highwayhash_test_vectors():
                               hhn.hash256_batch(hhn.TEST_KEY, chunks))
 
 
-def test_default_algo_is_route_aware(monkeypatch):
-    """CPU-routed deployments default to HighwayHash256S (AVX2 ingest +
-    reference parity, cmd/bitrot.go:51); forced-device deployments to
-    MUR3X256S (u32-native on the VPU). See BASELINE.md."""
+def test_default_algo_is_highwayhash(monkeypatch):
+    """HighwayHash256S is the default (reference parity, fastest on both
+    the AVX2 ingest path and — after the round-5 layout fix — the device
+    fused path); MUR3X256S stays selectable. See BASELINE.md."""
     from minio_tpu import native
     from minio_tpu.erasure.bitrot import (DEFAULT_BITROT_ALGO,
                                           BitrotAlgorithm,
                                           default_bitrot_algo)
     if native.available():
-        monkeypatch.delenv("MINIO_TPU_DISPATCH_MODE", raising=False)
         monkeypatch.delenv("MINIO_TPU_BITROT_ALGO", raising=False)
         assert default_bitrot_algo() is BitrotAlgorithm.HIGHWAYHASH256S
-        monkeypatch.setenv("MINIO_TPU_DISPATCH_MODE", "device")
-        assert default_bitrot_algo() is BitrotAlgorithm.MUR3X256S
         monkeypatch.setenv("MINIO_TPU_BITROT_ALGO", "mur3x256S")
-        monkeypatch.delenv("MINIO_TPU_DISPATCH_MODE", raising=False)
         assert default_bitrot_algo() is BitrotAlgorithm.MUR3X256S
     assert DEFAULT_BITROT_ALGO.streaming
     assert DEFAULT_BITROT_ALGO.available

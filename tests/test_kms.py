@@ -265,3 +265,139 @@ def test_admin_kms_endpoints(c, srv):
     r = c.request("POST", "/minio/admin/v3/kms/key/create",
                   query={"key-id": "newkey"})
     assert r.status_code == 200
+
+
+# --- Vault transit KMS (reference cmd/crypto/vault.go) ----------------------
+
+
+class _StubVault(BaseHTTPRequestHandler):
+    """Minimal Vault speaking the transit + AppRole HTTP API: login issues
+    a token, transit seals with per-key AES-GCM and vault:v1: ASCII
+    ciphertexts, context bound into the AAD — the same blob/endpoint
+    shapes cmd/crypto/vault.go drives."""
+
+    keys: dict = {}
+    tokens: set = set()
+    role = ("test-role", "test-secret")
+    expire_tokens = False  # force 403 once to exercise re-login
+
+    def log_message(self, *a):  # noqa: D102
+        pass
+
+    def do_POST(self):  # noqa: N802
+        from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+        body = json.loads(
+            self.rfile.read(int(self.headers.get("Content-Length", 0) or 0))
+            or b"{}")
+        path = self.path.strip("/").split("/")  # v1/...
+        if path[1] == "auth":  # v1/auth/approle/login
+            if (body.get("role_id"), body.get("secret_id")) != self.role:
+                return self._reply(400, {"errors": ["invalid role"]})
+            tok = secrets.token_hex(12)
+            _StubVault.tokens.add(tok)
+            return self._reply(200, {"auth": {"client_token": tok}})
+        tok = self.headers.get("X-Vault-Token", "")
+        if _StubVault.expire_tokens:
+            _StubVault.expire_tokens = False
+            _StubVault.tokens.discard(tok)
+        if tok not in self.tokens:
+            return self._reply(403, {"errors": ["permission denied"]})
+        op, name = path[2], path[-1]  # v1/transit/<op>[/plaintext]/<name>
+        if op == "keys":
+            self.keys.setdefault(name, secrets.token_bytes(32))
+            return self._reply(200, {})
+        if name not in self.keys:
+            return self._reply(400, {"errors": ["unknown key"]})
+        aead = AESGCM(self.keys[name])
+        ctx = base64.b64decode(body.get("context", "") or "")
+        if op == "datakey":
+            key = secrets.token_bytes(32)
+            nonce = secrets.token_bytes(12)
+            ct = "vault:v1:" + base64.b64encode(
+                nonce + aead.encrypt(nonce, key, ctx)).decode()
+            return self._reply(200, {"data": {
+                "plaintext": base64.b64encode(key).decode(),
+                "ciphertext": ct}})
+        if op in ("decrypt", "rewrap"):
+            ct = body.get("ciphertext", "")
+            if not ct.startswith("vault:v1:"):
+                return self._reply(400, {"errors": ["bad ciphertext"]})
+            blob = base64.b64decode(ct[len("vault:v1:"):])
+            try:
+                key = aead.decrypt(blob[:12], blob[12:], ctx)
+            except Exception:  # noqa: BLE001
+                return self._reply(400, {"errors": ["decryption failed"]})
+            if op == "decrypt":
+                return self._reply(200, {"data": {
+                    "plaintext": base64.b64encode(key).decode()}})
+            nonce = secrets.token_bytes(12)
+            ct2 = "vault:v1:" + base64.b64encode(
+                nonce + aead.encrypt(nonce, key, ctx)).decode()
+            return self._reply(200, {"data": {"ciphertext": ct2}})
+        self._reply(404, {"errors": ["unknown op"]})
+
+    def _reply(self, status, obj):
+        body = json.dumps(obj).encode()
+        self.send_response(status)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+
+@pytest.fixture(scope="module")
+def vault_srv():
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), _StubVault)
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    yield f"http://127.0.0.1:{httpd.server_address[1]}"
+    httpd.shutdown()
+
+
+def test_vault_client_wire(vault_srv):
+    from minio_tpu.crypto import VaultClient
+    v = VaultClient(vault_srv, "vault-root-key",
+                    role_id="test-role", secret_id="test-secret")
+    v.create_key("vault-root-key")
+    key, blob = v.generate_key("bucket/obj")
+    assert len(key) == 32 and blob.startswith(b"vault:v1:")
+    assert v.unseal(blob, "bucket/obj") == key
+    # wrong context must fail (AAD binding)
+    with pytest.raises(KMSError):
+        v.unseal(blob, "other/obj")
+    # rewrap produces a different blob that still unseals to the same key
+    blob2 = v.rewrap(blob, "bucket/obj")
+    assert blob2 != blob and v.unseal(blob2, "bucket/obj") == key
+
+
+def test_vault_token_expiry_relogin(vault_srv):
+    from minio_tpu.crypto import VaultClient
+    v = VaultClient(vault_srv, "vault-root-key",
+                    role_id="test-role", secret_id="test-secret")
+    v.create_key("vault-root-key")
+    key, blob = v.generate_key("b/o")
+    _StubVault.expire_tokens = True  # next call 403s once
+    assert v.unseal(blob, "b/o") == key  # transparent re-login
+
+
+def test_vault_unreachable():
+    from minio_tpu.crypto import KMSUnreachable, VaultClient
+    v = VaultClient("http://127.0.0.1:1", "k", token="x", timeout=0.3)
+    with pytest.raises(KMSUnreachable):
+        v.generate_key("b/o")
+
+
+def test_sse_kms_via_vault(c, vault_srv):
+    """The full stack: S3 SSE-KMS requests served by a Vault-backed KMS."""
+    from minio_tpu.crypto import VaultClient
+    v = VaultClient(vault_srv, "vault-root-key",
+                    role_id="test-role", secret_id="test-secret")
+    v.create_key("vault-root-key")
+    crypto.set_kms(v)
+    try:
+        r = c.request("PUT", "/kms/obj-vault", body=BODY,
+                      headers=_kms_headers())
+        assert r.status_code == 200, r.text
+        r = c.request("GET", "/kms/obj-vault")
+        assert r.status_code == 200 and r.content == BODY
+    finally:
+        crypto.set_kms(None)

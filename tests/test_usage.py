@@ -67,21 +67,51 @@ def test_tracker_skips_clean_buckets(tmp_path):
     assert set(walked) == {"clean", "busy"}
 
 
-def test_tracker_overflow_degrades_to_dirty():
-    t = UpdateTracker()
+def test_tracker_bloom_semantics():
+    """Rotating blooms: marks are never hidden (no false negatives), a
+    completed sweep clears covered generations, the history cap merges
+    oldest filters instead of dropping them."""
     import minio_tpu.scanner.tracker as trmod
-    old = trmod.MAX_ENTRIES
-    trmod.MAX_ENTRIES = 3
-    try:
-        for i in range(5):
-            t.mark("b", f"p{i}/x")
-        assert t.bucket_dirty("b")
-        assert t.bucket_dirty("other")  # overflow: everything dirty
-        gen = t.begin_cycle()
-        t.end_cycle(gen)
-        assert not t.bucket_dirty("other")  # cleared after a full sweep
-    finally:
-        trmod.MAX_ENTRIES = old
+    t = UpdateTracker()
+    for i in range(5):
+        t.mark("b", f"p{i}/x")
+    assert t.bucket_dirty("b")
+    assert t.prefix_dirty("b", "p3")
+    gen = t.begin_cycle()
+    t.end_cycle(gen)
+    assert not t.bucket_dirty("b")  # cleared after a full sweep
+    # stalled scanner: rotations beyond MAX_HISTORY merge, never drop
+    t.mark("keep", "deep/x")
+    for _ in range(trmod.MAX_HISTORY + 4):
+        t.begin_cycle()  # no end_cycle: sweeps never complete
+    assert t.bucket_dirty("keep")  # oldest dirt still visible
+
+
+def test_tracker_persistence_roundtrip(tmp_path):
+    """Skip-state survives a restart (reference persisted blooms,
+    cmd/data-update-tracker.go): dirtiness marked before 'shutdown' is
+    visible in a fresh tracker after load."""
+    path = str(tmp_path / "tracker.bin")
+    t = UpdateTracker(persist_path=path)
+    t.mark("survivor", "pre/x")
+    t.save()
+    t2 = UpdateTracker()
+    t2.attach_persistence(path)
+    assert t2.bucket_dirty("survivor")
+    assert t2.prefix_dirty("survivor", "pre")
+    assert not t2.bucket_dirty("neverseen")
+    # a completed sweep in the reloaded tracker clears and persists
+    gen = t2.begin_cycle()
+    t2.end_cycle(gen)
+    t3 = UpdateTracker()
+    t3.attach_persistence(path)
+    assert not t3.bucket_dirty("survivor")
+    # corrupt file: load fails closed (clean state), no crash
+    with open(path, "wb") as f:
+        f.write(b"garbage")
+    t4 = UpdateTracker()
+    assert t4.attach_persistence(path) is None  # no exception
+    assert not t4.bucket_dirty("survivor")
 
 
 def test_marks_survive_mid_cycle(tmp_path):
