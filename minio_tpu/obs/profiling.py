@@ -142,6 +142,36 @@ def thread_dump() -> bytes:
     return out.getvalue().encode()
 
 
+def _drive_kernel_stats(path: str) -> dict:
+    """Kernel block-device view of the drive backing ``path`` (the
+    unprivileged slice of the reference's pkg/smart drive report: SMART
+    ioctls need CAP_SYS_RAWIO, but /proc/diskstats exposes the health-
+    relevant IO counters — error spikes show as io_time/weighted-io
+    divergence)."""
+    import os
+    try:
+        st = os.stat(path)
+        major, minor = os.major(st.st_dev), os.minor(st.st_dev)
+        with open("/proc/diskstats") as f:
+            for ln in f:
+                parts = ln.split()
+                if len(parts) >= 14 and int(parts[0]) == major and \
+                        int(parts[1]) == minor:
+                    return {
+                        "name": parts[2],
+                        "reads_completed": int(parts[3]),
+                        "sectors_read": int(parts[5]),
+                        "writes_completed": int(parts[7]),
+                        "sectors_written": int(parts[9]),
+                        "io_in_progress": int(parts[11]),
+                        "io_time_ms": int(parts[12]),
+                        "weighted_io_time_ms": int(parts[13]),
+                    }
+    except (OSError, ValueError):
+        pass
+    return {}
+
+
 def health_info(server) -> dict:
     """OBD health report (reference getServerOBDInfo subset that applies
     to this runtime): cpu, memory, per-disk capacity + latency probe,
@@ -205,6 +235,9 @@ def health_info(server) -> dict:
             os.unlink(probe)
         except OSError as e:
             entry["error"] = str(e)
+        smart = _drive_kernel_stats(base)
+        if smart:
+            entry["device"] = smart
         drives.append(entry)
     info["drives"] = drives
     # cluster view

@@ -111,12 +111,69 @@ def gf_matmul(masks: jnp.ndarray, x: jnp.ndarray) -> jnp.ndarray:
     return gf_matmul_pallas(masks, x, interpret=not on_tpu())
 
 
-# Batched: one shared matrix across the batch (encode path).
-gf_matmul_batch = jax.jit(
-    jax.vmap(gf_matmul, in_axes=(None, 0)))
-# Batched with per-element matrices (heal path).
-gf_matmul_batch_per = jax.jit(
-    jax.vmap(gf_matmul, in_axes=(0, 0)))
+def _dyn_batch_kernel(masks_ref, x_ref, out_ref):
+    """nb batch elements per grid step, per-element masks: small shards
+    coalesce so each step still moves ~16K words (mirrors the static
+    kernel's _batch_block; the old per-element vmap grid was DMA-bound
+    at 64 KiB blocks)."""
+    nb, i = x_ref.shape[0], x_ref.shape[1]
+    p = x_ref[:]
+    acc = jnp.zeros(out_ref.shape, dtype=jnp.uint32)
+    for b in range(7, -1, -1):
+        if b != 7:
+            acc = gf2x_packed(acc)
+        m = masks_ref[:, b]  # (nb, o, i)
+        for j in range(i):
+            acc = acc ^ (m[:, :, j][:, :, None, None]
+                         & p[:, j][:, None, :, :])
+    out_ref[:] = acc
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _gf_matmul_batched(masks: jnp.ndarray, x: jnp.ndarray,
+                       interpret: bool = False) -> jnp.ndarray:
+    """masks uint32 [B, 8, o, i], x uint32 [B, i, W] -> [B, o, W]."""
+    bsz, _, o, i = masks.shape
+    w = x.shape[-1]
+    wpad, tl, lanes = _layout(w)
+    if wpad != w:
+        x = jnp.pad(x, ((0, 0), (0, 0), (0, wpad - w)))
+    rows = wpad // lanes
+    nb = _batch_block(bsz, wpad)
+    x4 = x.reshape(bsz, i, rows, lanes)
+    out = pl.pallas_call(
+        _dyn_batch_kernel,
+        out_shape=jax.ShapeDtypeStruct((bsz, o, rows, lanes), jnp.uint32),
+        grid=(bsz // nb, rows // tl),
+        in_specs=[
+            pl.BlockSpec((nb, 8, o, i), lambda e, t: (e, 0, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((nb, i, tl, lanes), lambda e, t: (e, 0, t, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((nb, o, tl, lanes),
+                               lambda e, t: (e, 0, t, 0),
+                               memory_space=pltpu.VMEM),
+        interpret=interpret,
+    )(masks, x4)
+    out = out.reshape(bsz, o, wpad)
+    return out[..., :w] if wpad != w else out
+
+
+@jax.jit
+def gf_matmul_batch(masks: jnp.ndarray, x: jnp.ndarray) -> jnp.ndarray:
+    """One shared matrix across the batch (encode-shape path): masks
+    [8, o, i], x [B, i, W] -> [B, o, W]."""
+    b = x.shape[0]
+    mb = jnp.broadcast_to(masks, (b,) + masks.shape)
+    return _gf_matmul_batched(mb, x, interpret=not on_tpu())
+
+
+@jax.jit
+def gf_matmul_batch_per(masks: jnp.ndarray, x: jnp.ndarray) -> jnp.ndarray:
+    """Per-element matrices (heal path): masks [B, 8, o, i],
+    x [B, i, W] -> [B, o, W]."""
+    return _gf_matmul_batched(masks, x, interpret=not on_tpu())
 
 
 # --- static-specialized encode ----------------------------------------------

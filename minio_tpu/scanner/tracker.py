@@ -57,9 +57,11 @@ class BloomFilter:
                    for p in self._positions(key))
 
     def union(self, other: "BloomFilter") -> None:
-        b, o = self.bits, other.bits
-        for i in range(len(b)):
-            b[i] |= o[i]
+        # big-int OR, not a 128Ki-iteration Python byte loop (load() and
+        # history merges run this under the tracker lock)
+        merged = int.from_bytes(self.bits, "little") | \
+            int.from_bytes(other.bits, "little")
+        self.bits = bytearray(merged.to_bytes(len(self.bits), "little"))
 
 
 def _bucket_key(bucket: str) -> bytes:
@@ -88,7 +90,8 @@ class UpdateTracker:
             self._cur.add(_bucket_key(bucket))
             self._cur.add(_prefix_key(bucket, top))
             self._marks_since_save += 1
-            flush = self._marks_since_save >= SAVE_EVERY
+            flush = self._persist_path is not None and \
+                self._marks_since_save >= SAVE_EVERY
         if flush:
             # background flush: the write path must not pay a multi-MiB
             # serialization + disk write per SAVE_EVERY marks (the
@@ -97,12 +100,15 @@ class UpdateTracker:
 
     def _save_async(self) -> None:
         with self._lock:
-            if self._save_thread is not None and \
-                    self._save_thread.is_alive():
+            t = self._save_thread
+            if t is not None and t.is_alive():
                 return
-            self._save_thread = threading.Thread(
-                target=self.save, daemon=True, name="tracker-save")
-        self._save_thread.start()
+            t = threading.Thread(target=self.save, daemon=True,
+                                 name="tracker-save")
+            self._save_thread = t
+        # start the LOCAL handle: re-reading the attribute here could
+        # start a thread a racing marker already started
+        t.start()
 
     def _blooms(self) -> list[BloomFilter]:
         return [self._cur] + [f for _, f in self._history]

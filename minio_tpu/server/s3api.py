@@ -274,12 +274,17 @@ class S3Server:
 
         class TunedServer(ThreadingHTTPServer):
             """Listener tuning (reference cmd/http/server.go +
-            listener.go): deep accept backlog for bursty S3 clients, and
+            listener.go): deep accept backlog for bursty S3 clients,
             TCP_NODELAY + keepalive on every accepted connection so small
             metadata responses don't sit in Nagle buffers and dead peers
-            get reaped."""
+            get reaped, and an idle read timeout so keep-alive
+            connections that go quiet release their handler thread
+            (thread-per-connection's slowloris exposure; reference
+            ReadTimeout, cmd/http/server.go)."""
             request_queue_size = 1024
             daemon_threads = True
+            idle_timeout_s = float(os.environ.get(
+                "MINIO_TPU_HTTP_IDLE_TIMEOUT_S", "120"))
 
             def process_request(self, request, client_address):
                 try:
@@ -287,6 +292,8 @@ class S3Server:
                                        socket.TCP_NODELAY, 1)
                     request.setsockopt(socket.SOL_SOCKET,
                                        socket.SO_KEEPALIVE, 1)
+                    if self.idle_timeout_s > 0:
+                        request.settimeout(self.idle_timeout_s)
                 except OSError:
                     pass
                 super().process_request(request, client_address)

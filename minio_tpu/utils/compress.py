@@ -274,17 +274,9 @@ def logical_bytes(oi, stored: bytes) -> bytes:
         return stored
     if marker in (ALGO_S2, ALGO_SNAPPY_V1):
         import io
-
-        class _Sink:
-            def __init__(self):
-                self.buf = io.BytesIO()
-
-            def write(self, b):
-                self.buf.write(b)
-
-        s = _Sink()
-        d = S2DecompressWriter(s)
+        buf = io.BytesIO()
+        d = S2DecompressWriter(buf)
         d.write(stored)
         d.finish()
-        return s.buf.getvalue()
+        return buf.getvalue()
     return zlib.decompress(stored)

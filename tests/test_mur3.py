@@ -64,6 +64,28 @@ def test_batch_entries_match():
             KEY, chunks[i].tobytes())
 
 
+def test_mur3_batched_dims_match_flat():
+    """The multi-dim device path (natural-dims lane streams — the fused
+    pipeline's shape) is bit-identical to the flat 2-D path and the
+    native digests."""
+    import jax.numpy as jnp
+
+    from minio_tpu.native import mur3py
+    from minio_tpu.ops import mur3_jax
+    rng = np.random.default_rng(5)
+    nbytes = 256
+    data = rng.integers(0, 256, (2, 3, 2, nbytes), dtype=np.uint8)
+    d32 = jnp.asarray(np.ascontiguousarray(data).view(np.uint32))
+    kw = mur3_jax._key_words(KEY)
+    got = np.asarray(mur3_jax.hash256_device_words(kw, nbytes, d32))
+    flat = np.asarray(mur3_jax.hash256_device_words(
+        kw, nbytes, d32.reshape(12, nbytes // 4)))
+    assert np.array_equal(got.reshape(12, 8), flat)
+    want = mur3py.hash256_batch(KEY, data.reshape(12, nbytes))
+    assert np.array_equal(
+        np.ascontiguousarray(got.reshape(12, 8)).view(np.uint8), want)
+
+
 @pytest.mark.skipif(not native.available(), reason="no native build")
 def test_mur3_objects_roundtrip_and_heal(tmp_path):
     """End-to-end with the explicit mur3 algo (the device-route default —
