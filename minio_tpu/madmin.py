@@ -183,6 +183,12 @@ class AdminClient:
     def service_stop(self) -> None:
         self._json("POST", "service", {"action": "stop"})
 
+    def server_update(self) -> dict:
+        """`mc admin update` (reference madmin ServerUpdate): reports the
+        running/available version; source deployments have no update
+        channel."""
+        return self._json("POST", "update")
+
     # -- kms ------------------------------------------------------------------
 
     def kms_status(self) -> dict:

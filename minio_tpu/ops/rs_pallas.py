@@ -116,7 +116,7 @@ def _dyn_batch_kernel(masks_ref, x_ref, out_ref):
     coalesce so each step still moves ~16K words (mirrors the static
     kernel's _batch_block; the old per-element vmap grid was DMA-bound
     at 64 KiB blocks)."""
-    nb, i = x_ref.shape[0], x_ref.shape[1]
+    i = x_ref.shape[1]
     p = x_ref[:]
     acc = jnp.zeros(out_ref.shape, dtype=jnp.uint32)
     for b in range(7, -1, -1):

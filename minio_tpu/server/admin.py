@@ -36,6 +36,17 @@ def _dispatch_admin(h, op: str) -> None:
             "mode": "online", "backend": h.s3.obj.backend_type(),
             "region": h.s3.region, **info}).encode()
         return h._send(200, body, "application/json")
+    if op == "update":
+        # reference cmd/update.go self-update from dl.min.io; this build
+        # is deployed from source, so the honest answer is the running
+        # version and "no update channel" rather than a silent no-op
+        from .. import __version__
+        return h._send(200, json.dumps({
+            "currentVersion": __version__,
+            "updatedVersion": __version__,
+            "message": "self-update disabled: source deployment "
+                       "(update via your package/checkout workflow)",
+        }).encode(), "application/json")
     if op == "storageinfo":
         return h._send(200, json.dumps(h.s3.obj.storage_info()).encode(),
                        "application/json")

@@ -39,6 +39,37 @@ def _usable_cpus() -> int:
 #: block (measured +0.35 s/GiB)
 _MULTI_CORE = _usable_cpus() > 1
 
+#: single-core concurrency adaptivity: the FIRST active large ingest
+#: hashes inline (fastest serial), ADDITIONAL concurrent ones share the
+#: multi-lane AVX2 MD5 server (8 streams cost ~1 scalar pass total) —
+#: measured (serial, par8) GiB/s: inline-only (0.34, 0.26), lane-only
+#: (0.25, 0.31), adaptive keeps the best of each
+_active_lock = threading.Lock()
+_active_large = 0
+
+
+def _enter_large() -> int:
+    """Register a large-body ingest; returns how many were already
+    active."""
+    global _active_large
+    with _active_lock:
+        n = _active_large
+        _active_large += 1
+        return n
+
+
+def _leave_large() -> None:
+    global _active_large
+    with _active_lock:
+        _active_large = max(0, _active_large - 1)
+
+
+def _release_large(token: dict) -> None:
+    """Idempotent decrement: runs at EOF (stream consumed) and again at
+    GC for abandoned readers (aborted upload) — only the first counts."""
+    if token.pop("on", None):
+        _leave_large()
+
 
 class _AsyncDigest:
     """Ordered digest updates on one worker thread. update() enqueues the
@@ -102,18 +133,26 @@ class HashReader:
         self._eof = False
         self._async: _AsyncDigest | None = None
         self._lane = False  # md5 runs on the shared lane server
-        if size >= ASYNC_DIGEST_MIN and _MULTI_CORE:
-            if self._sha256 is None:
-                # MD5-only large body: hash on the shared multi-lane
-                # server (md5simd) — concurrent PUT streams share AVX2
-                # lanes instead of each paying a scalar MD5 pass
-                from .md5simd import global_server
-                srv = global_server()
-                if srv is not None:
-                    self._md5 = srv.stream()
-                    self._lane = True
-            if not self._lane:
-                self._async = _AsyncDigest(self._hashes())
+        self._active_token: dict = {}
+        if size >= ASYNC_DIGEST_MIN:
+            already_active = _enter_large()
+            self._active_token = {"on": True}
+            weakref.finalize(self, _release_large, self._active_token)
+            # offload rules: any spare core -> offload always; one core ->
+            # only CONCURRENT streams offload (to the shared AVX2 lanes),
+            # the lone stream hashes inline (see _MULTI_CORE notes)
+            if _MULTI_CORE or already_active >= 1:
+                if self._sha256 is None:
+                    # MD5-only large body: hash on the shared multi-lane
+                    # server (md5simd) — concurrent PUT streams share AVX2
+                    # lanes instead of each paying a scalar MD5 pass
+                    from .md5simd import global_server
+                    srv = global_server()
+                    if srv is not None:
+                        self._md5 = srv.stream()
+                        self._lane = True
+                if not self._lane and _MULTI_CORE:
+                    self._async = _AsyncDigest(self._hashes())
 
     def _hashes(self) -> list:
         return [self._md5] + (
@@ -138,12 +177,23 @@ class HashReader:
             self._finish()
             return b""
         self._read += len(b)
-        if self._async is None and self.size < 0 and \
-                self._read >= ASYNC_DIGEST_MIN and _MULTI_CORE:
-            # unknown-size body that turned out large: move the digest
-            # chain to a worker from here on (hash state carries over, so
-            # inline-hashed bytes so far stay counted)
-            self._async = _AsyncDigest(self._hashes())
+        if self.size < 0 and not self._active_token and \
+                self._read >= ASYNC_DIGEST_MIN:
+            # unknown-size body that turned out large: count it toward
+            # the active-ingest concurrency (so sized streams arriving
+            # now route to the shared lanes instead of claiming the
+            # lone-stream inline slot)...
+            _enter_large()
+            self._active_token = {"on": True}
+            weakref.finalize(self, _release_large, self._active_token)
+            if _MULTI_CORE:
+                # ...and with a spare core, move the digest chain to a
+                # worker from here on (hash state carries over, so
+                # inline-hashed bytes so far stay counted). On one core
+                # it stays inline: the lane server cannot adopt a
+                # mid-stream hashlib state, and the worker hop only adds
+                # a queue round-trip there.
+                self._async = _AsyncDigest(self._hashes())
         if self._async is not None:
             self._async.update(b)
         else:
@@ -161,6 +211,7 @@ class HashReader:
 
     def _finish(self):
         self._eof = True
+        _release_large(self._active_token)
         self._drain()
         if self.want_md5 and self.md5_hex() != self.want_md5:
             raise BadDigestError(self.want_md5, self.md5_hex())

@@ -111,3 +111,12 @@ def test_top_api(adm, srv):
     assert admin.get("calls", 0) >= 2
     # latency percentiles ride the duration histograms
     assert any("p50_ms" in v for v in out.values())
+
+
+def test_server_update_honest_stub(adm):
+    """`mc admin update` surface (reference cmd/update.go): reports the
+    running version and says plainly that source deployments have no
+    update channel — no silent no-op."""
+    out = adm.server_update()
+    assert out["currentVersion"] == out["updatedVersion"]
+    assert "self-update disabled" in out["message"]

@@ -50,6 +50,38 @@ def test_pallas_batched():
         assert np.array_equal(got[b], ref.encode(batch[b]))
 
 
+def test_pallas_batched_small_shard_coalescing():
+    """Even batch + small shard drives the nb>1 coalesced grid (several
+    batch elements per pallas step) for BOTH the shared-mask and the
+    per-element-mask kernels — a block-index regression here would
+    rebuild from the wrong element's matrices."""
+    import jax.numpy as jnp
+    B, size = 8, 2048  # W=512 words -> wpad 2048 -> nb>1
+    rs = rs_jax.ReedSolomon(4, 2, backend="pallas")
+    batch = np.stack([rand(4, size, seed=100 + s) for s in range(B)])
+    got = rs.encode_batch(batch)
+    ref = rs_jax.ReedSolomon(4, 2, backend="xla")
+    for b in range(B):
+        assert np.array_equal(got[b], ref.encode(batch[b])), b
+    # per-element masks: a DIFFERENT loss pattern per element; the
+    # multiply input is each element's chosen PRESENT shards
+    fulls = [np.concatenate([batch[s], ref.encode(batch[s])])
+             for s in range(B)]
+    presents = [tuple(j for j in range(6) if j != (s % 4))[:4]
+                for s in range(B)]
+    gathered = np.stack([fulls[s][list(presents[s])] for s in range(B)])
+    masks = np.stack([
+        np.asarray(rs.target_masks_np(presents[s], (s % 4,)))
+        for s in range(B)])
+    out = np.asarray(rs_pallas.gf_matmul_batch_per(
+        jnp.asarray(masks), jnp.asarray(rs_jax.pack_shards(gathered))))
+    for s in range(B):
+        want = fulls[s][s % 4]  # the lost data shard, rebuilt
+        assert np.array_equal(
+            rs_jax.unpack_shards(np.ascontiguousarray(out[s]))[0],
+            want), s
+
+
 @pytest.mark.parametrize("k,m,size", [
     (4, 2, 1024),          # padded sub-tile
     (16, 4, 65536),        # north-star shard: (16, 512) layout
