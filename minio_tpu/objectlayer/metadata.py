@@ -16,7 +16,18 @@ _meta_pool: ThreadPoolExecutor | None = None
 def meta_pool() -> ThreadPoolExecutor:
     global _meta_pool
     if _meta_pool is None:
-        _meta_pool = ThreadPoolExecutor(max_workers=64,
+        # host-scaled like erasure.streaming.io_pool: a fixed 64 made a
+        # 1-core host accumulate 64 mostly-idle threads (metadata reads
+        # are tmpfs/page-cache memcpys there, not real IO waits);
+        # remote-disk deployments can raise the floor via the env knob
+        import os
+        default = min(64, max(8, 4 * (os.cpu_count() or 1)))
+        try:
+            workers = max(1, int(os.environ.get(
+                "MINIO_TPU_META_THREADS", default)))
+        except ValueError:  # malformed knob: serve with the default
+            workers = default
+        _meta_pool = ThreadPoolExecutor(max_workers=workers,
                                         thread_name_prefix="minio-tpu-meta")
     return _meta_pool
 
