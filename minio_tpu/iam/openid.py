@@ -61,11 +61,34 @@ class OpenIDProvider:
         self.timeout = timeout_s
         self._keys: dict[str, tuple[int, int]] = {}  # kid -> (n, e)
         self._fetched_at = 0.0
+        self._disc_doc: dict | None = None
+        self._disc_at = 0.0
         self._forced_at = 0.0
         self._lock = threading.Lock()
 
     def configured(self) -> bool:
         return bool(self.jwks_url or self.config_url or self.hmac_secret)
+
+    def discovery_doc(self) -> dict:
+        """The IdP's OpenID configuration document (console SSO needs the
+        authorization endpoint before any credential exists); {} when
+        only a JWKS URL / shared secret is configured. Cached for the
+        JWKS TTL — this feeds an UNAUTHENTICATED console endpoint, which
+        must not become an IdP-hammering amplifier."""
+        if not self.config_url:
+            return {}
+        with self._lock:
+            cached = getattr(self, "_disc_doc", None)
+            if cached is not None and \
+                    time.time() - self._disc_at < JWKS_TTL_S:
+                return cached
+        with urllib.request.urlopen(self.config_url,
+                                    timeout=self.timeout) as r:
+            doc = json.loads(r.read())
+        with self._lock:
+            self._disc_doc = doc
+            self._disc_at = time.time()
+        return doc
 
     # --- JWKS -------------------------------------------------------------
 

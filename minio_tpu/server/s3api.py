@@ -713,6 +713,9 @@ class _S3Handler(BaseHTTPRequestHandler):
             rest = self.url_path[len("/minio/download/"):]
             bucket, _, obj = rest.partition("/")
             return handle_download(self, bucket, obj)
+        if self.url_path == "/minio/zip":
+            from .webrpc import handle_download_zip
+            return handle_download_zip(self)
         # STS endpoint: POST / with form-encoded Action (cmd/sts-handlers.go)
         # — AssumeRoleWithWebIdentity carries no Authorization header (the
         # JWT is the credential), so the gate is the Action itself

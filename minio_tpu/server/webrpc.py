@@ -107,7 +107,7 @@ def handle_webrpc(h) -> None:
     if fn is None:
         return _reply(h, rpc_id, error=f"unknown method {method}")
     ak = ""
-    if name != "login":
+    if name not in _NO_AUTH:
         ak = _auth(h, params)
         if not ak:
             return _reply(h, rpc_id, error="authentication failed",
@@ -213,6 +213,158 @@ def _m_create_url_token(h, p: dict, ak: str):
                               ttl_s=URL_TOKEN_TTL_S)}
 
 
+_BUCKET_ARN = "arn:aws:s3:::{b}"
+_OBJ_ARN = "arn:aws:s3:::{b}/{p}*"
+_WRITE_OBJ_ACTIONS = ["s3:AbortMultipartUpload", "s3:DeleteObject",
+                      "s3:ListMultipartUploadParts", "s3:PutObject"]
+
+
+def _policy_doc(h, bucket: str) -> dict:
+    meta = h.s3.bucket_meta.get(bucket)
+    if meta.policy_json:
+        try:
+            return json.loads(meta.policy_json)
+        except ValueError:
+            pass
+    return {"Version": "2012-10-17", "Statement": []}
+
+
+def _stmt_objects(stmt) -> list[str]:
+    res = stmt.get("Resource", [])
+    return [res] if isinstance(res, str) else list(res)
+
+
+def _is_anon(stmt) -> bool:
+    pr = stmt.get("Principal")
+    aws = pr.get("AWS") if isinstance(pr, dict) else pr
+    vals = [aws] if isinstance(aws, str) else (aws or [])
+    return stmt.get("Effect") == "Allow" and "*" in vals
+
+
+def _prefix_level(doc: dict, bucket: str, prefix: str) -> str:
+    obj_arn = _OBJ_ARN.format(b=bucket, p=prefix)
+    read = write = False
+    for stmt in doc.get("Statement", []):
+        if not _is_anon(stmt) or obj_arn not in _stmt_objects(stmt):
+            continue
+        acts = stmt.get("Action", [])
+        acts = [acts] if isinstance(acts, str) else acts
+        if "s3:GetObject" in acts:
+            read = True
+        if "s3:PutObject" in acts:
+            write = True
+    return {(False, False): "none", (True, False): "readonly",
+            (False, True): "writeonly", (True, True): "readwrite"}[
+        (read, write)]
+
+
+def _m_get_bucket_policy(h, p: dict, ak: str):
+    """The canned anonymous-access level at a prefix (reference
+    web-handlers.go:1786 via minio-go policy.GetPolicy)."""
+    bucket = p.get("bucketName", "")
+    _check(h, ak, "s3:GetBucketPolicy", bucket)
+    h.s3.obj.get_bucket_info(bucket)
+    doc = _policy_doc(h, bucket)
+    return {"policy": _prefix_level(doc, bucket, p.get("prefix", ""))}
+
+
+def _m_list_all_bucket_policies(h, p: dict, ak: str):
+    """Every prefix with a canned anonymous policy (reference
+    web-handlers.go:1884)."""
+    bucket = p.get("bucketName", "")
+    _check(h, ak, "s3:GetBucketPolicy", bucket)
+    h.s3.obj.get_bucket_info(bucket)
+    doc = _policy_doc(h, bucket)
+    head = f"arn:aws:s3:::{bucket}/"
+    prefixes = set()
+    for stmt in doc.get("Statement", []):
+        if not _is_anon(stmt):
+            continue
+        for arn in _stmt_objects(stmt):
+            if arn.startswith(head) and arn.endswith("*"):
+                prefixes.add(arn[len(head):-1])
+    return {"policies": [
+        {"prefix": pre + "*",
+         "policy": _prefix_level(doc, bucket, pre)}
+        for pre in sorted(prefixes)]}
+
+
+def _m_set_bucket_policy(h, p: dict, ak: str):
+    """Set/replace the canned anonymous policy at a prefix (reference
+    web-handlers.go:1973): none|readonly|writeonly|readwrite become the
+    standard AWS statement shapes, which the S3 anonymous-access gate
+    then enforces."""
+    bucket = p.get("bucketName", "")
+    prefix = p.get("prefix", "")
+    level = p.get("policy", "none")
+    if level not in ("none", "readonly", "writeonly", "readwrite"):
+        raise dt.InvalidRequest(bucket, "", f"bad policy {level!r}")
+    _check(h, ak, "s3:PutBucketPolicy", bucket)
+    h.s3.obj.get_bucket_info(bucket)
+    doc = _policy_doc(h, bucket)
+    bucket_arn = _BUCKET_ARN.format(b=bucket)
+    obj_arn = _OBJ_ARN.format(b=bucket, p=prefix)
+    # strip this prefix's statements (object-level, and bucket-level
+    # ListBucket entries conditioned on the prefix)
+    kept = []
+    for stmt in doc.get("Statement", []):
+        if _is_anon(stmt):
+            if _stmt_objects(stmt) == [obj_arn]:
+                continue
+            cond = stmt.get("Condition", {}).get(
+                "StringEquals", {}).get("s3:prefix", [])
+            if cond == [prefix]:
+                continue
+        kept.append(stmt)
+    if level in ("readonly", "readwrite"):
+        kept.append({"Effect": "Allow", "Principal": {"AWS": ["*"]},
+                     "Action": ["s3:ListBucket"],
+                     "Condition": {"StringEquals": {"s3:prefix": [prefix]}},
+                     "Resource": [bucket_arn]})
+        kept.append({"Effect": "Allow", "Principal": {"AWS": ["*"]},
+                     "Action": ["s3:GetObject"], "Resource": [obj_arn]})
+    if level in ("writeonly", "readwrite"):
+        kept.append({"Effect": "Allow", "Principal": {"AWS": ["*"]},
+                     "Action": list(_WRITE_OBJ_ACTIONS),
+                     "Resource": [obj_arn]})
+    doc["Statement"] = kept
+    h.s3.bucket_meta.update(
+        bucket, policy_json=json.dumps(doc).encode() if kept else b"")
+    return True
+
+
+def _m_get_discovery_doc(h, p: dict, ak: str):
+    """OpenID discovery for console SSO (reference GetDiscoveryDoc,
+    web-handlers.go:2223): the configured provider's document, or null
+    when SSO is not configured. Unauthenticated by design — the login
+    page needs it before any credential exists."""
+    iam = h.s3.iam
+    prov = iam._openid_provider() if iam is not None else None
+    if prov is None or not prov.configured():
+        return {"DiscoveryDoc": None}
+    doc = {}
+    try:
+        doc = prov.discovery_doc()
+    except Exception:  # noqa: BLE001 — IDP down: login page degrades
+        pass
+    return {"DiscoveryDoc": doc or None}
+
+
+def _m_login_sts(h, p: dict, ak: str):
+    """Console SSO login (reference LoginSTS, web-handlers.go:2240):
+    exchange an OpenID id_token for STS temporary credentials, return a
+    web JWT bound to them."""
+    if h.s3.iam is None:
+        raise dt.NotImplemented(extra="STS login needs IAM enabled")
+    try:
+        cred = h.s3.iam.assume_role_with_web_identity(
+            p.get("token", ""), 3600, b"")
+    except ValueError as e:
+        raise dt.AccessDenied(extra=f"STS login failed: {e}") from None
+    return {"token": make_jwt(cred.access_key, cred.secret_key),
+            "uiVersion": "minio-tpu"}
+
+
 def _m_presigned_get(h, p: dict, ak: str):
     """Presigned GET URL for the console's share dialog."""
     from .auth import presign_v4
@@ -238,7 +390,18 @@ _METHODS = {
     "setauth": _m_set_auth,
     "createurltoken": _m_create_url_token,
     "presignedget": _m_presigned_get,
+    "getbucketpolicy": _m_get_bucket_policy,
+    "listallbucketpolicies": _m_list_all_bucket_policies,
+    "setbucketpolicy": _m_set_bucket_policy,
+    "getdiscoverydoc": _m_get_discovery_doc,
+    "loginsts": _m_login_sts,
 }
+
+#: methods callable without a JWT: Login issues tokens, LoginSTS trades
+#: an IDP token for one, and the login page needs the discovery doc
+#: before any credential exists (reference web-router registers these
+#: the same way)
+_NO_AUTH = {"login", "loginsts", "getdiscoverydoc"}
 
 
 # -- static console -----------------------------------------------------------
@@ -321,10 +484,7 @@ def handle_download(h, bucket: str, object: str) -> None:
         sse = h._sse_read_ctx(oi)
     except dt.ObjectAPIError as e:
         return h._api_error(e)
-    from ..utils import compress as cz
-    compressed = oi.internal.get(cz.META_COMPRESSION, "")
-    plain_size = sse[2] if sse else (
-        oi.actual_size if compressed else oi.size)
+    plain_size = _logical_size(h, oi, sse)
     h.send_response(200)
     h.send_header("Content-Type",
                   oi.content_type or "application/octet-stream")
@@ -332,18 +492,103 @@ def handle_download(h, bucket: str, object: str) -> None:
     h.send_header("Content-Disposition",
                   f'attachment; filename="{_disposition_name(object)}"')
     h.end_headers()
-    if plain_size <= 0:
-        return
+    if plain_size > 0:
+        _write_logical(h, bucket, object, oi, sse, h.wfile)
+
+
+def _logical_size(h, oi, sse) -> int:
+    from ..utils import compress as cz
+    if sse:
+        return sse[2]
+    return oi.actual_size if oi.internal.get(cz.META_COMPRESSION) \
+        else oi.size
+
+
+def _write_logical(h, bucket: str, object: str, oi, sse, sink) -> None:
+    """Stream the object's PLAINTEXT into sink — the same read context
+    as the S3 GET path (decrypt SSE with the unsealed OEK, inflate
+    compressed objects)."""
+    from ..utils import compress as cz
+    compressed = oi.internal.get(cz.META_COMPRESSION, "")
     if sse:
         from ..crypto import DecryptWriter
         oek, base_iv, psize, _ = sse
-        dw = DecryptWriter(h.wfile, oek, base_iv, 0, 0, psize,
+        dw = DecryptWriter(sink, oek, base_iv, 0, 0, psize,
                            bucket, object)
         h.s3.obj.get_object(bucket, object, dw)
         dw.finish()
     elif compressed:
-        dz = cz.decompress_writer(compressed, h.wfile)
+        dz = cz.decompress_writer(compressed, sink)
         h.s3.obj.get_object(bucket, object, dz)
         dz.finish()
     else:
-        h.s3.obj.get_object(bucket, object, h.wfile)
+        h.s3.obj.get_object(bucket, object, sink)
+
+
+def handle_download_zip(h) -> None:
+    """POST /minio/zip?token=... body {bucketName, prefix, objects: []}
+    — the console's multi-select download (reference web-handlers.go
+    DownloadZip): entries ending in "/" expand to every object under
+    them; each entry streams through the logical read context."""
+    import json as _json
+    import zipfile
+    from tempfile import SpooledTemporaryFile
+    if h.command != "POST":
+        return h._error("MethodNotAllowed", "zip is POST-only", 405)
+    q = {k: v[0] for k, v in h.query.items()}
+    ak = check_jwt(q.get("token", ""), h.s3.lookup_secret)
+    if not ak:
+        return h._error("AccessDenied", "invalid token", 401)
+    try:
+        req = _json.loads(h._read_body() or b"{}")
+        bucket = req.get("bucketName", "")
+        prefix = req.get("prefix", "")
+        names = req.get("objects") or []
+        if not isinstance(bucket, str) or not bucket or \
+                not isinstance(prefix, str) or \
+                not isinstance(names, list) or not names or \
+                not all(isinstance(n, str) for n in names):
+            raise ValueError("bucketName and string objects[] required")
+    except (ValueError, AttributeError) as e:
+        return h._error("InvalidRequest", f"bad zip request: {e}", 400)
+    try:
+        keys: list[str] = []
+        for name in names:
+            full = prefix + name
+            if full.endswith("/"):
+                keys.extend(oi.name for oi in
+                            h.s3.obj.iter_objects(bucket, full))
+            else:
+                keys.append(full)
+        spool = SpooledTemporaryFile(max_size=64 << 20)
+        with zipfile.ZipFile(spool, "w", zipfile.ZIP_STORED,
+                             allowZip64=True) as zf:
+            for key in keys:
+                # PER-OBJECT authorization, like handle_download and the
+                # reference: per-key Deny statements must hold inside a
+                # multi-select zip too
+                _check(h, ak, "s3:GetObject", bucket, key)
+                oi = h.s3.obj.get_object_info(bucket, key)
+                h.bucket, h.key = bucket, key
+                sse = h._sse_read_ctx(oi)
+                arc = key[len(prefix):] if key.startswith(prefix) else key
+                with zf.open(zipfile.ZipInfo(arc or key), "w",
+                             force_zip64=True) as entry:
+                    if _logical_size(h, oi, sse) > 0:
+                        _write_logical(h, bucket, key, oi, sse, entry)
+    except dt.ObjectAPIError as e:
+        return h._api_error(e)
+    size = spool.tell()
+    spool.seek(0)
+    h.send_response(200)
+    h.send_header("Content-Type", "application/zip")
+    h.send_header("Content-Length", str(size))
+    h.send_header("Content-Disposition",
+                  'attachment; filename="download.zip"')
+    h.end_headers()
+    while True:
+        chunk = spool.read(1 << 20)
+        if not chunk:
+            break
+        h.wfile.write(chunk)
+    spool.close()

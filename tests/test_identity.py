@@ -324,3 +324,36 @@ def test_expired_rs256_token_rejected(server, stub_idp, rsa_key,
     r = _sts(server, {"Action": "AssumeRoleWithWebIdentity",
                       "WebIdentityToken": token})
     assert r.status_code == 400
+
+
+def test_console_sso_login_and_discovery(server, stub_idp, rsa_key,
+                                         monkeypatch):
+    """Console SSO plane (reference LoginSTS + GetDiscoveryDoc,
+    web-handlers.go:2223-2280): the login page fetches the discovery
+    doc without credentials, exchanges the IdP token for a web JWT, and
+    that JWT drives authenticated webrpc calls."""
+    import requests
+    n, _e, d = rsa_key
+    monkeypatch.setenv(
+        "MINIO_TPU_IDENTITY_OPENID_CONFIG_URL",
+        f"http://127.0.0.1:{stub_idp.server_port}/.well-known/"
+        "openid-configuration")
+
+    def rpc(method, params):
+        return requests.post(
+            server.endpoint() + "/minio/webrpc",
+            json={"jsonrpc": "2.0", "id": 1, "method": f"web.{method}",
+                  "params": params}, timeout=10).json()
+
+    doc = rpc("GetDiscoveryDoc", {})["result"]["DiscoveryDoc"]
+    assert doc and doc["issuer"] == "http://stub"
+    token = sign_jwt_rs256(n, d, {
+        "sub": "sso-user", "exp": int(time.time()) + 600,
+        "policy": "readwrite"})
+    out = rpc("LoginSTS", {"token": token})
+    assert "result" in out, out
+    web_jwt = out["result"]["token"]
+    ls = rpc("ListBuckets", {"token": web_jwt})
+    assert "result" in ls, ls
+    # a garbage IdP token is refused
+    assert "error" in rpc("LoginSTS", {"token": token[:-6] + "AAAAAA"})

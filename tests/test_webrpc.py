@@ -154,6 +154,15 @@ def test_web_plane_enforces_iam_policy(tmp_path_factory):
         r = requests.get(srv.endpoint() + "/minio/download/iamb/doc",
                          params={"token": tok}, timeout=10)
         assert r.status_code == 200 and r.content == b"data"
+        # multi-select zip rides the same PER-OBJECT read authorization
+        r = requests.post(srv.endpoint() + "/minio/zip",
+                          params={"token": tok},
+                          json={"bucketName": "iamb", "prefix": "",
+                                "objects": ["doc"]}, timeout=10)
+        assert r.status_code == 200
+        import io as _io2
+        import zipfile as _zf
+        assert _zf.ZipFile(_io2.BytesIO(r.content)).read("doc") == b"data"
         # writes denied
         out = _rpc(srv, "MakeBucket", {"bucketName": "newb"}, tok)
         assert "error" in out
@@ -231,6 +240,110 @@ def test_console_spa_served(srv):
         assert b"web.Login" in r.content or b'"web." + method' in r.content
     r = requests.post(srv.endpoint() + "/minio/", timeout=10)
     assert r.status_code == 405
+
+
+def test_download_zip(srv, token):
+    """POST /minio/zip: multi-object console download, including a
+    folder entry that expands to everything under it (reference
+    web-handlers.go DownloadZip)."""
+    import io
+    import zipfile
+    bodies = {"z/a.txt": b"alpha" * 100, "z/b.bin": os.urandom(4096),
+              "z/sub/c.txt": b"charlie"}
+    assert _rpc(srv, "MakeBucket", {"bucketName": "zipb"},
+                token)["result"] is True
+    for key, body in bodies.items():
+        r = requests.put(srv.endpoint() + f"/minio/upload/zipb/{key}",
+                         data=body,
+                         headers={"Authorization": f"Bearer {token}"},
+                         timeout=10)
+        assert r.status_code == 200
+    r = requests.post(
+        srv.endpoint() + "/minio/zip", params={"token": token},
+        json={"bucketName": "zipb", "prefix": "z/",
+              "objects": ["a.txt", "sub/"]}, timeout=30)
+    assert r.status_code == 200, r.text
+    assert r.headers["Content-Type"] == "application/zip"
+    zf = zipfile.ZipFile(io.BytesIO(r.content))
+    assert sorted(zf.namelist()) == ["a.txt", "sub/c.txt"]
+    assert zf.read("a.txt") == bodies["z/a.txt"]
+    assert zf.read("sub/c.txt") == bodies["z/sub/c.txt"]
+    # bad token rejected
+    r = requests.post(srv.endpoint() + "/minio/zip",
+                      params={"token": "bad"},
+                      json={"bucketName": "zipb", "objects": ["a.txt"]},
+                      timeout=10)
+    assert r.status_code == 401
+
+
+def test_bucket_policy_methods(tmp_path_factory):
+    """Get/Set/ListAll canned bucket policies through the console plane:
+    the generated statements also REALLY grant anonymous S3 access —
+    IAM enabled, because the anonymous gate rides bucket policies
+    there."""
+    tmp = tmp_path_factory.mktemp("webpol")
+    obj = ErasureObjects([XLStorage(str(tmp / f"d{i}")) for i in range(4)],
+                         default_parity=1)
+    srv = S3Server(obj, "127.0.0.1", 0, access_key=AK, secret_key=SK)
+    srv.enable_iam()
+    srv.start_background()
+    try:
+        token = _rpc(srv, "Login", {"username": AK, "password": SK}
+                     )["result"]["token"]
+        assert _rpc(srv, "MakeBucket", {"bucketName": "polb"},
+                    token)["result"] is True
+        body = b"public content"
+        r = requests.put(
+            srv.endpoint() + "/minio/upload/polb/pub/doc.txt", data=body,
+            headers={"Authorization": f"Bearer {token}"}, timeout=10)
+        assert r.status_code == 200
+        # default: none, and anonymous GET is refused
+        out = _rpc(srv, "GetBucketPolicy",
+                   {"bucketName": "polb", "prefix": "pub"},
+                   token)["result"]
+        assert out["policy"] == "none"
+        assert requests.get(srv.endpoint() + "/polb/pub/doc.txt",
+                            timeout=10).status_code in (403, 401)
+        # readonly at the prefix
+        assert _rpc(srv, "SetBucketPolicy",
+                    {"bucketName": "polb", "prefix": "pub",
+                     "policy": "readonly"}, token)["result"] is True
+        out = _rpc(srv, "GetBucketPolicy",
+                   {"bucketName": "polb", "prefix": "pub"},
+                   token)["result"]
+        assert out["policy"] == "readonly"
+        lst = _rpc(srv, "ListAllBucketPolicies",
+                   {"bucketName": "polb"}, token)["result"]["policies"]
+        assert {"prefix": "pub*", "policy": "readonly"} in lst
+        r = requests.get(srv.endpoint() + "/polb/pub/doc.txt",
+                         timeout=10)
+        assert r.status_code == 200 and r.content == body
+        # upgrade to readwrite, then clear
+        assert _rpc(srv, "SetBucketPolicy",
+                    {"bucketName": "polb", "prefix": "pub",
+                     "policy": "readwrite"}, token)["result"] is True
+        assert _rpc(srv, "GetBucketPolicy",
+                    {"bucketName": "polb", "prefix": "pub"},
+                    token)["result"]["policy"] == "readwrite"
+        assert _rpc(srv, "SetBucketPolicy",
+                    {"bucketName": "polb", "prefix": "pub",
+                     "policy": "none"}, token)["result"] is True
+        assert requests.get(srv.endpoint() + "/polb/pub/doc.txt",
+                            timeout=10).status_code in (403, 401)
+    finally:
+        srv.shutdown()
+
+
+def test_discovery_doc_unconfigured(srv):
+    """GetDiscoveryDoc needs no JWT (the login page calls it first) and
+    reports null when SSO is not configured."""
+    out = _rpc(srv, "GetDiscoveryDoc", {})
+    assert out["result"]["DiscoveryDoc"] is None
+
+
+def test_login_sts_requires_iam(srv):
+    out = _rpc(srv, "LoginSTS", {"token": "x.y.z"})
+    assert "error" in out
 
 
 def test_webrpc_non_object_body(srv):
