@@ -78,16 +78,20 @@ class OpenIDProvider:
         if not self.config_url:
             return {}
         with self._lock:
-            cached = getattr(self, "_disc_doc", None)
-            if cached is not None and \
-                    time.time() - self._disc_at < JWKS_TTL_S:
-                return cached
-        with urllib.request.urlopen(self.config_url,
-                                    timeout=self.timeout) as r:
-            doc = json.loads(r.read())
+            if time.time() - self._disc_at < JWKS_TTL_S:
+                # fresh success OR recent attempt (negative cache): a
+                # down IdP must not be re-fetched per anonymous request
+                return self._disc_doc or {}
+            self._disc_at = time.time()  # claim the fetch slot
+        try:
+            with urllib.request.urlopen(self.config_url,
+                                        timeout=self.timeout) as r:
+                doc = json.loads(r.read())
+        except Exception:  # noqa: BLE001 — IdP down: serve stale/empty
+            with self._lock:
+                return self._disc_doc or {}
         with self._lock:
             self._disc_doc = doc
-            self._disc_at = time.time()
         return doc
 
     # --- JWKS -------------------------------------------------------------

@@ -406,9 +406,13 @@ class _ChunkedWriter:
     def __init__(self, wfile):
         self.wfile = wfile
 
-    def write(self, b: bytes):
+    def write(self, b: bytes) -> int:
         if b:
             self.wfile.write(f"{len(b):x}\r\n".encode() + b + b"\r\n")
+        return len(b)
+
+    def flush(self):  # writer-protocol consumers (zipfile) call this
+        pass
 
     def close(self):
         self.wfile.write(b"0\r\n\r\n")
