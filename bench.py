@@ -364,6 +364,13 @@ def heal_latency(rng) -> dict:
         rng.integers(0, 256, (K, shard), dtype=np.uint8))
 
     def run_mode(mode: str) -> dict:
+        # percentiles come from the SAME last-minute sliding-window class
+        # the server exports as minio_tpu_heal_shard_latency_p99_seconds
+        # (minio_tpu/obs/latency.py) — bench numbers and production
+        # metrics cannot diverge in method. Runs longer than the window
+        # therefore report steady-state (last-minute) percentiles.
+        from minio_tpu.obs import latency as obslat
+
         # warm every pow2 batch shape the timed runs can hit (a first-time
         # jit compile inside the timed region would own the p99)
         for warm_burst in (1, 2, 8, 16, 64, 128, 128):
@@ -373,16 +380,14 @@ def heal_latency(rng) -> dict:
         res = {}
         for conc in (1, 8, 128):
             n_ops = 40 if conc == 1 else max(conc * 3, 120)
-            lats: list[float] = []
-            lock = threading.Lock()
+            win = obslat.reset_window("kernel", op="heal_shard")
 
             def worker(count):
                 for _ in range(count):
                     t0 = time.perf_counter()
                     q.masked(codec, words, masks).result()
-                    dt = time.perf_counter() - t0
-                    with lock:
-                        lats.append(dt)
+                    obslat.observe("kernel", time.perf_counter() - t0,
+                                   BLOCK, op="heal_shard")
 
             per_worker = max(1, n_ops // conc)
             threads = [threading.Thread(target=worker, args=(per_worker,))
@@ -393,12 +398,14 @@ def heal_latency(rng) -> dict:
             for t in threads:
                 t.join()
             wall = time.perf_counter() - t0
-            arr = np.array(sorted(lats))
-            p50 = float(np.percentile(arr, 50)) * 1e3
-            p99 = float(np.percentile(arr, 99)) * 1e3
-            thr = len(lats) * BLOCK / wall / (1 << 30)
+            n_done = per_worker * conc
+            ps = win.percentiles((0.5, 0.99))
+            p50 = ps[0.5] * 1e3
+            p99 = ps[0.99] * 1e3
+            thr = n_done * BLOCK / wall / (1 << 30)
             log(f"heal-shard latency [{mode}] conc={conc}: p50={p50:.1f}ms "
-                f"p99={p99:.1f}ms agg={thr:.2f} GiB/s ({len(lats)} ops)")
+                f"p99={p99:.1f}ms agg={thr:.2f} GiB/s ({n_done} ops, "
+                f"{win.count()} in window)")
             res[f"conc{conc}"] = {"p50_ms": round(p50, 1),
                                   "p99_ms": round(p99, 1),
                                   "agg_gibs": round(thr, 2)}

@@ -32,7 +32,17 @@ import urllib.error
 import urllib.parse
 import urllib.request
 
-from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+try:
+    from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+    HAVE_CRYPTOGRAPHY = True
+except ImportError:  # gated optional dep: KMS raises at use, not import
+    HAVE_CRYPTOGRAPHY = False
+
+    class AESGCM:  # type: ignore[no-redef]
+        def __init__(self, *a, **k):
+            raise RuntimeError(
+                "the 'cryptography' package is not installed: "
+                "SSE/KMS is unavailable on this build")
 
 
 class KMSError(Exception):

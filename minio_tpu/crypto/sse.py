@@ -17,8 +17,21 @@ import secrets
 import struct
 from dataclasses import dataclass, field
 
-from cryptography.exceptions import InvalidTag
-from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+try:
+    from cryptography.exceptions import InvalidTag
+    from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+    HAVE_CRYPTOGRAPHY = True
+except ImportError:  # gated optional dep: SSE raises at use, not import
+    HAVE_CRYPTOGRAPHY = False
+
+    class InvalidTag(Exception):  # type: ignore[no-redef]
+        pass
+
+    class AESGCM:  # type: ignore[no-redef]
+        def __init__(self, *a, **k):
+            raise RuntimeError(
+                "the 'cryptography' package is not installed: "
+                "SSE/KMS is unavailable on this build")
 
 from ..objectlayer import datatypes as dt
 

@@ -210,9 +210,25 @@ class AdminClient:
         """Per-API call counts + latency percentiles."""
         return self._json("GET", "top/api")
 
-    def trace(self, count: int = 50, timeout: float = 5.0) -> list[dict]:
-        raw = self._request("GET", "trace", {"count": str(count),
-                                             "timeout": str(timeout)})
+    def trace(self, count: int = 50, timeout: float = 5.0,
+              trace_type: str = "", threshold: str = "",
+              errors_only: bool = False,
+              peers: bool = False) -> list[dict]:
+        """`mc admin trace` analogue. ``trace_type`` is a csv of
+        http|storage|kernel|scanner (or "all"; server default: http),
+        ``threshold`` a minimum duration ("100ms", "1.5s" or bare
+        seconds), ``errors_only`` keeps only failed calls, ``peers``
+        fans out cluster-wide."""
+        q = {"count": str(count), "timeout": str(timeout)}
+        if trace_type:
+            q["type"] = trace_type
+        if threshold:
+            q["threshold"] = str(threshold)
+        if errors_only:
+            q["err"] = "1"
+        if peers:
+            q["peers"] = "1"
+        raw = self._request("GET", "trace", q)
         return [json.loads(ln) for ln in raw.splitlines() if ln.strip()]
 
     def recent_logs(self, n: int = 100) -> list[dict]:

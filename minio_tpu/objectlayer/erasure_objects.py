@@ -10,11 +10,14 @@ accelerator via minio_tpu.erasure.
 """
 from __future__ import annotations
 
+import time as _time
 import uuid
 from dataclasses import replace
 
 from ..erasure import (DEFAULT_BITROT_ALGO, Erasure, new_bitrot_reader,
                        new_bitrot_writer)
+from ..obs import latency as _lat
+from ..obs import trace as _trc
 from ..erasure.bitrot import (BITROT_CHUNK_KEY, BitrotAlgorithm,
                               pick_bitrot_chunk)
 from ..erasure.codec import ceil_div
@@ -1147,11 +1150,30 @@ class ErasureObjects(MultipartMixin, ObjectLayer):
                         sink, algo, bitrot_chunk)
                 except Exception:  # noqa: BLE001
                     pass
+            # heal-shard span: the paper's p99 heal-shard metric is THIS
+            # wall time (read + rebuild through the dispatch queue +
+            # bitrot-framed write), fed to the last-minute window behind
+            # minio_tpu_heal_shard_latency_p99_seconds
+            t0 = _time.perf_counter()
+            heal_err = ""
             try:
                 erasure_heal(er, writers, readers, part.size)
             except Exception as e:  # noqa: BLE001
+                heal_err = str(e)
                 raise to_object_err(e, bucket, object) from e
             finally:
+                dur = _time.perf_counter() - t0
+                shard_bytes = logical * len(to_heal)
+                if not heal_err:
+                    # only successful rebuilds move the north-star
+                    # p99/GiB/s window — a burst of fast failures must
+                    # not read as heal throughput
+                    _lat.observe("kernel", dur, shard_bytes,
+                                 op="heal_shard")
+                _trc.publish_scanner(
+                    func="heal.shard", path=f"{bucket}/{object}",
+                    duration_s=dur, input_bytes=shard_bytes,
+                    error=heal_err)
                 for r in readers:
                     src = getattr(r, "src", None)
                     if src is not None and hasattr(src, "close"):

@@ -381,6 +381,69 @@ def _g_heal(server) -> list[str]:
     return lines
 
 
+_QUANTILES = ((0.5, "0.5"), (0.95, "0.95"), (0.99, "0.99"))
+
+
+def _esc(v: str) -> str:
+    """Prometheus label-value escaping: a disk endpoint is a
+    user-supplied path, and one quote/backslash/newline in it must not
+    break the whole exposition."""
+    return str(v).replace("\\", "\\\\").replace('"', '\\"') \
+        .replace("\n", "\\n")
+
+
+def _g_disk_latency(server) -> list[str]:
+    """Per-disk per-op online latency percentiles from the last-minute
+    sliding windows the storage layer feeds (reference metrics-v2 drive
+    latency rows over lastMinuteLatency)."""
+    from . import latency as lat
+    rows = lat.snapshot("disk")
+    if not rows:
+        return []
+    lines = ["# TYPE minio_tpu_disk_latency_seconds gauge",
+             "# TYPE minio_tpu_disk_op_last_minute_total gauge"]
+    for labels, w in rows:
+        disk = _esc(labels.get("disk", ""))
+        op = _esc(labels.get("op", ""))
+        st = w.stats(tuple(q for q, _ in _QUANTILES))
+        for q, qs in _QUANTILES:
+            lines.append(
+                f'minio_tpu_disk_latency_seconds{{disk="{disk}",op="{op}",'
+                f'quantile="{qs}"}} {st["percentiles"][q]:.6f}')
+        lines.append(
+            f'minio_tpu_disk_op_last_minute_total{{disk="{disk}",'
+            f'op="{op}"}} {st["count"]}')
+    return lines
+
+
+def _g_kernel(server) -> list[str]:
+    """Per-op dispatch/heal kernel latency percentiles + GiB/s — the
+    paper's headline metric (erasure encode/reconstruct GiB/s, p99
+    heal-shard latency) served online instead of only by bench.py."""
+    from . import latency as lat
+    lines = ["# TYPE minio_tpu_kernel_op_latency_seconds gauge",
+             "# TYPE minio_tpu_kernel_op_gibs gauge",
+             "# TYPE minio_tpu_kernel_op_last_minute_total gauge"]
+    for labels, w in lat.snapshot("kernel"):
+        op = _esc(labels.get("op", ""))
+        st = w.stats(tuple(q for q, _ in _QUANTILES))
+        for q, qs in _QUANTILES:
+            lines.append(
+                f'minio_tpu_kernel_op_latency_seconds{{op="{op}",'
+                f'quantile="{qs}"}} {st["percentiles"][q]:.6f}')
+        lines.append(f'minio_tpu_kernel_op_gibs{{op="{op}"}} '
+                     f'{st["rate_gibs"]:.4f}')
+        lines.append(f'minio_tpu_kernel_op_last_minute_total{{op="{op}"}} '
+                     f'{st["count"]}')
+    # the north-star number gets its own stable gauge (creating the
+    # window on first scrape so the family is always present)
+    heal = lat.get_window("kernel", op="heal_shard")
+    lines += ["# TYPE minio_tpu_heal_shard_latency_p99_seconds gauge",
+              "minio_tpu_heal_shard_latency_p99_seconds "
+              f"{heal.percentiles((0.99,))[0.99]:.6f}"]
+    return lines
+
+
 def _g_locks(server) -> list[str]:
     locker = getattr(server, "local_locker", None)
     if locker is None:
@@ -400,6 +463,10 @@ _GROUPS = [
     MetricsGroup("replication", "cluster", _g_replication),
     MetricsGroup("cache", "node", _g_cache),
     MetricsGroup("dispatch", "node", _g_dispatch),
+    # latency groups read in-memory windows — interval 0 keeps scrapes
+    # (and tests driving heals) fresh at negligible cost
+    MetricsGroup("disk_latency", "node", _g_disk_latency, interval=0),
+    MetricsGroup("kernel", "node", _g_kernel, interval=0),
     MetricsGroup("process", "node", _g_process),
     MetricsGroup("locks", "node", _g_locks),
     MetricsGroup("notification", "cluster", _g_notification),
@@ -430,6 +497,73 @@ def _store_lines() -> list[str]:
     return lines
 
 
+def _sample_name(line: str) -> str:
+    """Metric name of one sample line (text up to '{' or the value)."""
+    cut = len(line)
+    for sep in ("{", " "):
+        i = line.find(sep)
+        if i != -1:
+            cut = min(cut, i)
+    return line[:cut]
+
+
+def _family_of(name: str, hist_families: set[str]) -> str:
+    for suf in ("_bucket", "_count", "_sum"):
+        if name.endswith(suf) and name[:-len(suf)] in hist_families:
+            return name[:-len(suf)]
+    return name
+
+
+def _annotate(lines: list[str]) -> list[str]:
+    """Exposition-format hygiene pass: every family gets exactly one
+    ``# HELP`` and one ``# TYPE`` line ahead of its first sample, with
+    the type inferred (histogram when ``X_bucket`` samples exist,
+    counter for ``*_total``, gauge otherwise) when a generator didn't
+    declare one. Generators therefore CANNOT ship malformed families —
+    tests/test_obs_naming.py locks this in."""
+    hist_families = {
+        _sample_name(ln)[:-len("_bucket")] for ln in lines
+        if not ln.startswith("#") and _sample_name(ln).endswith("_bucket")}
+    out: list[str] = []
+    declared: set[str] = set()
+    pending_help: dict[str, str] = {}
+
+    def declare(fam: str, typ: str | None = None):
+        if fam in declared:
+            return
+        declared.add(fam)
+        if typ is None:
+            typ = "histogram" if fam in hist_families else \
+                ("counter" if fam.endswith("_total") else "gauge")
+        help_text = pending_help.pop(fam, "") or \
+            fam.removeprefix("minio_tpu_").replace("_", " ")
+        out.append(f"# HELP {fam} {help_text}")
+        out.append(f"# TYPE {fam} {typ}")
+
+    for ln in lines:
+        if ln.startswith("# HELP "):
+            parts = ln.split(maxsplit=3)
+            if len(parts) >= 3 and parts[2] not in declared:
+                # stash author help; declaration waits for the TYPE
+                # line (or first sample) so an explicit type wins
+                pending_help[parts[2]] = \
+                    parts[3] if len(parts) > 3 else ""
+            continue
+        if ln.startswith("# TYPE "):
+            parts = ln.split()
+            if len(parts) >= 3:
+                declare(parts[2], parts[3] if len(parts) > 3 else None)
+                continue
+            out.append(ln)
+            continue
+        if ln.startswith("#") or not ln.strip():
+            out.append(ln)
+            continue
+        declare(_family_of(_sample_name(ln), hist_families))
+        out.append(ln)
+    return out
+
+
 def render_prometheus(server, scope: str = "") -> bytes:
     """Text exposition. scope "" or "cluster" renders every group;
     "node" renders only node-scoped groups (reference mounts
@@ -440,4 +574,4 @@ def render_prometheus(server, scope: str = "") -> bytes:
             continue
         lines.extend(g.lines(server))
     lines.extend(_store_lines())
-    return ("\n".join(lines) + "\n").encode()
+    return ("\n".join(_annotate(lines)) + "\n").encode()

@@ -12,11 +12,15 @@ class PubSub:
         self._subs: list[queue.Queue] = []
         self._lock = threading.Lock()
         self.maxsize = maxsize
+        #: lock-free mirror of len(_subs) so hot paths can gate trace
+        #: generation on "is anyone listening" without taking the lock
+        self.subscriber_count = 0
 
     def subscribe(self) -> queue.Queue:
         q: queue.Queue = queue.Queue(maxsize=self.maxsize)
         with self._lock:
             self._subs.append(q)
+            self.subscriber_count = len(self._subs)
         return q
 
     def unsubscribe(self, q: queue.Queue):
@@ -25,15 +29,21 @@ class PubSub:
                 self._subs.remove(q)
             except ValueError:
                 pass
+            self.subscriber_count = len(self._subs)
 
-    def publish(self, item) -> None:
+    def publish(self, item) -> int:
+        """Non-blocking fan-out; returns how many slow subscribers
+        DROPPED the item (callers surface that as a counter instead of
+        losing it silently)."""
         with self._lock:
             subs = list(self._subs)
+        dropped = 0
         for q in subs:
             try:
                 q.put_nowait(item)
             except queue.Full:
-                pass  # slow subscriber: drop, never block the hot path
+                dropped += 1  # slow subscriber: never block the hot path
+        return dropped
 
     @property
     def num_subscribers(self) -> int:

@@ -41,7 +41,14 @@ from dataclasses import dataclass, field
 
 import numpy as np
 
+from ..obs import latency as _lat
+from ..obs import trace as _trc
+
 log = logging.getLogger("minio_tpu.dispatch")
+
+#: dispatch op -> the kernel-metrics op name exported as
+#: minio_tpu_kernel_op_latency_seconds{op=...}
+_OP_NAME = {"encode": "encode", "masked": "reconstruct", "fused": "fused"}
 
 MAX_BATCH = int(os.environ.get("MINIO_TPU_DISPATCH_BATCH", "128"))
 MAX_DELAY_S = float(os.environ.get("MINIO_TPU_DISPATCH_DELAY_MS", "1.0")) / 1e3
@@ -267,6 +274,24 @@ class DispatchQueue:
     def _submit(self, key, codec, op, words, masks, digests=None,
                 hash_key=None, chunk_size=0, hash_algo=0) -> Future:
         p = _Pending(words=words, masks=masks, digests=digests)
+        # per-item wall latency through the queue (what a caller sees:
+        # queue wait + flush + readback) into the last-minute window
+        # behind minio_tpu_kernel_op_latency_seconds
+        op_name = _OP_NAME.get(op, op)
+        nbytes = words.nbytes
+
+        def _record(_f, t=p.t, op_name=op_name, nbytes=nbytes):
+            try:
+                if _f.exception() is not None:
+                    # failed ops must not read as kernel throughput —
+                    # same rule the heal_shard window applies
+                    return
+                _lat.observe("kernel", time.monotonic() - t, nbytes,
+                             op=op_name)
+            except Exception:  # noqa: BLE001 — obs never breaks the path
+                pass
+
+        p.future.add_done_callback(_record)
         with self._cv:
             b = self._buckets.get(key)
             if b is None:
@@ -434,6 +459,7 @@ class DispatchQueue:
         self.cpu_batches += 1
         self.items += len(items)
         self.cpu_items += len(items)
+        trace_done = self._flush_trace_cb(b, items, "cpu")
 
         def one(p: _Pending):
             try:
@@ -463,7 +489,36 @@ class DispatchQueue:
                     p.future.set_exception(e)
 
         for p in items:
+            if trace_done is not None:
+                p.future.add_done_callback(trace_done)
             self._completers.submit(one, p)
+
+    def _flush_trace_cb(self, b: _Bucket, items: list[_Pending],
+                        route: str):
+        """Future-done callback publishing ONE kernel-type trace per
+        flush (op, route, batch size, queue wait, wall duration) once
+        the flush's last item resolves; None when nobody subscribes to
+        the trace plane (zero hot-path cost while unobserved)."""
+        if not _trc.subscribed():
+            return None
+        t0 = time.monotonic()
+        qwait = t0 - min(p.t for p in items)
+        bytes_in, bytes_out = self._flush_bytes(b, items)
+        remaining = [len(items)]
+        rlock = threading.Lock()
+
+        def done(_f):
+            with rlock:
+                remaining[0] -= 1
+                if remaining[0]:
+                    return
+            _trc.publish_kernel(
+                op=_OP_NAME.get(b.op, b.op), route=route,
+                batch=len(items), queue_wait_s=qwait,
+                duration_s=time.monotonic() - t0,
+                input_bytes=bytes_in, output_bytes=bytes_out)
+
+        return done
 
     def _device_saturated(self) -> bool:
         with self._profile_lock:
@@ -512,6 +567,7 @@ class DispatchQueue:
             self._probe_failed_at = time.monotonic()
 
     def _flush_device(self, b: _Bucket, items: list[_Pending]):
+        trace_done = self._flush_trace_cb(b, items, "device")
         import jax.numpy as jnp
         from .mesh import object_mesh, replicated_for, sharded_batched
         n = len(items)
@@ -577,6 +633,9 @@ class DispatchQueue:
                 self._dev_busy_until = max(self._dev_busy_until, now) + \
                     prof.device_flush_s(bytes_in, bytes_out)
         # hand host readback to a completer so the next batch launches now
+        if trace_done is not None:
+            for p in items:
+                p.future.add_done_callback(trace_done)
         self._completers.submit(self._complete, b, out_dev, items,
                                 accounted)
 

@@ -112,11 +112,20 @@ class GlobalHealer:
         return results
 
     def _heal_one(self, bucket: str, name: str, scan_mode: str) -> bool:
+        from ..obs import trace as trc
+        t0 = time.perf_counter()
+        err = ""
         try:
             self.obj.heal_object(bucket, name, scan_mode=scan_mode)
             return True
-        except Exception:  # noqa: BLE001
+        except Exception as e:  # noqa: BLE001
+            err = str(e)
             return False
+        finally:
+            trc.publish_scanner(func="heal.object",
+                                path=f"{bucket}/{name}",
+                                duration_s=time.perf_counter() - t0,
+                                error=err)
 
 
 class AutoHealMonitor:

@@ -54,6 +54,8 @@ class HealSequence:
                     if self._stop.is_set():
                         break
                     self.scanned += 1
+                    from ..obs import trace as trc
+                    t0 = time.perf_counter()
                     try:
                         r = self.obj.heal_object(bucket, oi.name,
                                                  dry_run=self.dry_run)
@@ -63,10 +65,19 @@ class HealSequence:
                         item = {"bucket": bucket, "object": oi.name,
                                 "before": r.before_state,
                                 "after": r.after_state}
+                        trc.publish_scanner(
+                            func="heal.object",
+                            path=f"{bucket}/{oi.name}",
+                            duration_s=time.perf_counter() - t0)
                     except Exception as e:  # noqa: BLE001
                         self.failed += 1
                         item = {"bucket": bucket, "object": oi.name,
                                 "error": str(e)}
+                        trc.publish_scanner(
+                            func="heal.object",
+                            path=f"{bucket}/{oi.name}",
+                            duration_s=time.perf_counter() - t0,
+                            error=str(e))
                     self.recent.append(item)
                     if len(self.recent) > 256:
                         del self.recent[:128]

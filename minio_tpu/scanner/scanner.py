@@ -65,11 +65,13 @@ class DataScanner:
         previous stats instead of re-walking — the bloom-filter skip of
         cmd/data-update-tracker.go. Deep-scan cycles always walk."""
         from ..obs import metrics as mx
+        from ..obs import trace as trc
         from .tracker import global_tracker
         self.cycle += 1
         deep = (self.cycle % DEEP_SCAN_EVERY == 0)
         mx.inc("minio_tpu_scanner_cycles_total",
                deep=str(deep).lower())
+        t_cycle = time.perf_counter()
         tracker = global_tracker()
         gen = tracker.begin_cycle()
         prev_buckets = self.last_usage.get("buckets", {}) \
@@ -129,6 +131,10 @@ class DataScanner:
             usage_mod.save_usage(self.obj, snapshot)
         except Exception:  # noqa: BLE001
             pass
+        trc.publish_scanner(func="scanner.cycle",
+                            path=f"cycle={self.cycle} deep={deep}",
+                            duration_s=time.perf_counter() - t_cycle,
+                            input_bytes=total_size)
         self.last_usage = snapshot
         return snapshot
 

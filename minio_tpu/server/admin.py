@@ -350,6 +350,49 @@ def _iam_op(h, op: str) -> bool:
     return True
 
 
+def _parse_duration(s: str) -> float:
+    """Duration query param -> seconds: bare float seconds or with an
+    us/ms/s suffix (madmin-style '?threshold=100ms')."""
+    s = s.strip().lower()
+    if not s:
+        return 0.0
+    for suf, mult in (("us", 1e-6), ("ms", 1e-3), ("s", 1.0)):
+        if s.endswith(suf):
+            return float(s[:-len(suf)]) * mult
+    return float(s)
+
+
+def _trace_filter(q: dict):
+    """Predicate over trace dicts from ?type= (csv of
+    http|storage|kernel|scanner, or 'all'; default http — the reference
+    traces only S3 calls unless asked), ?threshold=<dur> (only events at
+    least this slow) and ?err=1 (only failures: error set or status >=
+    400). Raises ValueError on an unknown type so a typo gets a 400
+    instead of a silent empty stream."""
+    from ..obs.trace import TRACE_TYPES
+    types = {t for t in q.get("type", "").split(",") if t}
+    unknown = types - set(TRACE_TYPES) - {"all"}
+    if unknown:
+        raise ValueError(f"unknown trace type {sorted(unknown)!r}")
+    if not types:
+        types = {"http"}
+    if "all" in types:
+        types = None
+    threshold = _parse_duration(q.get("threshold", ""))
+    err_only = q.get("err") == "1"
+
+    def want(d: dict) -> bool:
+        if types is not None and d.get("trace_type", "http") not in types:
+            return False
+        if threshold and d.get("duration_s", 0.0) < threshold:
+            return False
+        if err_only and not (d.get("error") or d.get("status", 0) >= 400):
+            return False
+        return True
+
+    return want
+
+
 def _trace(h) -> None:
     """`mc admin trace` analogue (reference peerRESTMethodTrace fan-out):
     streams JSON-line trace events. ?peers=1 dumps every peer's recent
@@ -357,7 +400,8 @@ def _trace(h) -> None:
     tracestream RPC is pumped on its own thread into the merged output
     as events happen (reference cmd/peer-rest-common.go:54 streaming;
     replaced the round-4 ring polling). Bounded by ?count / ?timeout so
-    clients and tests terminate.
+    clients and tests terminate. ?type/?threshold/?err filter every
+    phase (local ring, peer rings, live events) alike.
     """
     import queue as qmod
     import threading
@@ -367,6 +411,10 @@ def _trace(h) -> None:
     q = {k: v[0] for k, v in h.query.items()}
     count = int(q.get("count", "50"))
     timeout = float(q.get("timeout", "10"))
+    try:
+        want = _trace_filter(q)
+    except ValueError as e:
+        return h._error("InvalidArgument", f"bad trace filter: {e}", 400)
     h.send_response(200)
     h.send_header("Content-Type", "application/x-ndjson")
     h.send_header("Transfer-Encoding", "chunked")
@@ -380,21 +428,30 @@ def _trace(h) -> None:
     for peer in peers:
         try:
             for t in peer.trace_recent():
+                if not want(t):
+                    continue
                 out.write((json.dumps(t) + "\n").encode())
                 sent += 1
         except Exception:  # noqa: BLE001 — peer down: skip
             continue
-    for t in recent(count):
-        out.write((json.dumps(t.to_dict()) + "\n").encode())
+    # filter over the FULL ring, then keep the newest `count` matches —
+    # truncating the ring first would hide matching events sitting
+    # behind newer non-matching ones
+    hist = [d for d in (t.to_dict() for t in recent()) if want(d)]
+    for d in hist[max(0, len(hist) - max(0, count - sent)):]:
+        out.write((json.dumps(d) + "\n").encode())
         sent += 1
     if sent < count:
         # live phase only if the history dumps left budget: each pump
         # holds a streaming RPC to its peer for up to `timeout` seconds
         for peer in peers:
             def pump(p=peer, budget=count - sent):
+                from ..obs import metrics as mx
                 try:
                     for t in p.trace_stream(timeout_s=timeout,
                                             count=budget):
+                        if not want(t):
+                            continue
                         try:
                             # never block: if the consumer is gone or
                             # slow, drop (trace is lossy by design —
@@ -403,7 +460,8 @@ def _trace(h) -> None:
                             # peer connection for the process lifetime
                             merged.put_nowait(t)
                         except qmod.Full:
-                            pass
+                            mx.inc("minio_tpu_trace_dropped_total",
+                                   reason="slow_subscriber")
                 except Exception:  # noqa: BLE001 — peer died mid-stream
                     pass
 
@@ -426,7 +484,10 @@ def _trace(h) -> None:
                 break
             try:
                 info = sub.get(timeout=0.01 if wrote else 0.2)
-                out.write((json.dumps(info.to_dict()) + "\n").encode())
+                d = info.to_dict()
+                if not want(d):
+                    continue
+                out.write((json.dumps(d) + "\n").encode())
                 sent += 1
             except qmod.Empty:
                 continue

@@ -17,9 +17,12 @@ from __future__ import annotations
 import os
 import shutil
 import threading
+import time
 import uuid
 from typing import Iterator
 
+from ..obs import latency as _lat
+from ..obs import trace as _trc
 from ..utils import errors
 from .datatypes import DiskInfo, FileInfo, VolInfo
 from .interface import StorageAPI
@@ -91,6 +94,41 @@ class _FileReadAt:
         self._f.close()
 
 
+class _OpSpan:
+    """One traced storage call (reference storageTrace wrapping every
+    xlStorage op with trace type madmin.TraceStorage): measures the op,
+    feeds the per-disk last-minute latency window, and — only while a
+    trace subscriber is listening — publishes a storage-type TraceInfo
+    with path, bytes and duration."""
+
+    __slots__ = ("disk", "op", "path", "in_bytes", "out_bytes", "t0")
+
+    def __init__(self, disk: str, op: str, path: str, in_bytes: int = 0):
+        self.disk = disk
+        self.op = op
+        self.path = path
+        self.in_bytes = in_bytes
+        self.out_bytes = 0
+
+    def __enter__(self) -> "_OpSpan":
+        self.t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, etype, exc, tb) -> bool:
+        dur = time.perf_counter() - self.t0
+        try:
+            _lat.observe("disk", dur, self.in_bytes + self.out_bytes,
+                         disk=self.disk, op=self.op)
+            _trc.publish_storage(
+                node=self.disk, op=self.op, path=self.path,
+                duration_s=dur, input_bytes=self.in_bytes,
+                output_bytes=self.out_bytes,
+                error=f"{etype.__name__}: {exc}" if etype else "")
+        except Exception:  # noqa: BLE001 — obs must never break storage
+            pass
+        return False
+
+
 class XLStorage(StorageAPI):
     def __init__(self, base_dir: str, endpoint: str = ""):
         self.base = os.path.abspath(base_dir)
@@ -111,6 +149,11 @@ class XLStorage(StorageAPI):
 
     def endpoint(self) -> str:
         return self._endpoint
+
+    def _op(self, op: str, volume: str, path: str = "",
+            in_bytes: int = 0) -> _OpSpan:
+        return _OpSpan(self._endpoint, op,
+                       f"{volume}/{path}" if path else volume, in_bytes)
 
     def get_disk_id(self) -> str:
         return self._disk_id
@@ -166,6 +209,11 @@ class XLStorage(StorageAPI):
 
     def list_dir(self, volume: str, dir_path: str, count: int = -1
                  ) -> list[str]:
+        with self._op("list", volume, dir_path):
+            return self._list_dir_inner(volume, dir_path, count)
+
+    def _list_dir_inner(self, volume: str, dir_path: str, count: int = -1
+                        ) -> list[str]:
         base = self._abs(volume, dir_path) if dir_path else self._abs(volume)
         if not os.path.isdir(self._abs(volume)):
             raise errors.VolumeNotFound(volume)
@@ -185,6 +233,14 @@ class XLStorage(StorageAPI):
         return out
 
     def read_all(self, volume: str, path: str) -> bytes:
+        with self._op("read_all", volume, path) as sp:
+            out = self._read_all_inner(volume, path)
+            sp.out_bytes = len(out)
+            return out
+
+    def _read_all_inner(self, volume: str, path: str) -> bytes:
+        """Untraced read_all for composite ops (xl.meta loads) — keeps
+        one logical storage call = one span/window observation."""
         try:
             with open(self._abs(volume, path), "rb") as f:
                 return f.read()
@@ -197,6 +253,10 @@ class XLStorage(StorageAPI):
 
     def write_all(self, volume: str, path: str, data: bytes) -> None:
         """Atomic whole-file write (tmp + rename)."""
+        with self._op("write_all", volume, path, in_bytes=len(data)):
+            self._write_all_inner(volume, path, data)
+
+    def _write_all_inner(self, volume: str, path: str, data: bytes) -> None:
         dst = self._abs(volume, path)
         if not os.path.isdir(self._abs(volume)):
             raise errors.VolumeNotFound(volume)
@@ -207,10 +267,11 @@ class XLStorage(StorageAPI):
         os.replace(tmp, dst)
 
     def append_file(self, volume: str, path: str, data: bytes) -> None:
-        dst = self._abs(volume, path)
-        os.makedirs(os.path.dirname(dst), exist_ok=True)
-        with open(dst, "ab") as f:
-            f.write(data)
+        with self._op("append_file", volume, path, in_bytes=len(data)):
+            dst = self._abs(volume, path)
+            os.makedirs(os.path.dirname(dst), exist_ok=True)
+            with open(dst, "ab") as f:
+                f.write(data)
 
     def create_file_writer(self, volume: str, path: str):
         return _FileWriter(self._abs(volume, path))
@@ -220,15 +281,21 @@ class XLStorage(StorageAPI):
 
     def rename_file(self, src_volume: str, src_path: str, dst_volume: str,
                     dst_path: str) -> None:
-        src = self._abs(src_volume, src_path)
-        dst = self._abs(dst_volume, dst_path)
-        if not os.path.exists(src):
-            raise errors.FileNotFound(src_path)
-        os.makedirs(os.path.dirname(dst), exist_ok=True)
-        os.replace(src, dst)
+        with self._op("rename_file", src_volume, src_path):
+            src = self._abs(src_volume, src_path)
+            dst = self._abs(dst_volume, dst_path)
+            if not os.path.exists(src):
+                raise errors.FileNotFound(src_path)
+            os.makedirs(os.path.dirname(dst), exist_ok=True)
+            os.replace(src, dst)
 
     def delete_path(self, volume: str, path: str, recursive: bool = False
                     ) -> None:
+        with self._op("delete", volume, path):
+            self._delete_path_inner(volume, path, recursive)
+
+    def _delete_path_inner(self, volume: str, path: str,
+                           recursive: bool = False) -> None:
         p = self._abs(volume, path)
         try:
             if os.path.isdir(p):
@@ -254,6 +321,10 @@ class XLStorage(StorageAPI):
             parent = os.path.dirname(parent)
 
     def stat_file_size(self, volume: str, path: str) -> int:
+        with self._op("stat", volume, path):
+            return self._stat_file_size_inner(volume, path)
+
+    def _stat_file_size_inner(self, volume: str, path: str) -> int:
         try:
             st = os.stat(self._abs(volume, path))
         except FileNotFoundError:
@@ -268,8 +339,9 @@ class XLStorage(StorageAPI):
         return self._abs(volume, path, XL_META_FILE)
 
     def _load_meta(self, volume: str, path: str) -> XLMeta:
+        # untraced inner read: the calling meta op owns the span
         try:
-            blob = self.read_all(volume, f"{path}/{XL_META_FILE}")
+            blob = self._read_all_inner(volume, f"{path}/{XL_META_FILE}")
         except errors.FileNotFound:
             raise errors.FileNotFound(path) from None
         return XLMeta.load(blob)
@@ -277,16 +349,18 @@ class XLStorage(StorageAPI):
     def _store_meta(self, volume: str, path: str, meta: XLMeta) -> None:
         if not meta.versions:
             # last version removed: delete the whole object dir
-            self.delete_path(volume, path, recursive=True)
+            self._delete_path_inner(volume, path, recursive=True)
             return
-        self.write_all(volume, f"{path}/{XL_META_FILE}", meta.dump())
+        self._write_all_inner(volume, f"{path}/{XL_META_FILE}",
+                              meta.dump())
 
     def rename_data(self, src_volume: str, src_path: str, fi: FileInfo,
                     dst_volume: str, dst_path: str) -> None:
         """Commit a freshly written object version: move
         ``<src>/<dataDir>`` under the object dir and add the version to
         xl.meta atomically w.r.t. this disk (reference RenameData)."""
-        with self._meta_lock:
+        with self._op("rename_data", dst_volume, dst_path), \
+                self._meta_lock:
             try:
                 meta = self._load_meta(dst_volume, dst_path)
             except errors.FileNotFound:
@@ -318,7 +392,7 @@ class XLStorage(StorageAPI):
                 pass
 
     def write_metadata(self, volume: str, path: str, fi: FileInfo) -> None:
-        with self._meta_lock:
+        with self._op("write_metadata", volume, path), self._meta_lock:
             try:
                 meta = self._load_meta(volume, path)
             except errors.FileNotFound:
@@ -340,19 +414,21 @@ class XLStorage(StorageAPI):
         # written at put time, as in the reference (cmd/xl-storage.go:1138).
         # part.N files hold bitrot-framed SHARD bytes, never object bytes,
         # so inlining them here would serve digest||shard as object data.
-        meta = self._load_meta(volume, path)
-        return meta.to_fileinfo(volume, path, version_id)
+        with self._op("read_version", volume, path):
+            meta = self._load_meta(volume, path)
+            return meta.to_fileinfo(volume, path, version_id)
 
     def list_versions(self, volume: str, path: str) -> list[FileInfo]:
         return self._load_meta(volume, path).list_versions(volume, path)
 
     def delete_version(self, volume: str, path: str, fi: FileInfo) -> None:
-        with self._meta_lock:
+        with self._op("delete_version", volume, path), self._meta_lock:
             meta = self._load_meta(volume, path)
             ddir = meta.delete_version(fi)
             if ddir:
                 try:
-                    self.delete_path(volume, f"{path}/{ddir}", recursive=True)
+                    self._delete_path_inner(volume, f"{path}/{ddir}",
+                                            recursive=True)
                 except errors.FileNotFound:
                     pass
             self._store_meta(volume, path, meta)
@@ -364,31 +440,37 @@ class XLStorage(StorageAPI):
                                       bitrot_shard_file_size)
         if fi.data is not None:
             return
-        algo = BitrotAlgorithm(fi.metadata.get(
-            "x-minio-internal-bitrot", "blake2b256S"))
-        chunk = int(fi.metadata.get(BITROT_CHUNK_KEY,
-                                    str(fi.erasure.shard_size())))
-        for part in fi.parts:
-            p = f"{path}/{fi.data_dir}/part.{part.number}"
-            want = bitrot_shard_file_size(
-                fi.erasure.shard_file_size(part.size), chunk, algo)
-            if self.stat_file_size(volume, p) != want:
-                raise errors.FileCorrupt(p)
+        with self._op("check_parts", volume, path):
+            algo = BitrotAlgorithm(fi.metadata.get(
+                "x-minio-internal-bitrot", "blake2b256S"))
+            chunk = int(fi.metadata.get(BITROT_CHUNK_KEY,
+                                        str(fi.erasure.shard_size())))
+            for part in fi.parts:
+                p = f"{path}/{fi.data_dir}/part.{part.number}"
+                want = bitrot_shard_file_size(
+                    fi.erasure.shard_file_size(part.size), chunk, algo)
+                if self._stat_file_size_inner(volume, p) != want:
+                    raise errors.FileCorrupt(p)
 
     def verify_file(self, volume: str, path: str, fi: FileInfo) -> None:
         """Deep bitrot scan of every part on this disk (reference
         VerifyFile / bitrotVerify)."""
-        from ..erasure.bitrot import (BITROT_CHUNK_KEY, BitrotAlgorithm,
-                                      bitrot_logical_size, new_bitrot_reader)
         if fi.data is not None:
             return
+        with self._op("verify_file", volume, path):
+            self._verify_file_inner(volume, path, fi)
+
+    def _verify_file_inner(self, volume: str, path: str,
+                           fi: FileInfo) -> None:
+        from ..erasure.bitrot import (BITROT_CHUNK_KEY, BitrotAlgorithm,
+                                      bitrot_logical_size, new_bitrot_reader)
         algo = BitrotAlgorithm(fi.metadata.get(
             "x-minio-internal-bitrot", "blake2b256S"))
         chunk = int(fi.metadata.get(BITROT_CHUNK_KEY,
                                     str(fi.erasure.shard_size())))
         for part in fi.parts:
             p = f"{path}/{fi.data_dir}/part.{part.number}"
-            fsize = self.stat_file_size(volume, p)
+            fsize = self._stat_file_size_inner(volume, p)
             logical = bitrot_logical_size(fsize, chunk, algo)
             want = fi.erasure.shard_file_size(part.size)
             if logical != want:

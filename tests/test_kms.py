@@ -12,6 +12,10 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 import pytest
 
+# KMS sealing needs the optional cryptography package (gated at use in
+# minio_tpu.crypto) — skip fast instead of failing through fixtures
+pytest.importorskip("cryptography")
+
 sys.path.insert(0, os.path.dirname(__file__))
 from s3client import S3Client  # noqa: E402
 

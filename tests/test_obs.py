@@ -307,6 +307,309 @@ def test_stream_pubsub_events_and_keepalive():
     assert all(not c.strip() for c in chunks)
 
 
+def test_latency_window_percentiles_and_expiry():
+    """The last-minute sliding window: fake timestamps verify p50/p99
+    math and per-second bucket expiry."""
+    from minio_tpu.obs.latency import Window
+    w = Window()
+    base = 1000.0
+    for i in range(50):
+        w.observe(0.010, nbytes=100, now=base + i * 0.5)
+    w.observe(1.0, now=base + 1.0)  # rank 50.49 of 51: the outlier IS p99
+    now = base + 55.0
+    assert w.count(now=now) == 51
+    ps = w.percentiles((0.5, 0.99), now=now)
+    assert 0.005 < ps[0.5] < 0.02
+    assert 0.5 < ps[0.99] < 2.0
+    # samples written in seconds [base, base+25) expire as now advances:
+    # at base+70 the window starts at base+11, keeping only the tail
+    assert 0 < w.count(now=base + 70.0) < 51
+    # far past the window everything is gone and percentiles read 0
+    assert w.count(now=base + 200.0) == 0
+    assert w.percentiles((0.99,), now=base + 200.0)[0.99] == 0.0
+
+
+def test_latency_window_slot_recycle():
+    """A slot reused by a later second (now % 60 collision) must drop
+    the old second's samples, not merge them."""
+    from minio_tpu.obs.latency import Window
+    w = Window()
+    w.observe(0.010, now=500.0)
+    w.observe(0.020, now=560.0)  # same slot, 60 s later
+    assert w.count(now=560.0) == 1
+    ps = w.percentiles((0.5,), now=560.0)
+    assert ps[0.5] > 0.015  # the surviving sample is the 20 ms one
+
+
+def test_latency_window_rate():
+    from minio_tpu.obs.latency import Window
+    w = Window()
+    for i in range(4):
+        w.observe(0.001, nbytes=1 << 30, now=2000.0 + i)
+    assert abs(w.rate_gibs(now=2003.0) - 1.0) < 0.01
+    # stats() serves the same numbers from one merge
+    st = w.stats((0.5,), now=2003.0)
+    assert st["count"] == 4
+    assert abs(st["rate_gibs"] - 1.0) < 0.01
+    assert st["percentiles"][0.5] == w.percentiles((0.5,),
+                                                   now=2003.0)[0.5]
+
+
+def test_storage_traces_and_disk_latency_metrics(c, srv):
+    """Storage-layer traces (trace_type=storage, per-op bytes/duration)
+    reach subscribers, and the per-disk latency windows surface as
+    minio_tpu_disk_latency_seconds percentile rows."""
+    import queue as qmod
+
+    from minio_tpu.obs.trace import trace_pubsub
+    sub = trace_pubsub.subscribe()
+    try:
+        c.request("PUT", "/sb")
+        c.request("PUT", "/sb/o", body=b"d" * 4096)
+        c.request("GET", "/sb/o")
+        got = []
+        deadline = time.time() + 5
+        while time.time() < deadline and len(got) < 3:
+            try:
+                t = sub.get(timeout=0.2)
+            except qmod.Empty:
+                continue
+            if t.trace_type == "storage":
+                got.append(t)
+        assert got, "no storage traces published"
+        assert all(t.func.startswith("storage.") for t in got)
+        assert any(t.input_bytes > 0 or t.output_bytes > 0 for t in got)
+        assert all(t.duration_s >= 0 for t in got)
+    finally:
+        trace_pubsub.unsubscribe(sub)
+    text = c.http.get(srv.endpoint() + "/minio/v2/metrics/cluster").text
+    assert "minio_tpu_disk_latency_seconds{" in text
+    for q in ('quantile="0.5"', 'quantile="0.95"', 'quantile="0.99"'):
+        assert q in text, q
+    assert 'op="write_all"' in text
+    # node exposition carries the disk/kernel latency groups too
+    node = c.http.get(srv.endpoint() + "/minio/v2/metrics/node").text
+    assert "minio_tpu_disk_latency_seconds{" in node
+
+
+def test_kernel_traces_and_metrics(c, srv):
+    """A dispatch-queue flush publishes one kernel-type trace (route,
+    batch, queue wait) and feeds minio_tpu_kernel_op_latency_seconds."""
+    import queue as qmod
+
+    import numpy as np
+
+    from minio_tpu.obs.trace import trace_pubsub
+    from minio_tpu.ops.rs_jax import get_codec, pack_shards
+    from minio_tpu.runtime.dispatch import global_queue
+    codec = get_codec(4, 2)
+    data = np.random.default_rng(0).integers(
+        0, 256, size=(4, 1024), dtype=np.uint8)
+    sub = trace_pubsub.subscribe()
+    try:
+        global_queue().encode(codec, pack_shards(data)).result(timeout=10)
+        got = None
+        deadline = time.time() + 5
+        while time.time() < deadline and got is None:
+            try:
+                t = sub.get(timeout=0.2)
+            except qmod.Empty:
+                continue
+            if t.trace_type == "kernel":
+                got = t
+        assert got is not None, "no kernel trace published"
+        assert got.func == "kernel.encode"
+        assert got.method in ("cpu", "device")
+        assert got.query.startswith("batch=")
+    finally:
+        trace_pubsub.unsubscribe(sub)
+    text = c.http.get(srv.endpoint() + "/minio/v2/metrics/cluster").text
+    assert 'minio_tpu_kernel_op_latency_seconds{op="encode",' \
+        'quantile="0.99"}' in text
+    assert 'minio_tpu_kernel_op_gibs{op="encode"}' in text
+
+
+def test_heal_shard_p99_gauge_moves(c, srv):
+    """Driving a real shard heal moves the online heal-shard p99 gauge —
+    the paper metric served from /minio/v2/metrics/cluster."""
+    import os as _os
+    import re
+
+    def p99():
+        text = c.http.get(
+            srv.endpoint() + "/minio/v2/metrics/cluster").text
+        m = re.search(
+            r"^minio_tpu_heal_shard_latency_p99_seconds (\S+)$",
+            text, re.M)
+        assert m, "heal-shard p99 gauge missing from exposition"
+        return float(m.group(1)), text
+
+    # a clean window isolates this test from heals other tests drove
+    # (the last-minute window is sliding, so old samples expiring could
+    # legally DECREASE the gauge mid-test)
+    from minio_tpu.obs import latency as lat
+    lat.reset_window("kernel", op="heal_shard")
+    before, _ = p99()
+    assert before == 0.0
+    c.request("PUT", "/hb")
+    body = _os.urandom(256 << 10)  # > inline threshold: real shard files
+    c.request("PUT", "/hb/big", body=body)
+    # break one disk's copy of the OBJECT (not the volume: a missing
+    # volume classifies the disk offline, not healable), then heal
+    d0 = srv.obj.disks[0]
+    import shutil as _sh
+    _sh.rmtree(_os.path.join(d0.base, "hb", "big"))
+    res = srv.obj.heal_object("hb", "big")
+    assert res.after_state.count("ok") == len(srv.obj.disks)
+    after, text = p99()
+    assert after > 0.0
+    assert 'minio_tpu_kernel_op_latency_seconds{op="heal_shard",' \
+        'quantile="0.99"}' in text
+    assert "minio_tpu_disk_latency_seconds" in text
+
+
+def test_admin_trace_type_filter_streams_storage(c, srv):
+    """?type=storage on the admin trace endpoint streams live
+    storage-layer events and nothing else."""
+    from minio_tpu.obs.trace import trace_pubsub
+    res = {}
+
+    def go():
+        res["r"] = c.request("GET", "/minio/admin/v3/trace",
+                             query={"count": "3", "timeout": "8",
+                                    "type": "storage"})
+
+    th = threading.Thread(target=go, daemon=True)
+    base_subs = trace_pubsub.num_subscribers
+    th.start()
+    # wait until the endpoint's live subscription is in place, then
+    # generate storage ops for it to observe
+    c2 = S3Client(srv.endpoint(), AK, SK)
+    deadline = time.time() + 5
+    while time.time() < deadline and \
+            trace_pubsub.num_subscribers <= base_subs:
+        time.sleep(0.05)
+    for i in range(4):
+        c2.request("PUT", f"/trb{i}")
+        c2.request("PUT", f"/trb{i}/o", body=b"x" * 512)
+    th.join(timeout=15)
+    assert "r" in res and res["r"].status_code == 200
+    lines = [json.loads(ln) for ln in res["r"].text.splitlines()
+             if ln.strip()]
+    assert lines, "no storage traces streamed"
+    assert all(e["trace_type"] == "storage" for e in lines)
+    assert all(e["func"].startswith("storage.") for e in lines)
+
+
+def test_admin_trace_type_filter_streams_kernel(c, srv):
+    """?type=kernel streams dispatch-queue flush events."""
+    import numpy as np
+
+    from minio_tpu.obs.trace import trace_pubsub
+    from minio_tpu.ops.rs_jax import get_codec, pack_shards
+    from minio_tpu.runtime.dispatch import global_queue
+    res = {}
+
+    def go():
+        res["r"] = c.request("GET", "/minio/admin/v3/trace",
+                             query={"count": "1", "timeout": "8",
+                                    "type": "kernel"})
+
+    th = threading.Thread(target=go, daemon=True)
+    base_subs = trace_pubsub.num_subscribers
+    th.start()
+    deadline = time.time() + 5
+    while time.time() < deadline and \
+            trace_pubsub.num_subscribers <= base_subs:
+        time.sleep(0.05)
+    codec = get_codec(4, 2)
+    data = np.random.default_rng(1).integers(
+        0, 256, size=(4, 1024), dtype=np.uint8)
+    global_queue().encode(codec, pack_shards(data)).result(timeout=10)
+    th.join(timeout=15)
+    assert "r" in res and res["r"].status_code == 200
+    lines = [json.loads(ln) for ln in res["r"].text.splitlines()
+             if ln.strip()]
+    assert lines and all(e["trace_type"] == "kernel" for e in lines)
+    assert all(e["func"].startswith("kernel.") for e in lines)
+
+
+def test_admin_trace_threshold_and_err_filters(c, srv):
+    """?err=1 keeps only failures; an absurd ?threshold filters
+    everything out."""
+    c.request("PUT", "/fb")
+    c.request("GET", "/fb/missing")  # 404 -> an error trace
+    deadline = time.time() + 5
+    from minio_tpu.obs.trace import recent
+    while time.time() < deadline and not any(
+            t.path == "/fb/missing" for t in recent()):
+        time.sleep(0.05)
+    r = c.request("GET", "/minio/admin/v3/trace",
+                  query={"count": "50", "timeout": "1", "err": "1"})
+    assert r.status_code == 200
+    lines = [json.loads(ln) for ln in r.text.splitlines() if ln.strip()]
+    assert lines, "no error traces returned"
+    assert all(e["status"] >= 400 or e["error"] for e in lines)
+    # threshold in madmin duration syntax: nothing is slower than 1000 s
+    r = c.request("GET", "/minio/admin/v3/trace",
+                  query={"count": "10", "timeout": "0.5", "type": "all",
+                         "threshold": "1000s"})
+    assert r.status_code == 200
+    assert [ln for ln in r.text.splitlines() if ln.strip()] == []
+    # a typo'd type is a 400, not a silently empty stream
+    r = c.request("GET", "/minio/admin/v3/trace",
+                  query={"count": "5", "timeout": "0.5",
+                         "type": "storge"})
+    assert r.status_code == 400
+
+
+def test_admin_trace_filters_via_madmin(c, srv):
+    """Round-trip the new filters through the AdminClient SDK."""
+    from minio_tpu.madmin import AdminClient
+    c.request("GET", "/madm/missing")  # guarantees one >=400 http trace
+    adm = AdminClient(srv.endpoint(), AK, SK)
+    out = adm.trace(count=50, timeout=1, errors_only=True)
+    assert out and all(e["status"] >= 400 or e["error"] for e in out)
+    out = adm.trace(count=10, timeout=0.5, trace_type="all",
+                    threshold="500s")
+    assert out == []
+
+
+def test_trace_ring_configurable_and_drop_counter(monkeypatch):
+    """MINIO_TPU_TRACE_RING resizes the ring (clamped); evictions and
+    slow-subscriber drops land in minio_tpu_trace_dropped_total."""
+    from minio_tpu.obs import metrics as mx
+    from minio_tpu.obs import trace as trc
+    old_cap = trc._ring.maxlen
+    try:
+        monkeypatch.setenv("MINIO_TPU_TRACE_RING", "32")
+        assert trc.configure_ring() == 32
+        assert trc._ring.maxlen == 32
+        # clamp floor / ceiling
+        assert trc.configure_ring(1) == 16
+        assert trc.configure_ring(10 ** 9) == 65536
+        trc.configure_ring(16)
+        key = 'minio_tpu_trace_dropped_total{reason="ring_evict"}'
+        before = mx.counters_snapshot().get(key, 0)
+        for i in range(40):
+            trc.publish(trc.TraceInfo(func=f"t{i}"))
+        after = mx.counters_snapshot().get(key, 0)
+        assert after >= before + 24  # 40 publishes into a 16-slot ring
+        assert len(trc.recent()) == 16
+        # slow subscriber: a full per-subscriber queue counts drops
+        sub = trc.trace_pubsub.subscribe()
+        try:
+            skey = ('minio_tpu_trace_dropped_total'
+                    '{reason="slow_subscriber"}')
+            for _ in range(trc.trace_pubsub.maxsize + 5):
+                trc.publish(trc.TraceInfo(func="flood"))
+            assert mx.counters_snapshot().get(skey, 0) >= 5
+        finally:
+            trc.trace_pubsub.unsubscribe(sub)
+    finally:
+        trc.configure_ring(old_cap)
+
+
 def test_inter_node_rpc_metrics():
     from minio_tpu.obs import metrics as mx
     before = {k: v for k, v in mx._counters.items()

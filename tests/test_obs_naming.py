@@ -1,0 +1,122 @@
+"""Static exposition lint: every metric family render_prometheus emits
+must be snake_case, carry the minio_tpu_ namespace, and be preceded by
+exactly one matching # HELP and # TYPE pair — so a new MetricsGroup (or
+store counter) can't ship a malformed family unnoticed."""
+import os
+import re
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(__file__))
+from s3client import S3Client  # noqa: E402
+
+from minio_tpu.objectlayer import ErasureObjects  # noqa: E402
+from minio_tpu.server import S3Server  # noqa: E402
+from minio_tpu.storage import XLStorage  # noqa: E402
+
+AK, SK = "nmak", "nmsecret1"
+
+NAME_RE = re.compile(r"^minio_tpu_[a-z0-9]+(_[a-z0-9]+)*$")
+HIST_SUFFIXES = ("_bucket", "_count", "_sum")
+
+
+@pytest.fixture
+def srv(tmp_path):
+    obj = ErasureObjects([XLStorage(str(tmp_path / f"d{i}"))
+                          for i in range(4)], default_parity=2)
+    server = S3Server(obj, "127.0.0.1", 0, access_key=AK, secret_key=SK)
+    server.start_background()
+    yield server
+    server.shutdown()
+
+
+def _exposition(srv, tmp_path=None) -> str:
+    """Drive enough traffic that the store families (request counters,
+    TTFB histograms, kernel/disk windows) all appear, then render."""
+    from minio_tpu.obs.metrics import render_prometheus
+    c = S3Client(srv.endpoint(), AK, SK)
+    c.request("PUT", "/nb")
+    c.request("PUT", "/nb/o", body=b"z" * 2048)
+    c.request("GET", "/nb/o")
+    c.request("GET", "/nb/missing")  # error counters
+    return render_prometheus(srv).decode()
+
+
+def _sample_name(line: str) -> str:
+    cut = len(line)
+    for sep in ("{", " "):
+        i = line.find(sep)
+        if i != -1:
+            cut = min(cut, i)
+    return line[:cut]
+
+
+def test_every_family_is_well_formed(srv, tmp_path):
+    text = _exposition(srv)
+    lines = [ln for ln in text.splitlines() if ln.strip()]
+    assert lines
+    helps: dict[str, int] = {}
+    types: dict[str, int] = {}
+    samples: list[tuple[int, str]] = []
+    for i, ln in enumerate(lines):
+        if ln.startswith("# HELP "):
+            helps.setdefault(ln.split()[2], i)
+            continue
+        if ln.startswith("# TYPE "):
+            fam = ln.split()[2]
+            assert fam not in types, f"duplicate # TYPE for {fam}"
+            types[fam] = i
+            assert ln.split()[3] in ("gauge", "counter", "histogram",
+                                     "summary", "untyped"), ln
+            continue
+        assert not ln.startswith("#"), f"unknown comment line: {ln}"
+        samples.append((i, _sample_name(ln)))
+    hist_families = {n[:-len("_bucket")] for _, n in samples
+                     if n.endswith("_bucket")}
+
+    def family(name: str) -> str:
+        for suf in HIST_SUFFIXES:
+            if name.endswith(suf) and name[:-len(suf)] in hist_families:
+                return name[:-len(suf)]
+        return name
+
+    assert samples
+    for i, name in samples:
+        fam = family(name)
+        assert NAME_RE.match(fam), \
+            f"metric name not snake_case/minio_tpu_-prefixed: {name}"
+        assert fam in types, f"sample {name} has no # TYPE {fam}"
+        assert fam in helps, f"sample {name} has no # HELP {fam}"
+        assert types[fam] < i, f"# TYPE {fam} must precede its samples"
+        assert helps[fam] < i, f"# HELP {fam} must precede its samples"
+
+
+def test_new_latency_families_present(srv, tmp_path):
+    """The tentpole families ship well-formed and typed."""
+    text = _exposition(srv)
+    assert "# TYPE minio_tpu_disk_latency_seconds gauge" in text
+    assert "# TYPE minio_tpu_kernel_op_latency_seconds gauge" in text
+    assert "# TYPE minio_tpu_heal_shard_latency_p99_seconds gauge" in text
+    assert "# HELP minio_tpu_disk_latency_seconds" in text
+    assert "# HELP minio_tpu_kernel_op_latency_seconds" in text
+
+
+def test_malformed_group_is_repaired():
+    """A generator that forgets its TYPE/HELP still renders a legal
+    family (the annotation pass backfills both)."""
+    from minio_tpu.obs.metrics import _annotate
+    out = _annotate(["minio_tpu_sloppy_total 3",
+                     'minio_tpu_sloppy_gauge{x="1"} 2'])
+    assert "# HELP minio_tpu_sloppy_total sloppy total" in out
+    assert "# TYPE minio_tpu_sloppy_total counter" in out
+    assert "# TYPE minio_tpu_sloppy_gauge gauge" in out
+    assert out.index("# TYPE minio_tpu_sloppy_total counter") < \
+        out.index("minio_tpu_sloppy_total 3")
+    # conventional HELP-then-TYPE order: author help text AND explicit
+    # type both survive (the explicit type beats the _total inference)
+    out = _annotate(["# HELP minio_tpu_jobs_total running jobs",
+                     "# TYPE minio_tpu_jobs_total gauge",
+                     "minio_tpu_jobs_total 7"])
+    assert "# HELP minio_tpu_jobs_total running jobs" in out
+    assert "# TYPE minio_tpu_jobs_total gauge" in out
