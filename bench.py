@@ -432,6 +432,13 @@ def heal_latency(rng) -> dict:
         "cpu_items": st["cpu_items"], "device_items": st["device_items"],
         "hold_events": st["hold_events"],
         "hold_seconds": st["hold_seconds"],
+        # QoS scheduler telemetry: forced-device runs through a slow
+        # link are expected to SPILL most items back to the CPU
+        # executor (bounded p99 instead of a multi-second backlog)
+        "spilled_items": st["spilled_items"],
+        "spilled_batches": st["spilled_batches"],
+        "spill_reasons": st["spill_reasons"],
+        "deadline_misses": st["deadline_misses"],
         "avg_batch": round(st["avg_batch"], 2),
         "device_pipeline": __import__(
             "minio_tpu.runtime.dispatch",

@@ -61,6 +61,32 @@ SUB_SYSTEMS: dict[str, dict[str, KV]] = {
         "completers": KV("", env="MINIO_TPU_COMPLETERS"),
         "probe_ttl_s": KV("60", env="MINIO_TPU_PROBE_TTL_S"),
     },
+    "qos": {
+        "spill_factor": KV(
+            "3", env="MINIO_TPU_QOS_SPILL_FACTOR",
+            help="spill an item to CPU when its predicted device "
+                 "completion exceeds N x its CPU estimate"),
+        "device_queue_bytes": KV(
+            str(64 << 20), env="MINIO_TPU_QOS_DEVICE_QUEUE_BYTES",
+            help="cap on bytes queued toward the device route"),
+        "interactive_budget_ms": KV(
+            "100", env="MINIO_TPU_QOS_INTERACTIVE_BUDGET_MS",
+            help="latency budget for interactive dispatch items"),
+        "background_budget_ms": KV(
+            "5000", env="MINIO_TPU_QOS_BACKGROUND_BUDGET_MS",
+            help="latency budget for heal/scanner dispatch items"),
+        "max_wait_ms": KV(
+            "500", env="MINIO_TPU_QOS_MAX_WAIT_MS",
+            help="max wait for an admission slot before 503 SlowDown"),
+        "interactive_rps": KV(
+            "0", env="MINIO_TPU_QOS_INTERACTIVE_RPS",
+            help="token-bucket refill for object-data requests "
+                 "(0 = unlimited)"),
+        "control_rps": KV(
+            "0", env="MINIO_TPU_QOS_CONTROL_RPS",
+            help="token-bucket refill for bucket/console requests "
+                 "(0 = unlimited)"),
+    },
     "scanner": {
         "interval_s": KV("60"),
         "sleep_per_object_ms": KV("1"),
@@ -178,7 +204,7 @@ SUB_SYSTEMS: dict[str, dict[str, KV]] = {
 #: Subsystems whose set() takes effect without restart (SubSystemsDynamic,
 #: config.go:132) — consumers read the registry at call time or register
 #: an apply callback.
-DYNAMIC = {"api", "scanner", "heal", "dispatch", "bitrot"}
+DYNAMIC = {"api", "scanner", "heal", "dispatch", "bitrot", "qos"}
 
 
 class ConfigSys:
@@ -227,6 +253,22 @@ class ConfigSys:
             return int(self.get(subsys, key))
         except (KeyError, ValueError):
             return fallback
+
+    def source(self, subsys: str, key: str) -> str:
+        """Where the effective value comes from: env | stored | default
+        (callers that take a constructor override use this to let an
+        explicit argument win over a registry DEFAULT while still
+        honoring operator-set env/stored values)."""
+        import os
+        kv = SUB_SYSTEMS.get(subsys, {}).get(key)
+        if kv is None:
+            raise KeyError(f"unknown config key {subsys}.{key}")
+        if kv.env and os.environ.get(kv.env) is not None:
+            return "env"
+        with self._lock:
+            if key in self._stored.get(subsys, {}):
+                return "stored"
+        return "default"
 
     def set(self, subsys: str, key: str, value: str):
         if key not in SUB_SYSTEMS.get(subsys, {}):

@@ -566,14 +566,16 @@ def erasure_decode(erasure: Erasure, writer, readers: list, offset: int,
         get_algo_id = native_algo_id(readers[0].algo)
         pool = global_pool()
 
-    def pread_block(fds, offs, shard_len):
-        """One native call: pread k framed spans + verify + assemble."""
+    def pread_block(fds, offs, shard_len, out=None):
+        """One native call: pread k framed spans + verify + assemble.
+        ``out`` may be a reserved view into the sink's final buffer
+        (zero-copy scatter); otherwise a pooled buffer is used."""
         scratch = pool.get(k * native.framed_len(shard_len, fuse_chunk))
         try:
             return native.get_block_pread(
                 fds, offs, k, shard_len, fuse_chunk, HIGHWAY_KEY,
                 get_algo_id, scratch=scratch,
-                out=pool.get(k * shard_len))
+                out=out if out is not None else pool.get(k * shard_len))
         finally:
             pool.put(scratch)
 
@@ -597,10 +599,20 @@ def erasure_decode(erasure: Erasure, writer, readers: list, offset: int,
         return None if failed else out
 
     window: deque = deque()
+    #: zero-copy sink protocol: a writer exposing reserve(n) hands out
+    #: sequential views of its final buffer; the native path scatters
+    #: assembled blocks straight into them, skipping the per-block
+    #: GIL-held copy that dominates parallel GET on few cores (round-5
+    #: verdict item 1: the 4+2 parallel-GET collapse was this copy
+    #: serializing 8 streams on the GIL)
+    reserve = getattr(writer, "reserve", None)
 
-    def submit(b: int):
+    def submit(b: int, dest: np.ndarray | None = None):
         """Read block b's shards and return a window entry, or None when
-        the block contributes no bytes to the requested range."""
+        the block contributes no bytes to the requested range. ``dest``
+        re-attaches an already-reserved destination on resubmits (the
+        bitrot-recovery path) — reservations are strictly in block
+        order, so reserving twice would corrupt the layout."""
         block_data_len = min(bs, total_length - b * bs)
         if block_data_len <= 0:
             return None
@@ -611,6 +623,8 @@ def erasure_decode(erasure: Erasure, writer, readers: list, offset: int,
             blen = block_data_len - boff
         if blen <= 0:
             return None
+        if dest is None and reserve is not None:
+            dest = reserve(blen)
         shard_len = ceil_div(block_data_len, k)
         shard_offset = b * erasure.shard_size()
         # Healthy stream + native library -> fused verify+assemble: one
@@ -621,6 +635,11 @@ def erasure_decode(erasure: Erasure, writer, readers: list, offset: int,
         # reads per block; RPC sources keep the pooled-read form.
         if native_get and all(preader.readers[i] is not None
                               for i in range(k)):
+            # a full aligned block whose assembled length equals the
+            # reserved span can scatter DIRECTLY into the sink buffer
+            out_dest = dest if dest is not None and boff == 0 and \
+                blen == k * shard_len and \
+                dest.flags["C_CONTIGUOUS"] else None
             try:
                 fds = [preader.readers[i].fileno() for i in range(k)]
                 offs = [preader.readers[i].phys_offset(shard_offset)
@@ -629,15 +648,18 @@ def erasure_decode(erasure: Erasure, writer, readers: list, offset: int,
                 fds = None
             if fds is not None:
                 fut = encode_pool().submit(pread_block, fds, offs,
-                                           shard_len)
-                return ["native", fut, b, block_data_len, boff, blen]
+                                           shard_len, out_dest)
+                return ["native", fut, b, block_data_len, boff, blen,
+                        dest]
             framed = read_framed_k(shard_offset, shard_len)
             if framed is not None:
                 fut = encode_pool().submit(
                     native.get_block, framed, k, shard_len, fuse_chunk,
                     HIGHWAY_KEY, get_algo_id,
-                    out=pool.get(k * shard_len))
-                return ["native", fut, b, block_data_len, boff, blen]
+                    out=out_dest if out_dest is not None
+                    else pool.get(k * shard_len))
+                return ["native", fut, b, block_data_len, boff, blen,
+                        dest]
         # Degraded data read + device-hash-capable sources -> fused
         # verify+reconstruct: one launch hashes every source shard AND
         # rebuilds the missing ones (BASELINE config 4). Healthy streams
@@ -651,10 +673,10 @@ def erasure_decode(erasure: Erasure, writer, readers: list, offset: int,
             fut = erasure.decode_data_blocks_verified_async(
                 shards, preader.last_digests, preader.fuse_chunk(),
                 preader.fuse_algo())
-            return ["fused", fut, b, block_data_len, boff, blen]
+            return ["fused", fut, b, block_data_len, boff, blen, dest]
         shards = preader.read_block(shard_offset, shard_len)
         return ["plain", erasure.decode_data_blocks_async(shards), b,
-                block_data_len, boff, blen]
+                block_data_len, boff, blen, dest]
 
     def recover_block(corrupt: tuple[int, ...], b: int,
                       block_data_len: int) -> list:
@@ -674,24 +696,52 @@ def erasure_decode(erasure: Erasure, writer, readers: list, offset: int,
         pending = list(window)
         window.clear()
         for e in pending:
-            window.append(e if e[0] == "plain" else submit(e[2]))
+            if e[0] == "plain":
+                window.append(e)
+                continue
+            # drain the abandoned future BEFORE resubmitting: a native
+            # entry may have been submitted with out= a reserved view of
+            # the sink buffer — letting it keep running would race the
+            # resubmit writing the same memory (silent corruption when
+            # the garbage-assembling call finishes last). Its pooled
+            # buffer (non-zero-copy case) is recycled here too.
+            try:
+                res = e[1].result()
+                if e[0] == "native":
+                    out_arr = res[0]
+                    if out_arr is not e[6]:
+                        pool.put(out_arr)
+            except Exception:  # noqa: BLE001 — failed either way: redo
+                pass
+            # resubmits re-attach the entry's reserved destination —
+            # reserving again would shift every later block's layout
+            window.append(submit(e[2], dest=e[6]))
         return blocks
 
     def emit(entry):
-        kind, fut, b, block_data_len, boff, blen = entry
+        kind, fut, b, block_data_len, boff, blen, dest = entry
         res = fut.result()
         if kind == "native":
             out_arr, bad = res
             if bad == -1:
-                # memoryview, not .tobytes(): the sink (BytesIO / socket)
-                # copies once anyway — a bytes() here doubled the GIL-held
-                # memcpy work per block, the main cost of 8-way reads on
-                # few cores
-                writer.write(memoryview(out_arr)[boff: boff + blen])
-                pool.put(out_arr)
+                if dest is None:
+                    # memoryview, not .tobytes(): the sink (BytesIO /
+                    # socket) copies once anyway — a bytes() here doubled
+                    # the GIL-held memcpy work per block, the main cost
+                    # of 8-way reads on few cores
+                    writer.write(memoryview(out_arr)[boff: boff + blen])
+                elif out_arr is not dest:
+                    # reserved sink but a pooled buffer was used (tail /
+                    # unaligned block): one copy into the final buffer
+                    dest[:] = out_arr[boff: boff + blen]
+                # else: zero-copy — the native call assembled straight
+                # into the reserved view
+                if out_arr is not dest:
+                    pool.put(out_arr)
                 stats.bytes_written += blen
                 return
-            pool.put(out_arr)
+            if out_arr is not dest:
+                pool.put(out_arr)
             if bad <= -10:
                 # a fused pread failed on shard -(bad+10): mark the
                 # source dead (a vote, like any disk read error) and
@@ -709,7 +759,10 @@ def erasure_decode(erasure: Erasure, writer, readers: list, offset: int,
         else:
             blocks = res
         block = np.concatenate(blocks[:k])
-        writer.write(memoryview(block)[boff: boff + blen])
+        if dest is None:
+            writer.write(memoryview(block)[boff: boff + blen])
+        else:
+            dest[:] = block[boff: boff + blen]
         stats.bytes_written += blen
 
     win = native_window_for(erasure.block_size) if native_get \
@@ -832,6 +885,75 @@ class BufferSink:
 
     def getvalue(self) -> bytes:
         return self.buf.getvalue()
+
+
+class PreallocSink:
+    """Zero-copy in-memory sink: one preallocated buffer, filled either
+    through the writer interface (write) or by handing erasure_decode
+    sequential ``reserve(n)`` views the native path assembles blocks
+    straight into. Replaces BufferSink under get_object_bytes — the
+    BytesIO sink cost TWO GIL-held copies per object (per-block write +
+    getvalue), which serialized 8-way parallel GETs on few cores (the
+    round-5 4+2 get_par8 collapse)."""
+
+    def __init__(self, nbytes: int | None = None):
+        self.arr = np.empty(nbytes, np.uint8) if nbytes is not None \
+            else None
+        self.pos = 0
+        self.closed = False
+        self._reserved = False  # any reserve() handed out a live view
+
+    def hint_total(self, n: int) -> None:
+        """Called by the read path once the object size is known."""
+        if self.arr is None:
+            self.arr = np.empty(n, np.uint8)
+
+    def _ensure(self, n: int) -> None:
+        if self.arr is not None and self.pos + n <= self.arr.nbytes:
+            return
+        if self._reserved:
+            # growing would reallocate the backing array while earlier
+            # reserve() views (possibly being filled by in-flight native
+            # calls) still point at the OLD memory — their bytes would
+            # be silently lost. The read path always hint_total()s the
+            # exact length first, so this firing means a caller broke
+            # the contract: fail loudly instead of corrupting data.
+            raise RuntimeError(
+                "PreallocSink buffer exhausted with reservations "
+                "outstanding — hint_total() must size the buffer before "
+                "reserve() is used")
+        if self.arr is None:
+            self.arr = np.empty(max(n, 64 << 10), np.uint8)
+        else:
+            grown = np.empty(max(self.arr.nbytes * 2, self.pos + n),
+                             np.uint8)
+            grown[:self.pos] = self.arr[:self.pos]
+            self.arr = grown
+
+    def reserve(self, n: int) -> np.ndarray:
+        """The next n bytes of the buffer as a writable view; the caller
+        fills it (possibly out of order relative to other reservations)."""
+        self._ensure(n)
+        self._reserved = True
+        v = self.arr[self.pos: self.pos + n]
+        self.pos += n
+        return v
+
+    def write(self, b) -> None:
+        n = len(b)
+        if n == 0:
+            return
+        self._ensure(n)
+        self.arr[self.pos: self.pos + n] = np.frombuffer(b, dtype=np.uint8)
+        self.pos += n
+
+    def close(self):
+        self.closed = True
+
+    def getvalue(self) -> bytes:
+        if self.arr is None:
+            return b""
+        return self.arr[: self.pos].tobytes()
 
 
 class BufferSource:

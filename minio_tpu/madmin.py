@@ -210,6 +210,12 @@ class AdminClient:
         """Per-API call counts + latency percentiles."""
         return self._json("GET", "top/api")
 
+    def qos_status(self) -> dict:
+        """Live QoS status: dispatch scheduler spill/hold counters +
+        device queue state, admission control inflight/reject totals,
+        per-class last-minute latency percentiles."""
+        return self._json("GET", "qos")
+
     def trace(self, count: int = 50, timeout: float = 5.0,
               trace_type: str = "", threshold: str = "",
               errors_only: bool = False,

@@ -464,6 +464,11 @@ class ErasureObjects(MultipartMixin, ObjectLayer):
             raise dt.InvalidRange(bucket, object)
         if fi.size == 0 or length == 0:
             return oi
+        hint = getattr(writer, "hint_total", None)
+        if hint is not None:
+            # size-aware sinks (PreallocSink) allocate once up front so
+            # the decode path can scatter blocks zero-copy via reserve()
+            hint(length)
 
         if fi.data is not None and len(fi.data) == fi.size:
             writer.write(fi.data[offset: offset + length])
@@ -546,8 +551,8 @@ class ErasureObjects(MultipartMixin, ObjectLayer):
 
     def get_object_bytes(self, bucket: str, object: str,
                          opts: ObjectOptions = None) -> bytes:
-        from ..erasure.streaming import BufferSink
-        sink = BufferSink()
+        from ..erasure.streaming import PreallocSink
+        sink = PreallocSink()
         self.get_object(bucket, object, sink, opts=opts)
         return sink.getvalue()
 

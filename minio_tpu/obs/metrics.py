@@ -259,6 +259,79 @@ def _g_dispatch(server) -> list[str]:
     return lines
 
 
+def _g_qos(server) -> list[str]:
+    """QoS plane (minio_tpu.qos): dispatch spill/deadline counters +
+    device queue state from the scheduler, admission inflight/rejects,
+    per-class last-minute latency percentiles. Admission REJECT totals
+    additionally ride the counter store
+    (minio_tpu_qos_admission_rejects_total{class,reason}) incremented at
+    rejection time."""
+    from . import latency as lat
+    from ..runtime.dispatch import _global
+    lines: list[str] = []
+    if _global is not None:
+        sched = _global.qos.stats()
+        lines += [
+            "# TYPE minio_tpu_qos_spilled_items_total counter",
+            f"minio_tpu_qos_spilled_items_total {sched['spilled_items']}",
+            "# TYPE minio_tpu_qos_spilled_batches_total counter",
+            "minio_tpu_qos_spilled_batches_total "
+            f"{sched['spilled_batches']}",
+            "# TYPE minio_tpu_qos_device_queued_bytes gauge",
+            "minio_tpu_qos_device_queued_bytes "
+            f"{sched['device_queued_bytes']}",
+            "# TYPE minio_tpu_qos_queue_depth gauge",
+            f"minio_tpu_qos_queue_depth {_global.stats()['queue_depth']}",
+        ]
+        if sched["spill_reasons"]:
+            lines.append(
+                "# TYPE minio_tpu_qos_spill_reason_total counter")
+            for reason, n in sorted(sched["spill_reasons"].items()):
+                lines.append(
+                    "minio_tpu_qos_spill_reason_total"
+                    f'{{reason="{_esc(reason)}"}} {n}')
+        lines.append("# TYPE minio_tpu_qos_class_items_total counter")
+        lines.append("# TYPE minio_tpu_qos_deadline_misses_total counter")
+        for cls, n in sorted(sched["class_items"].items()):
+            lines.append(
+                f'minio_tpu_qos_class_items_total{{class="{_esc(cls)}"}} '
+                f"{n}")
+        for cls, n in sorted(sched["deadline_misses"].items()):
+            lines.append(
+                "minio_tpu_qos_deadline_misses_total"
+                f'{{class="{_esc(cls)}"}} {n}')
+    adm = getattr(server, "qos_admission", None)
+    if adm is not None:
+        st = adm.stats()
+        lines += [
+            "# TYPE minio_tpu_qos_admission_max_requests gauge",
+            f"minio_tpu_qos_admission_max_requests {st['max_requests']}",
+            "# TYPE minio_tpu_qos_admission_inflight gauge",
+            "minio_tpu_qos_admission_inflight "
+            f"{st['inflight_total']}",
+        ]
+        if st["admitted"]:
+            lines.append(
+                "# TYPE minio_tpu_qos_admitted_total counter")
+            for cls, n in sorted(st["admitted"].items()):
+                lines.append(
+                    f'minio_tpu_qos_admitted_total{{class="{_esc(cls)}"}} '
+                    f"{n}")
+    rows = lat.snapshot("qos")
+    if rows:
+        lines.append(
+            "# TYPE minio_tpu_qos_class_latency_seconds gauge")
+        for labels, w in rows:
+            cls = _esc(labels.get("class", ""))
+            st = w.stats(tuple(q for q, _ in _QUANTILES))
+            for q, qs in _QUANTILES:
+                lines.append(
+                    "minio_tpu_qos_class_latency_seconds"
+                    f'{{class="{cls}",quantile="{qs}"}} '
+                    f'{st["percentiles"][q]:.6f}')
+    return lines
+
+
 def _g_process(server) -> list[str]:
     """Node process resources (reference getMinioProcMetrics:
     /proc/self/io rchar/wchar, fds, rss)."""
@@ -467,6 +540,9 @@ _GROUPS = [
     # (and tests driving heals) fresh at negligible cost
     MetricsGroup("disk_latency", "node", _g_disk_latency, interval=0),
     MetricsGroup("kernel", "node", _g_kernel, interval=0),
+    # qos reads in-memory scheduler/admission state — interval 0 keeps
+    # overload tests (and scrapes mid-incident) fresh
+    MetricsGroup("qos", "node", _g_qos, interval=0),
     MetricsGroup("process", "node", _g_process),
     MetricsGroup("locks", "node", _g_locks),
     MetricsGroup("notification", "cluster", _g_notification),

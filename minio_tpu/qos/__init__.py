@@ -1,0 +1,96 @@
+"""QoS subsystem — deadline-aware dispatch scheduling + S3 admission
+control (no single reference analogue; the closest pieces are MinIO's
+per-node request throttle, cmd/handler-api.go, and the latency budgets
+any accelerator-backed serving stack carries).
+
+Three parts:
+
+* ``qos.budget`` — per-route (device/CPU) cost model: the dispatch link
+  profile's analytic estimates, EWMA-corrected by observed flush wall
+  times, plus per-class latency budgets.
+* ``qos.scheduler`` — priority classes (interactive vs background),
+  per-route queued-bytes caps and SPILL-TO-CPU: when an item's predicted
+  device completion exceeds ~N x its CPU estimate (or its class budget,
+  or the device queued-bytes cap) the item is re-routed to the CPU
+  executor even under MINIO_TPU_DISPATCH_MODE=device.
+* ``qos.admission`` — per-class token buckets + a bounded-wait
+  concurrency gate behind the HTTP server that answer ``503 SlowDown``
+  with ``Retry-After`` under overload instead of piling threads.
+
+Work class rides a context variable: request handlers run as
+``interactive`` (the default); scanners/healers tag themselves
+``background`` so their dispatch items queue behind interactive work and
+spill first.
+"""
+from __future__ import annotations
+
+import contextlib
+import contextvars
+
+CLASS_INTERACTIVE = "interactive"
+CLASS_BACKGROUND = "background"
+
+#: flush/admission priority order (lower = flushed first)
+CLASS_PRIORITY = {CLASS_INTERACTIVE: 0, CLASS_BACKGROUND: 1}
+
+_current: contextvars.ContextVar[str] = contextvars.ContextVar(
+    "minio_tpu_qos_class", default=CLASS_INTERACTIVE)
+
+
+def current_class() -> str:
+    """The QoS class of the calling context (default: interactive)."""
+    return _current.get()
+
+
+@contextlib.contextmanager
+def work_class(cls: str):
+    """Run a block under a QoS class; dispatch items submitted inside
+    inherit it."""
+    tok = _current.set(cls)
+    try:
+        yield
+    finally:
+        _current.reset(tok)
+
+
+def background():
+    """Sugar for the scanners/healers: ``with qos.background(): ...``."""
+    return work_class(CLASS_BACKGROUND)
+
+
+from .admission import AdmissionController, classify_request  # noqa: E402
+from .budget import CostModel  # noqa: E402
+from .scheduler import QosScheduler  # noqa: E402
+
+__all__ = [
+    "CLASS_INTERACTIVE", "CLASS_BACKGROUND", "CLASS_PRIORITY",
+    "current_class", "work_class", "background",
+    "CostModel", "QosScheduler", "AdmissionController",
+    "classify_request", "qos_status",
+]
+
+
+def qos_status(server=None) -> dict:
+    """One JSON-able snapshot of the whole QoS plane: scheduler counters
+    from the global dispatch queue, admission state from ``server`` (when
+    given), and the per-class last-minute latency percentiles — the admin
+    ``qos`` op and tests read this."""
+    from ..obs import latency as lat
+    from ..runtime import dispatch as dp
+    out: dict = {"classes": {}}
+    q = dp._global
+    if q is not None and getattr(q, "qos", None) is not None:
+        out["scheduler"] = q.qos.stats()
+        out["dispatch"] = q.stats()
+    adm = getattr(server, "qos_admission", None) if server is not None \
+        else None
+    if adm is not None:
+        out["admission"] = adm.stats()
+    for labels, w in lat.snapshot("qos"):
+        st = w.stats((0.5, 0.99))
+        out["classes"][labels.get("class", "")] = {
+            "p50_ms": round(st["percentiles"][0.5] * 1e3, 3),
+            "p99_ms": round(st["percentiles"][0.99] * 1e3, 3),
+            "last_minute": st["count"],
+        }
+    return out

@@ -1,0 +1,130 @@
+"""Cost model + deadline budgets for the dispatch scheduler.
+
+Per-route completion-time estimates start from the dispatch link probe's
+analytic formula (round-trip + transfer + kernel for the device route,
+bytes / native-kernel rate for the CPU route) and are corrected by an
+EWMA of observed-vs-predicted flush wall times, so the model tracks the
+link as it drifts instead of trusting one probe forever. Each dispatch
+item then gets a predicted completion time (route backlog + corrected
+flush estimate) and a latency budget derived from its QoS class.
+
+Env/KVS knobs (config subsystem ``qos``):
+
+* ``MINIO_TPU_QOS_INTERACTIVE_BUDGET_MS`` (default 100) — latency budget
+  for interactive items (PUT/GET encode/rebuild).
+* ``MINIO_TPU_QOS_BACKGROUND_BUDGET_MS`` (default 5000) — budget for
+  background items (heal/scanner).
+"""
+from __future__ import annotations
+
+import os
+import threading
+
+from . import CLASS_BACKGROUND, CLASS_INTERACTIVE
+
+#: EWMA smoothing for the observed/predicted correction ratio
+ALPHA = 0.25
+#: correction clamp: one absurd observation (GC pause, probe race) must
+#: not swing the route model by orders of magnitude
+CORR_MIN, CORR_MAX = 0.1, 10.0
+
+_DEFAULT_BUDGET_MS = {CLASS_INTERACTIVE: 100.0, CLASS_BACKGROUND: 5000.0}
+_BUDGET_ENV = {
+    CLASS_INTERACTIVE: "MINIO_TPU_QOS_INTERACTIVE_BUDGET_MS",
+    CLASS_BACKGROUND: "MINIO_TPU_QOS_BACKGROUND_BUDGET_MS",
+}
+_BUDGET_KEY = {
+    CLASS_INTERACTIVE: "interactive_budget_ms",
+    CLASS_BACKGROUND: "background_budget_ms",
+}
+
+
+#: stored-config lookups cached briefly: budget_s runs in every dispatch
+#: item's done-callback, and taking the process-global ConfigSys lock
+#: per item would serialize the completer threads for a value that only
+#: changes on operator action. Env vars are read fresh (cheap, and tests
+#: flip them); only the registry layer is cached.
+_CFG_TTL_S = 5.0
+_cfg_cache: dict[tuple[str, str], tuple[str | None, float]] = {}
+
+
+def _config_float(subsys: str, key: str, env: str, default: float) -> float:
+    """env > stored > default, without importing the config registry at
+    module load (qos must stay import-light for the dispatch hot path)."""
+    import time
+    v = os.environ.get(env)
+    if v is None:
+        hit = _cfg_cache.get((subsys, key))
+        now = time.monotonic()
+        if hit is not None and now < hit[1]:
+            v = hit[0]
+        else:
+            try:
+                from ..config import get_config_sys
+                v = get_config_sys().get(subsys, key)
+            except Exception:  # noqa: BLE001 — registry not wired
+                v = None
+            _cfg_cache[(subsys, key)] = (v, now + _CFG_TTL_S)
+    try:
+        return float(v) if v not in (None, "") else default
+    except ValueError:
+        return default
+
+
+class CostModel:
+    """Per-route cost estimates + per-class latency budgets."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._corr = {"device": 1.0, "cpu": 1.0}
+        self._observed = {"device": 0, "cpu": 0}
+
+    # -- route estimates ------------------------------------------------------
+
+    def device_s(self, profile, bytes_in: int, bytes_out: int) -> float:
+        """Corrected wall-seconds estimate for one device flush."""
+        base = profile.device_flush_s(bytes_in, bytes_out)
+        return base * self._corr["device"]
+
+    def cpu_s(self, profile, nbytes: int, workers: int = 1) -> float:
+        """Corrected wall-seconds estimate for ``nbytes`` through the
+        native CPU kernel across ``workers`` completer threads."""
+        base = nbytes / profile.cpu_gibs / (1 << 30) / max(1, workers)
+        return base * self._corr["cpu"]
+
+    def observe(self, route: str, predicted_s: float,
+                actual_s: float) -> None:
+        """Feed one completed flush; the correction EWMA converges the
+        analytic estimate onto what the route actually delivers."""
+        if predicted_s <= 0 or actual_s <= 0 or route not in self._corr:
+            return
+        # predicted already includes the current correction, so the
+        # correction this observation implies is ratio * current
+        ratio = min(CORR_MAX, max(CORR_MIN, actual_s / predicted_s))
+        with self._lock:
+            prev = self._corr[route]
+            new = (1 - ALPHA) * prev + ALPHA * (ratio * prev)
+            self._corr[route] = min(CORR_MAX, max(CORR_MIN, new))
+            self._observed[route] += 1
+
+    # -- class budgets --------------------------------------------------------
+
+    @staticmethod
+    def budget_s(cls: str) -> float:
+        """Latency budget (seconds) for a QoS class."""
+        default = _DEFAULT_BUDGET_MS.get(cls,
+                                         _DEFAULT_BUDGET_MS[CLASS_BACKGROUND])
+        key = _BUDGET_KEY.get(cls, _BUDGET_KEY[CLASS_BACKGROUND])
+        env = _BUDGET_ENV.get(cls, _BUDGET_ENV[CLASS_BACKGROUND])
+        return _config_float("qos", key, env, default) / 1e3
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "correction": {k: round(v, 3)
+                               for k, v in self._corr.items()},
+                "observed_flushes": dict(self._observed),
+                "budgets_ms": {c: round(self.budget_s(c) * 1e3, 1)
+                               for c in (CLASS_INTERACTIVE,
+                                         CLASS_BACKGROUND)},
+            }

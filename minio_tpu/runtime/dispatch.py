@@ -28,6 +28,14 @@ of ms RT, MB/s bandwidth) single hot PUTs fall back to the same
 CPU-SIMD-per-request behavior as the reference instead of eating a tunnel
 round-trip. Override with MINIO_TPU_DISPATCH_MODE=device|cpu|auto.
 
+QoS (minio_tpu.qos): every flush consults the deadline-aware scheduler
+PER ITEM — items whose predicted device completion (backlog + transfer)
+exceeds ~N x their CPU estimate, their class latency budget, or the
+device queued-bytes cap SPILL to the CPU executor, even in forced-device
+mode, so a saturated link yields bounded latency instead of a backlog.
+Work class (interactive vs background) rides a context variable set by
+the scanners/healers; interactive buckets flush first.
+
 Enable/disable batching entirely with MINIO_TPU_DISPATCH=1/0 (default: on).
 """
 from __future__ import annotations
@@ -43,6 +51,7 @@ import numpy as np
 
 from ..obs import latency as _lat
 from ..obs import trace as _trc
+from .. import qos as _qos
 
 log = logging.getLogger("minio_tpu.dispatch")
 
@@ -172,12 +181,15 @@ class _Pending:
 
 class _Bucket:
     def __init__(self, codec, op: str, hash_key: bytes | None = None,
-                 chunk_size: int = 0, hash_algo: int = 0):
+                 chunk_size: int = 0, hash_algo: int = 0,
+                 cls: str = _qos.CLASS_INTERACTIVE):
         self.codec = codec
         self.op = op  # 'encode' | 'masked' | 'fused'
         self.hash_key = hash_key
         self.chunk_size = chunk_size
         self.hash_algo = hash_algo  # native ALGO_* id for 'fused'
+        self.cls = cls  # QoS class: buckets never mix classes, so the
+        # loop can flush interactive work ahead of heal/scanner batches
         self.items: list[_Pending] = []
         #: set while the loop holds this bucket for coalescing (device
         #: pipeline saturated); cleared at flush — feeds hold telemetry
@@ -220,6 +232,9 @@ class DispatchQueue:
         self.device_items = 0
         self.hold_events = 0
         self.hold_seconds = 0.0
+        #: deadline-aware scheduler: per-item device-vs-CPU routing with
+        #: spill + per-route queued-bytes caps (minio_tpu.qos.scheduler)
+        self.qos = _qos.QosScheduler()
         # predicted drain deadline for device flushes already dispatched
         # and their in-flight count (under _profile_lock); the estimate
         # self-corrects — when the last in-flight flush completes early
@@ -245,6 +260,17 @@ class DispatchQueue:
         """words uint32 [k, W] -> Future[uint32 [m, W]] (parity)."""
         key = ("encode", codec.k, codec.m, words.shape[-1], id(codec.matrix))
         return self._submit(key, codec, "encode", words, None)
+
+    @staticmethod
+    def _item_bytes(b: "_Bucket", p: _Pending) -> tuple[int, int]:
+        """(bytes up the link, bytes back) for ONE pending item — the
+        unit the QoS scheduler costs per-item routing on."""
+        bytes_in = p.words.nbytes
+        out_rows = b.codec.m
+        if p.masks is not None:
+            bytes_in += p.masks.nbytes
+            out_rows = p.masks.shape[1]
+        return bytes_in, out_rows * p.words.shape[-1] * 4
 
     def masked(self, codec, words: np.ndarray, masks: np.ndarray) -> Future:
         """words uint32 [k, W] + masks uint32 [8, o, k] -> Future[[o, W]].
@@ -274,20 +300,28 @@ class DispatchQueue:
     def _submit(self, key, codec, op, words, masks, digests=None,
                 hash_key=None, chunk_size=0, hash_algo=0) -> Future:
         p = _Pending(words=words, masks=masks, digests=digests)
+        # QoS class rides the bucket key: interactive PUT/GET work and
+        # background heal/scanner work never share a flush, so the loop
+        # can order and spill them independently
+        cls = _qos.current_class()
+        key = key + (cls,)
         # per-item wall latency through the queue (what a caller sees:
         # queue wait + flush + readback) into the last-minute window
-        # behind minio_tpu_kernel_op_latency_seconds
+        # behind minio_tpu_kernel_op_latency_seconds — and the per-class
+        # window behind minio_tpu_qos_class_latency_seconds
         op_name = _OP_NAME.get(op, op)
         nbytes = words.nbytes
 
-        def _record(_f, t=p.t, op_name=op_name, nbytes=nbytes):
+        def _record(_f, t=p.t, op_name=op_name, nbytes=nbytes, cls=cls):
             try:
                 if _f.exception() is not None:
                     # failed ops must not read as kernel throughput —
                     # same rule the heal_shard window applies
                     return
-                _lat.observe("kernel", time.monotonic() - t, nbytes,
-                             op=op_name)
+                wall = time.monotonic() - t
+                _lat.observe("kernel", wall, nbytes, op=op_name)
+                _lat.observe("qos", wall, nbytes, **{"class": cls})
+                self.qos.note_deadline(cls, wall)
             except Exception:  # noqa: BLE001 — obs never breaks the path
                 pass
 
@@ -296,7 +330,8 @@ class DispatchQueue:
             b = self._buckets.get(key)
             if b is None:
                 b = self._buckets[key] = _Bucket(codec, op, hash_key,
-                                                 chunk_size, hash_algo)
+                                                 chunk_size, hash_algo,
+                                                 cls=cls)
             b.items.append(p)
             self._cv.notify()
         return p.future
@@ -347,6 +382,10 @@ class DispatchQueue:
                             deadline = d if deadline is None \
                                 else min(deadline, d)
                     if to_flush:
+                        # interactive flushes launch ahead of background
+                        # ones collected in the same pass (QoS priority)
+                        to_flush.sort(key=lambda e: _qos.CLASS_PRIORITY.get(
+                            e[1].cls, 1))
                         break
                     timeout = None if deadline is None \
                         else max(0.0, deadline - time.monotonic())
@@ -427,20 +466,22 @@ class DispatchQueue:
             bytes_in += n * items[0].masks.nbytes
         return bytes_in, n * out_rows * w.shape[-1] * 4
 
-    def _route(self, b: _Bucket, items: list[_Pending]) -> str:
+    def _plan_flush(self, b: _Bucket, items: list[_Pending]) -> int:
+        """Per-item consultation of the QoS scheduler (replaces the old
+        flush-granular device_wins coin flip): how many leading items of
+        this flush take the device route; the rest SPILL to the CPU
+        executor — even in forced-device mode, when an item's predicted
+        device completion exceeds ~N x its CPU estimate, its class
+        budget, or the device queued-bytes cap."""
         mode = os.environ.get("MINIO_TPU_DISPATCH_MODE", "auto")
-        if mode in ("device", "cpu"):
-            return mode
+        if mode == "cpu":
+            return 0
         prof = self._get_profile()
-        if prof is None:
-            # probe still in flight (or failed): CPU is the safe default —
-            # it always works and single-flush latency never eats a probe
-            return "cpu"
-        bytes_in, bytes_out = self._flush_bytes(b, items)
-        backlog = max(0.0, self._dev_busy_until - time.monotonic())
-        return "device" if prof.device_wins(
-            bytes_in, bytes_out, len(items), self.completer_count,
-            backlog_s=backlog) else "cpu"
+        with self._profile_lock:
+            backlog = max(0.0, self._dev_busy_until - time.monotonic())
+        sizes = [self._item_bytes(b, p) for p in items]
+        return self.qos.plan(mode, prof, b.cls, sizes, backlog,
+                             self.completer_count)
 
     @staticmethod
     def _rows_from_masks(masks: np.ndarray) -> np.ndarray:
@@ -460,6 +501,26 @@ class DispatchQueue:
         self.items += len(items)
         self.cpu_items += len(items)
         trace_done = self._flush_trace_cb(b, items, "cpu")
+        # observed CPU flush wall corrects the route cost EWMA (only
+        # meaningful once a link profile provides the base estimate)
+        prof = self._profile
+        cost_done = None
+        if prof is not None:
+            bytes_in, bytes_out = self._flush_bytes(b, items)
+            predicted = self.qos.cost.cpu_s(
+                prof, bytes_in + bytes_out,
+                min(len(items), self.completer_count))
+            t0 = time.monotonic()
+            left = [len(items)]
+            llock = threading.Lock()
+
+            def cost_done(_f, predicted=predicted, t0=t0):  # noqa: F811
+                with llock:
+                    left[0] -= 1
+                    if left[0]:
+                        return
+                self.qos.cost.observe("cpu", predicted,
+                                      time.monotonic() - t0)
 
         def one(p: _Pending):
             try:
@@ -491,6 +552,8 @@ class DispatchQueue:
         for p in items:
             if trace_done is not None:
                 p.future.add_done_callback(trace_done)
+            if cost_done is not None:
+                p.future.add_done_callback(cost_done)
             self._completers.submit(one, p)
 
     def _flush_trace_cb(self, b: _Bucket, items: list[_Pending],
@@ -525,40 +588,41 @@ class DispatchQueue:
             return self._dev_inflight >= DEVICE_PIPELINE
 
     def _device_bound(self, b: _Bucket) -> bool:
-        """Would this bucket's flush take the device route? Forced-cpu
-        never holds; forced-device always does; auto holds only when the
-        profile currently favors the device (a saturated link makes auto
-        pick CPU via the backlog term anyway)."""
+        """Would any of this bucket's flush take the device route? Pure
+        probe of the QoS scheduler (record=False: hold checks must not
+        charge spill counters). Work the scheduler would spill entirely
+        to CPU is NOT held — holding it up to MAX_HOLD_S would blow its
+        latency budget for a device launch that will never happen."""
         mode = os.environ.get("MINIO_TPU_DISPATCH_MODE", "auto")
         if mode == "cpu":
             return False
-        if mode == "device":
-            return True
         prof = self._profile
-        if prof is None:
+        if mode != "device" and prof is None:
             return False
-        bytes_in, bytes_out = self._flush_bytes(b, b.items)
         with self._profile_lock:
             backlog = max(0.0, self._dev_busy_until - time.monotonic())
-        return prof.device_wins(bytes_in, bytes_out, len(b.items),
-                                cpu_workers=self.completer_count,
-                                backlog_s=backlog)
+        sizes = [self._item_bytes(b, p) for p in b.items]
+        return self.qos.plan(mode, prof, b.cls, sizes, backlog,
+                             self.completer_count, record=False) > 0
 
     def _flush(self, b: _Bucket, items: list[_Pending]):
-        if self._route(b, items) == "cpu":
-            self._flush_cpu(b, items)
-            return
-        try:
-            self._flush_device(b, items)
-        except Exception:  # noqa: BLE001 — dead/hung device: degrade
-            log.warning("device flush failed; falling back to CPU route",
-                        exc_info=True)
-            self._mark_device_failed()
-            self.batches -= 1  # _flush_cpu re-counts this flush
-            self.items -= len(items)
-            self.device_batches -= 1  # the device flush never completed
-            self.device_items -= len(items)
-            self._flush_cpu(b, items)
+        self.qos.note_items(b.cls, len(items))
+        n_dev = self._plan_flush(b, items)
+        dev_items, cpu_items = items[:n_dev], items[n_dev:]
+        if dev_items:
+            try:
+                self._flush_device(b, dev_items)
+            except Exception:  # noqa: BLE001 — dead/hung device: degrade
+                log.warning("device flush failed; falling back to CPU "
+                            "route", exc_info=True)
+                self._mark_device_failed()
+                self.batches -= 1  # _flush_cpu re-counts this flush
+                self.items -= len(dev_items)
+                self.device_batches -= 1  # the flush never completed
+                self.device_items -= len(dev_items)
+                self._flush_cpu(b, dev_items)
+        if cpu_items:
+            self._flush_cpu(b, cpu_items)
 
     def _mark_device_failed(self):
         with self._profile_lock:
@@ -622,28 +686,46 @@ class DispatchQueue:
                                      out_batch=2)
                 out_dev = fn(masks, stack, digs)
         # queue model: extend the predicted drain deadline by this
-        # flush's link+kernel estimate so _route sees the backlog
+        # flush's link+kernel estimate so the scheduler sees the backlog
         prof = self._profile
         accounted = prof is not None
+        bytes_in, bytes_out = self._flush_bytes(b, items)
+        predicted_s = 0.0
         if accounted:
-            bytes_in, bytes_out = self._flush_bytes(b, items)
+            predicted_s = self.qos.cost.device_s(prof, bytes_in, bytes_out)
             now = time.monotonic()
             with self._profile_lock:
                 self._dev_inflight += 1
                 self._dev_busy_until = max(self._dev_busy_until, now) + \
                     prof.device_flush_s(bytes_in, bytes_out)
+        # per-route queued-bytes accounting feeds the scheduler's cap
+        self.qos.device_dispatched(bytes_in + bytes_out)
         # hand host readback to a completer so the next batch launches now
         if trace_done is not None:
             for p in items:
                 p.future.add_done_callback(trace_done)
-        self._completers.submit(self._complete, b, out_dev, items,
-                                accounted)
+        try:
+            self._completers.submit(self._complete, b, out_dev, items,
+                                    accounted, bytes_in + bytes_out,
+                                    predicted_s, time.monotonic())
+        except BaseException:  # submit refused (shutdown): the paired
+            self.qos.device_completed(bytes_in + bytes_out)  # decrement
+            if accounted:  # and the pipeline slot must not stay occupied
+                with self._profile_lock:
+                    self._dev_inflight = max(0, self._dev_inflight - 1)
+            raise  # must not leak into the queued-bytes cap
 
     def _complete(self, b: _Bucket, out_dev, items: list[_Pending],
-                  accounted: bool = True):
+                  accounted: bool = True, qbytes: int = 0,
+                  predicted_s: float = 0.0, t0: float = 0.0):
         try:
             self._finish_readback(b, out_dev, items)
         finally:
+            self.qos.device_completed(qbytes)
+            if predicted_s > 0.0 and t0 > 0.0:
+                # observed flush wall corrects the route cost EWMA
+                self.qos.cost.observe("device", predicted_s,
+                                      time.monotonic() - t0)
             if accounted:  # pairs with _flush_device's increment
                 with self._profile_lock:
                     self._dev_inflight = max(0, self._dev_inflight - 1)
@@ -692,6 +774,8 @@ class DispatchQueue:
         self._completers.shutdown(wait=True)
 
     def stats(self) -> dict:
+        with self._cv:
+            qdepth = sum(len(b.items) for b in self._buckets.values())
         return {"batches": self.batches, "items": self.items,
                 "cpu_batches": self.cpu_batches,
                 "device_batches": self.device_batches,
@@ -699,6 +783,13 @@ class DispatchQueue:
                 "device_items": self.device_items,
                 "hold_events": self.hold_events,
                 "hold_seconds": round(self.hold_seconds, 3),
+                "spilled_items": self.qos.spilled_items,
+                "spilled_batches": self.qos.spilled_batches,
+                "spill_reasons": dict(self.qos.spill_reasons),
+                "class_items": dict(self.qos.class_items),
+                "deadline_misses": dict(self.qos.deadline_misses),
+                "queue_depth": qdepth,
+                "device_queued_bytes": self.qos.device_queued_bytes(),
                 "avg_batch": self.items / self.batches if self.batches else 0}
 
 

@@ -112,11 +112,15 @@ class GlobalHealer:
         return results
 
     def _heal_one(self, bucket: str, name: str, scan_mode: str) -> bool:
+        from .. import qos
         from ..obs import trace as trc
         t0 = time.perf_counter()
         err = ""
         try:
-            self.obj.heal_object(bucket, name, scan_mode=scan_mode)
+            # global-heal rebuilds are background-class dispatch work:
+            # they queue behind interactive items and spill first
+            with qos.background():
+                self.obj.heal_object(bucket, name, scan_mode=scan_mode)
             return True
         except Exception as e:  # noqa: BLE001
             err = str(e)

@@ -42,6 +42,14 @@ class HealSequence:
         return [b.name for b in self.obj.list_buckets()]
 
     def _run(self):
+        # admin heals are background-class work: their shard rebuilds
+        # queue behind interactive PUT/GET dispatch items and spill to
+        # the CPU route first under device backlog (minio_tpu.qos)
+        from .. import qos
+        with qos.background():
+            self._run_inner()
+
+    def _run_inner(self):
         try:
             for bucket in self._buckets():
                 if self._stop.is_set():

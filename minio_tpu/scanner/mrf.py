@@ -49,8 +49,11 @@ class MRFHealer:
             except queue.Empty:
                 continue
             try:
-                self.obj.heal_object(bucket, object, version_id,
-                                     scan_mode=scan_mode)
+                from .. import qos
+                # MRF heals are background-class dispatch work
+                with qos.background():
+                    self.obj.heal_object(bucket, object, version_id,
+                                         scan_mode=scan_mode)
                 self.healed += 1
             except Exception:  # noqa: BLE001
                 self.failed += 1

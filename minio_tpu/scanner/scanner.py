@@ -53,9 +53,13 @@ class DataScanner:
         return self
 
     def _loop(self):
+        from .. import qos
         while not self._stop.wait(self.interval):
             try:
-                self.scan_cycle()
+                # scanner work (incl. deep-scan bitrot verifies) is
+                # background class for the QoS dispatch scheduler
+                with qos.background():
+                    self.scan_cycle()
             except Exception:  # noqa: BLE001 — scanner must never die
                 pass
 

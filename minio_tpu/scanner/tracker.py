@@ -217,6 +217,16 @@ class UpdateTracker:
             # merge, don't replace: marks recorded before attach survive
             self._cur.union(cur)
             self._history.extend(hist)
+            # the overflow merge below (and begin_cycle's) assumes
+            # ascending generation order — loaded entries may interleave
+            # with live ones, and a merged bloom labeled with an OLDER
+            # generation could be dropped early by a concurrent
+            # end_cycle. Re-sort and re-cap while still holding the lock.
+            self._history.sort(key=lambda gf: gf[0])
+            while len(self._history) > MAX_HISTORY:
+                (g0, f0), (g1, f1) = self._history[0], self._history[1]
+                f1.union(f0)
+                self._history[:2] = [(g1, f1)]
             self.generation = max(self.generation, gen)
         return True
 

@@ -58,6 +58,15 @@ def main(argv=None):
         banner = f"gateway {args.gateway} -> {args.dirs[0]}"
     elif any(d.startswith(("http://", "https://")) for d in args.dirs):
         return _serve_distributed(args, ak, sk)
+    elif len(args.dirs) > 1 and any("{" in d for d in args.dirs) and \
+            not all("{" in d for d in args.dirs):
+        # the reference rejects mixed ellipses/non-ellipses endpoint args
+        # (cmd/endpoint-ellipses.go): silently flattening `/p/d{1...4}
+        # /extra` into one set layout would place data on a topology the
+        # operator never asked for
+        ap.error("invalid endpoint args: all disk args must use ellipses "
+                 "patterns ({...}) or none may; mixing patterns and "
+                 "plain paths is not supported")
     elif len(args.dirs) > 1 and all("{" in d for d in args.dirs):
         # multiple ellipses args = one POOL per arg (reference server
         # pool expansion: `minio server dir{1...4} dir{5...8}` is two

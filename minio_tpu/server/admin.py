@@ -198,6 +198,13 @@ def _dispatch_admin(h, op: str) -> None:
                         mine["currentBandwidth"] +
                         st.get("currentBandwidth", 0.0), 2)
         return h._send(200, json.dumps(rep).encode(), "application/json")
+    if op == "qos":
+        # live QoS plane: scheduler spill/hold counters + device queue
+        # state from the dispatch queue, admission inflight/reject
+        # totals, per-class last-minute latency percentiles
+        from ..qos import qos_status
+        return h._send(200, json.dumps(qos_status(h.s3)).encode(),
+                       "application/json")
     if op == "bg-heal-status":
         from ..scanner import background_heal_stats
         out = background_heal_stats(h.s3)

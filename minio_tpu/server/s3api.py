@@ -5,7 +5,9 @@ ObjectLayer, SigV4 auth, XML responses.
 Threaded stdlib HTTP server: request concurrency maps to the dispatch
 queue's batching (many in-flight PUT/GET blocks coalesce into single device
 launches); the reference's per-node request throttle (cmd/handler-api.go:29)
-is a semaphore here."""
+is the QoS admission controller (minio_tpu.qos.admission): per-class token
+buckets + a bounded-wait concurrency gate answering 503 SlowDown +
+Retry-After under overload."""
 from __future__ import annotations
 
 import hashlib
@@ -68,12 +70,37 @@ class S3Server:
         self.port = port
         from ..crypto import kms as _kms_mod
         _kms_mod.configure(self.secret_key)
+        cfg = None
         if objlayer is not None:
             # attach the config KVS to its persistence backend so stored
             # settings survive restarts (env > stored > default)
             from ..config import get_config_sys
-            get_config_sys(objlayer)
-        self._sem = threading.BoundedSemaphore(max_requests)
+            cfg = get_config_sys(objlayer)
+        # QoS admission control (minio_tpu.qos.admission) replaces the
+        # old bare 256-permit semaphore: a request that cannot get a slot
+        # within the bounded wait (or whose class token bucket is empty)
+        # is answered 503 SlowDown + Retry-After instead of parking a
+        # handler thread
+        from ..qos import AdmissionController
+        if cfg is not None and cfg.source("api", "requests_max") != \
+                "default":
+            # operator-set env/stored value wins over the constructor
+            # default; an explicit constructor argument wins otherwise
+            max_requests = cfg.get_int("api", "requests_max", max_requests)
+        self.qos_admission = AdmissionController(max_requests=max_requests)
+        if cfg is not None:
+            import weakref
+            ref = weakref.ref(self)
+
+            def _apply_api(c, _ref=ref):
+                s = _ref()
+                if s is not None and \
+                        c.source("api", "requests_max") != "default":
+                    s.qos_admission.reconfigure(
+                        c.get_int("api", "requests_max",
+                                  s.qos_admission.max_requests))
+
+            cfg.on_apply("api", _apply_api)
         self._httpd: ThreadingHTTPServer | None = None
         #: internal RPC services mounted under /minio/<name>/v1/<method>
         #: (storage/lock/peer — populated by dist.node.Node)
@@ -1245,10 +1272,49 @@ class _S3Handler(BaseHTTPRequestHandler):
             self._t_first = _time.perf_counter()  # TTFB anchor
         super().send_response(code, message)
 
+    def _admit(self):
+        """Admission control (minio_tpu.qos.admission) ahead of routing:
+        object/control-plane requests pass the per-class token bucket +
+        bounded-wait concurrency gate or are answered ``503 SlowDown`` +
+        ``Retry-After`` (reference AmzRequestsDeadline behavior of
+        cmd/handler-api.go, with S3-semantic backpressure instead of
+        silent thread pile-up). Health, metrics, admin and internal-RPC
+        planes are exempt — an overloaded server must stay observable.
+        Returns (proceed, release_cb)."""
+        from ..qos import classify_request
+        adm = getattr(self.s3, "qos_admission", None)
+        cls = classify_request(self.command, self.path,
+                               internal=self.s3.internal) \
+            if adm is not None else None
+        if cls is None:
+            return True, None
+        grant = adm.admit(cls)
+        if grant.ok:
+            return True, lambda: adm.release(grant)
+        from ..obs import metrics as mx
+        mx.inc("minio_tpu_qos_admission_rejects_total",
+               reason=grant.reason, **{"class": cls})
+        # parse url/headers so the surrounding observability plane (per-
+        # API 503 counters, trace, audit) attributes this rejection like
+        # any other response; the body stays unread — close instead of
+        # leaving the keep-alive connection mid-stream
+        self._parse()
+        self.close_connection = True
+        self._send(
+            503,
+            xu.error_xml(
+                "SlowDown",
+                "request rate/concurrency limit exceeded; reduce "
+                "your request rate", self.url_path),
+            headers={"Retry-After": adm.retry_after_header(grant)})
+        return False, None
+
     def _handle(self):
         """Route one request wrapped in the observability plane
         (cmd/http-tracer.go httpTraceAll + cmd/http-stats.go): timing,
-        metrics, trace pubsub, audit entry."""
+        metrics, trace pubsub, audit entry. Admission rejections run
+        INSIDE this wrapper so overload 503s land in the same per-API
+        counters, trace stream and audit log as every other response."""
         import time as _time
 
         from ..obs import metrics as mx
@@ -1257,9 +1323,14 @@ class _S3Handler(BaseHTTPRequestHandler):
         self._last_status = 0
         self._t_first = None
         t0 = _time.perf_counter()
+        release = None
         try:
-            self._route()
+            proceed, release = self._admit()
+            if proceed:
+                self._route()
         finally:
+            if release is not None:
+                release()
             try:
                 self._drain_body()
             except Exception:  # noqa: BLE001
@@ -1831,15 +1902,11 @@ class _S3Handler(BaseHTTPRequestHandler):
         out = {}
         ct = self.hdr.get("content-type")
         if not ct and self.key:
-            # extension-based detection (reference mimedb, a 4,632-line
-            # generated table; the stdlib registry covers the same
-            # role). Compressed extensions report an encoding — there
-            # the inner type would mislead clients (.tar.gz is not a
-            # plain tar), so fall back to octet-stream.
-            import mimetypes
-            guess, encoding = mimetypes.guess_type(self.key, strict=False)
-            if encoding is None:
-                ct = guess
+            # extension-based detection via the curated mimedb table
+            # (reference pkg/mimedb; deterministic across containers,
+            # stdlib mimetypes as fallback for exotic extensions)
+            from ..utils.mimedb import content_type
+            ct = content_type(self.key)
         if ct:
             out["content-type"] = ct
         for k, v in self.hdr.items():

@@ -444,11 +444,12 @@ def handle_upload(h, bucket: str, object: str) -> None:
         # _body_stream bounds the socket read to Content-Length
         # (keep-alive sockets never EOF) and handles aws-chunked bodies
         hr = HashReader(h._body_stream(size), size)
+        from ..utils.mimedb import content_type
+        ct = h.hdr.get("content-type") or content_type(
+            object, "application/octet-stream")
         oi = h.s3.obj.put_object(
             bucket, object, hr, size,
-            dt.ObjectOptions(user_defined={
-                "content-type": h.hdr.get("content-type",
-                                          "application/octet-stream")}))
+            dt.ObjectOptions(user_defined={"content-type": ct}))
     except dt.ObjectAPIError as e:
         return h._api_error(e)
     h._send(200, json.dumps({"etag": oi.etag}).encode(),
@@ -531,11 +532,17 @@ def handle_download_zip(h) -> None:
     DownloadZip): entries ending in "/" expand to every object under
     them; each entry streams through the logical read context.
 
-    Authorization and metadata resolve BEFORE the response starts (so
-    policy/not-found surface as proper HTTP errors), then the archive
-    STREAMS chunked — no spooling, a multi-GB selection needs no temp
-    disk and the first bytes arrive immediately (the reference streams
-    its zip the same way)."""
+    Every REQUESTED entry (object or folder prefix) is authorized
+    up-front — so a read-denied caller gets a proper 403 before any
+    prefix walk or data read happens — then the archive STREAMS chunked
+    with entries resolved and re-authorized LAZILY: folder prefixes
+    expand via iter_objects while streaming and each object's
+    metadata/SSE context is fetched just before its bytes go out, so a
+    multi-GB selection never pre-buffers O(#objects) ObjectInfo +
+    unsealed-OEK tuples (the reference checks each requested entry
+    before listing and streams the same way). A mid-stream denial or
+    failure cuts the connection — with chunked framing the client sees
+    a truncated archive, never a silent success."""
     import zipfile
     if h.command != "POST":
         return h._error("MethodNotAllowed", "zip is POST-only", 405)
@@ -556,23 +563,19 @@ def handle_download_zip(h) -> None:
     except (ValueError, AttributeError) as e:
         return h._error("InvalidRequest", f"bad zip request: {e}", 400)
     try:
-        keys: list[str] = []
+        # authorize every REQUESTED entry before any walk/read: folder
+        # prefixes gate on the prefix itself (a deny on bucket/prefix/*
+        # matches), explicit objects on their key — nothing is listed or
+        # resolved for a caller the policy rejects. Explicitly named
+        # objects also get a cheap existence probe so a typo answers a
+        # proper pre-stream NoSuchKey (the result is discarded: no
+        # ObjectInfo/OEK buffering; folder contents stay fully lazy).
+        h.s3.obj.get_bucket_info(bucket)
         for name in names:
             full = prefix + name
-            if full.endswith("/"):
-                keys.extend(oi.name for oi in
-                            h.s3.obj.iter_objects(bucket, full))
-            else:
-                keys.append(full)
-        entries = []
-        for key in keys:
-            # PER-OBJECT authorization, like handle_download and the
-            # reference: per-key Deny statements must hold inside a
-            # multi-select zip too
-            _check(h, ak, "s3:GetObject", bucket, key)
-            oi = h.s3.obj.get_object_info(bucket, key)
-            h.bucket, h.key = bucket, key
-            entries.append((key, oi, h._sse_read_ctx(oi)))
+            _check(h, ak, "s3:GetObject", bucket, full)
+            if not full.endswith("/"):
+                h.s3.obj.get_object_info(bucket, full)
     except dt.ObjectAPIError as e:
         return h._api_error(e)
     h.send_response(200)
@@ -583,17 +586,35 @@ def handle_download_zip(h) -> None:
     h.end_headers()
     from .s3api import _ChunkedWriter
     out = _ChunkedWriter(h.wfile)
+
+    def keys():
+        for name in names:
+            full = prefix + name
+            if full.endswith("/"):
+                yield from (oi.name for oi in
+                            h.s3.obj.iter_objects(bucket, full))
+            else:
+                yield full
+
     try:
         # ZipFile handles the non-seekable sink via data descriptors
         with zipfile.ZipFile(out, "w", zipfile.ZIP_STORED,
                              allowZip64=True) as zf:
-            for key, oi, sse in entries:
+            for key in keys():
+                # PER-OBJECT authorization, like handle_download and the
+                # reference: per-key Deny statements must hold inside a
+                # multi-select zip too — re-checked lazily as each entry
+                # streams, with metadata/SSE resolved just-in-time
+                _check(h, ak, "s3:GetObject", bucket, key)
+                oi = h.s3.obj.get_object_info(bucket, key)
+                h.bucket, h.key = bucket, key
+                sse = h._sse_read_ctx(oi)
                 arc = key[len(prefix):] if key.startswith(prefix) else key
                 with zf.open(zipfile.ZipInfo(arc or key), "w",
                              force_zip64=True) as entry:
                     if _logical_size(h, oi, sse) > 0:
                         _write_logical(h, bucket, key, oi, sse, entry)
-    except Exception:  # noqa: BLE001 — mid-stream failure: cut the
-        h.close_connection = True  # connection, the client sees EOF
+    except Exception:  # noqa: BLE001 — mid-stream failure/denial: cut
+        h.close_connection = True  # the connection, the client sees EOF
         return
     out.close()

