@@ -65,6 +65,15 @@ class PeerRESTClient:
         import json as _json
         return _json.loads(self.rpc.call("tracerecent", {"n": str(n)}))
 
+    def trace_tree(self, trace_id: str) -> dict:
+        """The peer's stored span fragment (or slow trace) for one
+        trace_id — {} when the peer holds nothing for it. The admin
+        ?trace_id=...&peers=1 query merges these into the caller's
+        tree."""
+        import json as _json
+        out = self.rpc.call("tracetree", {"trace_id": trace_id})
+        return _json.loads(out) if out else {}
+
     def trace_stream(self, timeout_s: float = 10.0, count: int = 1000):
         """LIVE trace events from the peer as they happen (reference
         peerRESTMethodTrace streaming, cmd/peer-rest-common.go:54):
@@ -199,6 +208,10 @@ class PeerRESTService:
             n = int(params.get("n", "256"))
             return json.dumps(
                 [t.to_dict() for t in recent(n)]).encode()
+        if method == "tracetree":
+            from ..obs import spans as _sp
+            ent = _sp.store().get(params.get("trace_id", ""))
+            return json.dumps(ent or {}).encode()
         if method == "tracestream":
             from ..obs.trace import trace_pubsub
             return _stream_pubsub(

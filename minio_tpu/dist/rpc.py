@@ -105,8 +105,13 @@ class RPCClient:
              body: bytes | None = None, stream: bool = False,
              timeout: float | None = None):
         """POST the method; returns response bytes (or the raw response when
-        stream=True). Typed storage errors re-raise as their class."""
+        stream=True). Typed storage errors re-raise as their class. A
+        request-scoped span context propagates over the
+        ``x-minio-tpu-traceparent`` header so peer-side spans share the
+        caller's trace_id (and a client span records the RPC leg in the
+        caller's own tree)."""
         from ..obs import metrics as mx
+        from ..obs import spans as sp
         if not self._online:
             raise errors.DiskNotFound(f"{self.base} offline")
         qs = urllib.parse.urlencode(
@@ -117,30 +122,39 @@ class RPCClient:
         if body:
             mx.inc("minio_tpu_inter_node_sent_bytes_total", len(body),
                    service=self.service)
-        try:
-            r = self._session.post(
-                url, data=body,
-                headers={"Authorization": f"Bearer "
-                         f"{make_token(self.secret)}"},
-                timeout=timeout or self.timeout, stream=stream)
-        except requests.RequestException as e:
-            self._mark_offline()
-            mx.inc("minio_tpu_inter_node_errors_total",
-                   service=self.service)
-            raise errors.DiskNotFound(f"{self.base}: {e}") from e
-        if r.status_code == 200:
-            if not stream:
-                mx.inc("minio_tpu_inter_node_received_bytes_total",
-                       len(r.content), service=self.service)
-            return r if stream else r.content
-        err_name = r.headers.get("x-minio-tpu-error", "")
-        msg = r.content.decode("utf-8", "replace")[:200]
-        if err_name in _ERR_BY_NAME:
-            raise _ERR_BY_NAME[err_name](msg)
-        if r.status_code in (502, 503, 504):
-            self._mark_offline()
-            raise errors.DiskNotFound(f"{self.base}: {r.status_code}")
-        raise RPCError(f"{method}: HTTP {r.status_code} {msg}")
+        # the status/typed-error handling stays INSIDE the client span:
+        # a peer's 500 + x-minio-tpu-error raises from here, and the
+        # span must record that failure — an error trace showing a
+        # clean rpc.* leg would hide the one thing it exists to show
+        with sp.span(f"rpc.{self.service}.{method}",
+                     peer=self.base) as span_ctx:
+            headers = {"Authorization": f"Bearer "
+                       f"{make_token(self.secret)}"}
+            if span_ctx is not None:
+                headers[sp.RPC_HEADER] = sp.to_traceparent(span_ctx)
+            try:
+                r = self._session.post(
+                    url, data=body, headers=headers,
+                    timeout=timeout or self.timeout, stream=stream)
+            except requests.RequestException as e:
+                self._mark_offline()
+                mx.inc("minio_tpu_inter_node_errors_total",
+                       service=self.service)
+                raise errors.DiskNotFound(f"{self.base}: {e}") from e
+            if r.status_code == 200:
+                if not stream:
+                    mx.inc("minio_tpu_inter_node_received_bytes_total",
+                           len(r.content), service=self.service)
+                return r if stream else r.content
+            err_name = r.headers.get("x-minio-tpu-error", "")
+            msg = r.content.decode("utf-8", "replace")[:200]
+            if err_name in _ERR_BY_NAME:
+                raise _ERR_BY_NAME[err_name](msg)
+            if r.status_code in (502, 503, 504):
+                self._mark_offline()
+                raise errors.DiskNotFound(
+                    f"{self.base}: {r.status_code}")
+            raise RPCError(f"{method}: HTTP {r.status_code} {msg}")
 
     def close(self):
         self._online = False

@@ -237,6 +237,27 @@ class AdminClient:
         raw = self._request("GET", "trace", q)
         return [json.loads(ln) for ln in raw.splitlines() if ln.strip()]
 
-    def recent_logs(self, n: int = 100) -> list[dict]:
-        """Recent structured log entries (console-log history analogue)."""
-        return self._json("GET", "logs", {"n": str(n)})
+    def trace_tree(self, trace_id: str, peers: bool = False) -> dict:
+        """Stored span tree for one trace id (tail-sampled slow/error
+        traces + RPC fragments): {"trace_id", "spans": [...],
+        "tree": [...]}. ``peers`` merges every peer's fragment of the
+        same trace into the tree."""
+        q = {"trace_id": trace_id}
+        if peers:
+            q["peers"] = "1"
+        return self._json("GET", "trace", q)
+
+    def slow_traces(self, count: int = 50) -> list[dict]:
+        """Newest-first summaries of the tail-sampled slow-trace store:
+        requests that breached their QoS class latency budget or
+        errored. Full trees via ``trace_tree``."""
+        return self._json("GET", "trace",
+                          {"slow": "1", "count": str(count)})
+
+    def recent_logs(self, n: int = 100, kind: str = "") -> list[dict]:
+        """Recent structured log entries (console-log history analogue);
+        ``kind="audit"`` returns the per-request audit mirror ring."""
+        q = {"n": str(n)}
+        if kind:
+            q["type"] = kind
+        return self._json("GET", "logs", q)

@@ -17,6 +17,7 @@ from dataclasses import replace
 from ..erasure import (DEFAULT_BITROT_ALGO, Erasure, new_bitrot_reader,
                        new_bitrot_writer)
 from ..obs import latency as _lat
+from ..obs import spans as _spans
 from ..obs import trace as _trc
 from ..erasure.bitrot import (BITROT_CHUNK_KEY, BitrotAlgorithm,
                               pick_bitrot_chunk)
@@ -266,6 +267,14 @@ class ErasureObjects(MultipartMixin, ObjectLayer):
 
     def put_object(self, bucket: str, object: str, stream, size: int,
                    opts: ObjectOptions = None) -> ObjectInfo:
+        with _spans.span("objectlayer.put_object", bucket=bucket,
+                         object=object):
+            return self._put_object_inner(bucket, object, stream, size,
+                                          opts)
+
+    def _put_object_inner(self, bucket: str, object: str, stream,
+                          size: int, opts: ObjectOptions = None
+                          ) -> ObjectInfo:
         opts = opts or ObjectOptions()
         check_names(bucket, object)
         self.get_bucket_info(bucket)  # BucketNotFound early
@@ -364,7 +373,8 @@ class ErasureObjects(MultipartMixin, ObjectLayer):
                 fij = replace(fi, erasure=replace(fi.erasure, index=j + 1),
                               metadata=dict(fi.metadata))
                 futs[j] = meta_pool().submit(
-                    d.rename_data, META_TMP, tmp_id, fij, bucket, object)
+                    _spans.wrap_ctx(d.rename_data), META_TMP, tmp_id, fij,
+                    bucket, object)
             for j, f in futs.items():
                 try:
                     f.result()
@@ -446,6 +456,14 @@ class ErasureObjects(MultipartMixin, ObjectLayer):
     def get_object(self, bucket: str, object: str, writer, offset: int = 0,
                    length: int = -1, opts: ObjectOptions = None
                    ) -> ObjectInfo:
+        with _spans.span("objectlayer.get_object", bucket=bucket,
+                         object=object):
+            return self._get_object_inner(bucket, object, writer, offset,
+                                          length, opts)
+
+    def _get_object_inner(self, bucket: str, object: str, writer,
+                          offset: int = 0, length: int = -1,
+                          opts: ObjectOptions = None) -> ObjectInfo:
         opts = opts or ObjectOptions()
         check_names(bucket, object)
         self.get_bucket_info(bucket)
@@ -996,9 +1014,16 @@ class ErasureObjects(MultipartMixin, ObjectLayer):
         cmd/erasure-healing.go:233): classify per-disk state, rebuild missing
         /corrupt shards via decode→encode, rewrite xl.meta on healed disks."""
         try:
-            return self._heal_object_inner(bucket, object, version_id,
-                                           dry_run, remove_dangling,
-                                           scan_mode)
+            # a request-triggered heal joins the request's trace; the
+            # background planes (MRF/scanner/heal sequences) get a root
+            # of their own, so the heal-p99 worst sample always links to
+            # a span tree and slow background heals tail-sample too
+            with _spans.maybe_root("heal.object", cls="background",
+                                   bucket=bucket, object=object,
+                                   mode=scan_mode):
+                return self._heal_object_inner(bucket, object, version_id,
+                                               dry_run, remove_dangling,
+                                               scan_mode)
         finally:
             if not dry_run:
                 # healed journals change quorum resolution; listings must
@@ -1173,8 +1198,12 @@ class ErasureObjects(MultipartMixin, ObjectLayer):
                     # only successful rebuilds move the north-star
                     # p99/GiB/s window — a burst of fast failures must
                     # not read as heal throughput
+                    _ctx = _spans.current()
                     _lat.observe("kernel", dur, shard_bytes,
-                                 op="heal_shard")
+                                 op="heal_shard",
+                                 trace_id=_ctx.trace_id
+                                 if _ctx is not None and _ctx.sampled
+                                 else "")
                 _trc.publish_scanner(
                     func="heal.shard", path=f"{bucket}/{object}",
                     duration_s=dur, input_bytes=shard_bytes,

@@ -79,12 +79,16 @@ def read_all_fileinfo(disks: list, bucket: str, object: str,
                     else errors.FaultyDisk(str(e))
         return fis, errs
     futs = {}
+    from ..obs import spans as _spans
     for i, d in enumerate(disks):
         if d is None:
             errs[i] = errors.DiskNotFound()
             continue
+        # carry the caller's span context across the pool hop so remote
+        # read_version spans land in the right request tree
         futs[i] = meta_pool().submit(
-            d.read_version, bucket, object, version_id, read_data)
+            _spans.wrap_ctx(d.read_version), bucket, object, version_id,
+            read_data)
     for i, f in futs.items():
         try:
             fis[i] = f.result()

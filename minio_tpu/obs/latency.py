@@ -56,11 +56,15 @@ class Window:
         self._total = [0.0] * window_s     # sum of observed seconds
         self._bytes = [0] * window_s       # payload bytes (throughput)
         self._n = [0] * window_s
+        # worst observation per slot + the trace that caused it, so the
+        # percentile rows can link straight to an offending span tree
+        self._worst = [0.0] * window_s
+        self._worst_tid = [""] * window_s
 
     # -- write path ----------------------------------------------------------
 
     def observe(self, seconds: float, nbytes: int = 0,
-                now: float | None = None) -> None:
+                now: float | None = None, trace_id: str = "") -> None:
         sec = int(time.monotonic() if now is None else now)
         slot = sec % self.window_s
         i = bisect.bisect_left(EDGES, seconds)
@@ -71,17 +75,23 @@ class Window:
                 self._total[slot] = 0.0
                 self._bytes[slot] = 0
                 self._n[slot] = 0
+                self._worst[slot] = 0.0
+                self._worst_tid[slot] = ""
             self._counts[slot][i] += 1
             self._total[slot] += seconds
             self._bytes[slot] += nbytes
             self._n[slot] += 1
+            if seconds >= self._worst[slot]:
+                self._worst[slot] = seconds
+                self._worst_tid[slot] = trace_id
 
     # -- read path -----------------------------------------------------------
 
     def _merge(self, now: float | None = None
-               ) -> tuple[list[int], int, float, int, int]:
-        """(bucket counts, n, total seconds, total bytes, active seconds)
-        over the slots still inside the window."""
+               ) -> tuple[list[int], int, float, int, int, float, str]:
+        """(bucket counts, n, total seconds, total bytes, active seconds,
+        worst seconds, worst trace_id) over the slots still inside the
+        window."""
         sec = int(time.monotonic() if now is None else now)
         lo = sec - self.window_s + 1
         counts = [0] * _NB
@@ -89,6 +99,8 @@ class Window:
         total = 0.0
         nbytes = 0
         active = 0
+        worst = 0.0
+        worst_tid = ""
         with self._lock:
             for s in range(self.window_s):
                 if not (lo <= self._epoch[s] <= sec) or not self._n[s]:
@@ -100,27 +112,40 @@ class Window:
                 total += self._total[s]
                 nbytes += self._bytes[s]
                 active += 1
-        return counts, n, total, nbytes, active
+                if self._worst[s] >= worst:
+                    worst = self._worst[s]
+                    worst_tid = self._worst_tid[s]
+        return counts, n, total, nbytes, active, worst, worst_tid
 
     def stats(self, qs: tuple[float, ...] = (0.5, 0.95, 0.99),
               now: float | None = None) -> dict:
         """One merge serving a whole metrics row: ``{"percentiles":
-        {q: v}, "count": n, "rate_gibs": r}`` — cheaper and internally
-        consistent vs calling percentiles()/count()/rate_gibs()
-        separately (each takes its own merge at its own now)."""
-        counts, n, _, nbytes, active = self._merge(now)
+        {q: v}, "count": n, "rate_gibs": r, "worst_s": w,
+        "worst_trace_id": t}`` — cheaper and internally consistent vs
+        calling percentiles()/count()/rate_gibs() separately (each takes
+        its own merge at its own now)."""
+        counts, n, _, nbytes, active, worst, worst_tid = self._merge(now)
         return {
             "percentiles": self._percentiles_from(counts, n, qs),
             "count": n,
             "rate_gibs": nbytes / active / (1 << 30) if active else 0.0,
+            "worst_s": worst,
+            "worst_trace_id": worst_tid,
         }
 
     def percentiles(self, qs: tuple[float, ...] = (0.5, 0.95, 0.99),
                     now: float | None = None) -> dict[float, float]:
         """Online percentiles, linearly interpolated inside the matched
         bucket; 0.0 when the window is empty."""
-        counts, n, _, _, _ = self._merge(now)
+        counts, n, *_ = self._merge(now)
         return self._percentiles_from(counts, n, qs)
+
+    def worst(self, now: float | None = None) -> tuple[float, str]:
+        """(worst observed seconds, trace_id of that sample) inside the
+        window — the exemplar linking a percentile row to the span tree
+        that produced its tail."""
+        *_, worst, worst_tid = self._merge(now)
+        return worst, worst_tid
 
     @staticmethod
     def _percentiles_from(counts: list[int], n: int,
@@ -150,13 +175,13 @@ class Window:
     def rate_gibs(self, now: float | None = None) -> float:
         """Observed payload GiB/s averaged over the window's ACTIVE
         seconds (idle seconds don't dilute a burst's rate)."""
-        _, _, _, nbytes, active = self._merge(now)
+        _, _, _, nbytes, active, _, _ = self._merge(now)
         if not active:
             return 0.0
         return nbytes / active / (1 << 30)
 
     def mean(self, now: float | None = None) -> float:
-        _, n, total, _, _ = self._merge(now)
+        _, n, total, *_ = self._merge(now)
         return total / n if n else 0.0
 
     def reset(self) -> None:
@@ -202,8 +227,9 @@ def reset_window(family: str, **labels) -> Window:
 
 
 def observe(family: str, seconds: float, nbytes: int = 0,
-            now: float | None = None, **labels) -> None:
-    get_window(family, **labels).observe(seconds, nbytes, now)
+            now: float | None = None, trace_id: str = "",
+            **labels) -> None:
+    get_window(family, **labels).observe(seconds, nbytes, now, trace_id)
 
 
 def snapshot(family: str) -> list[tuple[dict, Window]]:

@@ -77,6 +77,10 @@ class LogSys:
         self.log_target: HTTPLogTarget | None = None
         self.audit_target: HTTPLogTarget | None = None
         self.ring: deque = deque(maxlen=512)
+        #: audit history rides its OWN ring: one entry per request would
+        #: otherwise churn error/warning history out of the console ring
+        #: within seconds under normal traffic
+        self.audit_ring: deque = deque(maxlen=512)
         #: live subscribers (admin console streaming across peers —
         #: reference cmd/consolelogger.go:66-126 pubsub)
         self.pubsub = PubSub()
@@ -113,11 +117,19 @@ class LogSys:
 
     def audit(self, entry: dict):
         """One entry per completed API request (reference audit-webhook;
-        entry shape mirrors the trace record plus identity)."""
+        entry shape mirrors the trace record — trace_id/request_id,
+        response status and duration included — plus identity). Entries
+        mirror into the admin console plane like the reference does:
+        the live pubsub (console streaming) plus a dedicated audit ring
+        served by ``/minio/admin/v3/logs?type=audit``, so `mc admin
+        logs`-style consumers see the audit stream without a webhook —
+        without churning error history out of the log ring."""
+        rec = {"version": "1", "deploymentid": "minio-tpu",
+               "type": "audit", "time": time.time(), **entry}
+        self.audit_ring.append(rec)
+        self.pubsub.publish(rec)
         if self.audit_target is not None:
-            self.audit_target.enqueue(
-                {"version": "1", "deploymentid": "minio-tpu",
-                 "time": time.time(), **entry})
+            self.audit_target.enqueue(rec)
 
     def stop(self):
         for t in (self.log_target, self.audit_target):

@@ -509,11 +509,28 @@ def _g_kernel(server) -> list[str]:
         lines.append(f'minio_tpu_kernel_op_last_minute_total{{op="{op}"}} '
                      f'{st["count"]}')
     # the north-star number gets its own stable gauge (creating the
-    # window on first scrape so the family is always present)
+    # window on first scrape so the family is always present); ONE
+    # stats() merge serves both the p99 and its worst-sample exemplar
+    # so they cannot disagree about the window
     heal = lat.get_window("kernel", op="heal_shard")
+    hst = heal.stats((0.99,))
     lines += ["# TYPE minio_tpu_heal_shard_latency_p99_seconds gauge",
               "minio_tpu_heal_shard_latency_p99_seconds "
-              f"{heal.percentiles((0.99,))[0.99]:.6f}"]
+              f"{hst['percentiles'][0.99]:.6f}"]
+    # exemplar-style link from the north-star metric to the span tree
+    # behind its worst sample (trace_id rides a label — Prometheus text
+    # format has no native exemplars; fetch via admin trace?trace_id=).
+    # Only ids that are actually FETCHABLE are advertised: the worst
+    # sample's trace is tail-discarded when the whole request stayed
+    # inside its budget, and an exemplar that 404s is worse than none.
+    worst_s, worst_tid = hst["worst_s"], hst["worst_trace_id"]
+    if worst_tid:
+        from . import spans as _sp
+        if _sp.store().contains(worst_tid):
+            lines += [
+                "# TYPE minio_tpu_heal_shard_latency_worst_seconds gauge",
+                "minio_tpu_heal_shard_latency_worst_seconds"
+                f'{{trace_id="{_esc(worst_tid)}"}} {worst_s:.6f}']
     return lines
 
 

@@ -88,12 +88,27 @@ class _Sampler(threading.Thread):
 
 def start(kind: str) -> dict:
     """Begin a profiling session; returns {kind, started_at}. Raises
-    ValueError on unknown kind or if a session is already running."""
+    ValueError on unknown kind or if a session is still RUNNING. A cpu
+    session whose sampler auto-halted at MAX_PROFILE_S no longer wedges
+    the profiler until a download: a new start() reaps it (the halted
+    session's samples are discarded — download before restarting to
+    keep them)."""
     global _active
     with _lock:
         if _active is not None:
-            raise ValueError(
-                f"profiling already running ({_active['kind']})")
+            sampler = _active.get("sampler")
+            if sampler is not None and not sampler.is_alive():
+                # auto-halted session abandoned by its client: reap it
+                # so the profiler is usable again without a download
+                _active = None
+            else:
+                age = time.time() - _active.get("started_at", time.time())
+                state = "running"
+                if sampler is not None and sampler._halt.is_set():
+                    state = "halted"
+                raise ValueError(
+                    f"profiling already {state} ({_active['kind']}, "
+                    f"started {age:.0f}s ago — download to collect it)")
         if kind == "cpu":
             sampler = _Sampler()
             sampler.start()

@@ -54,6 +54,12 @@ class TraceInfo:
     remote: str = ""
     error: str = ""
     trace_type: str = TRACE_HTTP
+    #: request-scoped span identity (obs/spans.py): empty outside a
+    #: traced request — flat trace consumers can join events to span
+    #: trees (and to the x-amz-request-id the server stamped) by these
+    trace_id: str = ""
+    span_id: str = ""
+    parent_span_id: str = ""
 
     def to_dict(self) -> dict:
         return asdict(self)
@@ -105,15 +111,28 @@ def subscribed() -> bool:
     return trace_pubsub.subscriber_count > 0
 
 
+def _span_ids() -> tuple[str, str]:
+    """(trace_id, span_id) of the calling context — joins the flat
+    trace stream to the span plane without importing it on module
+    load."""
+    from . import spans
+    ctx = spans.current()
+    if ctx is None or not ctx.sampled:
+        return "", ""
+    return ctx.trace_id, ctx.span_id
+
+
 def publish_storage(node: str, op: str, path: str, duration_s: float,
                     input_bytes: int = 0, output_bytes: int = 0,
                     error: str = "") -> None:
     if not subscribed():
         return
+    tid, sid = _span_ids()
     publish(TraceInfo(trace_type=TRACE_STORAGE, node=node,
                       func=f"storage.{op}", path=path,
                       duration_s=duration_s, input_bytes=input_bytes,
-                      output_bytes=output_bytes, error=error))
+                      output_bytes=output_bytes, error=error,
+                      trace_id=tid, parent_span_id=sid))
 
 
 def publish_kernel(op: str, route: str, batch: int, queue_wait_s: float,
@@ -134,9 +153,10 @@ def publish_scanner(func: str, path: str, duration_s: float,
                     input_bytes: int = 0, error: str = "") -> None:
     if not subscribed():
         return
+    tid, sid = _span_ids()
     publish(TraceInfo(trace_type=TRACE_SCANNER, func=func, path=path,
                       duration_s=duration_s, input_bytes=input_bytes,
-                      error=error))
+                      error=error, trace_id=tid, parent_span_id=sid))
 
 
 def recent(n: int = 256) -> list[TraceInfo]:

@@ -32,10 +32,21 @@ from dataclasses import dataclass
 
 CLASS_CONTROL = "control"
 
-#: URL prefixes never throttled (reference keeps its health/admin
-#: handlers outside the throttle for the same reason)
-_EXEMPT_PREFIXES = ("/minio/health/", "/minio/metrics",
-                    "/minio/v2/metrics", "/minio/admin/")
+def plane_exempt(path: str, internal=()) -> bool:
+    """True for the observability/data planes every wrapper must leave
+    alone: health/readiness + metrics probes and internal-RPC paths for
+    the mounted ``internal`` services. Shared by admission control
+    (classify_request) and the span tracer (s3api._span_exempt) so the
+    two exemption lists cannot drift."""
+    if path.startswith("/minio/health/") or \
+            path.startswith("/minio/metrics") or \
+            path.startswith("/minio/v2/metrics"):
+        return True
+    if path.startswith("/minio/"):
+        parts = path.split("/", 3)  # ['', 'minio', <service>, rest]
+        if len(parts) > 2 and internal and parts[2] in internal:
+            return True
+    return False
 
 _RPS_ENV = {"interactive": "MINIO_TPU_QOS_INTERACTIVE_RPS",
             CLASS_CONTROL: "MINIO_TPU_QOS_CONTROL_RPS"}
@@ -53,13 +64,9 @@ def classify_request(method: str, path: str,
     plane (webrpc/upload/download/zip) must stay throttled on
     distributed nodes too."""
     p = path.split("?", 1)[0]
-    for pre in _EXEMPT_PREFIXES:
-        if p.startswith(pre):
-            return None
+    if p.startswith("/minio/admin/") or plane_exempt(p, internal):
+        return None
     if p.startswith("/minio/"):
-        parts = p.split("/", 3)  # ['', 'minio', <service>, rest]
-        if len(parts) > 2 and internal and parts[2] in internal:
-            return None
         return CLASS_CONTROL  # console webrpc/upload/download/zip
     parts = p.lstrip("/").split("/", 1)
     has_key = len(parts) > 1 and parts[1] != ""

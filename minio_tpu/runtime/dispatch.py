@@ -50,6 +50,7 @@ from dataclasses import dataclass, field
 import numpy as np
 
 from ..obs import latency as _lat
+from ..obs import spans as _sp
 from ..obs import trace as _trc
 from .. import qos as _qos
 
@@ -177,6 +178,11 @@ class _Pending:
     digests: np.ndarray | None = None  # [k, 8] expected digests (fused only)
     future: Future = field(default_factory=Future)
     t: float = field(default_factory=time.monotonic)
+    #: span context of the submitting request (None when untraced) —
+    #: a flush serves items from MANY requests, so the kernel span
+    #: links back to each item's context instead of pretending the
+    #: batch belongs to one trace
+    ctx: object | None = None
 
 
 class _Bucket:
@@ -232,6 +238,10 @@ class DispatchQueue:
         self.device_items = 0
         self.hold_events = 0
         self.hold_seconds = 0.0
+        #: monotone flush sequence — the batch id every coalesced item's
+        #: span records, so concurrent requests can prove they shared
+        #: (or didn't share) a device launch
+        self._batch_seq = 0
         #: deadline-aware scheduler: per-item device-vs-CPU routing with
         #: spill + per-route queued-bytes caps (minio_tpu.qos.scheduler)
         self.qos = _qos.QosScheduler()
@@ -299,7 +309,10 @@ class DispatchQueue:
 
     def _submit(self, key, codec, op, words, masks, digests=None,
                 hash_key=None, chunk_size=0, hash_algo=0) -> Future:
-        p = _Pending(words=words, masks=masks, digests=digests)
+        ctx = _sp.current()
+        if ctx is not None and not ctx.sampled:
+            ctx = None
+        p = _Pending(words=words, masks=masks, digests=digests, ctx=ctx)
         # QoS class rides the bucket key: interactive PUT/GET work and
         # background heal/scanner work never share a flush, so the loop
         # can order and spill them independently
@@ -311,16 +324,20 @@ class DispatchQueue:
         # window behind minio_tpu_qos_class_latency_seconds
         op_name = _OP_NAME.get(op, op)
         nbytes = words.nbytes
+        tid = ctx.trace_id if ctx is not None else ""
 
-        def _record(_f, t=p.t, op_name=op_name, nbytes=nbytes, cls=cls):
+        def _record(_f, t=p.t, op_name=op_name, nbytes=nbytes, cls=cls,
+                    tid=tid):
             try:
                 if _f.exception() is not None:
                     # failed ops must not read as kernel throughput —
                     # same rule the heal_shard window applies
                     return
                 wall = time.monotonic() - t
-                _lat.observe("kernel", wall, nbytes, op=op_name)
-                _lat.observe("qos", wall, nbytes, **{"class": cls})
+                _lat.observe("kernel", wall, nbytes, op=op_name,
+                             trace_id=tid)
+                _lat.observe("qos", wall, nbytes, trace_id=tid,
+                             **{"class": cls})
                 self.qos.note_deadline(cls, wall)
             except Exception:  # noqa: BLE001 — obs never breaks the path
                 pass
@@ -501,6 +518,7 @@ class DispatchQueue:
         self.items += len(items)
         self.cpu_items += len(items)
         trace_done = self._flush_trace_cb(b, items, "cpu")
+        span_done = self._flush_span_cb(b, items, "cpu")
         # observed CPU flush wall corrects the route cost EWMA (only
         # meaningful once a link profile provides the base estimate)
         prof = self._profile
@@ -552,6 +570,8 @@ class DispatchQueue:
         for p in items:
             if trace_done is not None:
                 p.future.add_done_callback(trace_done)
+            if span_done is not None:
+                p.future.add_done_callback(span_done)
             if cost_done is not None:
                 p.future.add_done_callback(cost_done)
             self._completers.submit(one, p)
@@ -581,6 +601,81 @@ class DispatchQueue:
                 duration_s=time.monotonic() - t0,
                 input_bytes=bytes_in, output_bytes=bytes_out)
 
+        return done
+
+    def _flush_span_cb(self, b: _Bucket, items: list[_Pending],
+                       route: str):
+        """Future-done callback recording the flush's KERNEL SPAN into
+        every traced item's span tree once the last item resolves. One
+        flush serves items from many requests, so ONE shared span_id is
+        recorded ONCE per involved trace (a pipelined request may
+        contribute several items to the same flush — those collapse
+        into its single record), carrying span links to every coalesced
+        context plus that trace's oldest queue wait, its item count and
+        the flush's batch id — per-request trees stay truthful under
+        batching. None when no item is traced (zero hot-path cost)."""
+        traced = [p for p in items if p.ctx is not None]
+        if not traced or not _sp.enabled():
+            return None
+        t0 = time.monotonic()
+        wall0 = time.time()
+        span_id = _sp.new_span_id()
+        with self._cv:
+            self._batch_seq += 1
+            batch_id = self._batch_seq
+        groups: dict[str, list[_Pending]] = {}
+        for p in traced:
+            groups.setdefault(p.ctx.trace_id, []).append(p)
+        qwait = {tid: t0 - min(p.t for p in ps)
+                 for tid, ps in groups.items()}
+        links = []
+        seen: set[tuple[str, str]] = set()
+        for p in traced:
+            key = (p.ctx.trace_id, p.ctx.span_id)
+            if key not in seen:
+                seen.add(key)
+                links.append({"trace_id": p.ctx.trace_id,
+                              "span_id": p.ctx.span_id})
+        op_name = _OP_NAME.get(b.op, b.op)
+        remaining = [len(items)]
+        rlock = threading.Lock()
+        cancelled = [False]
+
+        def done(_f):
+            with rlock:
+                remaining[0] -= 1
+                if remaining[0]:
+                    return
+            if cancelled[0]:
+                # device readback salvaged on CPU: the CPU re-flush
+                # records its own truthful span; a route="device" span
+                # spanning the whole salvage would be a phantom launch
+                return
+            dur = round(time.monotonic() - t0, 6)
+            for tid, ps in groups.items():
+                exc = None
+                for p in ps:
+                    try:
+                        exc = p.future.exception()
+                    except BaseException:  # noqa: BLE001 — cancelled
+                        exc = None  # futures raise CancelledError,
+                        # which is NOT an Exception since Python 3.8
+                    if exc is not None:
+                        break
+                _sp.record({
+                    "name": f"kernel.{op_name}",
+                    "trace_id": tid, "span_id": span_id,
+                    "parent_span_id": ps[0].ctx.span_id, "time": wall0,
+                    "duration_s": dur,
+                    "error": f"{type(exc).__name__}: {exc}" if exc
+                             else "",
+                    "links": links,
+                    "attrs": {"route": route, "batch": len(items),
+                              "batch_id": batch_id,
+                              "items": len(ps),
+                              "queue_wait_s": round(qwait[tid], 6)}})
+
+        done.cancel = lambda: cancelled.__setitem__(0, True)
         return done
 
     def _device_saturated(self) -> bool:
@@ -632,6 +727,7 @@ class DispatchQueue:
 
     def _flush_device(self, b: _Bucket, items: list[_Pending]):
         trace_done = self._flush_trace_cb(b, items, "device")
+        span_done = self._flush_span_cb(b, items, "device")
         import jax.numpy as jnp
         from .mesh import object_mesh, replicated_for, sharded_batched
         n = len(items)
@@ -701,13 +797,16 @@ class DispatchQueue:
         # per-route queued-bytes accounting feeds the scheduler's cap
         self.qos.device_dispatched(bytes_in + bytes_out)
         # hand host readback to a completer so the next batch launches now
-        if trace_done is not None:
-            for p in items:
+        for p in items:
+            if trace_done is not None:
                 p.future.add_done_callback(trace_done)
+            if span_done is not None:
+                p.future.add_done_callback(span_done)
         try:
             self._completers.submit(self._complete, b, out_dev, items,
                                     accounted, bytes_in + bytes_out,
-                                    predicted_s, time.monotonic())
+                                    predicted_s, time.monotonic(),
+                                    span_done)
         except BaseException:  # submit refused (shutdown): the paired
             self.qos.device_completed(bytes_in + bytes_out)  # decrement
             if accounted:  # and the pipeline slot must not stay occupied
@@ -717,9 +816,10 @@ class DispatchQueue:
 
     def _complete(self, b: _Bucket, out_dev, items: list[_Pending],
                   accounted: bool = True, qbytes: int = 0,
-                  predicted_s: float = 0.0, t0: float = 0.0):
+                  predicted_s: float = 0.0, t0: float = 0.0,
+                  span_done=None):
         try:
-            self._finish_readback(b, out_dev, items)
+            self._finish_readback(b, out_dev, items, span_done)
         finally:
             self.qos.device_completed(qbytes)
             if predicted_s > 0.0 and t0 > 0.0:
@@ -737,7 +837,8 @@ class DispatchQueue:
                 with self._cv:
                     self._cv.notify()
 
-    def _finish_readback(self, b: _Bucket, out_dev, items: list[_Pending]):
+    def _finish_readback(self, b: _Bucket, out_dev,
+                         items: list[_Pending], span_done=None):
         try:
             if b.op == "fused":
                 out = np.asarray(out_dev[0])
@@ -752,6 +853,10 @@ class DispatchQueue:
             log.warning("device readback failed; salvaging flush on CPU",
                         exc_info=True)
             self._mark_device_failed()
+            if span_done is not None:
+                # the device launch delivered nothing — the CPU
+                # re-flush below records the truthful kernel span
+                span_done.cancel()
             pending = [p for p in items if not p.future.done()]
             if pending:
                 self.batches -= 1

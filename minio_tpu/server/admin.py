@@ -99,11 +99,15 @@ def _dispatch_admin(h, op: str) -> None:
     if op == "top/api":
         return _top_api(h)
     if op == "logs":
-        # recent structured log entries (reference console-log history)
+        # recent structured log entries (reference console-log history);
+        # ?type=audit serves the per-request audit mirror instead
         from ..obs.logger import log_sys
-        n = int({k: v[0] for k, v in h.query.items()}.get("n", "100"))
-        return h._send(200, json.dumps(
-            list(log_sys().ring)[-n:]).encode(), "application/json")
+        q = {k: v[0] for k, v in h.query.items()}
+        n = int(q.get("n", "100"))
+        ring = log_sys().audit_ring if q.get("type") == "audit" \
+            else log_sys().ring
+        return h._send(200, json.dumps(list(ring)[-n:]).encode(),
+                       "application/json")
     if op == "tier":
         q = {k: v[0] for k, v in h.query.items()}
         if h.command == "GET":
@@ -400,6 +404,62 @@ def _trace_filter(q: dict):
     return want
 
 
+def _trace_tree(h, q: dict) -> None:
+    """Stored span tree by trace id (tail-sampled slow/error traces and
+    RPC fragments): ?trace_id=<id>, with ?peers=1 merging every peer's
+    fragment of the same trace into one tree (the peer-side spans share
+    the caller's trace_id via the traceparent RPC header)."""
+    from ..obs import spans as sp
+    tid = q.get("trace_id", "")
+    entry = sp.store().get(tid)
+    spans_list = list(entry.get("spans", ())) if entry else []
+    meta = {k: v for k, v in (entry or {}).items() if k != "spans"}
+    if q.get("peers") == "1":
+        for peer in getattr(h.s3, "peers", lambda: [])():
+            try:
+                frag = peer.trace_tree(tid)
+            except Exception:  # noqa: BLE001 — peer down: partial tree
+                continue
+            if not frag:
+                continue
+            spans_list.extend(frag.get("spans", ()))
+            if not meta:
+                meta = {k: v for k, v in frag.items() if k != "spans"}
+        # kept traces already snapshotted peer fragments eagerly, so a
+        # live peers=1 fetch re-delivers the same records — dedup by
+        # span_id (unique within one trace) keeping first occurrence
+        seen: set = set()
+        deduped = []
+        for s in spans_list:
+            sid = s.get("span_id", "")
+            if sid in seen:
+                continue
+            seen.add(sid)
+            deduped.append(s)
+        spans_list = deduped
+    if not spans_list:
+        return h._error("XMinioTraceNotFound",
+                        f"no stored trace {tid!r} (only slow/error "
+                        "traces and RPC fragments are kept)", 404)
+    out = {**meta, "trace_id": tid, "spans": spans_list,
+           "tree": sp.assemble(spans_list)}
+    h._send(200, json.dumps(out).encode(), "application/json")
+
+
+def _trace_slow(h, q: dict) -> None:
+    """Newest-first summaries of the tail-sampled slow-trace store
+    (?slow=1&count=N): requests that breached their QoS class latency
+    budget or errored, kept WITHOUT any live trace subscriber attached;
+    fetch a full tree via ?trace_id=."""
+    from ..obs import spans as sp
+    try:
+        n = int(q.get("count", "50"))
+    except ValueError:
+        n = 50
+    h._send(200, json.dumps(sp.store().list_slow(n)).encode(),
+            "application/json")
+
+
 def _trace(h) -> None:
     """`mc admin trace` analogue (reference peerRESTMethodTrace fan-out):
     streams JSON-line trace events. ?peers=1 dumps every peer's recent
@@ -408,7 +468,9 @@ def _trace(h) -> None:
     as events happen (reference cmd/peer-rest-common.go:54 streaming;
     replaced the round-4 ring polling). Bounded by ?count / ?timeout so
     clients and tests terminate. ?type/?threshold/?err filter every
-    phase (local ring, peer rings, live events) alike.
+    phase (local ring, peer rings, live events) alike. Two non-stream
+    forms ride the same route: ?trace_id= returns one stored span tree,
+    ?slow=1 lists the tail-sampled slow-trace store.
     """
     import queue as qmod
     import threading
@@ -416,6 +478,10 @@ def _trace(h) -> None:
 
     from ..obs.trace import recent, trace_pubsub
     q = {k: v[0] for k, v in h.query.items()}
+    if q.get("trace_id"):
+        return _trace_tree(h, q)
+    if q.get("slow") == "1":
+        return _trace_slow(h, q)
     count = int(q.get("count", "50"))
     timeout = float(q.get("timeout", "10"))
     try:
@@ -439,15 +505,22 @@ def _trace(h) -> None:
                     continue
                 out.write((json.dumps(t) + "\n").encode())
                 sent += 1
+        except (BrokenPipeError, ConnectionResetError):
+            h.close_connection = True  # client hung up mid-dump
+            return
         except Exception:  # noqa: BLE001 — peer down: skip
             continue
     # filter over the FULL ring, then keep the newest `count` matches —
     # truncating the ring first would hide matching events sitting
     # behind newer non-matching ones
     hist = [d for d in (t.to_dict() for t in recent()) if want(d)]
-    for d in hist[max(0, len(hist) - max(0, count - sent)):]:
-        out.write((json.dumps(d) + "\n").encode())
-        sent += 1
+    try:
+        for d in hist[max(0, len(hist) - max(0, count - sent)):]:
+            out.write((json.dumps(d) + "\n").encode())
+            sent += 1
+    except (BrokenPipeError, ConnectionResetError):
+        h.close_connection = True
+        return
     if sent < count:
         # live phase only if the history dumps left budget: each pump
         # holds a streaming RPC to its peer for up to `timeout` seconds
@@ -498,9 +571,13 @@ def _trace(h) -> None:
                 sent += 1
             except qmod.Empty:
                 continue
+        out.close()
+    except (BrokenPipeError, ConnectionResetError, OSError):
+        # client hung up mid-stream: normal end of a trace follow — no
+        # error response can be sent on a half-written chunked body
+        h.close_connection = True
     finally:
         trace_pubsub.unsubscribe(sub)
-    out.close()
 
 
 def _top_api(h) -> None:
@@ -543,6 +620,26 @@ def _top_api(h) -> None:
         entry["p99_ms"] = round(vals[min(len(vals) - 1,
                                          int(len(vals) * 0.99))] * 1e3, 2)
         entry["max_ms"] = round(vals[-1] * 1e3, 2)
+    # exemplar link: each API name's worst last-minute sample keeps the
+    # trace_id it belonged to, so the tail row points straight at a
+    # span tree (fetch via admin trace?trace_id=). These windows are
+    # keyed by S3 API NAME (getobject-style) — finer than the
+    # method-level store rows above, so they land as their own rows.
+    # worst_trace_id is the request id either way (joins audit logs);
+    # worst_trace_stored says whether trace?trace_id= will serve a tree
+    # (the trace is tail-discarded when the request stayed in budget).
+    from ..obs import latency as lat
+    from ..obs import spans as sp
+    for labels, w in lat.snapshot("api"):
+        api = labels.get("api", "")
+        st = w.stats(())  # one merge serves count + worst consistently
+        worst_tid = st["worst_trace_id"]
+        if not worst_tid:
+            continue
+        entry = out.setdefault(api, {"calls": st["count"], "errors": 0})
+        entry["worst_ms"] = round(st["worst_s"] * 1e3, 2)
+        entry["worst_trace_id"] = worst_tid
+        entry["worst_trace_stored"] = sp.store().contains(worst_tid)
     h._send(200, json.dumps(out).encode(), "application/json")
 
 

@@ -31,6 +31,20 @@ from .auth import (STREAMING_PAYLOAD, UNSIGNED_PAYLOAD, AuthError,
 MAX_OBJECT_SIZE = 5 << 40       # 5 TiB (docs/minio-limits.md:25)
 MAX_PUT_SIZE = 5 << 30          # single PUT cap 5 GiB
 
+_HOST_ID = ""
+
+
+def host_id() -> str:
+    """Stable per-host opaque id stamped as ``x-amz-id-2`` / error-XML
+    ``HostId`` (the reference derives its extended request id the same
+    way: an opaque token identifying the serving host)."""
+    global _HOST_ID
+    if not _HOST_ID:
+        import base64
+        _HOST_ID = base64.b64encode(hashlib.sha256(
+            socket.gethostname().encode()).digest()).decode()[:44]
+    return _HOST_ID
+
 
 class S3Server:
     """Owns the ObjectLayer, auth, bucket metadata; builds the HTTP server."""
@@ -564,7 +578,10 @@ class _S3Handler(BaseHTTPRequestHandler):
     def _error(self, code: str, message: str, status: int):
         if status in (204, 304):  # bodiless statuses per RFC 9110
             return self._send(status)
-        self._send(status, xu.error_xml(code, message, self.url_path))
+        self._send(status, xu.error_xml(
+            code, message, getattr(self, "url_path", self.path),
+            request_id=getattr(self, "_request_id", ""),
+            host_id=host_id()))
 
     def _api_error(self, e: dt.ObjectAPIError):
         self._error(e.code, str(e), e.http_status)
@@ -899,8 +916,17 @@ class _S3Handler(BaseHTTPRequestHandler):
             return self._send(401, b"invalid rpc token", "text/plain")
         params = {k: v[0] for k, v in self.query.items()}
         body = self._read_body()
+        # span propagation: an RPC that carried the caller's traceparent
+        # joins that trace — storage/lock/peer spans recorded under this
+        # fragment share the caller's trace_id and are stored locally
+        # for the caller's ?trace_id=...&peers=1 merge
+        from ..obs import spans as sp
+        ctx_in = sp.parse_traceparent(self.hdr.get(sp.RPC_HEADER, ""))
         try:
-            out = self.s3.internal[service].handle(method, params, body)
+            with sp.fragment(ctx_in, f"rpc.{service}.{method}",
+                             node=f"{self.s3.address}:{self.s3.port}"):
+                out = self.s3.internal[service].handle(method, params,
+                                                       body)
         except Exception as e:  # noqa: BLE001
             return rpc_error_response(self, e)
         if out is not None and not isinstance(out, (bytes, bytearray)):
@@ -1271,6 +1297,14 @@ class _S3Handler(BaseHTTPRequestHandler):
             import time as _time
             self._t_first = _time.perf_counter()  # TTFB anchor
         super().send_response(code, message)
+        # every response carries the request id (= trace id) and host id
+        # (reference setAmzRequestID middleware: x-amz-request-id +
+        # x-amz-id-2 on all paths, streams and errors included) so
+        # client-reported slowness joins server-side traces
+        rid = getattr(self, "_request_id", "")
+        if rid:
+            self.send_header("x-amz-request-id", rid)
+            self.send_header("x-amz-id-2", host_id())
 
     def _admit(self):
         """Admission control (minio_tpu.qos.admission) ahead of routing:
@@ -1283,10 +1317,11 @@ class _S3Handler(BaseHTTPRequestHandler):
         Returns (proceed, release_cb)."""
         from ..qos import classify_request
         adm = getattr(self.s3, "qos_admission", None)
-        cls = classify_request(self.command, self.path,
-                               internal=self.s3.internal) \
-            if adm is not None else None
-        if cls is None:
+        # stashed for the finish-side tail-sampling budget: the trace
+        # must be judged under the SAME class it was admitted under
+        cls = self._qos_class = classify_request(
+            self.command, self.path, internal=self.s3.internal)
+        if adm is None or cls is None:
             return True, None
         grant = adm.admit(cls)
         if grant.ok:
@@ -1305,23 +1340,64 @@ class _S3Handler(BaseHTTPRequestHandler):
             xu.error_xml(
                 "SlowDown",
                 "request rate/concurrency limit exceeded; reduce "
-                "your request rate", self.url_path),
+                "your request rate", self.url_path,
+                request_id=getattr(self, "_request_id", ""),
+                host_id=host_id()),
             headers={"Retry-After": adm.retry_after_header(grant)})
         return False, None
+
+    def _span_exempt(self, path: str, query: str = "") -> bool:
+        """Requests that never open a request-scoped trace:
+        health/metrics probes (pure overhead), internal RPC (which
+        instead JOINS the caller's trace via the traceparent header in
+        _internal_rpc) — the same plane list admission control exempts
+        — and long-poll streams (admin trace follows, bucket event
+        listens) whose duration is client-chosen: they would breach any
+        latency budget by design and churn genuinely slow traces out of
+        the bounded store."""
+        from ..qos.admission import plane_exempt
+        if plane_exempt(path, internal=self.s3.internal):
+            return True
+        if path.startswith("/minio/admin/") and \
+                path.rstrip("/").endswith("/trace"):
+            return True
+        if self.command == "GET" and "events=" in query and \
+                not path.startswith("/minio/"):
+            # ListenBucketNotification long-poll: a GET on a BUCKET
+            # path with an events param — object GETs that merely carry
+            # an events= value in some parameter stay traced
+            parts = path.lstrip("/").split("/", 1)
+            bucket_level = len(parts) < 2 or parts[1] == ""
+            if bucket_level and "events" in urllib.parse.parse_qs(
+                    query, keep_blank_values=True):
+                return True
+        return False
 
     def _handle(self):
         """Route one request wrapped in the observability plane
         (cmd/http-tracer.go httpTraceAll + cmd/http-stats.go): timing,
-        metrics, trace pubsub, audit entry. Admission rejections run
-        INSIDE this wrapper so overload 503s land in the same per-API
-        counters, trace stream and audit log as every other response."""
+        metrics, trace pubsub, audit entry, request-scoped span root
+        (obs/spans.py) with tail-sampled slow-trace capture. Admission
+        rejections run INSIDE this wrapper so overload 503s land in the
+        same per-API counters, trace stream and audit log as every
+        other response."""
         import time as _time
 
+        from ..obs import latency as _lt
         from ..obs import metrics as mx
+        from ..obs import spans as sp
         from ..obs import trace as trc
         from ..obs.logger import log_sys
         self._last_status = 0
         self._t_first = None
+        # the trace id IS the x-amz-request-id — minted before routing
+        # so even admission 503s and parse errors carry it
+        rid = sp.new_trace_id()
+        self._request_id = rid
+        root = tok = None
+        raw_path, _, raw_query = self.path.partition("?")
+        if sp.enabled() and not self._span_exempt(raw_path, raw_query):
+            root, tok = sp.begin_request(rid)
         t0 = _time.perf_counter()
         release = None
         try:
@@ -1336,14 +1412,15 @@ class _S3Handler(BaseHTTPRequestHandler):
             except Exception:  # noqa: BLE001
                 self.close_connection = True
             dur = _time.perf_counter() - t0
+            status = getattr(self, "_last_status", 0)
+            path = getattr(self, "url_path", self.path)
+            api = f"s3.{self.command}"
+            if path.startswith("/minio/admin/"):
+                api = "admin"
+            elif path.startswith("/minio/"):
+                api = "internal"
+            api_detail = api
             try:
-                status = getattr(self, "_last_status", 0)
-                path = getattr(self, "url_path", self.path)
-                api = f"s3.{self.command}"
-                if path.startswith("/minio/admin/"):
-                    api = "admin"
-                elif path.startswith("/minio/"):
-                    api = "internal"
                 mx.inc("minio_tpu_requests_total", api=api,
                        code=str(status))
                 mx.observe("minio_tpu_request_duration_seconds", dur,
@@ -1353,11 +1430,26 @@ class _S3Handler(BaseHTTPRequestHandler):
                     # per-API-name family (reference metrics-v2 label
                     # scheme: api="getobject"-style)
                     name = self._api_name()
+                    api_detail = f"s3.{name}"
                     mx.inc("minio_tpu_s3_requests_total", api=name)
                     if status >= 400:
                         mx.inc("minio_tpu_s3_requests_errors_total",
                                api=name)
                     mx.observe("minio_tpu_s3_ttfb_seconds", ttfb, api=name)
+                    # per-API window whose worst sample keeps its trace
+                    # id — `top/api` links the tail to a span tree.
+                    # Only TRACED requests feed it: span-exempt
+                    # long-polls (trace follows, event listens) would
+                    # otherwise park multi-second traceless samples as
+                    # the window's worst and blank the exemplar row
+                    if root is not None:
+                        _lt.observe("api", dur, 0,
+                                    trace_id=rid if root.sampled else "",
+                                    api=name)
+                elif api == "admin" and root is not None:
+                    _lt.observe("api", dur, 0,
+                                trace_id=rid if root.sampled else "",
+                                api="admin")
                 if api != "internal":
                     info = trc.TraceInfo(
                         node=f"{self.s3.address}:{self.s3.port}",
@@ -1366,11 +1458,42 @@ class _S3Handler(BaseHTTPRequestHandler):
                         status=status, duration_s=dur, ttfb_s=ttfb,
                         input_bytes=int(getattr(self, "hdr", {}).get(
                             "content-length", "0") or 0),
-                        remote=self.client_address[0])
+                        remote=self.client_address[0],
+                        trace_id=rid,
+                        span_id=root.span_id if root is not None else "")
                     trc.publish(info)
-                    log_sys().audit(info.to_dict())
+                    # audit entries join traces by trace_id/request_id
+                    # and carry the response outcome (status + duration
+                    # already ride the trace record)
+                    entry = info.to_dict()
+                    entry["request_id"] = rid
+                    entry["api"] = api_detail
+                    log_sys().audit(entry)
             except Exception:  # noqa: BLE001 — obs must never break serving
                 pass
+            if root is not None:
+                try:
+                    cls = getattr(self, "_qos_class", None) or "control"
+                    sp.finish_request(
+                        root, tok, name=api_detail, method=self.command,
+                        path=path, status=status, duration_s=dur,
+                        cls=cls,
+                        node=f"{self.s3.address}:{self.s3.port}",
+                        remote=self.client_address[0])
+                    kept = sp.store().get(rid) if root.sampled else None
+                    if kept is not None and any(
+                            s.get("name", "").startswith("rpc.")
+                            for s in kept.get("spans", ())):
+                        # the trace was KEPT and fanned out over RPC:
+                        # snapshot peer fragments now, before their
+                        # small LRUs churn them out (bounded background
+                        # worker — the response is already sent)
+                        peers = getattr(self.s3, "peers",
+                                        lambda: [])()
+                        if peers:
+                            sp.schedule_collect(rid, peers)
+                except Exception:  # noqa: BLE001
+                    pass
 
     def do_GET(self):  # noqa: N802
         self._handle()

@@ -52,11 +52,11 @@ class X:
 
 
 def error_xml(code: str, message: str, resource: str = "",
-              request_id: str = "") -> bytes:
+              request_id: str = "", host_id: str = "minio-tpu") -> bytes:
     x = X("Error")
     x.el("Code", code).el("Message", message)
     x.el("Resource", resource).el("RequestId", request_id)
-    x.el("HostId", "minio-tpu")
+    x.el("HostId", host_id)
     return x.done()
 
 

@@ -22,6 +22,7 @@ import uuid
 from typing import Iterator
 
 from ..obs import latency as _lat
+from ..obs import spans as _spans
 from ..obs import trace as _trc
 from ..utils import errors
 from .datatypes import DiskInfo, FileInfo, VolInfo
@@ -133,13 +134,28 @@ class _OpSpan:
     def __exit__(self, etype, exc, tb) -> bool:
         dur = time.perf_counter() - self.t0
         try:
+            ctx = _spans.current()
+            tid = ctx.trace_id if ctx is not None and ctx.sampled else ""
             _lat.observe("disk", dur, self.in_bytes + self.out_bytes,
-                         disk=self.disk, op=self.op)
+                         disk=self.disk, op=self.op, trace_id=tid)
             _trc.publish_storage(
                 node=self.disk, op=self.op, path=self.path,
                 duration_s=dur, input_bytes=self.in_bytes,
                 output_bytes=self.out_bytes,
                 error=f"{etype.__name__}: {exc}" if etype else "")
+            if tid:
+                # leaf span into the request's tree (the inner _inner
+                # helpers stay untraced: one logical storage call = one
+                # span, same rule the window observation follows)
+                _spans.record({
+                    "name": f"storage.{self.op}", "trace_id": tid,
+                    "span_id": _spans.new_span_id(),
+                    "parent_span_id": ctx.span_id,
+                    "time": time.time() - dur,
+                    "duration_s": round(dur, 6),
+                    "error": f"{etype.__name__}: {exc}" if etype else "",
+                    "attrs": {"disk": self.disk, "path": self.path,
+                              "bytes": self.in_bytes + self.out_bytes}})
         except Exception:  # noqa: BLE001 — obs must never break storage
             pass
         return False

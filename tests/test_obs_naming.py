@@ -102,6 +102,27 @@ def test_new_latency_families_present(srv, tmp_path):
     assert "# HELP minio_tpu_kernel_op_latency_seconds" in text
 
 
+def test_documented_endpoints_are_routed(srv):
+    """docs/observability.md's endpoint table and the router cannot
+    drift: every `GET /...` documented there must answer 200 on a live
+    server (parameterized endpoints get the minimal query that
+    terminates quickly)."""
+    md_path = os.path.join(os.path.dirname(__file__), os.pardir,
+                           "docs", "observability.md")
+    with open(md_path) as f:
+        table = re.findall(r"^\|\s*`GET (/[^`?\s]+)", f.read(),
+                           flags=re.MULTILINE)
+    assert table, "endpoint table not found in docs/observability.md"
+    # bounded queries for endpoints that would otherwise stream/block
+    queries = {"/minio/admin/v3/trace": {"count": "1", "timeout": "0.2"}}
+    c = S3Client(srv.endpoint(), AK, SK)
+    c.request("PUT", "/epb")  # some endpoints want traffic to exist
+    for path in sorted(set(table)):
+        r = c.request("GET", path, query=queries.get(path, {}))
+        assert r.status_code == 200, \
+            f"documented endpoint {path} answered {r.status_code}"
+
+
 def test_malformed_group_is_repaired():
     """A generator that forgets its TYPE/HELP still renders a legal
     family (the annotation pass backfills both)."""

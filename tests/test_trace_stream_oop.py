@@ -28,12 +28,12 @@ def free_port():
     return p
 
 
-def spawn(node_idx, ports, tmp):
+def spawn(node_idx, ports, tmp, extra_env=None):
     endpoints = [f"http://127.0.0.1:{ports[n]}{tmp}/n{n}/d{d}"
                  for n in range(N_NODES) for d in range(DISKS_PER_NODE)]
     env = dict(os.environ, MINIO_TPU_ROOT_USER=AK,
                MINIO_TPU_ROOT_PASSWORD=SK, JAX_PLATFORMS="cpu",
-               PYTHONPATH=REPO)
+               PYTHONPATH=REPO, **(extra_env or {}))
     return subprocess.Popen(
         [sys.executable, "-m", "minio_tpu.server",
          "--address", f"127.0.0.1:{ports[node_idx]}"] + endpoints,
@@ -111,6 +111,92 @@ def test_live_trace_streams_from_remote_node(tmp_path):
         assert remote_live, \
             "no live event from the remote node reached the stream"
         r.close()
+    finally:
+        for p in procs:
+            p.terminate()
+        for p in procs:
+            try:
+                p.wait(timeout=15)
+            except subprocess.TimeoutExpired:
+                p.kill()
+
+
+def test_span_context_propagates_over_peer_rpc(tmp_path):
+    """Traceparent round-trips the RPC header, out of process: a PUT on
+    node 0 fans storage RPCs out to node 1, whose span fragments share
+    the caller's trace_id (= the x-amz-request-id node 0 stamped) — and
+    ?trace_id=...&peers=1 on node 0 merges them into one tree."""
+    tmp = str(tmp_path)
+    ports = [free_port() for _ in range(N_NODES)]
+    for n in range(N_NODES):
+        for d in range(DISKS_PER_NODE):
+            os.makedirs(os.path.join(tmp, f"n{n}", f"d{d}"))
+    # every request breaches its budget -> every trace is kept
+    procs = [spawn(i, ports, tmp, extra_env={
+        "MINIO_TPU_QOS_INTERACTIVE_BUDGET_MS": "0.0001"})
+        for i in range(N_NODES)]
+    try:
+        clients = [S3Client(f"http://127.0.0.1:{p}", AK, SK)
+                   for p in ports]
+        for c, p in zip(clients, procs):
+            wait_ready(c, p)
+        node1_addr = f"127.0.0.1:{ports[1]}"
+
+        r = clients[0].request("PUT", "/spanb")
+        assert r.status_code == 200
+        r = clients[0].request("PUT", "/spanb/o", body=b"s" * 300_000)
+        assert r.status_code == 200
+        rid = r.headers.get("x-amz-request-id", "")
+        assert len(rid) == 32
+
+        def frag_spans(resp):
+            return resp.json().get("spans", []) if \
+                resp.status_code == 200 else []
+
+        # node 1 stored a fragment of node 0's trace (the traceparent
+        # header rode the storage RPCs)
+        deadline = time.time() + 20
+        spans1 = []
+        while time.time() < deadline and not spans1:
+            spans1 = frag_spans(clients[1].request(
+                "GET", "/minio/admin/v3/trace", query={"trace_id": rid}))
+            if not spans1:
+                time.sleep(0.25)
+        assert spans1, "peer kept no fragment for the caller's trace"
+        assert all(s["trace_id"] == rid for s in spans1)
+        assert any(s["name"].startswith("rpc.storage.")
+                   for s in spans1), [s["name"] for s in spans1]
+        assert any(s["name"].startswith("storage.")
+                   for s in spans1), [s["name"] for s in spans1]
+
+        # the caller-side merge: peers=1 folds node 1's fragment into
+        # node 0's tree
+        out = clients[0].request(
+            "GET", "/minio/admin/v3/trace",
+            query={"trace_id": rid, "peers": "1"}).json()
+        names = [s["name"] for s in out["spans"]]
+        assert any(n.startswith("s3.") for n in names)
+        assert any(
+            s["attrs"].get("node") == node1_addr
+            for s in out["spans"] if s["name"].startswith("rpc.")), \
+            "merged tree is missing the peer-side fragment"
+
+        # kept traces snapshot peer fragments EAGERLY: the plain
+        # (no peers=1) local query also serves the cross-node spans,
+        # surviving peer-side LRU churn
+        deadline = time.time() + 10
+        local_names = []
+        while time.time() < deadline:
+            local = clients[0].request(
+                "GET", "/minio/admin/v3/trace",
+                query={"trace_id": rid}).json()
+            local_names = [s["name"] for s in local["spans"]
+                           if s["attrs"].get("node") == node1_addr]
+            if local_names:
+                break
+            time.sleep(0.25)
+        assert local_names, \
+            "kept trace did not snapshot the peer fragment"
     finally:
         for p in procs:
             p.terminate()
