@@ -13,6 +13,10 @@ block, plus p99 heal-shard latency — ALL FIVE configs of BASELINE.md:
   plus: p50/p99 latency of a single 16+4 heal-shard rebuild THROUGH the
      dispatch queue at 1/8/128 concurrent requesters.
 
+`--chaos` additionally arms a 1-slow-disk + 1-dead-disk fault profile at
+16+4 (docs/fault.md) and reports GET / heal-shard p50/p99 for the clean
+and degraded runs side by side under `extra.chaos`.
+
 Prints exactly ONE JSON line on stdout:
   {"metric": ..., "value": N, "unit": "GiB/s", "vs_baseline": N, "extra": {...}}
 
@@ -452,6 +456,115 @@ def heal_latency(rng) -> dict:
     return out
 
 
+def chaos_profile(rng) -> dict:
+    """--chaos: the degraded-operation half of the north-star. A 16+4
+    set of 1 MiB objects is measured clean, then with a 1-slow-disk
+    (delay(200) on every shard read) + 1-dead-disk (typed DiskNotFound
+    on every op) profile armed through the production fault registry
+    (docs/fault.md) — the same rules an operator would arm via
+    `mc admin`-style POST /minio/admin/v3/fault. Reported side by side:
+    GET p50/p99 (hedged reads route around the straggler; the health
+    tracker trips the dead disk to fast-fail), heal-shard p50/p99 wall
+    time (each heal rebuilds toward the dead disk under a slow source),
+    plus the fired/won hedge counters and final disk health states.
+    Both passes pin MINIO_TPU_GET_PATH=dispatch so they measure the
+    same (Python shard-read) code path — chaos runs always take it, and
+    its shard reads feed the adaptive hedge threshold's p95 window."""
+    import threading
+
+    from minio_tpu import fault
+    from minio_tpu.objectlayer import ErasureObjects
+    from minio_tpu.obs.metrics import counters_snapshot
+    from minio_tpu.storage import XLStorage
+    K, M, OBJ = 16, 4, 1 << 20
+    N_OBJ, GET_REPS, DELAY_MS = 8, 4, 200.0
+    body = rng.integers(0, 256, OBJ, dtype=np.uint8).tobytes()
+    root = tempfile.mkdtemp(prefix="benchchaos-", dir=bench_dir())
+    ol = None  # the finally below must not NameError if setup raises
+    prev_path = os.environ.get("MINIO_TPU_GET_PATH")
+    os.environ["MINIO_TPU_GET_PATH"] = "dispatch"
+    # probe cadence must undercut the cleanup join(timeout=2) below, or
+    # a tripped disk's probe thread outlives the rmtree'd backing dir
+    prev_cool = os.environ.get("MINIO_TPU_HEALTH_COOLDOWN_S")
+    os.environ["MINIO_TPU_HEALTH_COOLDOWN_S"] = "0.5"
+    out: dict = {"profile": f"slow=delay({DELAY_MS:.0f}ms) dead=DiskNotFound "
+                            f"at {K}+{M}, {N_OBJ}x1MiB"}
+
+    def pcts(samples: list[float]) -> dict:
+        return {"p50_ms": round(float(np.percentile(samples, 50)) * 1e3, 1),
+                "p99_ms": round(float(np.percentile(samples, 99)) * 1e3, 1)}
+
+    def run_pass(ol) -> dict:
+        gets: list[float] = []
+        for _ in range(GET_REPS):
+            for i in range(N_OBJ):
+                t0 = time.perf_counter()
+                if ol.get_object_bytes("b", f"o{i}") != body:
+                    raise AssertionError(f"o{i} bytes mismatch")
+                gets.append(time.perf_counter() - t0)
+        heals: list[float] = []
+        for i in range(N_OBJ):
+            t0 = time.perf_counter()
+            ol.heal_object("b", f"o{i}")
+            heals.append(time.perf_counter() - t0)
+        return {"get": pcts(gets), "heal": pcts(heals)}
+
+    try:
+        # zero-padded dirs: rule targets match by substring, and a bare
+        # ".../d1" would also hit ".../d10"-".../d19"
+        disks = [XLStorage(os.path.join(root, f"d{i:02d}"))
+                 for i in range(K + M)]
+        ol = ErasureObjects(disks, default_parity=M)
+        ol.make_bucket("b")
+        for i in range(N_OBJ):
+            ol.put_object("b", f"o{i}", io.BytesIO(body), OBJ)
+        out["clean"] = run_pass(ol)
+        def fired_count() -> float:
+            return sum(v for k, v in counters_snapshot().items()
+                       if "minio_tpu_hedged_reads_total" in k
+                       and 'outcome="fired"' in k)
+
+        hedged_before = fired_count()
+        slow, dead = ol.disks[0], ol.disks[1]
+        fault.arm(f"disk:{slow.endpoint()}:read_at:delay({DELAY_MS:.0f})")
+        fault.arm(f"disk:{dead.endpoint()}:*:error(DiskNotFound)")
+        out["chaos"] = run_pass(ol)
+        snap = counters_snapshot()
+        out["chaos"]["hedged_reads"] = {
+            k.split('outcome="')[1].rstrip('"}'): v
+            for k, v in snap.items()
+            if "minio_tpu_hedged_reads_total" in k} or {}
+        out["chaos"]["hedged_fired_during"] = fired_count() - hedged_before
+        out["chaos"]["disk_states"] = {
+            d.endpoint(): d.health_state() for d in ol.disks
+            if hasattr(d, "health_state")
+            and d.health_state() != "ok"}
+        log(f"chaos 16+4 1MiB: clean get p99 "
+            f"{out['clean']['get']['p99_ms']}ms -> chaos get p99 "
+            f"{out['chaos']['get']['p99_ms']}ms (hedges fired: "
+            f"{out['chaos']['hedged_fired_during']}); heal p99 "
+            f"{out['clean']['heal']['p99_ms']} -> "
+            f"{out['chaos']['heal']['p99_ms']}ms")
+    finally:
+        fault.clear()
+        if prev_path is None:
+            os.environ.pop("MINIO_TPU_GET_PATH", None)
+        else:
+            os.environ["MINIO_TPU_GET_PATH"] = prev_path
+        if prev_cool is None:
+            os.environ.pop("MINIO_TPU_HEALTH_COOLDOWN_S", None)
+        else:
+            os.environ["MINIO_TPU_HEALTH_COOLDOWN_S"] = prev_cool
+        # let tripped-disk probe threads notice the cleared faults and
+        # exit before their backing dirs vanish
+        for d in (ol.disks if ol is not None else []):
+            t = getattr(d, "_probe_thread", None)
+            if isinstance(t, threading.Thread):
+                t.join(timeout=2)
+        shutil.rmtree(root, ignore_errors=True)
+    return out
+
+
 def finish(payload: dict) -> None:
     """Print the one-line result, quiesce framework threads, and exit 0
     deterministically. The axon JAX client's teardown intermittently aborts
@@ -468,6 +581,7 @@ def finish(payload: dict) -> None:
 
 
 def main() -> None:
+    chaos = "--chaos" in sys.argv[1:]
     rng = np.random.default_rng(0)
     cpu_gibs = cpu_baseline(rng)
     host = host_profile(rng)
@@ -476,10 +590,13 @@ def main() -> None:
     # (tmpfs writes -25%, syscall time ~2x on this host), which would tax
     # the e2e numbers with state the data plane didn't create
     put = e2e_put(rng)
+    # chaos rides the same disk-bound slot (before device staging churn)
+    cha = chaos_profile(rng) if chaos else None
     dev = device_configs(rng)
     lat = heal_latency(rng)
 
     enc = dev["encode_16p4_1MiB_b128"]
+    extra_chaos = {"chaos": cha} if cha is not None else {}
     finish({
         "metric": "erasure_encode_gibs_16+4_1MiB_batch128",
         "value": round(enc, 2),
@@ -501,6 +618,7 @@ def main() -> None:
             "heal_shard_latency": lat,                # north-star p99 half
             "reconstruct_vs_cpu": round(
                 dev["reconstruct_2loss_16p4_b128"] / cpu_gibs, 2),
+            **extra_chaos,                        # --chaos degraded run
         },
     })
 

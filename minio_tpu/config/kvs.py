@@ -87,6 +87,32 @@ SUB_SYSTEMS: dict[str, dict[str, KV]] = {
             help="token-bucket refill for bucket/console requests "
                  "(0 = unlimited)"),
     },
+    "fault": {
+        "enable": KV("1", help="honor KVS-armed fault-injection rules"),
+        "rules": KV(
+            "", env="MINIO_TPU_FAULT_RULES",
+            help="';'-separated compact rules, e.g. "
+                 "disk:*:read_at:delay(200)@ttl=60 (docs/fault.md)"),
+        "hedge": KV("1", env="MINIO_TPU_HEDGE",
+                    help="hedged degraded shard reads (0 disables)"),
+        "hedge_ms": KV(
+            "", env="MINIO_TPU_HEDGE_MS",
+            help="fixed hedge threshold ms (default: 3x shard-read p95, "
+                 "clamped to [floor, ceil])"),
+        "hedge_floor_ms": KV("25", env="MINIO_TPU_HEDGE_FLOOR_MS"),
+        "hedge_ceil_ms": KV("1000", env="MINIO_TPU_HEDGE_CEIL_MS"),
+    },
+    "health": {
+        "enable": KV("1", env="MINIO_TPU_HEALTH",
+                     help="per-disk health tracking wrapper"),
+        "trip_threshold": KV(
+            "4", env="MINIO_TPU_HEALTH_TRIP",
+            help="consecutive disk errors/timeouts before fast-fail"),
+        "deadline_ms": KV("2000", env="MINIO_TPU_HEALTH_DEADLINE_MS",
+                          help="per-op deadline; slower counts a timeout"),
+        "cooldown_s": KV("5", env="MINIO_TPU_HEALTH_COOLDOWN_S",
+                         help="probe cadence while a disk is tripped"),
+    },
     "scanner": {
         "interval_s": KV("60"),
         "sleep_per_object_ms": KV("1"),
@@ -204,7 +230,7 @@ SUB_SYSTEMS: dict[str, dict[str, KV]] = {
 #: Subsystems whose set() takes effect without restart (SubSystemsDynamic,
 #: config.go:132) — consumers read the registry at call time or register
 #: an apply callback.
-DYNAMIC = {"api", "scanner", "heal", "dispatch", "bitrot", "qos"}
+DYNAMIC = {"api", "scanner", "heal", "dispatch", "bitrot", "qos", "fault"}
 
 
 class ConfigSys:

@@ -8,16 +8,24 @@ from __future__ import annotations
 
 import hashlib
 import hmac
+import random
 import threading
 import time
 import urllib.parse
 
 import requests
 
+from .. import fault as _fault
 from ..utils import errors
 
 RPC_VERSION = "v1"
 HEALTH_INTERVAL_S = 1.0
+#: health ping backoff ceiling: a long-dead peer costs one probe per
+#: ~HEALTH_MAX_INTERVAL_S instead of one per second forever
+HEALTH_MAX_INTERVAL_S = 30.0
+#: extra attempts for idempotent (read-only) calls on transport errors
+RETRY_BUDGET = 2
+RETRY_BACKOFF_S = 0.05
 
 #: wire form of typed storage errors (class name travels in a header)
 _ERR_BY_NAME = {c.__name__: c for c in [
@@ -67,6 +75,7 @@ class RPCClient:
         self.timeout = timeout
         self._session = requests.Session()
         self._online = True
+        self._closed = False
         self._lock = threading.Lock()
         self._ping_thread: threading.Thread | None = None
         self.on_reconnect = None  # hook: called when back online
@@ -85,31 +94,47 @@ class RPCClient:
             t.start()
 
     def _ping_loop(self):
-        while not self._online:
-            time.sleep(HEALTH_INTERVAL_S)
+        """Jittered exponential backoff probe (1s doubling to
+        HEALTH_MAX_INTERVAL_S, x[0.5, 1.5) jitter so a cluster of
+        clients doesn't probe a recovering peer in lockstep). An
+        on_reconnect hook failure is logged-and-swallowed — the ping
+        daemon itself must survive any callback."""
+        interval = HEALTH_INTERVAL_S
+        while not self._online and not self._closed:
+            time.sleep(interval * (0.5 + random.random()))
+            if self._closed:
+                return
             try:
                 r = self._session.get(f"{self.base}/minio/health/live",
                                       timeout=2)
-                if r.status_code == 200:
-                    self._online = True
-                    if self.on_reconnect is not None:
-                        try:
-                            self.on_reconnect(self)
-                        except Exception:  # noqa: BLE001
-                            pass
-                    return
             except requests.RequestException:
+                interval = min(interval * 2, HEALTH_MAX_INTERVAL_S)
                 continue
+            if r.status_code != 200:
+                interval = min(interval * 2, HEALTH_MAX_INTERVAL_S)
+                continue
+            self._online = True
+            if self.on_reconnect is not None:
+                try:
+                    self.on_reconnect(self)
+                except Exception:  # noqa: BLE001 — a broken hook must
+                    pass  # not kill the daemon or the online flip
+            return
 
     def call(self, method: str, params: dict | None = None,
              body: bytes | None = None, stream: bool = False,
-             timeout: float | None = None):
+             timeout: float | None = None, idempotent: bool = False):
         """POST the method; returns response bytes (or the raw response when
         stream=True). Typed storage errors re-raise as their class. A
         request-scoped span context propagates over the
         ``x-minio-tpu-traceparent`` header so peer-side spans share the
         caller's trace_id (and a client span records the RPC leg in the
-        caller's own tree)."""
+        caller's own tree).
+
+        ``idempotent=True`` (read-only methods) grants a small retry
+        budget with jittered exponential backoff on transport-level
+        failures — the peer is only marked offline once the budget is
+        exhausted, so one dropped packet doesn't fence a healthy disk."""
         from ..obs import metrics as mx
         from ..obs import spans as sp
         if not self._online:
@@ -122,6 +147,7 @@ class RPCClient:
         if body:
             mx.inc("minio_tpu_inter_node_sent_bytes_total", len(body),
                    service=self.service)
+        attempts = 1 + (RETRY_BUDGET if idempotent else 0)
         # the status/typed-error handling stays INSIDE the client span:
         # a peer's 500 + x-minio-tpu-error raises from here, and the
         # span must record that failure — an error trace showing a
@@ -132,31 +158,47 @@ class RPCClient:
                        f"{make_token(self.secret)}"}
             if span_ctx is not None:
                 headers[sp.RPC_HEADER] = sp.to_traceparent(span_ctx)
-            try:
-                r = self._session.post(
-                    url, data=body, headers=headers,
-                    timeout=timeout or self.timeout, stream=stream)
-            except requests.RequestException as e:
-                self._mark_offline()
-                mx.inc("minio_tpu_inter_node_errors_total",
-                       service=self.service)
-                raise errors.DiskNotFound(f"{self.base}: {e}") from e
-            if r.status_code == 200:
-                if not stream:
-                    mx.inc("minio_tpu_inter_node_received_bytes_total",
-                           len(r.content), service=self.service)
-                return r if stream else r.content
-            err_name = r.headers.get("x-minio-tpu-error", "")
-            msg = r.content.decode("utf-8", "replace")[:200]
-            if err_name in _ERR_BY_NAME:
-                raise _ERR_BY_NAME[err_name](msg)
-            if r.status_code in (502, 503, 504):
-                self._mark_offline()
-                raise errors.DiskNotFound(
-                    f"{self.base}: {r.status_code}")
-            raise RPCError(f"{method}: HTTP {r.status_code} {msg}")
+            for attempt in range(attempts):
+                if attempt:
+                    # jittered exponential backoff between retries
+                    time.sleep(RETRY_BACKOFF_S * (1 << (attempt - 1))
+                               * (0.5 + random.random()))
+                try:
+                    if _fault.armed("rpc"):
+                        # per-call injection point (chaos harness);
+                        # typed errors raise like a peer-sent error,
+                        # transport-class errors retry like one
+                        _fault.inject("rpc", self.base, method)
+                    r = self._session.post(
+                        url, data=body, headers=headers,
+                        timeout=timeout or self.timeout, stream=stream)
+                except (requests.RequestException,
+                        errors.RPCError) as e:
+                    mx.inc("minio_tpu_inter_node_errors_total",
+                           service=self.service)
+                    if attempt + 1 < attempts:
+                        continue
+                    self._mark_offline()
+                    raise errors.DiskNotFound(f"{self.base}: {e}") from e
+                if r.status_code == 200:
+                    if not stream:
+                        mx.inc("minio_tpu_inter_node_received_bytes_total",
+                               len(r.content), service=self.service)
+                    return r if stream else r.content
+                err_name = r.headers.get("x-minio-tpu-error", "")
+                msg = r.content.decode("utf-8", "replace")[:200]
+                if err_name in _ERR_BY_NAME:
+                    raise _ERR_BY_NAME[err_name](msg)
+                if r.status_code in (502, 503, 504):
+                    if attempt + 1 < attempts:
+                        continue
+                    self._mark_offline()
+                    raise errors.DiskNotFound(
+                        f"{self.base}: {r.status_code}")
+                raise RPCError(f"{method}: HTTP {r.status_code} {msg}")
 
     def close(self):
+        self._closed = True
         self._online = False
         self._session.close()
 

@@ -22,11 +22,20 @@ class StorageRESTClient(StorageAPI):
         self.disk_path = disk_path
         self._endpoint = f"{node_url}{disk_path}"
 
+    #: read-only methods safe to retry on transport failures (the
+    #: RPC client grants these a jittered-backoff retry budget)
+    IDEMPOTENT = frozenset({
+        "diskinfo", "getdiskid", "listvols", "statvol", "listdir",
+        "readall", "readfileat", "statfilesize", "readversion",
+        "listversions", "checkparts", "verifyfile", "walkdir",
+        "walkversions"})
+
     def _call(self, method: str, params: dict | None = None,
               body: bytes | None = None):
         p = {"disk": self.disk_path}
         p.update(params or {})
-        return self.rpc.call(method, p, body)
+        return self.rpc.call(method, p, body,
+                             idempotent=method in self.IDEMPOTENT)
 
     # --- identity -----------------------------------------------------------
 

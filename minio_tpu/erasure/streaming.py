@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import io
 import os
+import time
 from collections import deque
 from concurrent.futures import (FIRST_COMPLETED, Future, ThreadPoolExecutor,
                                 wait)
@@ -21,6 +22,7 @@ from dataclasses import dataclass, field
 
 import numpy as np
 
+from .. import fault as _fault
 from ..utils import errors
 from .codec import Erasure, ceil_div
 
@@ -76,6 +78,10 @@ def _native_put_eligible(erasure: Erasure, writers: list) -> bool:
     mt_put_block) with on-disk output bit-identical to the Python path."""
     if os.environ.get("MINIO_TPU_PUT_PATH", "auto") == "dispatch":
         return False
+    if _fault.armed("disk"):
+        # chaos runs take the interpretable Python path: the native
+        # pwrite pipeline bypasses the per-op injection points
+        return False
     from .bitrot import StreamingBitrotWriter, native_algo_id
     live = [w for w in writers if w is not None]
     if not live:
@@ -101,6 +107,11 @@ def _native_get_eligible(erasure: Erasure, readers: list) -> bool:
     (mt_get_block): all k data-shard readers alive and HighwayHash-framed
     with one chunk size dividing the shard."""
     if os.environ.get("MINIO_TPU_GET_PATH", "auto") == "dispatch":
+        return False
+    if _fault.armed("disk"):
+        # chaos runs need the Python shard reads (where read_at faults
+        # inject and hedging mitigates); the fused C pread would bypass
+        # both
         return False
     from .bitrot import StreamingBitrotReader, native_algo_id
     k = erasure.data_blocks
@@ -128,6 +139,89 @@ class DecodeStats:
     cmd/erasure-object.go:325-336)."""
     errs: list = field(default_factory=list)  # per-reader exception or None
     bytes_written: int = 0
+    hedged: int = 0           # hedge reads fired across the call's blocks
+
+
+# --- hedged reads (Dean & Barroso, "The Tail at Scale", CACM 2013) -----------
+#
+# A GET launches exactly k data-shard reads; when none of the in-flight
+# reads completes within the hedge threshold, one replacement (parity)
+# read is issued WITHOUT declaring the straggler dead, and the first k
+# distinct shards to arrive reconstruct the block through the normal TPU
+# decode path. The threshold tracks the p95 of the last-minute shard-read
+# latency window (obs/latency.py), clamped to [floor, ceil].
+
+#: hedging master switch ("0" disables; default on)
+HEDGE_ENV = "MINIO_TPU_HEDGE"
+#: fixed threshold override in ms (skips the p95 computation entirely)
+HEDGE_MS_ENV = "MINIO_TPU_HEDGE_MS"
+HEDGE_FLOOR_MS_ENV = "MINIO_TPU_HEDGE_FLOOR_MS"
+HEDGE_CEIL_MS_ENV = "MINIO_TPU_HEDGE_CEIL_MS"
+#: threshold = max(floor, MULT * p95(shard_read window)) — the multiple
+#: keeps normal jitter from firing wasted parity reads
+HEDGE_P95_MULT = 3.0
+
+#: latency-window family fed by every shard read and consumed by
+#: hedge_threshold_s() (one unlabeled series: the threshold is global,
+#: per-disk skew is exactly what hedging routes around)
+_HEDGE_FAMILY = "hedge"
+
+
+def _hedge_knob(key: str, env: str, default: str) -> str:
+    """Resolve a ``fault.hedge*`` knob through the config registry
+    (env > stored > default) so dynamic config changes take effect
+    without env mutation; pure-library use falls back to env."""
+    try:
+        from ..config import get_config_sys
+        return get_config_sys().get("fault", key)
+    except Exception:  # noqa: BLE001 — registry unavailable/unloaded
+        return os.environ.get(env, default)
+
+
+def hedging_enabled() -> bool:
+    return _hedge_knob("hedge", HEDGE_ENV, "1") not in ("0", "off")
+
+
+#: adaptive threshold cache: the p95 scan walks the window's slots in
+#: Python, and a GET calls this once per block wave — recompute at most
+#: every THRESHOLD_TTL_S instead (value, monotonic stamp)
+_THRESHOLD_TTL_S = 0.5
+_threshold_cache: tuple[float, float] = (0.0, -1.0)
+
+
+def hedge_threshold_s() -> float:
+    """Current hedge trigger in seconds."""
+    global _threshold_cache
+    ms = _hedge_knob("hedge_ms", HEDGE_MS_ENV, "")
+    if ms:
+        try:
+            return max(1e-3, float(ms) / 1e3)
+        except ValueError:
+            pass
+    val, stamp = _threshold_cache
+    now = time.monotonic()
+    if 0.0 <= now - stamp < _THRESHOLD_TTL_S:
+        return val
+    from ..obs import latency as _lat
+    win = _lat.get_window(_HEDGE_FAMILY, op="shard_read")
+    p95 = win.percentiles((0.95,))[0.95]
+    # floor/ceil read per refresh (not at import) so dynamic config /
+    # tests changing them actually move the clamp
+    try:
+        floor = float(_hedge_knob("hedge_floor_ms",
+                                  HEDGE_FLOOR_MS_ENV, "25"))
+        ceil = float(_hedge_knob("hedge_ceil_ms",
+                                 HEDGE_CEIL_MS_ENV, "1000"))
+    except ValueError:
+        floor, ceil = 25.0, 1000.0
+    val = min(ceil / 1e3, max(floor / 1e3, HEDGE_P95_MULT * p95))
+    _threshold_cache = (val, now)
+    return val
+
+
+def _observe_shard_read(dur_s: float, nbytes: int) -> None:
+    from ..obs import latency as _lat
+    _lat.observe(_HEDGE_FAMILY, dur_s, nbytes, op="shard_read")
 
 
 def parallel_write_shards(writers: list, shards: list[np.ndarray],
@@ -443,6 +537,7 @@ class _ParallelReader:
         self.erasure = erasure
         self.errs: list[BaseException | None] = [None] * len(readers)
         self.last_digests: list[bytes | None] = [None] * len(readers)
+        self.hedged = 0  # hedge reads fired across this reader's blocks
         for i, r in enumerate(self.readers):
             if r is None:
                 self.errs[i] = errors.DiskNotFound()
@@ -484,9 +579,10 @@ class _ParallelReader:
         shards: list[np.ndarray | None] = [None] * n
         digests: list[bytes | None] = [None] * n
         pending: dict[object, int] = {}  # future -> reader index
+        t_launch: dict[object, float] = {}
         next_idx = 0
 
-        def launch_one():
+        def launch_one() -> int | None:
             nonlocal next_idx
             while next_idx < n:
                 i = next_idx
@@ -497,27 +593,47 @@ class _ParallelReader:
                     else self.readers[i].read_at
                 f = io_pool().submit(fn, shard_offset, shard_len)
                 pending[f] = i
-                return True
-            return False
+                t_launch[f] = time.monotonic()
+                return i
+            return None
 
         for _ in range(k):
-            if not launch_one():
+            if launch_one() is None:
                 break
         done = 0
-        while pending:
+        hedge_t = hedge_threshold_s() if hedging_enabled() else None
+        hedged_idx: set[int] = set()
+        while pending and done < k:
             # first-completed order so a fast failure fires its replacement
             # read while slower disks are still in flight (the readTriggerCh
             # overlap property of the reference)
-            ready, _ = wait(list(pending), return_when=FIRST_COMPLETED)
+            ready, _ = wait(list(pending), timeout=hedge_t,
+                            return_when=FIRST_COMPLETED)
+            if not ready:
+                # hedge trigger: nothing completed within the threshold —
+                # fire ONE replacement (parity) read without declaring the
+                # stragglers dead ("The Tail at Scale"); first k distinct
+                # shards win, abandoned stragglers are simply not consumed
+                i = launch_one()
+                if i is None:
+                    hedge_t = None  # nothing left to hedge with: wait out
+                    continue
+                hedged_idx.add(i)
+                self.hedged += 1
+                self._note_hedge(i)
+                continue
             for f in ready:
                 i = pending.pop(f)
                 try:
                     data = f.result()
+                    _observe_shard_read(
+                        time.monotonic() - t_launch.pop(f, 0.0), shard_len)
                     if raw:
                         digests[i], data = data
                     shards[i] = np.frombuffer(data, dtype=np.uint8)
                     done += 1
                 except Exception as e:  # noqa: BLE001
+                    t_launch.pop(f, None)
                     self.errs[i] = e if isinstance(e, errors.StorageError) \
                         else errors.FaultyDisk(str(e))
                     self.readers[i] = None
@@ -526,8 +642,33 @@ class _ParallelReader:
             err = errors.reduce_read_quorum_errs(
                 self.errs, errors.BASE_IGNORED_ERRS, k)
             raise err if err is not None else errors.ErasureReadQuorum()
+        if hedged_idx:
+            from ..obs import metrics as mx
+            won = any(shards[i] is not None for i in hedged_idx)
+            mx.inc("minio_tpu_hedged_reads_total",
+                   outcome="won" if won else "lost")
         self.last_digests = digests
         return shards
+
+    @staticmethod
+    def _note_hedge(idx: int) -> None:
+        """Count the fired hedge and annotate the live span tree (the
+        hedged/tripped paths must be visible in a chaos run's traces)."""
+        from ..obs import metrics as mx
+        mx.inc("minio_tpu_hedged_reads_total", outcome="fired")
+        try:
+            from ..obs import spans as sp
+            ctx = sp.current()
+            if ctx is None or not ctx.sampled:
+                return
+            sp.record({
+                "name": "hedge.read", "trace_id": ctx.trace_id,
+                "span_id": sp.new_span_id(),
+                "parent_span_id": ctx.span_id, "time": time.time(),
+                "duration_s": 0.0, "error": "",
+                "attrs": {"shard": idx}})
+        except Exception:  # noqa: BLE001 — obs must never break reads
+            pass
 
     def drop_corrupt(self, corrupt: tuple[int, ...]) -> None:
         """Mark sources whose device-verified digests mismatched as failed
@@ -776,14 +917,17 @@ def erasure_decode(erasure: Erasure, writer, readers: list, offset: int,
             emit(window.popleft())
     while window:
         emit(window.popleft())
+    stats.hedged = preader.hedged
     return stats
 
 
 def erasure_heal(erasure: Erasure, writers: list, readers: list,
-                 total_length: int) -> None:
+                 total_length: int) -> list:
     """Rebuild the shards owned by the non-None writers (outdated/offline
     disks being healed) blockwise and stream them out; write quorum 1
     (reference Erasure.Heal, cmd/erasure-lowlevel-heal.go:28-48).
+    Returns the per-reader error votes (the caller re-enqueues a deep
+    MRF heal when a SOURCE shard turned out bitrot-corrupt mid-heal).
 
     Only the target shards are computed (targets <= parity count or the
     object would be unrecoverable) and rebuilds ride the dispatch queue, so
@@ -794,12 +938,12 @@ def erasure_heal(erasure: Erasure, writers: list, readers: list,
         for w in writers:
             if w is not None:
                 w.close()
-        return
+        return [None] * len(readers)
     k = erasure.data_blocks
     bs = erasure.block_size
     targets = tuple(i for i, w in enumerate(writers) if w is not None)
     if not targets:
-        return
+        return [None] * len(readers)
     preader = _ParallelReader(readers, erasure)
     n_blocks = ceil_div(total_length, bs)
 
@@ -868,6 +1012,7 @@ def erasure_heal(erasure: Erasure, writers: list, readers: list,
     for w in writers:
         if w is not None:
             w.close()
+    return preader.errs
 
 
 class BufferSink:

@@ -189,6 +189,26 @@ class AdminClient:
         channel."""
         return self._json("POST", "update")
 
+    # -- fault injection (chaos harness) --------------------------------------
+
+    def fault_status(self) -> dict:
+        """Armed fault rules + per-disk health tracker states."""
+        return self._json("GET", "fault")
+
+    def fault_arm(self, rule) -> str:
+        """Arm one fault rule; ``rule`` is a compact-grammar string
+        (``disk:*:read_at:delay(200)@ttl=60``, docs/fault.md) or a dict
+        of FaultRule fields. Returns the rule id."""
+        body = {"rule": rule} if isinstance(rule, str) else dict(rule)
+        return self._json("POST", "fault", None,
+                          json.dumps(body).encode())["id"]
+
+    def fault_disarm(self, rule_id: str) -> None:
+        self._json("DELETE", "fault", {"id": rule_id})
+
+    def fault_clear(self) -> None:
+        self._json("DELETE", "fault")
+
     # -- kms ------------------------------------------------------------------
 
     def kms_status(self) -> dict:

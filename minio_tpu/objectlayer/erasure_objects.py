@@ -96,7 +96,21 @@ class ErasureObjects(MultipartMixin, ObjectLayer):
                  block_size: int = DEFAULT_BLOCK_SIZE,
                  bitrot_algo: BitrotAlgorithm = DEFAULT_BITROT_ALGO,
                  set_index: int = 0, pool_index: int = 0):
-        self._disks = list(disks)
+        from ..storage.health import wrap_disks
+        # every disk rides a health tracker: N consecutive errors/
+        # timeouts trip it to fast-fail DiskNotFound (quorum math then
+        # routes around it immediately), a cooldown probe re-onlines it
+        self._disks = wrap_disks(list(disks))
+        for d in self._disks:
+            if d is not None and hasattr(d, "state_listeners"):
+                # replace, don't accumulate: rebuilding a layer over
+                # already-wrapped disks must not leave stale bound
+                # listeners pinning the old instance alive
+                d.state_listeners = [
+                    fn for fn in d.state_listeners
+                    if getattr(fn, "__func__", None)
+                    is not ErasureObjects._on_disk_state]
+                d.state_listeners.append(self._on_disk_state)
         n = len(disks)
         if n < 2:
             raise ValueError("erasure set needs >= 2 disks")
@@ -109,6 +123,10 @@ class ErasureObjects(MultipartMixin, ObjectLayer):
         #: MRF hook — called with (bucket, object, version_id) when an op
         #: detects a partial/degraded state (cmd/erasure-object.go:1132).
         self.on_partial = None
+        #: called with (disk, "ok"|"faulty") on health-tracker
+        #: transitions — the server wires an auto-heal nudge here so a
+        #: re-onlined disk gets the objects it missed rebuilt
+        self.on_disk_state = None
         #: namespace lock map (dist.dsync.NSLockMap) — None in library use;
         #: the Node wires the cluster lockers in distributed mode
         self.ns_lock = None
@@ -164,6 +182,33 @@ class ErasureObjects(MultipartMixin, ObjectLayer):
     @property
     def disks(self) -> list:
         return list(self._disks)
+
+    def _on_disk_state(self, disk, state: str):
+        """Health-tracker transition fan-in: forwards to the server's
+        hook (auto-heal nudge on re-online)."""
+        if self.on_disk_state is not None:
+            try:
+                self.on_disk_state(disk, state)
+            except Exception:  # noqa: BLE001 — hooks are best-effort
+                pass
+
+    def _signal_read_faults(self, bucket, object, version_id, errs,
+                            extra_degraded: bool = False):
+        """THE one bitrot/degraded-read funnel (satellite: every read
+        path that saw shard-level trouble routes through here): corrupt
+        shards enqueue a DEEP MRF heal (a normal heal's size-only check
+        cannot find a corrupt-but-right-sized shard), missing/failed
+        shards a normal one."""
+        saw_bitrot = any(isinstance(e, errors.FileCorrupt) for e in errs)
+        degraded = extra_degraded or saw_bitrot or any(
+            isinstance(e, (errors.FileNotFound, errors.FaultyDisk,
+                           errors.DiskNotFound))
+            for e in errs)
+        if degraded:
+            self._notify_partial(bucket, object, version_id,
+                                 scan_mode="deep" if saw_bitrot
+                                 else "normal")
+        return degraded
 
     def _notify_partial(self, bucket, object, version_id="",
                         scan_mode="normal"):
@@ -512,8 +557,7 @@ class ErasureObjects(MultipartMixin, ObjectLayer):
             if 1 <= idx <= len(disks) and per_shard_disk[idx - 1] is None:
                 per_shard_disk[idx - 1] = d
 
-        degraded = False
-        saw_bitrot = False
+        shard_errs: list = []
         part_start = 0  # start byte of current part within the object
         for part in fi.parts:
             part_end = part_start + part.size
@@ -552,19 +596,14 @@ class ErasureObjects(MultipartMixin, ObjectLayer):
                     src = getattr(r, "src", None)
                     if src is not None and hasattr(src, "close"):
                         src.close()
-            if any(isinstance(e, (errors.FileCorrupt, errors.FileNotFound))
-                   for e in stats.errs):
-                degraded = True
-                if any(isinstance(e, errors.FileCorrupt)
-                       for e in stats.errs):
-                    saw_bitrot = True
-        if degraded or any(e is not None for e in errs) \
-                or any(d is None for d in per_shard_disk[
-                    :fi.erasure.data_blocks + fi.erasure.parity_blocks]):
-            # heal-on-read signal (cmd/erasure-object.go:325-336)
-            self._notify_partial(bucket, object, fi.version_id,
-                                 scan_mode="deep" if saw_bitrot
-                                 else "normal")
+            shard_errs.extend(stats.errs)
+        # heal-on-read signal (cmd/erasure-object.go:325-336) through the
+        # single bitrot/degraded funnel: corrupt shards -> deep MRF heal
+        self._signal_read_faults(
+            bucket, object, fi.version_id, shard_errs,
+            extra_degraded=any(e is not None for e in errs)
+            or any(d is None for d in per_shard_disk[
+                :fi.erasure.data_blocks + fi.erasure.parity_blocks]))
         return oi
 
     def get_object_bytes(self, bucket: str, object: str,
@@ -1154,6 +1193,7 @@ class ErasureObjects(MultipartMixin, ObjectLayer):
         # target shard index per healed disk: reuse the quorum distribution
         dist = fi.erasure.distribution or hash_order(f"{bucket}/{object}", n)
         tmp_id = str(uuid.uuid4())
+        src_errs: list = []
         for part in fi.parts:
             logical = er.shard_file_size(part.size)
             readers = []
@@ -1187,7 +1227,8 @@ class ErasureObjects(MultipartMixin, ObjectLayer):
             t0 = _time.perf_counter()
             heal_err = ""
             try:
-                erasure_heal(er, writers, readers, part.size)
+                src_errs.extend(
+                    erasure_heal(er, writers, readers, part.size))
             except Exception as e:  # noqa: BLE001
                 heal_err = str(e)
                 raise to_object_err(e, bucket, object) from e
@@ -1221,5 +1262,12 @@ class ErasureObjects(MultipartMixin, ObjectLayer):
                 state[i] = DRIVE_STATE_OK
             except Exception:  # noqa: BLE001
                 pass
+        if scan_mode != "deep" and any(
+                isinstance(e, errors.FileCorrupt) for e in src_errs):
+            # a SOURCE shard turned out bitrot-corrupt mid-heal: this
+            # normal-mode pass did not target it (size-only check), so
+            # re-enqueue the object for a deep heal via the shared funnel
+            self._signal_read_faults(bucket, object, fi.version_id,
+                                     src_errs)
         res.after_state = state
         return res

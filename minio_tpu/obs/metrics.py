@@ -534,6 +534,44 @@ def _g_kernel(server) -> list[str]:
     return lines
 
 
+def _g_disk_health(server) -> list[str]:
+    """Disk health tracker states + the live hedged-read threshold
+    (minio_tpu/storage/health.py + erasure/streaming.py hedging). The
+    companion counters ride the store: minio_tpu_fault_injected_total
+    {layer,action}, minio_tpu_disk_trips_total{disk},
+    minio_tpu_disk_reonline_total{disk}, minio_tpu_hedged_reads_total
+    {outcome}, minio_tpu_mrf_dropped_total."""
+    lines = []
+    rows = []
+    for d in _all_disks(server.obj):
+        stats_fn = getattr(d, "health_stats", None)
+        if stats_fn is None:
+            continue
+        try:
+            rows.append((d.endpoint(), stats_fn()))
+        except Exception:  # noqa: BLE001
+            continue
+    if rows:
+        lines += ["# TYPE minio_tpu_disk_state gauge",
+                  "# TYPE minio_tpu_disk_health_ewma_seconds gauge"]
+        for ep, st in rows:
+            lines.append(
+                f'minio_tpu_disk_state{{disk="{_esc(ep)}",'
+                f'state="{_esc(st["state"])}"}} 1')
+            lines.append(
+                f'minio_tpu_disk_health_ewma_seconds{{disk="{_esc(ep)}"}} '
+                f'{st["ewma_ms"] / 1e3:.6f}')
+    try:
+        from ..erasure.streaming import hedge_threshold_s, hedging_enabled
+        if hedging_enabled():
+            lines += ["# TYPE minio_tpu_hedge_threshold_seconds gauge",
+                      "minio_tpu_hedge_threshold_seconds "
+                      f"{hedge_threshold_s():.6f}"]
+    except Exception:  # noqa: BLE001
+        pass
+    return lines
+
+
 def _g_locks(server) -> list[str]:
     locker = getattr(server, "local_locker", None)
     if locker is None:
@@ -560,6 +598,9 @@ _GROUPS = [
     # qos reads in-memory scheduler/admission state — interval 0 keeps
     # overload tests (and scrapes mid-incident) fresh
     MetricsGroup("qos", "node", _g_qos, interval=0),
+    # disk health reads in-memory tracker state — interval 0 so a trip
+    # is visible on the very next scrape (and in chaos tests)
+    MetricsGroup("disk_health", "node", _g_disk_health, interval=0),
     MetricsGroup("process", "node", _g_process),
     MetricsGroup("locks", "node", _g_locks),
     MetricsGroup("notification", "cluster", _g_notification),

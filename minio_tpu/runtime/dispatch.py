@@ -701,7 +701,17 @@ class DispatchQueue:
                              self.completer_count, record=False) > 0
 
     def _flush(self, b: _Bucket, items: list[_Pending]):
+        from .. import fault as _fault
         self.qos.note_items(b.cls, len(items))
+        if _fault.armed("kernel"):
+            # per-flush injection point (chaos harness): an injected
+            # device error exercises the CPU-salvage path — the whole
+            # flush re-routes to the CPU executor, results stay correct
+            try:
+                _fault.inject("kernel", "device", b.op)
+            except Exception:  # noqa: BLE001 — injected device failure
+                self._flush_cpu(b, items)
+                return
         n_dev = self._plan_flush(b, items)
         dev_items, cpu_items = items[:n_dev], items[n_dev:]
         if dev_items:

@@ -143,6 +143,7 @@ class AutoHealMonitor:
         self.interval = interval_s
         self.healer = GlobalHealer(objlayer)
         self._stop = threading.Event()
+        self._kick = threading.Event()
         self._thread: threading.Thread | None = None
         self.heal_passes = 0
 
@@ -152,12 +153,21 @@ class AutoHealMonitor:
         self._thread.start()
         return self
 
+    def kick(self) -> None:
+        """Run the next check immediately (a health-tracked disk just
+        re-onlined) instead of waiting out the poll interval."""
+        self._kick.set()
+
     def stats(self) -> dict:
         return {"heal_passes": self.heal_passes,
                 "disks_watched": len(self.local_disks)}
 
     def _loop(self):
-        while not self._stop.wait(self.interval):
+        while True:
+            self._kick.wait(self.interval)
+            self._kick.clear()
+            if self._stop.is_set():
+                return
             try:
                 self.check_and_heal()
             except Exception:  # noqa: BLE001
@@ -221,5 +231,6 @@ class AutoHealMonitor:
 
     def stop(self):
         self._stop.set()
+        self._kick.set()  # wake the loop so stop doesn't wait a cycle
         if self._thread is not None:
             self._thread.join(timeout=5)

@@ -17,19 +17,41 @@ class MRFHealer:
         self._thread: threading.Thread | None = None
         self.healed = 0
         self.failed = 0
+        self.dropped = 0
 
     def add_partial(self, bucket: str, object: str, version_id: str = "",
                     scan_mode: str = "normal"):
         """scan_mode='deep' when the enqueuer saw bitrot (a normal heal's
-        size-only check would classify the disk as healthy)."""
-        try:
-            self.q.put_nowait((bucket, object, version_id, scan_mode))
-        except queue.Full:
-            try:  # drop-oldest; racing producers may refill the slot
-                self.q.get_nowait()
-                self.q.put_nowait((bucket, object, version_id, scan_mode))
-            except (queue.Empty, queue.Full):
-                pass
+        size-only check would classify the disk as healthy).
+
+        Overflow policy is drop-OLDEST (heal is best-effort; the scanner
+        sweeps anything missed), retried once: racing producers can
+        refill the freed slot between get and put, and the single-try
+        fallback used to drop the NEWEST entry — the one a request just
+        flagged as degraded. Every lost entry counts in
+        ``minio_tpu_mrf_dropped_total`` and ``stats()['dropped']``."""
+        from ..obs import metrics as mx
+        item = (bucket, object, version_id, scan_mode)
+        landed = False
+        dropped = 0
+        for attempt in range(3):  # initial put + drop-oldest + one retry
+            try:
+                self.q.put_nowait(item)
+                landed = True
+                break
+            except queue.Full:
+                if attempt == 2:
+                    break
+                try:
+                    self.q.get_nowait()
+                    dropped += 1  # an older entry made room
+                except queue.Empty:
+                    pass
+        if not landed:
+            dropped += 1  # both retries lost the race: the NEW entry
+        if dropped:
+            self.dropped += dropped
+            mx.inc("minio_tpu_mrf_dropped_total", dropped)
 
     def start(self):
         self._thread = threading.Thread(target=self._loop, daemon=True,
@@ -39,7 +61,7 @@ class MRFHealer:
 
     def stats(self) -> dict:
         return {"healed": self.healed, "failed": self.failed,
-                "queued": self.q.qsize()}
+                "queued": self.q.qsize(), "dropped": self.dropped}
 
     def _loop(self):
         while not self._stop.is_set():

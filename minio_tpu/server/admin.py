@@ -209,6 +209,8 @@ def _dispatch_admin(h, op: str) -> None:
         from ..qos import qos_status
         return h._send(200, json.dumps(qos_status(h.s3)).encode(),
                        "application/json")
+    if op == "fault":
+        return _fault_op(h)
     if op == "bg-heal-status":
         from ..scanner import background_heal_stats
         out = background_heal_stats(h.s3)
@@ -239,6 +241,50 @@ def _dispatch_admin(h, op: str) -> None:
     if _iam_op(h, op):
         return
     h._error("NotImplemented", f"admin op {op}", 501)
+
+
+def _fault_op(h) -> None:
+    """Fault-injection control plane (chaos harness, docs/fault.md):
+    GET lists armed rules + disk health states; POST arms one rule
+    (JSON body ``{"rule": "<compact grammar>"}`` or the rule fields
+    directly); DELETE ``?id=<rule id>`` disarms one, no id clears all.
+    Root credentials only (enforced by handle_admin)."""
+    from .. import fault
+    if h.command == "GET":
+        from ..obs.metrics import _all_disks
+        disks = []
+        for d in _all_disks(h.s3.obj):
+            stats = getattr(d, "health_stats", None)
+            if stats is None:
+                continue
+            disks.append({"endpoint": d.endpoint(), **stats()})
+        return h._send(200, json.dumps(
+            {"rules": fault.rules(), "disks": disks}).encode(),
+            "application/json")
+    if h.command == "DELETE":
+        q = {k: v[0] for k, v in h.query.items()}
+        rid = q.get("id", "")
+        if not rid:
+            fault.clear()
+            return h._send(200, b"{}", "application/json")
+        if not fault.disarm(rid):
+            return h._error("InvalidArgument",
+                            f"unknown fault rule {rid!r}", 400)
+        return h._send(200, b"{}", "application/json")
+    # POST: arm
+    try:
+        body = json.loads(h._read_body() or b"{}")
+        if "rule" in body:
+            rid = fault.arm(body["rule"])
+        else:
+            rid = fault.arm(fault.FaultRule(**{
+                k: v for k, v in body.items()
+                if k in ("layer", "target", "op", "action", "error",
+                         "delay_ms", "jitter_ms", "prob", "hang_s",
+                         "count", "ttl_s", "seed")}))
+    except (ValueError, TypeError) as e:
+        return h._error("InvalidArgument", f"bad fault rule: {e}", 400)
+    h._send(200, json.dumps({"id": rid}).encode(), "application/json")
 
 
 def _profiling_obd(h, op: str) -> None:

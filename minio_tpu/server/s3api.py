@@ -115,6 +115,11 @@ class S3Server:
                                   s.qos_admission.max_requests))
 
             cfg.on_apply("api", _apply_api)
+            # declarative KVS fault rules (chaos harness): applied once
+            # at start and on every dynamic `fault` subsystem change
+            from .. import fault as _fault
+            cfg.on_apply("fault", _fault.apply_config)
+            _fault.apply_config(cfg)
         self._httpd: ThreadingHTTPServer | None = None
         #: internal RPC services mounted under /minio/<name>/v1/<method>
         #: (storage/lock/peer — populated by dist.node.Node)
@@ -405,6 +410,35 @@ class S3Server:
             mrf=self.mrf, lifecycle=lc).start()
         self.autoheal = AutoHealMonitor(
             self.obj, _all_disks(self.obj)).start()
+
+        # wire the degraded-path signals into the background plane:
+        # partial/bitrot detections enqueue MRF heals, and a health-
+        # tracked disk that re-onlines kicks the auto-heal monitor so
+        # the objects it missed get rebuilt promptly
+        def _disk_state(disk, state, _srv=self):
+            if state == "ok" and getattr(_srv, "autoheal", None) is not None:
+                from ..scanner.autoheal import set_healing_tracker
+                try:
+                    set_healing_tracker(disk)
+                except Exception:  # noqa: BLE001 — disk may still be sick
+                    pass
+                _srv.autoheal.kick()
+        for layer in self._erasure_layers():
+            layer.on_partial = self.mrf.add_partial
+            layer.on_disk_state = _disk_state
+
+    def _erasure_layers(self) -> list:
+        """Every ErasureObjects under any ObjectLayer shape (one set, a
+        sets layer, or server pools)."""
+        obj = self.obj
+        if hasattr(obj, "pools"):
+            out = []
+            for p in obj.pools:
+                out.extend(p.sets if hasattr(p, "sets") else [p])
+            return out
+        if hasattr(obj, "sets"):
+            return list(obj.sets)
+        return [obj] if hasattr(obj, "on_partial") else []
 
     def shutdown(self):
         for svc_name in ("scanner", "autoheal", "mrf"):

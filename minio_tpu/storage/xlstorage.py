@@ -21,6 +21,7 @@ import time
 import uuid
 from typing import Iterator
 
+from .. import fault as _fault
 from ..obs import latency as _lat
 from ..obs import spans as _spans
 from ..obs import trace as _trc
@@ -78,8 +79,9 @@ class _FileReadAt:
     these per request — the BufferedReader setup was measurable GIL time
     under concurrent reads."""
 
-    def __init__(self, path: str):
+    def __init__(self, path: str, endpoint: str = ""):
         self._fd = -1  # __del__ runs even when os.open below raises
+        self._endpoint = endpoint
         try:
             self._fd = os.open(path, os.O_RDONLY)
         except FileNotFoundError:
@@ -95,7 +97,16 @@ class _FileReadAt:
             raise errors.IsNotRegular(path)
 
     def read_at(self, offset: int, length: int) -> bytes:
-        return os.pread(self._fd, length, offset)
+        out = os.pread(self._fd, length, offset)
+        if _fault.armed("disk"):
+            # per-shard-read injection (chaos harness): delay/hang make
+            # this source a straggler (hedged reads route around it),
+            # error raises a typed vote, bitrot corrupts the returned
+            # span (the bitrot reader upstairs detects the mismatch)
+            if _fault.inject("disk", self._endpoint,
+                             "read_at") is _fault.BITROT:
+                out = _fault.corrupt(out)
+        return out
 
     def fileno(self) -> int:
         """Expose the fd for the fused native read path (pread from
@@ -129,6 +140,12 @@ class _OpSpan:
 
     def __enter__(self) -> "_OpSpan":
         self.t0 = time.perf_counter()
+        if _fault.armed("disk"):
+            # per-op injection point (chaos harness): a raised typed
+            # error propagates to the caller exactly like a real disk
+            # failure; a delay lands inside the measured span so the
+            # latency windows and health EWMA see it
+            _fault.inject("disk", self.disk, self.op)
         return self
 
     def __exit__(self, etype, exc, tb) -> bool:
@@ -323,10 +340,14 @@ class XLStorage(StorageAPI):
                 f.write(data)
 
     def create_file_writer(self, volume: str, path: str):
+        if _fault.armed("disk"):
+            _fault.inject("disk", self._endpoint, "create_file_writer")
         return _FileWriter(self._abs(volume, path))
 
     def read_file_at(self, volume: str, path: str):
-        return _FileReadAt(self._abs(volume, path))
+        if _fault.armed("disk"):
+            _fault.inject("disk", self._endpoint, "read_file_at")
+        return _FileReadAt(self._abs(volume, path), self._endpoint)
 
     def rename_file(self, src_volume: str, src_path: str, dst_volume: str,
                     dst_path: str) -> None:
