@@ -29,7 +29,7 @@ class Node:
                  region: str = "us-east-1"):
         self.endpoints: list[Endpoint] = parse_endpoints(endpoint_args)
         self.local_url = local_url.rstrip("/")
-        self._start = time.time()
+        self._start = time.monotonic()  # uptime() measures a duration
 
         #: disk path -> XLStorage (this node's disks, served over RPC)
         self.local_disks: dict[str, XLStorage] = {}
@@ -74,7 +74,7 @@ class Node:
         self.default_parity = default_parity
 
     def uptime(self) -> float:
-        return time.time() - self._start
+        return time.monotonic() - self._start
 
     def layout_fingerprint(self) -> dict:
         return {"endpoints": [str(e) for e in self.endpoints],

@@ -117,8 +117,14 @@ class RPCClient:
             if self.on_reconnect is not None:
                 try:
                     self.on_reconnect(self)
-                except Exception:  # noqa: BLE001 — a broken hook must
-                    pass  # not kill the daemon or the online flip
+                except Exception as e:  # noqa: BLE001 — a broken hook
+                    # must not kill the daemon or the online flip, but
+                    # must not vanish either (graftlint GL007)
+                    from ..obs.logger import log_sys
+                    log_sys().log_once(
+                        f"rpc-reconnect:{type(e).__name__}", "warning",
+                        "rpc", f"on_reconnect hook failed for "
+                        f"{self.base}: {e!r}")
             return
 
     def call(self, method: str, params: dict | None = None,

@@ -23,6 +23,7 @@ from dataclasses import dataclass, field
 import numpy as np
 
 from .. import fault as _fault
+from ..obs import spans as _spans
 from ..utils import errors
 from .codec import Erasure, ceil_div
 
@@ -235,7 +236,8 @@ def parallel_write_shards(writers: list, shards: list[np.ndarray],
         if w is None:
             errs[i] = errors.DiskNotFound()
             continue
-        futs[i] = io_pool().submit(w.write, shards[i].tobytes())
+        futs[i] = io_pool().submit(_spans.wrap_ctx(w.write),
+                                   shards[i].tobytes())
     for i, f in futs.items():
         try:
             f.result()
@@ -308,15 +310,19 @@ class _OrderedWriter:
                 self._dead = e
                 out.set_exception(e)
 
+        # bind the span context at ENQUEUE time — by the time the chained
+        # callback fires, the executing thread is an arbitrary pool one
+        wrapped = _spans.wrap_ctx(run)
         prev, self._last = self._last, out
         if prev is None:
-            io_pool().submit(run)
+            io_pool().submit(wrapped)
         else:
             # always hop to the pool: add_done_callback runs inline in the
             # CALLING thread when prev is already done, which would pull
             # the blocking write onto the encoder thread and serialize the
             # whole fan-out
-            prev.add_done_callback(lambda _f: io_pool().submit(run))
+            prev.add_done_callback(
+                lambda _f: io_pool().submit(wrapped))
         return out
 
 
@@ -389,9 +395,10 @@ def erasure_encode(erasure: Erasure, stream, writers: list,
             nonlocal fd_offset
             off = fd_offset
             fd_offset += native.framed_len(shard_len, chunk)
-            return ("fd", encode_pool().submit(fd_block, buf, shard_len,
+            # pure CPU kernel work — records no spans, so no ctx handoff
+            return ("fd", encode_pool().submit(fd_block, buf, shard_len,  # graftlint: disable=GL005
                                                off), shard_len)
-        fut = encode_pool().submit(
+        fut = encode_pool().submit(  # graftlint: disable=GL005 — pure kernel compute
             native.put_block, buf, len(buf), pmat, k, m, shard_len, chunk,
             HIGHWAY_KEY, algo_id,
             out=pool.get((k + m) * native.framed_len(shard_len, chunk)))
@@ -591,7 +598,8 @@ class _ParallelReader:
                     continue
                 fn = self.readers[i].read_at_raw if raw \
                     else self.readers[i].read_at
-                f = io_pool().submit(fn, shard_offset, shard_len)
+                f = io_pool().submit(_spans.wrap_ctx(fn), shard_offset,
+                                     shard_len)
                 pending[f] = i
                 t_launch[f] = time.monotonic()
                 return i
@@ -724,8 +732,9 @@ def erasure_decode(erasure: Erasure, writer, readers: list, offset: int,
         """Concurrently read the k data shards' framed spans; on any read
         failure mark the reader dead and return None (the caller falls back
         to the generic replacement-read path for this block)."""
-        futs = {io_pool().submit(preader.readers[i].read_framed,
-                                 shard_offset, shard_len): i
+        futs = {io_pool().submit(
+                    _spans.wrap_ctx(preader.readers[i].read_framed),
+                    shard_offset, shard_len): i
                 for i in range(k)}
         out: list = [None] * k
         failed = False
@@ -788,13 +797,14 @@ def erasure_decode(erasure: Erasure, writer, readers: list, offset: int,
             except (AttributeError, OSError):
                 fds = None
             if fds is not None:
-                fut = encode_pool().submit(pread_block, fds, offs,
+                # pure CPU kernel work — records no spans
+                fut = encode_pool().submit(pread_block, fds, offs,  # graftlint: disable=GL005
                                            shard_len, out_dest)
                 return ["native", fut, b, block_data_len, boff, blen,
                         dest]
             framed = read_framed_k(shard_offset, shard_len)
             if framed is not None:
-                fut = encode_pool().submit(
+                fut = encode_pool().submit(  # graftlint: disable=GL005 — pure kernel compute
                     native.get_block, framed, k, shard_len, fuse_chunk,
                     HIGHWAY_KEY, get_algo_id,
                     out=out_dest if out_dest is not None

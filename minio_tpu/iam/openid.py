@@ -60,11 +60,19 @@ class OpenIDProvider:
         self.hmac_secret = hmac_secret
         self.timeout = timeout_s
         self._keys: dict[str, tuple[int, int]] = {}  # kid -> (n, e)
-        self._fetched_at = 0.0
+        # monotonic TTL clocks (never persisted): an NTP step must not
+        # re-fetch the JWKS early nor pin a stale one. Seeded one full
+        # window in the past so the first check is always "stale" even
+        # on a freshly-booted machine where monotonic() is small.
+        self._fetched_at = -JWKS_TTL_S
         self._disc_doc: dict | None = None
-        self._disc_at = 0.0
-        self._forced_at = 0.0
+        self._disc_at = -JWKS_TTL_S
+        self._forced_at = -FORCED_REFRESH_COOLDOWN_S
         self._lock = threading.Lock()
+        #: guards JWKS refresh single-flight; shares self._lock so every
+        #: state read below stays under the one lock
+        self._cv = threading.Condition(self._lock)
+        self._fetching = False
 
     def configured(self) -> bool:
         return bool(self.jwks_url or self.config_url or self.hmac_secret)
@@ -78,11 +86,11 @@ class OpenIDProvider:
         if not self.config_url:
             return {}
         with self._lock:
-            if time.time() - self._disc_at < JWKS_TTL_S:
+            if time.monotonic() - self._disc_at < JWKS_TTL_S:
                 # fresh success OR recent attempt (negative cache): a
                 # down IdP must not be re-fetched per anonymous request
                 return self._disc_doc or {}
-            self._disc_at = time.time()  # claim the fetch slot
+            self._disc_at = time.monotonic()  # claim the fetch slot
         try:
             with urllib.request.urlopen(self.config_url,
                                         timeout=self.timeout) as r:
@@ -105,28 +113,43 @@ class OpenIDProvider:
         self.jwks_url = doc["jwks_uri"]
         return self.jwks_url
 
+    def _keys_fresh(self) -> bool:
+        return bool(self._keys) and \
+            time.monotonic() - self._fetched_at < JWKS_TTL_S
+
     def _refresh_keys(self, force: bool = False) -> None:
-        if not force and self._keys and \
-                time.time() - self._fetched_at < JWKS_TTL_S:
+        """Fetch/refresh the JWKS. The IdP round-trip happens OUTSIDE
+        the provider lock (graftlint GL002 finding: the fetch used to
+        run under ``self._lock``, so one slow IdP round-trip queued
+        every concurrent token validation behind the network); a
+        single-flight flag keeps it to one fetch per TTL window while
+        waiters block on the condition, not on a held lock."""
+        if not force and self._keys_fresh():
             return
-        with self._lock:
-            if not force and self._keys and \
-                    time.time() - self._fetched_at < JWKS_TTL_S:
+        with self._cv:
+            # budget covers the fetcher's worst case: discovery
+            # round-trip + JWKS round-trip, each bounded by self.timeout
+            deadline = time.monotonic() + 2.0 * self.timeout + 1.0
+            while self._fetching:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0 or not self._cv.wait(timeout=remaining):
+                    break  # fetcher wedged past its own timeout
+            if not force and self._keys_fresh():
                 return
-            try:
-                url = self._discover_jwks_url()
-                with urllib.request.urlopen(url, timeout=self.timeout) as r:
-                    doc = json.loads(r.read())
-            except Exception as e:  # noqa: BLE001
+            if self._fetching:
+                # timed out waiting on a wedged fetcher: serve cached
+                # keys if any rather than piling on the IdP
                 if self._keys:
-                    # IdP briefly unreachable: keep serving with the
-                    # cached keys rather than failing every STS request;
-                    # back off further fetches for one TTL window.
-                    self._fetched_at = time.time()
                     return
-                raise ValueError(f"openid: JWKS fetch failed: {e}") \
-                    from None
-            keys = {}
+                raise ValueError("openid: JWKS fetch already in flight")
+            self._fetching = True
+        ok = False
+        err: Exception | None = None
+        keys: dict[str, tuple[int, int]] = {}
+        try:
+            url = self._discover_jwks_url()
+            with urllib.request.urlopen(url, timeout=self.timeout) as r:
+                doc = json.loads(r.read())
             for jwk in doc.get("keys", []):
                 if jwk.get("kty") != "RSA":
                     continue
@@ -134,18 +157,40 @@ class OpenIDProvider:
                 n = int.from_bytes(_b64url_decode(jwk["n"]), "big")
                 e = int.from_bytes(_b64url_decode(jwk["e"]), "big")
                 keys[kid] = (n, e)
-            self._keys = keys
-            self._fetched_at = time.time()
+            ok = True
+        except Exception as e:  # noqa: BLE001 — handled below
+            err = e
+        finally:
+            # ALWAYS unwedge the single-flight flag — a malformed JWKS
+            # document (or even a BaseException) must fail only this
+            # call, never leave every future waiter stuck behind
+            # _fetching=True. Failure also stamps _fetched_at: back off
+            # further fetches for one TTL window instead of hammering a
+            # down IdP.
+            with self._cv:
+                self._fetching = False
+                self._fetched_at = time.monotonic()
+                if ok:
+                    self._keys = keys
+                self._cv.notify_all()
+        if not ok:
+            # IdP briefly unreachable: keep serving with the cached
+            # keys rather than failing every STS request.
+            if self._keys:
+                return
+            raise ValueError(f"openid: JWKS fetch failed: {err}") \
+                from None
 
     def _key_for(self, kid: str) -> tuple[int, int] | None:
         self._refresh_keys()
         key = self._keys.get(kid)
         if key is None and kid and \
-                time.time() - self._forced_at > FORCED_REFRESH_COOLDOWN_S:
+                time.monotonic() - self._forced_at > \
+                FORCED_REFRESH_COOLDOWN_S:
             # unknown kid: the IdP may have rotated — one forced refresh,
             # rate-limited (unauthenticated STS callers must not be able
             # to drive a fetch to the IdP per request)
-            self._forced_at = time.time()
+            self._forced_at = time.monotonic()
             self._refresh_keys(force=True)
             key = self._keys.get(kid)
         if key is None and len(self._keys) == 1 and not kid:

@@ -234,7 +234,8 @@ class ErasureObjects(MultipartMixin, ObjectLayer):
             if d is None:
                 errs[i] = errors.DiskNotFound()
                 continue
-            futs[i] = meta_pool().submit(d.make_vol, bucket)
+            futs[i] = meta_pool().submit(
+                _spans.wrap_ctx(d.make_vol), bucket)
         for i, f in futs.items():
             try:
                 f.result()
@@ -287,7 +288,8 @@ class ErasureObjects(MultipartMixin, ObjectLayer):
             if d is None:
                 errs[i] = errors.DiskNotFound()
                 continue
-            futs[i] = meta_pool().submit(d.delete_vol, bucket, force)
+            futs[i] = meta_pool().submit(
+                _spans.wrap_ctx(d.delete_vol), bucket, force)
         for i, f in futs.items():
             try:
                 f.result()
@@ -644,7 +646,7 @@ class ErasureObjects(MultipartMixin, ObjectLayer):
                     errs[i] = errors.DiskNotFound()
                     continue
                 futs[i] = meta_pool().submit(
-                    d.delete_version, bucket, object, fi)
+                    _spans.wrap_ctx(d.delete_version), bucket, object, fi)
             for i, f in futs.items():
                 try:
                     f.result()

@@ -214,7 +214,10 @@ class _CacheState:
     def usable(self, cur_seq: int, dirty_at: float = 0.0) -> bool:
         if self.error is not None:
             return False
-        if time.time() - self.created > CACHE_TTL_S:
+        # wall clock is CORRECT here: `created` is persisted in the
+        # manifest and compared against other nodes' clocks/dirty marks,
+        # so a monotonic stamp would be meaningless across processes
+        if time.time() - self.created > CACHE_TTL_S:  # graftlint: disable=GL001
             return False
         # a locally-observed write after creation invalidates. Local
         # states compare write sequences; manifests loaded from disk

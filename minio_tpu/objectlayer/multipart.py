@@ -14,6 +14,7 @@ import msgpack
 
 from ..erasure import Erasure, new_bitrot_writer
 from ..erasure.streaming import erasure_encode
+from ..obs import spans as _spans
 from ..storage.datatypes import ErasureInfo, FileInfo, ObjectPartInfo
 from ..storage.xlstorage import META_MULTIPART, META_TMP
 from ..utils import errors
@@ -84,7 +85,8 @@ class MultipartMixin:
                 fi.erasure, index=fi.erasure.distribution[i]),
                 metadata=dict(fi.metadata))
             futs[i] = meta_pool().submit(
-                d.write_metadata, META_MULTIPART, upath, fij)
+                _spans.wrap_ctx(d.write_metadata), META_MULTIPART, upath,
+                fij)
         for i, f in futs.items():
             try:
                 f.result()
@@ -344,8 +346,8 @@ class MultipartMixin:
                 continue
             shard_idx = fis[i].erasure.index
             futs[i] = meta_pool().submit(
-                self._commit_one_disk, d, upath, tmp_id, fi, shard_idx,
-                parts, bucket, object)
+                _spans.wrap_ctx(self._commit_one_disk), d, upath, tmp_id,
+                fi, shard_idx, parts, bucket, object)
         for i, f in futs.items():
             try:
                 f.result()

@@ -1,0 +1,383 @@
+"""Runtime lock-order race detector (the Python stand-in for Go's
+``-race`` + the lock-rank assertions the reference relies on in CI).
+
+Enabled under ``MINIO_TPU_LOCKRANK=1`` (tests turn it on by default via
+``tests/conftest.py``), :func:`install` patches ``threading.Lock`` /
+``threading.RLock`` with factories that hand **tracked** locks to code
+whose *creating frame* lives in ``minio_tpu`` or the test tree — stdlib,
+JAX and every other library keep raw locks, so the interpreter's own
+locking is never perturbed.
+
+Each tracked acquire pushes onto a per-thread held-lock stack and adds
+an edge ``(top-of-stack site) -> (new site)`` to the global lock-order
+graph, where a *site* is the ``file:line`` that created the lock (one
+node per static lock site — instance churn does not grow the graph).
+Two detectors run on top:
+
+* **Cycle (potential ABBA deadlock)**: when a new edge closes a cycle in
+  the order graph, a report records the cycle's sites and the full
+  acquisition stack captured at each edge's first observation — i.e.
+  where B was first taken while A was held, and vice versa.
+* **Lock held across a device flush**: ``runtime/dispatch.py`` calls
+  :func:`note_blocking` at its device-flush boundary; if the flushing
+  thread holds any tracked lock, a report names the held locks (with
+  their acquisition sites) and the flush stack. A lock held across a
+  multi-millisecond XLA launch is a convoy generator even when it never
+  deadlocks.
+
+Hot-path cost per acquire is one thread-local list push and one dict
+membership test; full stacks are only captured the first time an edge
+appears (edges are as static as the code), so steady state adds no
+tracebacks. Reports accumulate in-process (bounded) and are read with
+:func:`reports`; ``tests/test_lockrank.py`` drives both detectors.
+
+Env knobs (docs/static-analysis.md):
+
+* ``MINIO_TPU_LOCKRANK`` — "1" activates install() (conftest default).
+* ``MINIO_TPU_LOCKRANK_FRAMES`` — stack depth kept per edge (default 8).
+* ``MINIO_TPU_LOCKRANK_MAX_REPORTS`` — report ring cap (default 64).
+"""
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import traceback
+
+_ORIG_LOCK = threading.Lock
+_ORIG_RLOCK = threading.RLock
+
+_FRAMES = int(os.environ.get("MINIO_TPU_LOCKRANK_FRAMES", "8"))
+_MAX_REPORTS = int(os.environ.get("MINIO_TPU_LOCKRANK_MAX_REPORTS", "64"))
+
+#: package prefixes whose lock creations get tracked
+_TRACK_PREFIXES = ("minio_tpu", "tests", "test_", "conftest",
+                   "tools.graftlint")
+
+_installed = False
+_enabled = False
+
+# all graph/report state below is guarded by an UNtracked lock
+_meta = _ORIG_LOCK()
+_graph: dict[str, set[str]] = {}          # site -> successor sites
+_edge_stacks: dict[tuple[str, str], dict] = {}   # first-sight evidence
+_reports: list[dict] = []
+_suppressed_reports = 0
+
+
+class _State(threading.local):
+    def __init__(self):
+        self.held: list["TrackedLock"] = []
+        self.counts: dict[int, int] = {}   # id(lock) -> reentry depth
+
+
+_state = _State()
+
+
+def enabled() -> bool:
+    return _enabled
+
+
+def _caller_site(depth: int) -> str:
+    try:
+        f = sys._getframe(depth)
+        return f"{f.f_code.co_filename.rsplit('/', 1)[-1]}:{f.f_lineno}"
+    except Exception:  # pragma: no cover — frame depth off the stack
+        return "?"
+
+
+def _stack() -> str:
+    """Formatted acquisition stack, lockrank's own frames dropped."""
+    here = os.path.abspath(__file__)
+    frames = [f for f in traceback.extract_stack()
+              if os.path.abspath(f.filename) != here]
+    return "".join(traceback.format_list(frames[-_FRAMES:]))
+
+
+def _add_report(rep: dict) -> None:
+    global _suppressed_reports
+    with _meta:
+        if len(_reports) < _MAX_REPORTS:
+            _reports.append(rep)
+        else:
+            _suppressed_reports += 1
+
+
+def _find_path(src: str, dst: str) -> list[str] | None:
+    """DFS path src -> dst in the order graph (meta lock held)."""
+    stack = [(src, [src])]
+    seen = {src}
+    while stack:
+        node, path = stack.pop()
+        for nxt in _graph.get(node, ()):
+            if nxt == dst:
+                return path + [dst]
+            if nxt not in seen:
+                seen.add(nxt)
+                stack.append((nxt, path + [nxt]))
+    return None
+
+
+class TrackedLock:
+    """Lock/RLock wrapper feeding the per-thread held stack and the
+    global order graph. Supports the full lock protocol plus the private
+    Condition hooks (``_is_owned``/``_release_save``/``_acquire_restore``)
+    so a tracked lock can back a ``threading.Condition``."""
+
+    __slots__ = ("_inner", "site", "name", "_reentrant")
+
+    def __init__(self, inner, site: str, name: str = "",
+                 reentrant: bool = False):
+        self._inner = inner
+        self.site = site
+        self.name = name or site
+        self._reentrant = reentrant
+
+    # -- tracking ------------------------------------------------------------
+
+    def _note_acquired(self) -> None:
+        if not _enabled:
+            return
+        try:
+            self._note_acquired_inner()
+        except Exception:  # detector must never break the locked code
+            pass
+
+    def _note_acquired_inner(self) -> None:
+        st = _state
+        if self._reentrant:
+            n = st.counts.get(id(self), 0)
+            st.counts[id(self)] = n + 1
+            if n:                       # reentry: no new order edge
+                return
+        if st.held:
+            top = st.held[-1]
+            if top.site != self.site:
+                self._note_edge(top)
+        st.held.append(self)
+
+    def _note_edge(self, top: "TrackedLock") -> None:
+        edge = (top.site, self.site)
+        if edge in _edge_stacks:        # GIL-atomic fast path
+            return
+        evidence = {
+            "edge": f"{top.name} -> {self.name}",
+            "thread": threading.current_thread().name,
+            "stack": _stack(),
+        }
+        with _meta:
+            if edge in _edge_stacks:
+                return
+            _edge_stacks[edge] = evidence
+            _graph.setdefault(top.site, set()).add(self.site)
+            # does the new edge close a cycle? (path new.dst -> new.src)
+            path = _find_path(self.site, top.site)
+        if path is None:
+            return
+        cycle = [top.site] + path
+        with _meta:
+            edges = []
+            for a, b in zip(cycle, cycle[1:]):
+                ev = _edge_stacks.get((a, b))
+                if ev:
+                    edges.append(dict(ev))
+        _add_report({
+            "kind": "lock-order-cycle",
+            "locks": sorted({top.name, self.name} |
+                            {s for s in cycle}),
+            "cycle": cycle,
+            "edges": edges,
+            "thread": threading.current_thread().name,
+        })
+
+    def _note_released(self) -> None:
+        if not _enabled:
+            return
+        st = _state
+        if self._reentrant:
+            n = st.counts.get(id(self), 0)
+            if n > 1:
+                st.counts[id(self)] = n - 1
+                return
+            st.counts.pop(id(self), None)
+        # locks are not always released LIFO — remove by identity
+        for i in range(len(st.held) - 1, -1, -1):
+            if st.held[i] is self:
+                del st.held[i]
+                break
+
+    # -- lock protocol -------------------------------------------------------
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        ok = self._inner.acquire(blocking, timeout)
+        if ok:
+            self._note_acquired()
+        return ok
+
+    def release(self) -> None:
+        self._note_released()
+        self._inner.release()
+
+    def locked(self) -> bool:
+        inner_locked = getattr(self._inner, "locked", None)
+        return inner_locked() if inner_locked is not None else False
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    def __repr__(self) -> str:  # pragma: no cover — debugging aid
+        return f"<TrackedLock {self.name} inner={self._inner!r}>"
+
+    # -- threading.Condition integration (RLock only) ------------------------
+
+    def _is_owned(self):
+        inner = getattr(self._inner, "_is_owned", None)
+        if inner is not None:
+            return inner()
+        # plain-lock fallback (same probe threading.Condition uses)
+        if self._inner.acquire(False):
+            self._inner.release()
+            return False
+        return True
+
+    def _release_save(self):
+        st = _state
+        # full release regardless of reentry depth: drop the count FIRST
+        # so _note_released takes the remove-from-held path
+        count = st.counts.pop(id(self), 1) if self._reentrant else 1
+        if self._reentrant:
+            st.counts[id(self)] = 1
+        self._note_released()
+        inner_rs = getattr(self._inner, "_release_save", None)
+        inner_state = inner_rs() if inner_rs is not None \
+            else self._inner.release()
+        return (inner_state, count)
+
+    def _acquire_restore(self, state):
+        inner_state, count = state
+        inner_ar = getattr(self._inner, "_acquire_restore", None)
+        if inner_ar is not None:
+            inner_ar(inner_state)
+        else:
+            self._inner.acquire()
+        self._note_acquired()
+        if self._reentrant and count > 1:
+            _state.counts[id(self)] = count
+
+
+def _should_track() -> bool:
+    """Does the frame creating this lock belong to tracked code?
+    (factory frame 0 -> patched Lock() caller frame 2)."""
+    try:
+        mod = sys._getframe(2).f_globals.get("__name__", "")
+    except Exception:  # pragma: no cover
+        return False
+    return mod.startswith(_TRACK_PREFIXES)
+
+
+def _lock_factory():
+    inner = _ORIG_LOCK()
+    if not _enabled or not _should_track():
+        return inner
+    return TrackedLock(inner, _caller_site(2))
+
+
+def _rlock_factory():
+    inner = _ORIG_RLOCK()
+    if not _enabled or not _should_track():
+        return inner
+    return TrackedLock(inner, _caller_site(2), reentrant=True)
+
+
+def tracked(name: str, reentrant: bool = False) -> TrackedLock:
+    """Explicitly named tracked lock (tests, long-lived subsystem
+    locks that want readable cycle reports)."""
+    inner = _ORIG_RLOCK() if reentrant else _ORIG_LOCK()
+    # the NAME is the graph node: two named locks created by one line
+    # (or one factory) must stay distinct order-graph sites
+    return TrackedLock(inner, name, name=name, reentrant=reentrant)
+
+
+def install() -> bool:
+    """Patch the threading lock factories. Idempotent; no-op unless
+    MINIO_TPU_LOCKRANK=1 (callers may also force via install after
+    setting the env)."""
+    global _installed, _enabled
+    if os.environ.get("MINIO_TPU_LOCKRANK", "0") != "1":
+        return False
+    _enabled = True
+    if _installed:
+        return True
+    threading.Lock = _lock_factory
+    threading.RLock = _rlock_factory
+    _installed = True
+    return True
+
+
+def uninstall() -> None:
+    """Restore the original factories and stop tracking (existing
+    TrackedLock instances keep working, silently)."""
+    global _installed, _enabled
+    _enabled = False
+    if _installed:
+        threading.Lock = _ORIG_LOCK
+        threading.RLock = _ORIG_RLOCK
+        _installed = False
+
+
+def note_blocking(what: str) -> None:
+    """Hook for known-blocking boundaries (device flush): report if the
+    calling thread holds any tracked lock. Zero-cost when disabled."""
+    if not _enabled:
+        return
+    held = _state.held
+    if not held:
+        return
+    _add_report({
+        "kind": "lock-held-across-blocking",
+        "what": what,
+        "locks": [lk.name for lk in held],
+        "lock_sites": [lk.site for lk in held],
+        "stack": _stack(),
+        "thread": threading.current_thread().name,
+    })
+
+
+def held_names() -> list[str]:
+    return [lk.name for lk in _state.held]
+
+
+def reports(kind: str | None = None) -> list[dict]:
+    with _meta:
+        out = [dict(r) for r in _reports]
+    return [r for r in out if kind is None or r["kind"] == kind]
+
+
+def suppressed_report_count() -> int:
+    with _meta:
+        return _suppressed_reports
+
+
+def clear() -> None:
+    """Drop accumulated graph + reports (test isolation)."""
+    global _suppressed_reports
+    with _meta:
+        _graph.clear()
+        _edge_stacks.clear()
+        _reports.clear()
+        _suppressed_reports = 0
+
+
+def stats() -> dict:
+    with _meta:
+        return {
+            "sites": len(_graph),
+            "edges": len(_edge_stacks),
+            "reports": len(_reports),
+            "suppressed": _suppressed_reports,
+            "enabled": _enabled,
+        }

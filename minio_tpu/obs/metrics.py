@@ -20,7 +20,7 @@ import os
 import threading
 import time
 
-_start = time.time()
+_start = time.monotonic()  # uptime is a duration: NTP-step-proof
 _lock = threading.Lock()
 _counters: dict[str, float] = {}
 _histograms: dict[str, list[float]] = {}
@@ -118,7 +118,7 @@ def _g_software(server) -> list[str]:
     from .. import __version__
     return [
         "# TYPE minio_tpu_uptime_seconds gauge",
-        f"minio_tpu_uptime_seconds {time.time() - _start:.1f}",
+        f"minio_tpu_uptime_seconds {time.monotonic() - _start:.1f}",
         "# TYPE minio_tpu_info gauge",
         f'minio_tpu_info{{version="{__version__}"}} 1',
     ]

@@ -102,7 +102,8 @@ def start(kind: str) -> dict:
                 # so the profiler is usable again without a download
                 _active = None
             else:
-                age = time.time() - _active.get("started_at", time.time())
+                age = time.monotonic() - _active.get(
+                    "started_mono", time.monotonic())
                 state = "running"
                 if sampler is not None and sampler._halt.is_set():
                     state = "halted"
@@ -121,7 +122,8 @@ def start(kind: str) -> dict:
             _active = {"kind": kind}
         else:
             raise ValueError(f"unknown profiler type {kind!r}")
-        _active["started_at"] = time.time()
+        _active["started_at"] = time.time()     # API timestamp (wall)
+        _active["started_mono"] = time.monotonic()  # age arithmetic
         return {"kind": kind, "started_at": _active["started_at"]}
 
 
@@ -212,7 +214,8 @@ def health_info(server) -> dict:
     info["memory"] = mem
     # process
     info["process"] = {"pid": os.getpid(),
-                       "uptime_s": round(time.time() - _proc_start, 1),
+                       "uptime_s": round(
+                           time.monotonic() - _proc_start, 1),
                        "threads": threading.active_count()}
     # drives: capacity + a small write/read latency probe per local disk
     from .metrics import _all_disks
@@ -270,4 +273,4 @@ def health_info(server) -> dict:
     return info
 
 
-_proc_start = time.time()
+_proc_start = time.monotonic()  # uptime is a duration, not a timestamp

@@ -514,8 +514,8 @@ def _collect_loop(q) -> None:
         tid, peers = q.get()
         try:
             collect_fragments(tid, peers)
-        except Exception:  # noqa: BLE001 — best-effort enrichment
-            pass
+        except Exception:  # noqa: BLE001 — best-effort enrichment,
+            _drop("peer_collect")  # but never silently (graftlint GL007)
 
 
 def collect_fragments(trace_id: str, peers) -> None:

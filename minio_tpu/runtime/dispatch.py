@@ -50,6 +50,7 @@ from dataclasses import dataclass, field
 import numpy as np
 
 from ..obs import latency as _lat
+from ..obs import lockrank as _lr
 from ..obs import spans as _sp
 from ..obs import trace as _trc
 from .. import qos as _qos
@@ -736,6 +737,9 @@ class DispatchQueue:
             self._probe_failed_at = time.monotonic()
 
     def _flush_device(self, b: _Bucket, items: list[_Pending]):
+        # a lock held across an XLA launch is a convoy generator even
+        # when it never deadlocks — lockrank reports the holder's stack
+        _lr.note_blocking(f"device_flush:{b.op}")
         trace_done = self._flush_trace_cb(b, items, "device")
         span_done = self._flush_span_cb(b, items, "device")
         import jax.numpy as jnp

@@ -13,6 +13,7 @@ import threading
 import time
 from concurrent.futures import ThreadPoolExecutor
 
+from ..obs import spans as _spans
 from ..storage.xlstorage import META_BUCKET
 from ..utils import errors
 
@@ -99,8 +100,8 @@ class GlobalHealer:
                     if rb == b.name and ro and oi.name <= ro:
                         continue
                     futs.append((pool.submit(
-                        self._heal_one, b.name, oi.name, scan_mode),
-                        b.name, oi.name))
+                        _spans.wrap_ctx(self._heal_one), b.name, oi.name,
+                        scan_mode), b.name, oi.name))
                     if len(futs) >= max_inflight:
                         reap()
             while futs:
@@ -170,8 +171,12 @@ class AutoHealMonitor:
                 return
             try:
                 self.check_and_heal()
-            except Exception:  # noqa: BLE001
-                pass
+            except Exception as e:  # noqa: BLE001 — loop survives, but
+                # a persistent failure must be visible (graftlint GL007)
+                from ..obs.logger import log_sys
+                log_sys().log_once(
+                    f"autoheal:{type(e).__name__}", "warning", "autoheal",
+                    f"background heal cycle failed: {e!r}")
 
     def check_and_heal(self) -> bool:
         tracked = [(d, t) for d in self.local_disks

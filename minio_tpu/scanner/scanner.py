@@ -60,8 +60,12 @@ class DataScanner:
                 # background class for the QoS dispatch scheduler
                 with qos.background():
                     self.scan_cycle()
-            except Exception:  # noqa: BLE001 — scanner must never die
-                pass
+            except Exception as e:  # noqa: BLE001 — scanner must never
+                # die, but also never fail silently (graftlint GL007)
+                from ..obs.logger import log_sys
+                log_sys().log_once(
+                    f"scanner:{type(e).__name__}", "warning", "scanner",
+                    f"scan cycle failed: {e!r}")
 
     def scan_cycle(self) -> dict:
         """One crawl; returns the usage snapshot (also persisted). Buckets

@@ -589,7 +589,8 @@ def _trace(h) -> None:
                             mx.inc("minio_tpu_trace_dropped_total",
                                    reason="slow_subscriber")
                 except Exception:  # noqa: BLE001 — peer died mid-stream
-                    pass
+                    mx.inc("minio_tpu_trace_dropped_total",
+                           reason="peer_stream_error")
 
             threading.Thread(target=pump, daemon=True,
                              name="admin-trace-pump").start()

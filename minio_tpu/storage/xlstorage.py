@@ -211,6 +211,10 @@ class XLStorage(StorageAPI):
         self._disk_id = disk_id
 
     def disk_info(self) -> DiskInfo:
+        with self._op("disk_info", ""):
+            return self._disk_info_inner()
+
+    def _disk_info_inner(self) -> DiskInfo:
         st = os.statvfs(self.base)
         total = st.f_blocks * st.f_frsize
         free = st.f_bavail * st.f_frsize
@@ -221,38 +225,43 @@ class XLStorage(StorageAPI):
     # --- volumes ------------------------------------------------------------
 
     def make_vol(self, volume: str) -> None:
-        p = self._abs(volume)
-        if os.path.isdir(p):
-            raise errors.VolumeExists(volume)
-        os.makedirs(p, exist_ok=True)
+        with self._op("make_vol", volume):
+            p = self._abs(volume)
+            if os.path.isdir(p):
+                raise errors.VolumeExists(volume)
+            os.makedirs(p, exist_ok=True)
 
     def list_vols(self) -> list[VolInfo]:
-        out = []
-        for name in sorted(os.listdir(self.base)):
-            if name == META_BUCKET:
-                continue
-            p = os.path.join(self.base, name)
-            if os.path.isdir(p):
-                out.append(VolInfo(name=name, created=os.stat(p).st_ctime))
-        return out
+        with self._op("list_vols", ""):
+            out = []
+            for name in sorted(os.listdir(self.base)):
+                if name == META_BUCKET:
+                    continue
+                p = os.path.join(self.base, name)
+                if os.path.isdir(p):
+                    out.append(VolInfo(name=name,
+                                       created=os.stat(p).st_ctime))
+            return out
 
     def stat_vol(self, volume: str) -> VolInfo:
-        p = self._abs(volume)
-        if not os.path.isdir(p):
-            raise errors.VolumeNotFound(volume)
-        return VolInfo(name=volume, created=os.stat(p).st_ctime)
+        with self._op("stat_vol", volume):
+            p = self._abs(volume)
+            if not os.path.isdir(p):
+                raise errors.VolumeNotFound(volume)
+            return VolInfo(name=volume, created=os.stat(p).st_ctime)
 
     def delete_vol(self, volume: str, force: bool = False) -> None:
-        p = self._abs(volume)
-        if not os.path.isdir(p):
-            raise errors.VolumeNotFound(volume)
-        if force:
-            shutil.rmtree(p)
-            return
-        try:
-            os.rmdir(p)
-        except OSError:
-            raise errors.VolumeNotEmpty(volume) from None
+        with self._op("delete_vol", volume):
+            p = self._abs(volume)
+            if not os.path.isdir(p):
+                raise errors.VolumeNotFound(volume)
+            if force:
+                shutil.rmtree(p)
+                return
+            try:
+                os.rmdir(p)
+            except OSError:
+                raise errors.VolumeNotEmpty(volume) from None
 
     # --- raw files ----------------------------------------------------------
 
@@ -472,7 +481,7 @@ class XLStorage(StorageAPI):
             self._purge_ddirs(volume, path, old_ddirs)
 
     def update_metadata(self, volume: str, path: str, fi: FileInfo) -> None:
-        with self._meta_lock:
+        with self._op("update_metadata", volume, path), self._meta_lock:
             meta = self._load_meta(volume, path)
             meta.find_version(fi.version_id)  # must exist
             meta.add_version(fi)
@@ -489,7 +498,9 @@ class XLStorage(StorageAPI):
             return meta.to_fileinfo(volume, path, version_id)
 
     def list_versions(self, volume: str, path: str) -> list[FileInfo]:
-        return self._load_meta(volume, path).list_versions(volume, path)
+        with self._op("list_versions", volume, path):
+            return self._load_meta(volume, path).list_versions(volume,
+                                                               path)
 
     def delete_version(self, volume: str, path: str, fi: FileInfo) -> None:
         with self._op("delete_version", volume, path), self._meta_lock:
@@ -563,10 +574,17 @@ class XLStorage(StorageAPI):
 
     def walk_dir(self, volume: str, dir_path: str = "",
                  recursive: bool = True) -> Iterator[str]:
+        # eager entry point (not a generator): volume validation and the
+        # chaos-harness hook fire at CALL time, before first next()
+        _fault.inject("disk", self._endpoint, "walk_dir")
         base = self._abs(volume)
         if not os.path.isdir(base):
             raise errors.VolumeNotFound(volume)
         root = os.path.join(base, dir_path) if dir_path else base
+        return self._walk_dir_inner(root, dir_path, recursive)
+
+    def _walk_dir_inner(self, root: str, dir_path: str,
+                        recursive: bool) -> Iterator[str]:
 
         def walk(d: str, rel: str) -> Iterator[str]:
             try:
@@ -601,9 +619,16 @@ class XLStorage(StorageAPI):
         treats non-leaf directories as ``name + "/"`` (the reference's
         trailing-slash convention) because a subtree's keys all carry the
         separator, which sorts differently from the bare dir name."""
+        # eager entry point (not a generator): validation + chaos hook
+        # fire at CALL time, before first next()
+        _fault.inject("disk", self._endpoint, "walk_versions")
         base = self._abs(volume)
         if not os.path.isdir(base):
             raise errors.VolumeNotFound(volume)
+        return self._walk_versions_inner(base, prefix, marker, limit)
+
+    def _walk_versions_inner(self, base: str, prefix: str, marker: str,
+                             limit: int) -> Iterator[tuple[str, bytes]]:
         high = "\U0010ffff"
         emitted = 0
 

@@ -20,3 +20,36 @@ if os.environ.get("MINIO_TPU_TEST_ON_DEVICE") != "1":
     import jax
 
     jax.config.update("jax_platforms", "cpu")
+
+# Runtime lock-order race detection (obs/lockrank.py) is ON by default
+# for the whole suite: every threading.Lock/RLock created by minio_tpu
+# code after this point is tracked, building the global lock-order graph
+# and reporting ABBA cycles / locks held across device flushes. Opt out
+# with MINIO_TPU_LOCKRANK=0. Installing here — before minio_tpu modules
+# import — is what lets module-level locks get wrapped too.
+if os.environ.get("MINIO_TPU_LOCKRANK", "") == "":
+    os.environ["MINIO_TPU_LOCKRANK"] = "1"
+if os.environ["MINIO_TPU_LOCKRANK"] == "1":
+    from minio_tpu.obs import lockrank
+
+    lockrank.install()
+
+
+def pytest_terminal_summary(terminalreporter, exitstatus, config):
+    """Surface accumulated lockrank reports at the end of the run so a
+    newly-introduced lock-order inversion is visible even when no test
+    asserted on it (tests/test_lockrank.py asserts the machinery)."""
+    try:
+        from minio_tpu.obs import lockrank
+    except Exception:  # pragma: no cover — lockrank absent
+        return
+    reps = lockrank.reports()  # test_lockrank clears its seeded ones
+    if not reps:
+        return
+    tw = terminalreporter
+    tw.section("lockrank reports")
+    for r in reps[:10]:
+        locks = ", ".join(r.get("locks", []))
+        tw.write_line(f"{r['kind']}: {locks} (thread {r['thread']})")
+    if len(reps) > 10:
+        tw.write_line(f"... {len(reps) - 10} more")

@@ -74,6 +74,23 @@ def test_server_cycles_do_not_leak_threads(tmp_path):
         f"thread leak: {baseline} at steady state, {n} after 3 cycles"
 
 
+def test_lint_run_spawns_no_daemon_threads():
+    """graftlint is pure AST analysis: a lint run must not start (or
+    leak) any thread — daemon or otherwise. Guards against a checker
+    growing an import of the checked code (whose modules DO start
+    daemons) or a parallel-walk 'optimization'. Linting the
+    daemon-heaviest subpackages suffices — if importing checked code
+    crept in, these are the modules that would spawn threads.
+    (test_lint.py::test_tree_is_clean pays for the full-tree pass.)"""
+    from tools import graftlint
+    before = {t.ident for t in threading.enumerate()}
+    fresh, _ = graftlint.run(["minio_tpu/scanner", "minio_tpu/runtime",
+                              "minio_tpu/obs"])
+    assert not fresh  # tier-1 cleanliness for these trees, re-asserted
+    grown = [t for t in threading.enumerate() if t.ident not in before]
+    assert not grown, f"lint run spawned threads: {grown}"
+
+
 def test_abandoned_hashreader_releases_ingest_slot():
     """An aborted upload (reader dropped mid-stream) must release its
     active-large-ingest slot via the GC backstop, or the adaptive MD5

@@ -1,0 +1,325 @@
+"""Tier-1 gate for graftlint (docs/static-analysis.md): the tree must
+carry zero unbaselined findings, all eight checkers must be active, and
+the suppression/baseline machinery must behave deterministically —
+checked here against synthetic sources so a checker regression fails
+loudly instead of silently passing a dirty tree."""
+import ast
+import json
+import os
+import sys
+import textwrap
+
+sys.path.insert(0, os.path.dirname(__file__))
+
+from tools import graftlint  # noqa: E402
+from tools.graftlint import checkers  # noqa: E402
+from tools.graftlint.__main__ import main as lint_main  # noqa: E402
+
+
+def ctx_for(src: str, path: str = "minio_tpu/_synthetic.py"):
+    """FileCtx from inline source, bypassing the filesystem."""
+    src = textwrap.dedent(src)
+    tree = ast.parse(src)
+    ctx = graftlint.FileCtx(path=path, abspath="/" + path, tree=tree,
+                            lines=src.splitlines())
+    ctx.scopes = graftlint._build_scopes(tree)
+    return ctx
+
+
+# --------------------------------------------------------------------------
+# the gate
+
+
+def test_tree_is_clean():
+    """THE tier-1 gate: `python -m tools.graftlint minio_tpu` green.
+    A new finding means either fix the site, pragma it with review
+    sign-off, or deliberately add it to baseline.json — never ignore."""
+    fresh, _old = graftlint.run(["minio_tpu"])
+    assert not fresh, "unbaselined graftlint findings:\n" + "\n".join(
+        f.render() for f in fresh)
+
+
+def test_eight_checkers_active():
+    assert len(checkers.PER_FILE) + len(checkers.PROJECT) >= 8
+
+
+def test_cli_clean_tree_exits_zero(capsys):
+    # one clean subpackage, not the whole tree — test_tree_is_clean
+    # already pays for the full pass; this asserts the CLI's exit-0
+    # contract without a second one
+    assert lint_main(["minio_tpu/obs"]) == 0
+
+
+def test_cli_reports_findings_and_exits_one(tmp_path, capsys):
+    p = tmp_path / "bad.py"
+    p.write_text(textwrap.dedent("""
+        import threading
+        _lock = threading.Lock()
+        def f():
+            _lock.acquire()
+            _lock.release()
+    """))
+    assert lint_main([str(p)]) == 1
+    out = capsys.readouterr().out
+    assert "GL003" in out and "bad.py" in out
+
+
+# --------------------------------------------------------------------------
+# per-checker positives / negatives
+
+
+def test_gl001_wall_clock_duration_flagged():
+    ctx = ctx_for("""
+        import time
+        def f():
+            t0 = time.time()
+            work()
+            return time.time() - t0
+    """)
+    found = checkers.check_wall_duration(ctx)
+    assert [f.checker for f in found] == ["GL001"]
+
+
+def test_gl001_timestamps_and_monotonic_ok():
+    ctx = ctx_for("""
+        import time
+        def stamp():
+            return {"mtime": time.time()}   # timestamp: fine
+        def dur():
+            t0 = time.monotonic()
+            return time.monotonic() - t0    # monotonic: fine
+    """)
+    assert not checkers.check_wall_duration(ctx)
+
+
+def test_gl001_tracks_self_attr_dataflow():
+    ctx = ctx_for("""
+        import time
+        class T:
+            def start(self):
+                self._t0 = time.time()
+            def lap(self):
+                return time.time() - self._t0
+    """)
+    assert len(checkers.check_wall_duration(ctx)) == 1
+
+
+def test_gl002_blocking_under_lock_flagged():
+    ctx = ctx_for("""
+        import threading, time
+        class T:
+            def __init__(self):
+                self._lock = threading.Lock()
+            def f(self):
+                with self._lock:
+                    time.sleep(1)
+    """)
+    found = checkers.check_blocking_under_lock(ctx)
+    assert len(found) == 1 and found[0].checker == "GL002"
+    assert "time.sleep" in found[0].message
+
+
+def test_gl002_cv_wait_on_held_condition_exempt():
+    ctx = ctx_for("""
+        import threading
+        class T:
+            def __init__(self):
+                self._cv = threading.Condition()
+            def f(self):
+                with self._cv:
+                    self._cv.wait()
+    """)
+    assert not checkers.check_blocking_under_lock(ctx)
+
+
+def test_gl002_deferred_bodies_not_lock_scope():
+    ctx = ctx_for("""
+        import threading, time
+        _lock = threading.Lock()
+        def f():
+            with _lock:
+                def later():
+                    time.sleep(1)   # runs after release — not a finding
+                return later
+    """)
+    assert not checkers.check_blocking_under_lock(ctx)
+
+
+def test_gl003_bare_acquire_flagged_with_ok():
+    ctx = ctx_for("""
+        import threading
+        _lock = threading.Lock()
+        def bad():
+            _lock.acquire()
+            try:
+                pass
+            finally:
+                _lock.release()
+        def good():
+            with _lock:
+                pass
+    """)
+    found = checkers.check_bare_acquire(ctx)
+    assert {f.checker for f in found} == {"GL003"} and len(found) == 2
+
+
+def test_gl004_undocumented_metric_flagged():
+    ctx = ctx_for("""
+        def f(store):
+            store.inc("minio_tpu_totally_undocumented_total", 1)
+    """)
+    found = checkers.check_metrics_documented([ctx])
+    assert len(found) == 1 and found[0].checker == "GL004"
+    assert "minio_tpu_totally_undocumented_total" in found[0].message
+
+
+def test_gl004_documented_metric_ok():
+    ctx = ctx_for("""
+        def f(store):
+            store.inc("minio_tpu_dispatch_batches_total", 1)
+    """)
+    assert not checkers.check_metrics_documented([ctx])
+
+
+def test_gl005_unwrapped_submit_flagged():
+    ctx = ctx_for("""
+        def fan_out(io_pool, fn):
+            return io_pool.submit(fn, 1)
+    """)
+    found = checkers.check_submit_wrap(ctx)
+    assert len(found) == 1 and found[0].checker == "GL005"
+
+
+def test_gl005_wrap_ctx_forms_ok():
+    ctx = ctx_for("""
+        from minio_tpu.obs.spans import wrap_ctx
+        def inline(io_pool, fn):
+            return io_pool.submit(wrap_ctx(fn), 1)
+        def bound(io_pool, fn):
+            w = wrap_ctx(fn)
+            return io_pool.submit(w, 1)
+        def untraced(plain_executor, fn):
+            return plain_executor.submit(fn)   # not a *pool* — out of scope
+    """)
+    assert not checkers.check_submit_wrap(ctx)
+
+
+def test_gl006_storage_op_without_hook_flagged():
+    ctx = ctx_for("""
+        class XLStorage:
+            def read_all(self, volume, path):
+                return open(path).read()
+            def stat_vol(self, volume):
+                with self._op("statvol", volume):
+                    return 1
+    """, path="minio_tpu/storage/xlstorage.py")
+    found = checkers.check_fault_hooks(ctx)
+    assert [f.token for f in found] == ["read_all"]
+
+
+def test_gl007_bare_except_and_daemon_swallow():
+    ctx = ctx_for("""
+        import threading
+        class Svc:
+            def start(self):
+                threading.Thread(target=self._loop, daemon=True).start()
+            def _loop(self):
+                while True:
+                    try:
+                        step()
+                    except Exception:
+                        pass            # silent forever — finding
+        def also_bad():
+            try:
+                step()
+            except:                     # bare — finding anywhere
+                pass
+        def fine():
+            try:
+                step()
+            except Exception as e:
+                log(e)                  # handled — ok
+    """)
+    found = checkers.check_swallowed_exceptions(ctx)
+    assert len(found) == 2
+    assert {f.token for f in found} == {"swallow:_loop", "bare-except"}
+
+
+def test_gl008_undocumented_dynamic_key_flagged():
+    ctx = ctx_for("""
+        SUB_SYSTEMS = {
+            "scanner": {"nonexistent_knob_xyz": KV("1")},
+        }
+        DYNAMIC = {"scanner"}
+    """, path="minio_tpu/config/kvs.py")
+    found = checkers.check_config_keys_documented(ctx)
+    assert len(found) == 1
+    assert found[0].token == "scanner.nonexistent_knob_xyz"
+
+
+# --------------------------------------------------------------------------
+# suppression: pragma + baseline
+
+
+def test_inline_pragma_suppresses(tmp_path):
+    src = textwrap.dedent("""
+        import threading
+        _lock = threading.Lock()
+        def f():
+            _lock.acquire()  # graftlint: disable=GL003
+            # graftlint: disable=GL003
+            _lock.release()
+    """)
+    p = tmp_path / "pragma.py"
+    p.write_text(src)
+    fresh, old = graftlint.run([str(p)], use_baseline=False)
+    assert not fresh and not old
+    # and only the named checker is suppressed
+    p.write_text(src.replace("GL003", "GL001"))
+    fresh, _ = graftlint.run([str(p)], use_baseline=False)
+    assert len(fresh) == 2
+
+
+def test_finding_keys_are_line_stable():
+    """Baseline identity must survive edits ABOVE the site."""
+    src = """
+        import threading
+        _lock = threading.Lock()
+        def f():
+            _lock.acquire()
+    """
+    k1 = checkers.check_bare_acquire(ctx_for(src))[0].key
+    k2 = checkers.check_bare_acquire(
+        ctx_for("\n\n# shifted\n" + textwrap.dedent(src)))[0].key
+    assert k1 == k2
+
+
+def test_baseline_roundtrip_deterministic(tmp_path):
+    ctx = ctx_for("""
+        import threading
+        _lock = threading.Lock()
+        def f():
+            _lock.acquire()
+            _lock.release()
+    """)
+    findings = checkers.check_bare_acquire(ctx)
+    bp = tmp_path / "baseline.json"
+    graftlint.write_baseline(findings, path=str(bp))
+    first = bp.read_bytes()
+    graftlint.write_baseline(list(reversed(findings)), path=str(bp))
+    assert bp.read_bytes() == first, "baseline output is order-dependent"
+    doc = json.loads(first)
+    keys = [e["key"] for e in doc["findings"]]
+    assert keys == sorted(keys)
+    # round-trip absorbs exactly `count` occurrences, extras still fail
+    base = graftlint.load_baseline(str(bp))
+    fresh, old = graftlint.split_baselined(findings, base)
+    assert not fresh and len(old) == len(findings)
+    fresh, _ = graftlint.split_baselined(findings + findings, base)
+    assert len(fresh) == len(findings)
+
+
+def test_real_baseline_file_is_sorted():
+    doc = json.loads(open(graftlint.BASELINE_PATH).read())
+    keys = [e["key"] for e in doc["findings"]]
+    assert keys == sorted(keys)

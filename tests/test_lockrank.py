@@ -1,0 +1,188 @@
+"""Runtime lock-order detector (minio_tpu/obs/lockrank.py, the Python
+stand-in for Go's -race lock-rank assertions): a deliberately seeded
+ABBA pair must produce a cycle report naming both locks with the
+acquisition stacks of both edges — WITHOUT the test ever deadlocking
+(the threads run sequentially; the detector flags the *order* pattern,
+not the unlucky interleaving)."""
+import os
+import sys
+import threading
+import time
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(__file__))
+
+from minio_tpu.obs import lockrank  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _lockrank_on(monkeypatch):
+    """Force-enable (normally conftest already installed it) and give
+    every test a clean graph/report slate."""
+    if not lockrank.enabled():
+        monkeypatch.setenv("MINIO_TPU_LOCKRANK", "1")
+        assert lockrank.install()
+    lockrank.clear()
+    yield
+    lockrank.clear()
+
+
+def _in_thread(fn, *args):
+    t = threading.Thread(target=fn, args=args, name=fn.__name__)
+    t.start()
+    t.join(10)
+    assert not t.is_alive()
+
+
+def _take_in_order(first, second):
+    with first:
+        with second:
+            pass
+
+
+def test_seeded_abba_cycle_reported():
+    a = lockrank.tracked("abba-lock-A")
+    b = lockrank.tracked("abba-lock-B")
+    _in_thread(_take_in_order, a, b)     # establishes A -> B
+    assert not lockrank.reports("lock-order-cycle")
+    _in_thread(_take_in_order, b, a)     # B -> A closes the cycle
+    reps = lockrank.reports("lock-order-cycle")
+    assert len(reps) == 1
+    rep = reps[0]
+    # ...naming both locks...
+    assert {"abba-lock-A", "abba-lock-B"} <= set(rep["locks"])
+    # ...with first-sight evidence (stack + thread) for BOTH edges
+    edges = {e["edge"]: e for e in rep["edges"]}
+    assert set(edges) == {"abba-lock-A -> abba-lock-B",
+                          "abba-lock-B -> abba-lock-A"}
+    for ev in edges.values():
+        assert "_take_in_order" in ev["stack"]
+        assert ev["thread"] == "_take_in_order"
+
+
+def test_consistent_order_is_silent():
+    """Negative case: same pair, same order from two threads — no
+    cycle, no report."""
+    a = lockrank.tracked("ok-lock-A")
+    b = lockrank.tracked("ok-lock-B")
+    _in_thread(_take_in_order, a, b)
+    _in_thread(_take_in_order, a, b)
+    assert not lockrank.reports()
+    st = lockrank.stats()
+    assert st["edges"] == 1 and st["reports"] == 0
+
+
+def test_three_lock_cycle_found():
+    """Cycles longer than ABBA: A->B, B->C, then C->A closes a
+    3-cycle and the report carries all three edges."""
+    a = lockrank.tracked("tri-A")
+    b = lockrank.tracked("tri-B")
+    c = lockrank.tracked("tri-C")
+    _in_thread(_take_in_order, a, b)
+    _in_thread(_take_in_order, b, c)
+    assert not lockrank.reports("lock-order-cycle")
+    _in_thread(_take_in_order, c, a)
+    reps = lockrank.reports("lock-order-cycle")
+    assert len(reps) == 1
+    assert {"tri-A", "tri-B", "tri-C"} <= set(reps[0]["locks"])
+    assert len(reps[0]["edges"]) == 3
+
+
+def test_reentrant_rlock_no_self_edge():
+    r = lockrank.tracked("re-lock", reentrant=True)
+    with r:
+        with r:   # reentry must not create an edge or a report
+            pass
+    assert not lockrank.reports()
+    assert lockrank.stats()["edges"] == 0
+
+
+def test_release_out_of_order_tracked():
+    """Non-LIFO release (common in handoff code) must not corrupt the
+    held stack — B released while A is still held, then C under A."""
+    a = lockrank.tracked("ooo-A")
+    b = lockrank.tracked("ooo-B")
+    c = lockrank.tracked("ooo-C")
+
+    def weird():
+        a.acquire()
+        b.acquire()
+        a.release()                 # A out from under B
+        with c:                     # edge must be B -> C, not A -> C
+            pass
+        b.release()
+
+    _in_thread(weird)
+    assert not lockrank.reports()
+    # now A -> B from another thread is still cycle-free
+    _in_thread(_take_in_order, a, b)
+    assert not lockrank.reports("lock-order-cycle")
+
+
+def test_note_blocking_reports_held_locks():
+    """The device-flush hook (runtime/dispatch.py calls this at its
+    flush boundary): flushing while holding a tracked lock is a
+    convoy generator and must be reported with the holder's stack."""
+    lk = lockrank.tracked("flush-holder")
+    lockrank.note_blocking("device_flush:test")    # nothing held: silent
+    assert not lockrank.reports()
+    with lk:
+        lockrank.note_blocking("device_flush:test")
+    reps = lockrank.reports("lock-held-across-blocking")
+    assert len(reps) == 1
+    rep = reps[0]
+    assert rep["what"] == "device_flush:test"
+    assert rep["locks"] == ["flush-holder"]
+    assert "test_note_blocking_reports_held_locks" in rep["stack"]
+
+
+def test_factory_wraps_project_locks_only():
+    """install() patches the threading factories: locks created by
+    minio_tpu/tests code come back tracked; the detector never
+    perturbs frames it cannot attribute to the project."""
+    lk = threading.Lock()
+    assert isinstance(lk, lockrank.TrackedLock)
+    rlk = threading.RLock()
+    assert isinstance(rlk, lockrank.TrackedLock)
+    with lk:
+        assert lockrank.held_names() == [lk.name]
+    assert lockrank.held_names() == []
+
+
+def test_condition_backed_by_tracked_lock():
+    """threading.Condition over a tracked RLock: wait() must fully
+    release (and restore) through the private hook protocol without
+    losing held-stack accounting."""
+    cv = threading.Condition(lockrank.tracked("cv-lock", reentrant=True))
+    held_after_wakeup = []
+
+    def waiter():
+        with cv:
+            cv.wait(timeout=5)
+            held_after_wakeup.append(list(lockrank.held_names()))
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline:
+        with cv:
+            cv.notify_all()
+        if held_after_wakeup:
+            break
+        time.sleep(0.01)
+    t.join(5)
+    assert held_after_wakeup == [["cv-lock"]]
+    assert lockrank.held_names() == []
+
+
+def test_report_ring_is_bounded():
+    """Reports past the cap are counted, not stored (a pathological
+    code path cannot OOM the detector)."""
+    lk = lockrank.tracked("ring-lock")
+    cap = lockrank._MAX_REPORTS
+    with lk:
+        for _ in range(cap + 5):
+            lockrank.note_blocking("device_flush:ring")
+    assert len(lockrank.reports()) == cap
+    assert lockrank.suppressed_report_count() == 5

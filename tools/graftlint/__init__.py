@@ -1,0 +1,204 @@
+"""graftlint — project-invariant static analysis for minio_tpu.
+
+The reference MinIO server leans on Go's toolchain (``go vet``, the race
+detector) to keep concurrency-heavy code honest; this package is the
+Python analogue for the invariants PRs 1-4 established by convention:
+monotonic clocks for durations, no blocking I/O under a lock, ``with``-
+only lock usage, documented metrics and config keys, span-context
+handoff across pool submits, fault-injection hooks on every op entry
+point, and no silently-swallowed exceptions in daemon threads.
+
+Checkers are AST passes (no imports of the checked code, so a broken
+module still lints). Findings carry ``file:line`` + a checker id and a
+STABLE key (path + checker + enclosing scope + token, no line numbers)
+so the checked-in baseline (``tools/graftlint/baseline.json``) survives
+unrelated edits. Suppress a single site inline with
+``# graftlint: disable=GL00X`` on the finding line (or the line above);
+burn down pre-existing debt by removing entries from the baseline.
+
+Run: ``python -m tools.graftlint [paths...]`` or via
+``tests/test_lint.py`` (tier-1).
+"""
+from __future__ import annotations
+
+import ast
+import json
+import os
+import re
+from dataclasses import dataclass, field
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+BASELINE_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             "baseline.json")
+
+_PRAGMA_RE = re.compile(
+    r"#\s*graftlint:\s*disable=([A-Za-z0-9_,\s]+|all)")
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One violation. ``key`` (not line) is the baseline identity."""
+    path: str          # repo-relative, posix separators
+    line: int
+    checker: str       # "GL001".."GL008"
+    message: str
+    token: str = ""    # stable site token (symbol/metric/key name)
+    scope: str = ""    # enclosing function qualname ("" = module)
+
+    @property
+    def key(self) -> str:
+        return f"{self.path}::{self.checker}::{self.scope}::{self.token}"
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: {self.checker} {self.message}"
+
+
+@dataclass
+class FileCtx:
+    """Parsed unit handed to every per-file checker."""
+    path: str                  # repo-relative
+    abspath: str
+    tree: ast.AST
+    lines: list[str]
+    scopes: dict[int, str] = field(default_factory=dict)  # lineno->qualname
+
+    def scope_at(self, lineno: int) -> str:
+        return self.scopes.get(lineno, "")
+
+    def suppressed(self, lineno: int, checker: str) -> bool:
+        """Inline pragma on the finding line or the line above."""
+        for ln in (lineno, lineno - 1):
+            if 1 <= ln <= len(self.lines):
+                m = _PRAGMA_RE.search(self.lines[ln - 1])
+                if m:
+                    ids = m.group(1)
+                    if ids.strip() == "all" or checker in \
+                            {i.strip() for i in ids.split(",")}:
+                        return True
+        return False
+
+
+def _build_scopes(tree: ast.AST) -> dict[int, str]:
+    """Map every line to its enclosing function qualname — the stable
+    half of a finding's baseline key."""
+    out: dict[int, str] = {}
+
+    def walk(node: ast.AST, prefix: str):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.ClassDef)):
+                qual = f"{prefix}.{child.name}" if prefix else child.name
+                end = getattr(child, "end_lineno", child.lineno)
+                if not isinstance(child, ast.ClassDef):
+                    for ln in range(child.lineno, end + 1):
+                        out[ln] = qual
+                walk(child, qual)
+            else:
+                walk(child, prefix)
+
+    walk(tree, "")
+    return out
+
+
+def iter_py_files(paths: list[str]) -> list[str]:
+    out = []
+    for p in paths:
+        ap = p if os.path.isabs(p) else os.path.join(REPO_ROOT, p)
+        if os.path.isfile(ap) and ap.endswith(".py"):
+            out.append(ap)
+        elif os.path.isdir(ap):
+            for root, dirs, files in os.walk(ap):
+                dirs[:] = [d for d in sorted(dirs)
+                           if d != "__pycache__"]
+                out.extend(os.path.join(root, f) for f in sorted(files)
+                           if f.endswith(".py"))
+    return out
+
+
+def parse_file(abspath: str) -> FileCtx | None:
+    rel = os.path.relpath(abspath, REPO_ROOT).replace(os.sep, "/")
+    try:
+        with open(abspath, encoding="utf-8") as f:
+            src = f.read()
+        tree = ast.parse(src, filename=rel)
+    except (OSError, SyntaxError):
+        return None
+    ctx = FileCtx(path=rel, abspath=abspath, tree=tree,
+                  lines=src.splitlines())
+    ctx.scopes = _build_scopes(tree)
+    return ctx
+
+
+def load_baseline(path: str = BASELINE_PATH) -> dict[str, int]:
+    """Baseline is a sorted multiset of finding keys -> count."""
+    try:
+        with open(path, encoding="utf-8") as f:
+            doc = json.load(f)
+    except (OSError, ValueError):
+        return {}
+    return {e["key"]: int(e.get("count", 1))
+            for e in doc.get("findings", [])}
+
+
+def write_baseline(findings: list[Finding],
+                   path: str = BASELINE_PATH) -> None:
+    """Deterministic (sorted, stable counts) so baseline diffs stay
+    reviewable."""
+    counts: dict[str, int] = {}
+    for f in findings:
+        counts[f.key] = counts.get(f.key, 0) + 1
+    doc = {
+        "comment": "pre-existing graftlint findings, burned down "
+                   "deliberately; regenerate with "
+                   "python -m tools.graftlint --write-baseline",
+        "findings": [{"key": k, "count": counts[k]}
+                     for k in sorted(counts)],
+    }
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(doc, f, indent=1, sort_keys=True)
+        f.write("\n")
+
+
+def split_baselined(findings: list[Finding],
+                    baseline: dict[str, int]
+                    ) -> tuple[list[Finding], list[Finding]]:
+    """(unbaselined, baselined) — a key's first ``count`` occurrences
+    are absorbed, extras (new sites with an old key) still fail."""
+    remaining = dict(baseline)
+    fresh, old = [], []
+    for f in sorted(findings,
+                    key=lambda f: (f.path, f.line, f.checker)):
+        if remaining.get(f.key, 0) > 0:
+            remaining[f.key] -= 1
+            old.append(f)
+        else:
+            fresh.append(f)
+    return fresh, old
+
+
+def run(paths: list[str] | None = None,
+        use_baseline: bool = True
+        ) -> tuple[list[Finding], list[Finding]]:
+    """Lint ``paths`` (default: minio_tpu). Returns (unbaselined,
+    baselined) findings, pragma-suppressed sites already removed."""
+    from . import checkers
+    files = iter_py_files(paths or ["minio_tpu"])
+    ctxs = [c for c in (parse_file(p) for p in files) if c is not None]
+    findings: list[Finding] = []
+    for ctx in ctxs:
+        for chk in checkers.PER_FILE:
+            findings.extend(chk(ctx))
+    for chk in checkers.PROJECT:
+        findings.extend(chk(ctxs))
+    findings = [f for f in findings
+                if not _ctx_suppressed(ctxs, f)]
+    baseline = load_baseline() if use_baseline else {}
+    return split_baselined(findings, baseline)
+
+
+def _ctx_suppressed(ctxs: list[FileCtx], f: Finding) -> bool:
+    for c in ctxs:
+        if c.path == f.path:
+            return c.suppressed(f.line, f.checker)
+    return False

@@ -1,0 +1,53 @@
+"""CLI: ``python -m tools.graftlint [paths...] [options]``.
+
+Exit codes: 0 = clean (no unbaselined findings), 1 = findings, 2 = bad
+usage. ``--write-baseline`` regenerates tools/graftlint/baseline.json
+(sorted + deterministic) from the current findings.
+"""
+from __future__ import annotations
+
+import argparse
+import sys
+
+from . import load_baseline, run, split_baselined, write_baseline
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.graftlint",
+        description="project-invariant static analysis for minio_tpu")
+    ap.add_argument("paths", nargs="*", default=["minio_tpu"],
+                    help="files/dirs to lint (default: minio_tpu)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="report every finding, baselined or not")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="rewrite baseline.json from current findings")
+    ap.add_argument("--stats", action="store_true",
+                    help="per-checker finding counts")
+    args = ap.parse_args(argv)
+
+    fresh, old = run(args.paths or ["minio_tpu"],
+                     use_baseline=not args.no_baseline)
+    if args.write_baseline:
+        write_baseline(fresh + old)
+        print(f"baseline.json written: {len(fresh + old)} findings")
+        return 0
+    shown = fresh if not args.no_baseline else \
+        sorted(fresh + old, key=lambda f: (f.path, f.line, f.checker))
+    for f in shown:
+        print(f.render())
+    if args.stats:
+        by: dict[str, int] = {}
+        for f in fresh + old:
+            by[f.checker] = by.get(f.checker, 0) + 1
+        for chk in sorted(by):
+            print(f"# {chk}: {by[chk]} total", file=sys.stderr)
+    n_base = len(load_baseline())
+    print(f"graftlint: {len(fresh)} unbaselined finding(s), "
+          f"{len(old)} baselined (baseline holds {n_base} keys)",
+          file=sys.stderr)
+    return 1 if fresh else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

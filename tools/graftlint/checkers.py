@@ -1,0 +1,577 @@
+"""The eight graftlint checkers (GL001-GL008).
+
+Each per-file checker takes a ``FileCtx`` and yields ``Finding``s; the
+project-wide checkers take the full list of parsed files (cross-file
+contracts: emitted metrics vs docs). All analysis is pure AST + source
+text — nothing in the checked tree is imported.
+
+| id    | invariant                                                    |
+|-------|--------------------------------------------------------------|
+| GL001 | no wall-clock (``time.time``) values in duration arithmetic  |
+| GL002 | no blocking call (sleep/IO/RPC/flush/result) under a lock    |
+| GL003 | locks acquired only via ``with`` — no bare acquire/release   |
+| GL004 | every emitted ``minio_tpu_*`` metric documented in           |
+|       | docs/observability.md                                        |
+| GL005 | pool submits on traced paths wrap the callable in            |
+|       | ``spans.wrap_ctx``                                           |
+| GL006 | storage/rpc/kernel op entry points carry a fault-inject hook |
+| GL007 | no bare ``except:`` / swallowed exceptions in daemon threads |
+| GL008 | every dynamic config KVS key documented in docs/             |
+"""
+from __future__ import annotations
+
+import ast
+import os
+import re
+
+from . import FileCtx, Finding, REPO_ROOT
+
+# --------------------------------------------------------------------------
+# shared AST helpers
+
+
+def dotted(expr: ast.AST) -> str:
+    """Dotted name of a Name/Attribute chain ('' when dynamic)."""
+    parts: list[str] = []
+    while isinstance(expr, ast.Attribute):
+        parts.append(expr.attr)
+        expr = expr.value
+    if isinstance(expr, ast.Name):
+        parts.append(expr.id)
+    elif isinstance(expr, ast.Call):
+        inner = dotted(expr.func)
+        parts.append(f"{inner}()" if inner else "()")
+    else:
+        return ""
+    return ".".join(reversed(parts))
+
+
+def _unparse(node: ast.AST, limit: int = 60) -> str:
+    try:
+        s = ast.unparse(node)
+    except Exception:  # pragma: no cover - defensive
+        s = type(node).__name__
+    s = re.sub(r"\s+", " ", s)
+    return s if len(s) <= limit else s[:limit - 1] + "…"
+
+
+def _walk_shallow(node: ast.AST):
+    """Walk, but do not descend into nested function/class/lambda bodies
+    (their execution is deferred — a lock held here is not held there)."""
+    stack = list(ast.iter_child_nodes(node))
+    while stack:
+        n = stack.pop()
+        yield n
+        if not isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda, ast.ClassDef)):
+            stack.extend(ast.iter_child_nodes(n))
+
+
+_LOCK_CTORS = {"threading.Lock", "threading.RLock", "threading.Condition",
+               "Lock", "RLock", "Condition"}
+_LOCK_NAME_RE = re.compile(r"(^|_)(lock|mutex|cv|cond)s?$")
+
+
+def _lockish_symbols(tree: ast.AST) -> set[str]:
+    """Dotted targets assigned from threading.Lock/RLock/Condition()
+    anywhere in the file ('self.X' kept as written — good enough for
+    matching use sites inside the same class)."""
+    out: set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            ctor = dotted(node.value.func)
+            if ctor in _LOCK_CTORS:
+                for t in node.targets:
+                    d = dotted(t)
+                    if d:
+                        out.add(d)
+    return out
+
+
+def _is_lock_expr(expr: ast.AST, lockish: set[str]) -> bool:
+    d = dotted(expr)
+    if not d:
+        return False
+    if d in lockish:
+        return True
+    return bool(_LOCK_NAME_RE.search(d.rsplit(".", 1)[-1]))
+
+
+# --------------------------------------------------------------------------
+# GL001 — wall clock in duration arithmetic
+
+
+def check_wall_duration(ctx: FileCtx) -> list[Finding]:
+    tree = ctx.tree
+    module_wall: set[str] = set()
+    class_wall: set[str] = set()   # 'self.X' attrs assigned time.time()
+
+    def is_time_time(node: ast.AST) -> bool:
+        return isinstance(node, ast.Call) and \
+            dotted(node.func) == "time.time"
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and is_time_time(node.value):
+            for t in node.targets:
+                d = dotted(t)
+                if not d:
+                    continue
+                if d.startswith("self."):
+                    class_wall.add(d)
+                else:
+                    module_wall.add(d)
+
+    # local names per function scope
+    func_wall: dict[str, set[str]] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            names = set()
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Assign) and is_time_time(sub.value):
+                    for t in sub.targets:
+                        if isinstance(t, ast.Name):
+                            names.add(t.id)
+            func_wall[f"{node.lineno}:{node.name}"] = names
+
+    all_local = set().union(*func_wall.values()) if func_wall else set()
+
+    def is_wall(e: ast.AST) -> bool:
+        if is_time_time(e):
+            return True
+        d = dotted(e)
+        if not d:
+            return False
+        return d in module_wall or d in class_wall or d in all_local
+
+    out = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Sub) \
+                and is_wall(node.left) and is_wall(node.right):
+            out.append(Finding(
+                ctx.path, node.lineno, "GL001",
+                "wall-clock duration: both operands of '-' derive from "
+                f"time.time() ({_unparse(node)}) — use time.monotonic() "
+                "so an NTP step cannot distort the measurement",
+                token=_unparse(node, 40),
+                scope=ctx.scope_at(node.lineno)))
+    return out
+
+
+# --------------------------------------------------------------------------
+# GL002 — blocking call under a held lock
+
+_BLOCKING_DOTTED = {
+    "time.sleep", "os.fsync", "os.fdatasync", "os.sync",
+    "subprocess.run", "subprocess.check_output", "subprocess.check_call",
+    "subprocess.call", "futures.wait", "concurrent.futures.wait",
+    "urllib.request.urlopen", "request.urlopen", "socket.create_connection",
+}
+_BLOCKING_ATTRS = {
+    "result", "block_until_ready", "urlopen", "getresponse", "recv",
+    "sendall", "connect", "flush", "fsync", "shutdown", "map",
+}
+_MAYBE_BLOCKING_ATTRS = {"get", "put"}    # only with timeout=/block=
+_IO_ATTRS = {"read", "write", "readinto", "read_at", "readline",
+             "read_framed"}
+
+
+def _is_blocking_call(call: ast.Call, with_expr_dump: str) -> str | None:
+    """Reason string when this call can block, else None."""
+    d = dotted(call.func)
+    attr = d.rsplit(".", 1)[-1] if d else ""
+    if d in _BLOCKING_DOTTED:
+        return d
+    if d == "open":
+        return "open()"
+    if attr == "wait":
+        # cv.wait() inside `with cv` releases that same lock — fine
+        if isinstance(call.func, ast.Attribute) and \
+                ast.dump(call.func.value) == with_expr_dump:
+            return None
+        return f"{d}()"
+    if attr == "join":
+        # distinguish thread.join([timeout]) from str.join(iterable)
+        if not call.args and not call.keywords:
+            return f"{d}()"
+        if len(call.args) == 1 and isinstance(call.args[0], ast.Constant) \
+                and isinstance(call.args[0].value, (int, float)):
+            return f"{d}(timeout)"
+        if any(k.arg == "timeout" for k in call.keywords):
+            return f"{d}(timeout)"
+        return None
+    if attr in _MAYBE_BLOCKING_ATTRS:
+        if any(k.arg in ("timeout", "block") for k in call.keywords):
+            return f"{d}(timeout=…)"
+        return None
+    if attr in _BLOCKING_ATTRS or attr in _IO_ATTRS:
+        return f"{d}()"
+    return None
+
+
+def check_blocking_under_lock(ctx: FileCtx) -> list[Finding]:
+    lockish = _lockish_symbols(ctx.tree)
+    out = []
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.With):
+            continue
+        lock_items = [it for it in node.items
+                      if _is_lock_expr(it.context_expr, lockish)]
+        if not lock_items:
+            continue
+        wdump = ast.dump(lock_items[0].context_expr)
+        lock_name = dotted(lock_items[0].context_expr)
+        for body_stmt in node.body:
+            if isinstance(body_stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                      ast.ClassDef)):
+                continue    # deferred body directly under the with
+            for sub in _walk_shallow(body_stmt):
+                if not isinstance(sub, ast.Call):
+                    continue
+                reason = _is_blocking_call(sub, wdump)
+                if reason is None:
+                    continue
+                out.append(Finding(
+                    ctx.path, sub.lineno, "GL002",
+                    f"blocking call {reason} inside `with {lock_name}` — "
+                    "move the blocking work outside the critical section",
+                    token=f"{lock_name}|{_unparse(sub.func, 40)}",
+                    scope=ctx.scope_at(sub.lineno)))
+    return out
+
+
+# --------------------------------------------------------------------------
+# GL003 — bare acquire()/release() on locks
+
+
+def check_bare_acquire(ctx: FileCtx) -> list[Finding]:
+    lockish = _lockish_symbols(ctx.tree)
+    out = []
+    for node in ast.walk(ctx.tree):
+        if not (isinstance(node, ast.Call) and
+                isinstance(node.func, ast.Attribute)):
+            continue
+        if node.func.attr not in ("acquire", "release"):
+            continue
+        if not _is_lock_expr(node.func.value, lockish):
+            continue
+        d = dotted(node.func)
+        out.append(Finding(
+            ctx.path, node.lineno, "GL003",
+            f"bare {d}() — acquire locks only via `with` so no "
+            "exception path can leak a held lock",
+            token=d, scope=ctx.scope_at(node.lineno)))
+    return out
+
+
+# --------------------------------------------------------------------------
+# GL004 — every emitted metric documented (project-wide)
+
+_METRIC_RE = re.compile(r"^minio_tpu_[a-z0-9_]+")
+_TYPE_LINE_RE = re.compile(r"#\s*(?:TYPE|HELP)\s+(minio_tpu_[a-z0-9_]+)")
+
+
+def _metric_literals(ctx: FileCtx) -> list[tuple[str, int]]:
+    """(family, line) pairs this file emits: first args of inc()/
+    observe(), families inside '# TYPE'/'# HELP' literals, and — in
+    obs/metrics.py, whose generators build sample lines directly —
+    every leading minio_tpu_* string/f-string fragment."""
+    out: list[tuple[str, int]] = []
+    is_metrics_mod = ctx.path.endswith("obs/metrics.py")
+
+    def from_str(s: str, line: int):
+        for m in _TYPE_LINE_RE.finditer(s):
+            out.append((m.group(1), line))
+        if is_metrics_mod and not s.lstrip().startswith("#"):
+            m = _METRIC_RE.match(s)
+            if m:
+                out.append((m.group(0), line))
+
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Call):
+            fn = dotted(node.func)
+            if fn.rsplit(".", 1)[-1] in ("inc", "observe") and node.args:
+                a0 = node.args[0]
+                if isinstance(a0, ast.Constant) and \
+                        isinstance(a0.value, str):
+                    m = _METRIC_RE.match(a0.value)
+                    if m:
+                        out.append((m.group(0), node.lineno))
+        elif isinstance(node, ast.Constant) and isinstance(node.value, str):
+            from_str(node.value, node.lineno)
+        elif isinstance(node, ast.JoinedStr):
+            for v in node.values:
+                if isinstance(v, ast.Constant) and \
+                        isinstance(v.value, str):
+                    from_str(v.value, node.lineno)
+    return out
+
+
+def check_metrics_documented(ctxs: list[FileCtx]) -> list[Finding]:
+    doc_path = os.path.join(REPO_ROOT, "docs", "observability.md")
+    try:
+        with open(doc_path, encoding="utf-8") as f:
+            doc = f.read()
+    except OSError:
+        doc = ""
+    seen: dict[str, tuple[str, int, str]] = {}
+    for ctx in ctxs:
+        for fam, line in _metric_literals(ctx):
+            if fam not in seen:
+                seen[fam] = (ctx.path, line, ctx.scope_at(line))
+    out = []
+    for fam in sorted(seen):
+        if fam in doc:
+            continue
+        path, line, scope = seen[fam]
+        out.append(Finding(
+            path, line, "GL004",
+            f"metric family {fam} is emitted but not documented in "
+            "docs/observability.md",
+            token=fam, scope=scope))
+    return out
+
+
+# --------------------------------------------------------------------------
+# GL005 — pool submits on traced paths must wrap_ctx the callable
+
+_POOL_RE = re.compile(r"pool", re.IGNORECASE)
+
+
+def _is_traced_pool(recv: ast.AST) -> bool:
+    """meta_pool()/io_pool()/encode_pool() results or *pool* attributes —
+    the shared executors traced fan-outs ride."""
+    if isinstance(recv, ast.Call):
+        return bool(_POOL_RE.search(dotted(recv.func)))
+    d = dotted(recv)
+    return bool(d and _POOL_RE.search(d.rsplit(".", 1)[-1]))
+
+
+def check_submit_wrap(ctx: FileCtx) -> list[Finding]:
+    # names assigned from wrap_ctx(...) anywhere in the file count as
+    # wrapped (the bind-at-enqueue pattern: w = wrap_ctx(fn); submit(w))
+    wrapped_names: set[str] = set()
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Assign) and \
+                isinstance(node.value, ast.Call) and \
+                dotted(node.value.func).rsplit(".", 1)[-1] == "wrap_ctx":
+            wrapped_names.update(d for d in (dotted(t)
+                                             for t in node.targets) if d)
+    out = []
+    for node in ast.walk(ctx.tree):
+        if not (isinstance(node, ast.Call) and
+                isinstance(node.func, ast.Attribute) and
+                node.func.attr == "submit" and node.args):
+            continue
+        if not _is_traced_pool(node.func.value):
+            continue
+        a0 = node.args[0]
+        if isinstance(a0, ast.Call) and \
+                dotted(a0.func).rsplit(".", 1)[-1] == "wrap_ctx":
+            continue
+        if dotted(a0) in wrapped_names:
+            continue
+        out.append(Finding(
+            ctx.path, node.lineno, "GL005",
+            f"pool submit of {_unparse(a0, 40)} without spans.wrap_ctx — "
+            "contextvars (span context) do not cross thread-pool "
+            "submissions on their own",
+            token=_unparse(a0, 40), scope=ctx.scope_at(node.lineno)))
+    return out
+
+
+# --------------------------------------------------------------------------
+# GL006 — fault-injection hooks on storage/rpc/kernel entry points
+
+#: XLStorage public methods that are pure in-memory accessors — no I/O,
+#: nothing to inject.
+_XL_NON_IO = {"endpoint", "get_disk_id", "set_disk_id"}
+
+
+def _contains_hook(fn: ast.FunctionDef) -> bool:
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call):
+            d = dotted(node.func)
+            tail = d.rsplit(".", 1)[-1]
+            if tail in ("inject", "_op") or tail.startswith("_op"):
+                return True
+            # delegating wrappers: self.<name>_inner / _<name> helpers
+            # are covered because ast.walk sees the call, not the body —
+            # require the hook in THIS function or a with self._op(...)
+    return False
+
+
+def check_fault_hooks(ctx: FileCtx) -> list[Finding]:
+    out = []
+    if ctx.path == "minio_tpu/storage/xlstorage.py":
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.ClassDef) and node.name == "XLStorage":
+                for fn in node.body:
+                    if not isinstance(fn, ast.FunctionDef):
+                        continue
+                    if fn.name.startswith("_") or fn.name in _XL_NON_IO:
+                        continue
+                    if _contains_hook(fn):
+                        continue
+                    out.append(Finding(
+                        ctx.path, fn.lineno, "GL006",
+                        f"storage op XLStorage.{fn.name} has no "
+                        "fault-injection hook (self._op(...) span or "
+                        "_fault.inject) — chaos tests cannot reach it",
+                        token=fn.name, scope=ctx.scope_at(fn.lineno + 1)))
+    elif ctx.path == "minio_tpu/dist/rpc.py":
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.ClassDef) and node.name == "RPCClient":
+                for fn in node.body:
+                    if isinstance(fn, ast.FunctionDef) and \
+                            fn.name == "call" and not _contains_hook(fn):
+                        out.append(Finding(
+                            ctx.path, fn.lineno, "GL006",
+                            "RPCClient.call has no fault-injection hook",
+                            token="call",
+                            scope=ctx.scope_at(fn.lineno + 1)))
+    elif ctx.path == "minio_tpu/runtime/dispatch.py":
+        if not any(isinstance(n, ast.Call) and
+                   dotted(n.func).endswith("inject")
+                   for n in ast.walk(ctx.tree)):
+            out.append(Finding(
+                ctx.path, 1, "GL006",
+                "dispatch has no kernel-layer fault-injection hook "
+                "(_fault.inject('kernel', ...) at the flush boundary)",
+                token="kernel-flush"))
+    return out
+
+
+# --------------------------------------------------------------------------
+# GL007 — no bare/swallowed exceptions in daemon threads
+
+_DAEMON_FN_RE = re.compile(r"(^|\.)(_?run|_?loop|[a-z0-9_]*_loop|"
+                           r"_worker|_probe_loop)$")
+_BROAD = {"Exception", "BaseException"}
+
+
+def _daemon_targets(tree: ast.AST) -> set[str]:
+    """Function names passed as Thread(target=...) in this module."""
+    out: set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and \
+                dotted(node.func).endswith("Thread"):
+            for kw in node.keywords:
+                if kw.arg == "target":
+                    d = dotted(kw.value)
+                    if d:
+                        out.add(d.rsplit(".", 1)[-1])
+    return out
+
+
+def _catches_broad(h: ast.ExceptHandler) -> bool:
+    t = h.type
+    if t is None:
+        return False
+    names = [t] if not isinstance(t, ast.Tuple) else t.elts
+    return any(dotted(n).rsplit(".", 1)[-1] in _BROAD for n in names)
+
+
+def check_swallowed_exceptions(ctx: FileCtx) -> list[Finding]:
+    daemons = _daemon_targets(ctx.tree)
+    out = []
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.ExceptHandler):
+            continue
+        if node.type is None:
+            out.append(Finding(
+                ctx.path, node.lineno, "GL007",
+                "bare `except:` also swallows KeyboardInterrupt/"
+                "SystemExit — catch Exception (and handle or log it)",
+                token="bare-except", scope=ctx.scope_at(node.lineno)))
+            continue
+        if not _catches_broad(node):
+            continue
+        body_is_noop = all(isinstance(s, (ast.Pass, ast.Continue))
+                           for s in node.body)
+        if not body_is_noop:
+            continue
+        scope = ctx.scope_at(node.lineno)
+        leaf = scope.rsplit(".", 1)[-1] if scope else ""
+        in_daemon = any(seg in daemons for seg in scope.split(".")) or \
+            bool(_DAEMON_FN_RE.search(leaf))
+        if in_daemon:
+            out.append(Finding(
+                ctx.path, node.lineno, "GL007",
+                "daemon thread swallows Exception with a bare pass — a "
+                "persistent failure loops silently forever; log or "
+                "count it",
+                token=f"swallow:{leaf}", scope=scope))
+    return out
+
+
+# --------------------------------------------------------------------------
+# GL008 — every dynamic config KVS key documented
+
+
+def check_config_keys_documented(ctx: FileCtx) -> list[Finding]:
+    if ctx.path != "minio_tpu/config/kvs.py":
+        return []
+    subsystems: dict[str, list[tuple[str, str, int]]] = {}
+    dynamic: set[str] = set()
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Assign):
+            names = {dotted(t) for t in node.targets}
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            names = {dotted(node.target)}
+        else:
+            continue
+        if "SUB_SYSTEMS" in names and isinstance(node.value, ast.Dict):
+            for k, v in zip(node.value.keys, node.value.values):
+                if not (isinstance(k, ast.Constant) and
+                        isinstance(v, ast.Dict)):
+                    continue
+                entries = []
+                for kk, vv in zip(v.keys, v.values):
+                    if not isinstance(kk, ast.Constant):
+                        continue
+                    env = ""
+                    if isinstance(vv, ast.Call):
+                        for kw in vv.keywords:
+                            if kw.arg == "env" and \
+                                    isinstance(kw.value, ast.Constant):
+                                env = kw.value.value
+                    entries.append((kk.value, env, kk.lineno))
+                subsystems[k.value] = entries
+        elif "DYNAMIC" in names and isinstance(node.value, ast.Set):
+            dynamic = {e.value for e in node.value.elts
+                       if isinstance(e, ast.Constant)}
+    docs = []
+    docs_dir = os.path.join(REPO_ROOT, "docs")
+    try:
+        for f in sorted(os.listdir(docs_dir)):
+            if f.endswith(".md"):
+                with open(os.path.join(docs_dir, f),
+                          encoding="utf-8") as fh:
+                    docs.append(fh.read())
+    except OSError:
+        pass
+    doc_text = "\n".join(docs)
+    out = []
+    for subsys in sorted(dynamic):
+        for key, env, line in subsystems.get(subsys, []):
+            if f"{subsys}.{key}" in doc_text or \
+                    (env and env in doc_text):
+                continue
+            out.append(Finding(
+                ctx.path, line, "GL008",
+                f"dynamic config key {subsys}.{key} (env {env or '—'}) "
+                "is not documented anywhere under docs/",
+                token=f"{subsys}.{key}"))
+    return out
+
+
+PER_FILE = [
+    check_wall_duration,
+    check_blocking_under_lock,
+    check_bare_acquire,
+    check_submit_wrap,
+    check_fault_hooks,
+    check_swallowed_exceptions,
+    check_config_keys_documented,
+]
+PROJECT = [check_metrics_documented]
