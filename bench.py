@@ -346,6 +346,74 @@ def e2e_put(rng) -> dict:
     return out
 
 
+def fsync_put(rng) -> dict:
+    """Durability tax (docs/durability.md): 8-way-parallel PUT GiB/s at
+    16+4 / 1 MiB objects under fsync=off|batched|always. batched's wall
+    time includes the flusher barrier so the number is the cost of
+    durability actually achieved, not of deferring it past the
+    measurement. Best-of-2 reps per mode after a discarded warmup pass:
+    small-object par8 runs swing 2x run-to-run on this 1-core host, and
+    a single sample can report a phantom 50% overhead (or a phantom
+    speedup) that is pure scheduler noise."""
+    import threading
+
+    from minio_tpu.objectlayer import ErasureObjects
+    from minio_tpu.storage import XLStorage
+    from minio_tpu.storage.durability import flusher
+    K, M, OBJ, N_PER, REPS = 16, 4, 1 << 20, 16, 2
+    body = rng.integers(0, 256, OBJ, dtype=np.uint8).tobytes()
+    out: dict = {}
+    prev = os.environ.get("MINIO_TPU_FSYNC")
+
+    def one_rep(mode) -> float:
+        root = tempfile.mkdtemp(prefix=f"benchfsync-{mode}-",
+                                dir=bench_dir())
+        try:
+            disks = [XLStorage(os.path.join(root, f"d{i}"))
+                     for i in range(K + M)]
+            ol = ErasureObjects(disks, default_parity=M)
+            ol.make_bucket("b")
+            ol.put_object("b", "warm", io.BytesIO(body), OBJ)
+
+            def worker(j):
+                for i in range(N_PER):
+                    ol.put_object("b", f"o{j}-{i}",
+                                  io.BytesIO(body), OBJ)
+
+            threads = [threading.Thread(target=worker, args=(j,))
+                       for j in range(8)]
+            t0 = time.perf_counter()
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            if mode == "batched":
+                flusher().flush(timeout=30.0)
+            dt = time.perf_counter() - t0
+            return 8 * N_PER * OBJ / dt / (1 << 30)
+        finally:
+            shutil.rmtree(root, ignore_errors=True)
+
+    try:
+        os.environ["MINIO_TPU_FSYNC"] = "off"
+        one_rep("off")  # warmup: first par8 run pays one-time init
+        for mode in ("off", "batched", "always"):
+            os.environ["MINIO_TPU_FSYNC"] = mode
+            out[mode] = round(max(one_rep(mode) for _ in range(REPS)), 3)
+        if out.get("off"):
+            out["batched_overhead_pct"] = round(
+                100.0 * (1.0 - out["batched"] / out["off"]), 1)
+            out["always_overhead_pct"] = round(
+                100.0 * (1.0 - out["always"] / out["off"]), 1)
+        log(f"fsync par8 16+4 1MiB PUT GiB/s: {out}")
+    finally:
+        if prev is None:
+            os.environ.pop("MINIO_TPU_FSYNC", None)
+        else:
+            os.environ["MINIO_TPU_FSYNC"] = prev
+    return out
+
+
 def heal_latency(rng) -> dict:
     """p50/p99 wall-clock latency of ONE 16+4 heal-shard rebuild (1 MiB
     block, 2 lost shards) through the dispatch queue, at 1/8/128 concurrent
@@ -590,6 +658,8 @@ def main() -> None:
     # (tmpfs writes -25%, syscall time ~2x on this host), which would tax
     # the e2e numbers with state the data plane didn't create
     put = e2e_put(rng)
+    # durability tax rides the disk-bound slot too
+    fsy = fsync_put(rng)
     # chaos rides the same disk-bound slot (before device staging churn)
     cha = chaos_profile(rng) if chaos else None
     dev = device_configs(rng)
@@ -606,6 +676,7 @@ def main() -> None:
             "cpu_avx2_encode_gibs": round(cpu_gibs, 2),
             "host": host,
             "e2e_put_gibs": put,                      # config 1
+            "fsync_put_gibs": fsy,             # durability tax (PR 6)
             "encode_sweep_8p4_gibs": dev["encode_sweep_8p4"],  # config 2
             "reconstruct_2loss_gibs": round(
                 dev["reconstruct_2loss_16p4_b128"], 2),        # config 3

@@ -31,12 +31,10 @@ class TierFS:
         os.makedirs(directory, exist_ok=True)
 
     def put(self, key: str, data: bytes) -> None:
+        from ..storage.durability import durable_write
         path = os.path.join(self.dir, key)
         os.makedirs(os.path.dirname(path), exist_ok=True)
-        tmp = path + ".tmp"
-        with open(tmp, "wb") as f:
-            f.write(data)
-        os.replace(tmp, path)
+        durable_write(path, data)
 
     def get(self, key: str) -> bytes:
         try:

@@ -81,12 +81,11 @@ class CacheObjects:
             return None
 
     def _save_meta(self, edir: str, meta: dict) -> None:
-        tmp = os.path.join(edir, CACHE_META + ".tmp")
+        from .storage.durability import durable_write
         try:
             os.makedirs(edir, exist_ok=True)
-            with open(tmp, "w", encoding="utf-8") as f:
-                json.dump(meta, f)
-            os.replace(tmp, os.path.join(edir, CACHE_META))
+            durable_write(os.path.join(edir, CACHE_META),
+                          json.dumps(meta).encode("utf-8"))
         except OSError:
             pass
 
@@ -184,10 +183,8 @@ class CacheObjects:
                         os.unlink(os.path.join(edir, name))
                     except OSError:
                         pass
-            tmp = os.path.join(edir, CACHE_DATA + ".tmp")
-            with open(tmp, "wb") as f:
-                f.write(data)
-            os.replace(tmp, os.path.join(edir, CACHE_DATA))
+            from .storage.durability import durable_write
+            durable_write(os.path.join(edir, CACHE_DATA), data)
         except OSError:
             return
         self._save_meta(edir, meta)
@@ -228,11 +225,9 @@ class CacheObjects:
         end = start + len(data) - 1
         fname = f"range-{start}-{end}"
         try:
+            from .storage.durability import durable_write
             os.makedirs(edir, exist_ok=True)
-            tmp = os.path.join(edir, fname + ".tmp")
-            with open(tmp, "wb") as f:
-                f.write(data)
-            os.replace(tmp, os.path.join(edir, fname))
+            durable_write(os.path.join(edir, fname), data)
         except OSError:
             return
         meta.setdefault("ranges", {})[f"{start}-{end}"] = fname

@@ -102,6 +102,24 @@ SUB_SYSTEMS: dict[str, dict[str, KV]] = {
         "hedge_floor_ms": KV("25", env="MINIO_TPU_HEDGE_FLOOR_MS"),
         "hedge_ceil_ms": KV("1000", env="MINIO_TPU_HEDGE_CEIL_MS"),
     },
+    "durability": {
+        "fsync": KV("off", env="MINIO_TPU_FSYNC",
+                    help="always|batched|off commit fsync policy "
+                         "(docs/durability.md)"),
+        "batch_interval_ms": KV(
+            "20", env="MINIO_TPU_FSYNC_BATCH_MS",
+            help="batched-mode flusher coalescing window"),
+        "startup_recovery": KV(
+            "1", env="MINIO_TPU_STARTUP_RECOVERY",
+            help="sweep tmp + expire stale multiparts at layer init"),
+        "tmp_expiry_s": KV(
+            "86400", env="MINIO_TPU_TMP_EXPIRY_S",
+            help="janitor reclaims .minio.sys/tmp entries older than "
+                 "this"),
+        "multipart_expiry_s": KV(
+            "86400", env="MINIO_TPU_MULTIPART_EXPIRY_S",
+            help="stale multipart uploads reaped after this"),
+    },
     "health": {
         "enable": KV("1", env="MINIO_TPU_HEALTH",
                      help="per-disk health tracking wrapper"),
@@ -230,14 +248,19 @@ SUB_SYSTEMS: dict[str, dict[str, KV]] = {
 #: Subsystems whose set() takes effect without restart (SubSystemsDynamic,
 #: config.go:132) — consumers read the registry at call time or register
 #: an apply callback.
-DYNAMIC = {"api", "scanner", "heal", "dispatch", "bitrot", "qos", "fault"}
+DYNAMIC = {"api", "scanner", "heal", "dispatch", "bitrot", "qos", "fault",
+           "durability"}
 
 
 class ConfigSys:
     def __init__(self, objlayer=None):
         self.obj = objlayer
         self._stored: dict[str, dict[str, str]] = {}
-        self._lock = threading.Lock()
+        # RLock, not Lock: set()/_snapshot_locked persist through the
+        # object layer while holding it, and the storage write path
+        # consults the registry (durability.fsync_mode) on the way down
+        # — the same-thread re-entry must not deadlock
+        self._lock = threading.RLock()
         self._apply: dict[str, list] = {}
         if objlayer is not None:
             self.load()
@@ -252,6 +275,7 @@ class ConfigSys:
             return
         with self._lock:
             self._stored = {k: dict(v) for k, v in doc.items()}
+        self._refresh_durability_cache()
 
     def _persist(self):
         if self.obj is None:
@@ -270,6 +294,17 @@ class ConfigSys:
             env = os.environ.get(kv.env)
             if env is not None:
                 return env
+        with self._lock:
+            stored = self._stored.get(subsys, {}).get(key)
+        return kv.default if stored is None else stored
+
+    def get_stored_or_default(self, subsys: str, key: str) -> str:
+        """Resolution WITHOUT the env override — for consumers that
+        cache the stored/default component and layer the env check
+        lock-free per call (durability.fsync_mode)."""
+        kv = SUB_SYSTEMS.get(subsys, {}).get(key)
+        if kv is None:
+            raise KeyError(f"unknown config key {subsys}.{key}")
         with self._lock:
             stored = self._stored.get(subsys, {}).get(key)
         return kv.default if stored is None else stored
@@ -410,11 +445,27 @@ class ConfigSys:
     def _fire(self, subsys: str):
         if subsys not in DYNAMIC:
             return
+        if subsys == "durability":
+            # built-in, not registration-dependent: the commit hot path
+            # reads a lock-free cached policy (durability.fsync_mode)
+            # that MUST be invalidated on every dynamic change even in
+            # bare library use where no server wired callbacks
+            self._refresh_durability_cache()
         for fn in self._apply.get(subsys, []):
             try:
                 fn(self)
             except Exception:  # noqa: BLE001 — apply must not break set()
                 pass
+
+    def _refresh_durability_cache(self):
+        # pass SELF: refresh_mode_cache falling back to get_config_sys()
+        # would deadlock when load() runs inside the module _global_lock
+        # (first get_config_sys(objlayer) call with a persisted config)
+        try:
+            from ..storage.durability import refresh_mode_cache
+            refresh_mode_cache(self)
+        except Exception:  # noqa: BLE001 — durability module absent
+            pass
 
 
 _global: ConfigSys | None = None

@@ -945,9 +945,7 @@ def erasure_heal(erasure: Erasure, writers: list, readers: list,
     (BASELINE config 5)."""
     if total_length == 0:
         # still commit empty shard files through the writers
-        for w in writers:
-            if w is not None:
-                w.close()
+        _close_heal_writers(writers)
         return [None] * len(readers)
     k = erasure.data_blocks
     bs = erasure.block_size
@@ -1019,10 +1017,23 @@ def erasure_heal(erasure: Erasure, writers: list, readers: list,
             emit(window.popleft())
     while window:
         emit(window.popleft())
-    for w in writers:
-        if w is not None:
-            w.close()
+    _close_heal_writers(writers)
     return preader.errs
+
+
+def _close_heal_writers(writers: list) -> None:
+    """Per-writer close with per-disk demotion: close() can raise under
+    fsync=always (strict writeback errors), and one disk's EIO must stay
+    that disk's vote — nulling its slot tells heal_object to skip its
+    rename_data — not abort the rebuild of every healthy target (heal
+    write quorum is 1; mirrors the PUT path's per-writer close)."""
+    for t, w in enumerate(writers):
+        if w is None:
+            continue
+        try:
+            w.close()
+        except Exception:  # noqa: BLE001 — demoted to a per-disk vote
+            writers[t] = None
 
 
 class BufferSink:

@@ -49,18 +49,24 @@ class QueueStore:
 
     def put(self, record: dict) -> bool:
         """Persist one event; False when the store is full (the reference
-        errors the same way rather than buffering unboundedly)."""
+        errors the same way rather than buffering unboundedly). Commits
+        through ``durable_replace`` so a queued event survives a crash
+        under the configured fsync policy; a failed write unlinks its
+        tmp file instead of leaking it into the store dir forever."""
+        from ..storage.durability import durable_write
         with self._count_lock:
             if self._count >= self.limit:
                 self.failed_puts += 1
                 return False
             self._count += 1
         name = f"{time.time_ns():020d}-{uuid.uuid4().hex}.event"
-        tmp = os.path.join(self.dir, f".{name}.tmp")
         try:
-            with open(tmp, "w", encoding="utf-8") as f:
-                json.dump(record, f, separators=(",", ":"))
-            os.replace(tmp, os.path.join(self.dir, name))
+            # durable_write commits under the fsync policy and unlinks
+            # its tmp on failure — nothing strands in the store dir
+            # (the tmp name never matches the sender's *.event filter)
+            durable_write(os.path.join(self.dir, name),
+                          json.dumps(record,
+                                     separators=(",", ":")).encode())
         except OSError:
             with self._count_lock:
                 self._count -= 1

@@ -19,8 +19,12 @@ Actions: ``error(<TypedStorageError>)``, ``delay(ms[,jitter_ms])``,
 ``bitrot`` (corrupt returned shard bytes — bitrot readers detect it),
 ``hang[(s)]`` (a long, clear()-interruptible stall), ``flaky(p[,seed])``
 (probabilistic typed error from a per-rule seeded RNG, so chaos tests
-stay deterministic). Every rule carries an optional hit budget
-(``count``) and TTL so faults disarm themselves.
+stay deterministic), ``crash`` (raise :class:`SimulatedCrash`, a
+BaseException that no cleanup handler catches — the in-process stand-in
+for kill -9 at a registered write step, docs/durability.md), and
+``torn`` (the caller truncates its tmp file at a random offset before
+commit — a power-cut torn write). Every rule carries an optional hit
+budget (``count``) and TTL so faults disarm themselves.
 
 Arming surfaces: this module's ``arm()``/``parse_rule()``, the admin
 ``/minio/admin/v3/fault`` op (+ ``madmin`` client), and the ``fault``
@@ -33,6 +37,7 @@ paths pay a single ``if`` when nothing is armed.
 from __future__ import annotations
 
 import itertools
+import os
 import random
 import re
 import threading
@@ -42,7 +47,17 @@ from dataclasses import dataclass, field
 from ..utils import errors
 
 LAYERS = ("disk", "rpc", "kernel")
-ACTIONS = ("error", "delay", "bitrot", "hang", "flaky")
+ACTIONS = ("error", "delay", "bitrot", "hang", "flaky", "crash", "torn")
+
+
+class SimulatedCrash(BaseException):
+    """Process death at a write step (chaos harness). Deliberately a
+    BaseException: every cleanup handler in the tree catches Exception,
+    so none of the in-process failure paths (_cleanup_tmp, rollbacks,
+    writer aborts) run — on-disk state is left exactly as a kill -9 at
+    that instruction would leave it. The crash matrix
+    (tests/test_crash.py) then rebuilds the object layer over the same
+    disk dirs and asserts recovery."""
 
 #: typed storage errors a rule may raise by name
 ERRORS_BY_NAME = {c.__name__: c for c in [
@@ -174,6 +189,17 @@ class _Bitrot:
 BITROT = _Bitrot()
 
 
+class _Torn:
+    """Returned by inject() for a ``torn`` rule: the caller owns the
+    about-to-commit tmp file and must truncate it via
+    :func:`torn_truncate` before the rename makes it visible. Carries
+    the rule's seeded RNG so the cut offset is reproducible — the same
+    determinism contract ``flaky`` keeps (seed via ``@seed=K``)."""
+
+    def __init__(self, rng: random.Random | None = None):
+        self.rng = rng
+
+
 class FaultRegistry:
     def __init__(self):
         self._lock = threading.Lock()
@@ -286,6 +312,12 @@ class FaultRegistry:
             return None
         if r.action == "bitrot":
             return BITROT
+        if r.action == "torn":
+            return _Torn(r._rng)
+        if r.action == "crash":
+            raise SimulatedCrash(
+                f"fault-injected crash [{r.id} {layer}:{r.target}:{r.op}] "
+                f"{target} at {op}")
         raise ERRORS_BY_NAME[r.error](
             f"fault-injected [{r.id} {layer}:{r.target}:{r.op}] {target}")
 
@@ -339,6 +371,34 @@ def armed(layer: str | None = None) -> bool:
 
 def inject(layer: str, target: str, op: str):
     return _registry.inject(layer, target, op)
+
+
+def torn_truncate(path: str, rng: random.Random | None = None) -> int:
+    """The file-mangling half of a ``torn`` rule: truncate ``path`` at a
+    random offset strictly inside [0, size), simulating the partial page
+    writeback a power cut leaves behind. A directory (a staged dataDir
+    about to be renamed) tears one of its files, chosen by the same RNG.
+    ``rng`` is the rule's seeded RNG (from the :class:`_Torn` result) so
+    a failing cut reproduces; falls back to the global RNG for direct
+    callers. Returns the new size (-1 when the target is missing/empty —
+    nothing to tear)."""
+    if os.path.isdir(path):
+        files = sorted(
+            os.path.join(r, f)
+            for r, _d, fs in os.walk(path) for f in fs)
+        if not files:
+            return -1
+        path = (rng or random).choice(files)
+    try:
+        size = os.path.getsize(path)
+    except OSError:
+        return -1
+    if size <= 0:
+        return -1
+    cut = (rng or random).randrange(0, size)
+    with open(path, "r+b") as f:
+        f.truncate(cut)
+    return cut
 
 
 def corrupt(data: bytes) -> bytes:

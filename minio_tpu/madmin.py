@@ -191,6 +191,12 @@ class AdminClient:
 
     # -- fault injection (chaos harness) --------------------------------------
 
+    def durability_status(self) -> dict:
+        """Durability plane: fsync policy, flusher state, crash-step
+        catalogue, recovery counters, last janitor sweep
+        (docs/durability.md)."""
+        return self._json("GET", "durability")
+
     def fault_status(self) -> dict:
         """Armed fault rules + per-disk health tracker states."""
         return self._json("GET", "fault")

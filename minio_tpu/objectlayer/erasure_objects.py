@@ -24,7 +24,7 @@ from ..erasure.bitrot import (BITROT_CHUNK_KEY, BitrotAlgorithm,
 from ..erasure.codec import ceil_div
 from ..erasure.streaming import erasure_decode, erasure_encode, erasure_heal
 from ..storage.datatypes import ErasureInfo, FileInfo, ObjectPartInfo
-from ..storage.xlstorage import META_BUCKET, META_TMP
+from ..storage.xlstorage import META_BUCKET, META_TMP, new_tmp_id
 from ..utils import errors
 from ..utils.hashreader import HashReader
 from . import datatypes as dt
@@ -133,6 +133,23 @@ class ErasureObjects(MultipartMixin, ObjectLayer):
         from .metacache import MetacacheStore
         #: persisted-listing coordinator (reference cmd/metacache.go:42)
         self.metacache = MetacacheStore(self)
+        # startup crash recovery (docs/durability.md): reclaim tmp
+        # staging stranded by a previous process and expire aged
+        # multipart uploads — O(tmp + multipart), never O(namespace);
+        # the scanner janitor owns the namespace-wide reconcile
+        from ..scanner.janitor import startup_recovery
+        try:
+            startup_recovery(self)
+        except Exception as e:  # noqa: BLE001 — must never block boot,
+            # but a recovery pass failing EVERY boot (perms on tmp, a
+            # sick disk) must not be invisible either
+            from ..obs.logger import log_sys
+            try:
+                log_sys().log_once(
+                    f"startup-recovery:{type(e).__name__}", "warning",
+                    "durability", f"startup recovery failed: {e!r}")
+            except Exception:  # noqa: BLE001 # graftlint: disable=GL007
+                pass  # logging plane absent in minimal library use
 
     def storage_info(self) -> dict:
         """Single-set view (reference StorageInfo for one erasure set);
@@ -345,7 +362,7 @@ class ErasureObjects(MultipartMixin, ObjectLayer):
 
         hr = stream if isinstance(stream, HashReader) else \
             HashReader(stream, size)
-        tmp_id = str(uuid.uuid4())
+        tmp_id = new_tmp_id()
         shuffled = shuffle_disks_by_distribution(disks, distribution)
         writers = []
         for j, d in enumerate(shuffled):
@@ -1140,9 +1157,13 @@ class ErasureObjects(MultipartMixin, ObjectLayer):
             if d is None:
                 state.append(DRIVE_STATE_OFFLINE)
             elif f is None:
+                # FileCorrupt = a torn/quarantined journal (the read
+                # already moved it to xl.meta.corrupt): rebuildable from
+                # quorum exactly like MISSING, not a disk outage
                 state.append(DRIVE_STATE_MISSING if isinstance(
                     errs[i], (errors.FileNotFound,
-                              errors.FileVersionNotFound))
+                              errors.FileVersionNotFound,
+                              errors.FileCorrupt))
                     else DRIVE_STATE_OFFLINE)
             elif round(f.mod_time, 3) != latest_mod or \
                     f.data_dir != fi.data_dir:
@@ -1194,8 +1215,12 @@ class ErasureObjects(MultipartMixin, ObjectLayer):
                 shard_disk[idx - 1] = d
         # target shard index per healed disk: reuse the quorum distribution
         dist = fi.erasure.distribution or hash_order(f"{bucket}/{object}", n)
-        tmp_id = str(uuid.uuid4())
+        tmp_id = new_tmp_id()
         src_errs: list = []
+        # targets whose shard write/close failed for ANY part: their tmp
+        # data is incomplete or not durably written — committing it via
+        # rename_data would heal in bad shards
+        failed_targets: set = set()
         for part in fi.parts:
             logical = er.shard_file_size(part.size)
             readers = []
@@ -1231,6 +1256,12 @@ class ErasureObjects(MultipartMixin, ObjectLayer):
             try:
                 src_errs.extend(
                     erasure_heal(er, writers, readers, part.size))
+                # a None slot here means the target failed THIS part —
+                # writer creation raised above, or erasure_heal nulled
+                # it on a write/close error — so the disk's tmp dataDir
+                # is incomplete and must not commit
+                failed_targets.update(
+                    i for i in to_heal if writers[dist[i] - 1] is None)
             except Exception as e:  # noqa: BLE001
                 heal_err = str(e)
                 raise to_object_err(e, bucket, object) from e
@@ -1256,6 +1287,8 @@ class ErasureObjects(MultipartMixin, ObjectLayer):
                     if src is not None and hasattr(src, "close"):
                         src.close()
         for i in to_heal:
+            if i in failed_targets:
+                continue  # incomplete/non-durable tmp shards stay tmp
             shard_idx = dist[i]
             fih = replace(fi, erasure=replace(fi.erasure, index=shard_idx),
                           metadata=dict(fi.metadata))

@@ -16,7 +16,7 @@ from ..erasure import Erasure, new_bitrot_writer
 from ..erasure.streaming import erasure_encode
 from ..obs import spans as _spans
 from ..storage.datatypes import ErasureInfo, FileInfo, ObjectPartInfo
-from ..storage.xlstorage import META_MULTIPART, META_TMP
+from ..storage.xlstorage import META_MULTIPART, META_TMP, new_tmp_id
 from ..utils import errors
 from ..utils.hashreader import HashReader, etag_from_parts
 from . import datatypes as dt
@@ -140,7 +140,7 @@ class MultipartMixin:
 
         hr = stream if isinstance(stream, HashReader) else \
             HashReader(stream, size)
-        tmp_id = str(uuid.uuid4())
+        tmp_id = new_tmp_id()
         shuffled = shuffle_disks_by_distribution(
             disks, fi.erasure.distribution)
         writers = []
@@ -337,7 +337,7 @@ class MultipartMixin:
         fi.metadata = meta
 
         write_quorum = fi.write_quorum(fi.erasure.parity_blocks)
-        tmp_id = str(uuid.uuid4())
+        tmp_id = new_tmp_id()
         errs = [None] * len(disks)
         futs = {}
         for i, d in enumerate(disks):

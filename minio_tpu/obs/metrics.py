@@ -572,6 +572,25 @@ def _g_disk_health(server) -> list[str]:
     return lines
 
 
+def _g_durability(server) -> list[str]:
+    """Durability plane: effective fsync policy + batched-flusher state
+    (the counters — fsyncs, recovered tmp, quarantines, purge failures —
+    live in the counter store and render with everything else)."""
+    try:
+        from ..storage import durability as dur
+        st = dur.status()
+    except Exception:  # noqa: BLE001
+        return []
+    return [
+        "# TYPE minio_tpu_durability_fsync_mode gauge",
+        f'minio_tpu_durability_fsync_mode{{mode="{st["fsync"]}"}} 1',
+        "# TYPE minio_tpu_durability_fsync_pending gauge",
+        f"minio_tpu_durability_fsync_pending {st['pending']}",
+        "# TYPE minio_tpu_durability_fsync_flushed_total counter",
+        f"minio_tpu_durability_fsync_flushed_total {st['flushed_total']}",
+    ]
+
+
 def _g_locks(server) -> list[str]:
     locker = getattr(server, "local_locker", None)
     if locker is None:
@@ -601,6 +620,9 @@ _GROUPS = [
     # disk health reads in-memory tracker state — interval 0 so a trip
     # is visible on the very next scrape (and in chaos tests)
     MetricsGroup("disk_health", "node", _g_disk_health, interval=0),
+    # durability reads in-memory flusher/config state — interval 0 so a
+    # policy flip or a growing fsync backlog shows immediately
+    MetricsGroup("durability", "node", _g_durability, interval=0),
     MetricsGroup("process", "node", _g_process),
     MetricsGroup("locks", "node", _g_locks),
     MetricsGroup("notification", "cluster", _g_notification),

@@ -2,11 +2,28 @@
 mrfOpCh + addPartial, cmd/erasure-object.go:1132): operations that detect a
 partial/degraded write or read enqueue the object here; a background worker
 heals them. Queue is bounded and drop-oldest (heal is best-effort; the
-scanner sweeps anything missed)."""
+scanner sweeps anything missed).
+
+PR 6: the queue optionally persists to a small journal
+(``attach_persistence``) committed through ``durable_replace``, so heal
+debt recorded before a crash is re-enqueued after reconstruction instead
+of waiting for the next deep scanner cycle to rediscover it. All journal
+IO runs on the MRF drain thread (throttled by FLUSH_INTERVAL_S, forced
+on idle passes) — add_partial runs on foreground threads signalling
+degraded reads and must never pay serialization + fsyncs. The accepted
+crash window is the marks since the drain loop's last flush, the same
+trade the update tracker makes."""
 from __future__ import annotations
 
+import json
+import os
 import queue
 import threading
+import time
+
+#: min seconds between journal rewrites (an add storm must not turn
+#: into a fsync storm); the drain loop flushes pending dirt on idle
+FLUSH_INTERVAL_S = 0.25
 
 
 class MRFHealer:
@@ -18,6 +35,19 @@ class MRFHealer:
         self.healed = 0
         self.failed = 0
         self.dropped = 0
+        self._persist_path: str | None = None
+        self._plock = threading.Lock()
+        #: (bucket, object, version_id) -> scan_mode, mirroring queued
+        #: entries for the journal ("deep" wins a dedupe collision);
+        #: bounded by the queue: dequeues AND drop-oldest evictions both
+        #: _forget their key
+        self._persist_entries: dict[tuple, str] = {}
+        self._pdirty = False
+        self._last_flush = 0.0
+        #: single-writer flush gate: two overlapping snapshots would
+        #: race their durable_replace and a stale journal could land
+        #: LAST with the dirty flag already cleared
+        self._flushing = False
 
     def add_partial(self, bucket: str, object: str, version_id: str = "",
                     scan_mode: str = "normal"):
@@ -34,6 +64,7 @@ class MRFHealer:
         item = (bucket, object, version_id, scan_mode)
         landed = False
         dropped = 0
+        evicted: list[tuple] = []
         for attempt in range(3):  # initial put + drop-oldest + one retry
             try:
                 self.q.put_nowait(item)
@@ -43,7 +74,7 @@ class MRFHealer:
                 if attempt == 2:
                     break
                 try:
-                    self.q.get_nowait()
+                    evicted.append(self.q.get_nowait())
                     dropped += 1  # an older entry made room
                 except queue.Empty:
                     pass
@@ -52,6 +83,115 @@ class MRFHealer:
         if dropped:
             self.dropped += dropped
             mx.inc("minio_tpu_mrf_dropped_total", dropped)
+        if self._persist_path is not None:
+            key = (bucket, object, version_id)
+            if landed:
+                with self._plock:
+                    if scan_mode == "deep" or \
+                            key not in self._persist_entries:
+                        self._persist_entries[key] = scan_mode
+                    self._pdirty = True
+            # drop-oldest evictions leave the journal too, or the
+            # persisted set outgrows the queue forever and resurrects
+            # debt the queue already shed — unless an identical-key
+            # duplicate is still queued (the queue does not dedupe):
+            # the journal mirrors the queue's KEY SET, and debt the
+            # queue still holds must survive a crash
+            for b, o, v, _m in evicted:
+                if (b, o, v) != key and not self._queued((b, o, v)):
+                    with self._plock:
+                        self._persist_entries.pop((b, o, v), None)
+                        self._pdirty = True
+            # NO inline flush: add_partial runs on foreground threads
+            # (degraded GETs signal read faults) and must not pay JSON
+            # serialization + strict fsyncs — the drain loop owns all
+            # journal IO; the marks stay dirty until its next pass
+
+    # -- persistence ----------------------------------------------------------
+
+    def attach_persistence(self, path: str, load: bool = True) -> int:
+        """Point the queue at its on-disk journal; an existing file's
+        entries are re-enqueued (restart recovery). Returns the number
+        of entries recovered.
+
+        The journal mirror is pre-populated with EVERY loaded entry
+        before the first replay add can flush — otherwise that first
+        flush rewrites the on-disk journal as a 1-entry snapshot and a
+        crash mid-replay loses the rest of the recovered heal debt."""
+        self._persist_path = path
+        if not load:
+            return 0
+        try:
+            with open(path, encoding="utf-8") as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            return 0
+        loaded = []
+        for e in doc.get("entries", []):
+            try:
+                loaded.append((e["bucket"], e["object"],
+                               e.get("version_id", ""),
+                               e.get("scan_mode", "normal")))
+            except (KeyError, TypeError):
+                continue
+        with self._plock:
+            for b, o, v, m in loaded:
+                if m == "deep" or (b, o, v) not in self._persist_entries:
+                    self._persist_entries[(b, o, v)] = m
+        for b, o, v, m in loaded:
+            self.add_partial(b, o, v, scan_mode=m)
+        return len(loaded)
+
+    def _queued(self, key: tuple) -> bool:
+        """Best-effort 'is this key still in the queue' (snapshot under
+        the GIL; evictions and post-heal forgets are rare, the queue is
+        bounded, so the O(n) scan is fine)."""
+        return any((b, o, v) == key
+                   for (b, o, v, _m) in list(self.q.queue))
+
+    def _forget(self, key: tuple) -> None:
+        if self._persist_path is None or self._queued(key):
+            return  # a duplicate still queued keeps the journal entry
+        with self._plock:
+            self._persist_entries.pop(key, None)
+            self._pdirty = True
+
+    def _flush(self, force: bool = False) -> None:
+        """Throttled single-writer journal rewrite via durable_write:
+        the snapshot is taken under the lock, the IO happens outside
+        it, and only ONE flush is ever in flight — a second snapshot
+        racing the first's rename could land a STALE journal last. A
+        skipped flush leaves the dirty flag set; the drain loop's idle
+        pass settles it."""
+        path = self._persist_path
+        if path is None:
+            return
+        now = time.monotonic()
+        with self._plock:
+            if not self._pdirty or self._flushing:
+                return
+            if not force and now - self._last_flush < FLUSH_INTERVAL_S:
+                return  # stays dirty; the drain loop flushes on idle
+            self._flushing = True
+            self._pdirty = False
+            self._last_flush = now
+            entries = [{"bucket": b, "object": o, "version_id": v,
+                        "scan_mode": m}
+                       for (b, o, v), m in self._persist_entries.items()]
+        from ..storage.durability import durable_write
+        try:
+            os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+            durable_write(path, json.dumps(
+                {"entries": entries}).encode("utf-8"))
+        except OSError:
+            # best-effort, but RETRYABLE: leave the state dirty so the
+            # drain loop's idle pass rewrites once the disk recovers —
+            # otherwise this snapshot is silently gone from the journal
+            with self._plock:
+                self._pdirty = True
+        finally:
+            with self._plock:
+                self._flushing = False
 
     def start(self):
         self._thread = threading.Thread(target=self._loop, daemon=True,
@@ -69,6 +209,7 @@ class MRFHealer:
                 bucket, object, version_id, scan_mode = self.q.get(
                     timeout=0.5)
             except queue.Empty:
+                self._flush(force=True)  # idle: settle throttled dirt
                 continue
             try:
                 from .. import qos
@@ -79,6 +220,15 @@ class MRFHealer:
                 self.healed += 1
             except Exception:  # noqa: BLE001
                 self.failed += 1
+            # attempted either way: a persistently failing entry must
+            # not resurrect forever across restarts (the deep scanner
+            # cycle re-finds anything still genuinely degraded)
+            self._forget((bucket, object, version_id))
+            self._flush()  # on OUR thread, throttled by FLUSH_INTERVAL_S
+
+    def flush_journal(self) -> None:
+        """Force the persistence journal onto disk (tests/shutdown)."""
+        self._flush(force=True)
 
     def drain(self, timeout: float = 30.0):
         """Block until the queue is empty (tests / shutdown)."""
@@ -91,3 +241,4 @@ class MRFHealer:
         self._stop.set()
         if self._thread is not None:
             self._thread.join(timeout=5)
+        self._flush(force=True)

@@ -45,6 +45,10 @@ class DataScanner:
                 _os.path.join(disk.base, META_BUCKET, "tracker.bin"))
         except StopIteration:
             pass
+        # crash-residue janitor (docs/durability.md): aged tmp + stale
+        # multipart every cycle, namespace reconcile on deep cycles
+        from .janitor import DurabilityJanitor
+        self.janitor = DurabilityJanitor(objlayer)
 
     def start(self):
         self._thread = threading.Thread(target=self._loop, daemon=True,
@@ -80,6 +84,17 @@ class DataScanner:
         mx.inc("minio_tpu_scanner_cycles_total",
                deep=str(deep).lower())
         t_cycle = time.perf_counter()
+        try:
+            # cheap jobs (aged tmp sweep + stale multipart expiry) every
+            # cycle; the O(namespace) ddir/quarantine reconcile only on
+            # deep cycles — the same cadence as the bitrot verify walk
+            self.janitor.sweep(reconcile=deep)
+        except Exception as e:  # noqa: BLE001 — best-effort, but a
+            # janitor failing every cycle must be visible (GL007 spirit)
+            from ..obs.logger import log_sys
+            log_sys().log_once(
+                f"janitor:{type(e).__name__}", "warning", "scanner",
+                f"durability sweep failed: {e!r}")
         tracker = global_tracker()
         gen = tracker.begin_cycle()
         prev_buckets = self.last_usage.get("buckets", {}) \

@@ -171,17 +171,12 @@ class UpdateTracker:
             for g, f in self._history:
                 blob += struct.pack("<Q", g)
                 blob += f.bits
-        tmp = f"{path}.tmp.{os.getpid()}.{threading.get_ident()}"
+        from ..storage.durability import durable_write
         try:
             os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
-            with open(tmp, "wb") as f:
-                f.write(bytes(blob))
-            os.replace(tmp, path)
+            durable_write(path, bytes(blob))
         except OSError:  # persistence is best-effort (reference save too)
-            try:
-                os.unlink(tmp)
-            except OSError:
-                pass
+            pass
 
     def load(self) -> bool:
         path = self._persist_path

@@ -209,6 +209,23 @@ def _dispatch_admin(h, op: str) -> None:
         from ..qos import qos_status
         return h._send(200, json.dumps(qos_status(h.s3)).encode(),
                        "application/json")
+    if op == "durability":
+        # durability plane: effective fsync policy + flusher state,
+        # registered crash steps, recovery/quarantine/purge counters,
+        # last janitor sweep stats (docs/durability.md)
+        from ..obs.metrics import counters_snapshot
+        from ..storage import durability as _dur
+        from ..storage.xlstorage import WRITE_STEPS
+        scanner = getattr(h.s3, "scanner", None)
+        janitor = getattr(scanner, "janitor", None)
+        counters = {k: v for k, v in counters_snapshot().items()
+                    if k.startswith("minio_tpu_durability_")}
+        return h._send(200, json.dumps({
+            **_dur.status(),
+            "write_steps": list(WRITE_STEPS),
+            "counters": counters,
+            "last_sweep": getattr(janitor, "last_stats", {}) or {},
+        }).encode(), "application/json")
     if op == "fault":
         return _fault_op(h)
     if op == "bg-heal-status":

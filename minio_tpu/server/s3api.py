@@ -402,7 +402,19 @@ class S3Server:
         from ..scanner.autoheal import AutoHealMonitor
         from ..scanner.mrf import MRFHealer
         from ..scanner.scanner import DataScanner
-        self.mrf = MRFHealer(self.obj).start()
+        self.mrf = MRFHealer(self.obj)
+        # persist the heal queue beside the tracker state on the first
+        # local disk: heal debt recorded before a crash is re-enqueued
+        # at the next start instead of waiting for a deep scanner cycle
+        try:
+            from ..storage.xlstorage import META_BUCKET
+            disk = next(d for d in _all_disks(self.obj)
+                        if getattr(d, "base", ""))
+            self.mrf.attach_persistence(
+                os.path.join(disk.base, META_BUCKET, "mrf.json"))
+        except StopIteration:
+            pass
+        self.mrf.start()
         lc = LifecycleSys(self.obj, self.bucket_meta, self.transition)
         self.scanner = DataScanner(
             self.obj, interval_s=float(os.environ.get(

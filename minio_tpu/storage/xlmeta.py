@@ -8,19 +8,44 @@ cmd/xl-storage-format-v2.go:33-38) followed by one msgpack map:
     {"Versions": [ {"Type": 1|2, "ModTime": f64, "V": {...}} ... ],
      "Data": {dataDir?: inlined bytes}}          # small-object inlining (A.4)
 
+New blobs write format version 2 (``XLT2 2  ``) and end with a
+``XLC1`` + CRC32 torn-write detector (PR 6; see XL_TRAILER_MAGIC
+below); version-1 blobs load trailer-free for backward compatibility.
+
 Versions are kept sorted newest-first. Type 1 = object (full FileInfo incl.
 erasure geometry), Type 2 = delete marker. The legacy v1 type is not carried
 over — this framework has no pre-v2 history to migrate.
 """
 from __future__ import annotations
 
+import struct
+import zlib
+
 import msgpack
 
 from ..utils import errors
 from .datatypes import ErasureInfo, FileInfo, ObjectPartInfo
 
+#: legacy format version (pre-PR-6): msgpack only, no trailer
 XL_HEADER = b"XLT2 1  "
+#: current format version: msgpack + REQUIRED trailing checksum
+XL_HEADER_V2 = b"XLT2 2  "
 XL_META_FILE = "xl.meta"
+#: quarantine name the recovery plane renames unparseable journals to
+#: (forensics survive; the object slot becomes healable)
+XL_META_CORRUPT_FILE = "xl.meta.corrupt"
+
+#: trailing torn-write detector: every dump() writes the version-2
+#: header and appends this magic + a CRC32 of everything before it. A
+#: power cut mid-writeback (or a ``torn`` fault rule) leaves a v2 blob
+#: whose trailer is missing or whose checksum mismatches — load()
+#: rejects it as FileCorrupt instead of serving a silently truncated
+#: version journal. The header version (not tail-sniffing) decides
+#: whether a trailer is expected, so a legacy v1 blob whose inlined
+#: data happens to end with the magic bytes can never be misread as
+#: torn; v1 blobs load trailer-free (pre-PR-6 stores stay readable).
+XL_TRAILER_MAGIC = b"XLC1"
+XL_TRAILER_LEN = len(XL_TRAILER_MAGIC) + 4
 
 TYPE_OBJECT = 1
 TYPE_DELETE_MARKER = 2
@@ -77,9 +102,23 @@ class XLMeta:
     def load(cls, blob: bytes) -> "XLMeta":
         if len(blob) < len(XL_HEADER) or blob[:4] != XL_HEADER[:4]:
             raise errors.FileCorrupt("bad xl.meta header")
+        if blob[:len(XL_HEADER_V2)] == XL_HEADER_V2:
+            # v2: the trailer is REQUIRED — a tear that removes exactly
+            # the trailer bytes is detected too, not mistaken for legacy
+            if len(blob) < len(XL_HEADER_V2) + XL_TRAILER_LEN or \
+                    blob[-XL_TRAILER_LEN:-4] != XL_TRAILER_MAGIC:
+                raise errors.FileCorrupt(
+                    "xl.meta v2 trailer missing (torn write)")
+            (want,) = struct.unpack("<I", blob[-4:])
+            if zlib.crc32(blob[:-XL_TRAILER_LEN]) & 0xFFFFFFFF != want:
+                raise errors.FileCorrupt(
+                    "xl.meta trailer checksum mismatch (torn write)")
+            payload = blob[len(XL_HEADER_V2):-XL_TRAILER_LEN]
+        else:
+            payload = blob[len(XL_HEADER):]  # v1 legacy: no trailer
         m = cls()
         try:
-            doc = msgpack.unpackb(blob[len(XL_HEADER):], raw=False,
+            doc = msgpack.unpackb(payload, raw=False,
                                   strict_map_key=False)
         except Exception as e:  # noqa: BLE001
             raise errors.FileCorrupt(f"xl.meta unpack: {e}") from e
@@ -89,7 +128,9 @@ class XLMeta:
 
     def dump(self) -> bytes:
         doc = {"Versions": self.versions, "Data": self.data}
-        return XL_HEADER + msgpack.packb(doc, use_bin_type=True)
+        body = XL_HEADER_V2 + msgpack.packb(doc, use_bin_type=True)
+        return body + XL_TRAILER_MAGIC + \
+            struct.pack("<I", zlib.crc32(body) & 0xFFFFFFFF)
 
     # -- journal ops ---------------------------------------------------------
 

@@ -6,7 +6,10 @@ cmd/xl-storage-format-v2.go:72-80, SURVEY.md A.2).
 
 Write discipline mirrors the reference: shard data streams into
 ``.minio.sys/tmp/<uuid>/...`` and is committed by an atomic rename
-(rename_data); xl.meta updates write-to-tmp + os.replace. Small objects
+(rename_data); xl.meta updates write-to-tmp + ``durable_replace`` (the
+fsync-policy commit primitive, storage/durability.py — docs/durability.md
+has the crash-consistency story, WRITE_STEPS below the crash-point
+catalogue). Small objects
 inline their data into xl.meta (A.4). O_DIRECT is intentionally not used —
 Python buffered I/O + the OS page cache stand in for the reference's
 hand-rolled aligned reads; the TPU hot path cares about device dispatch, not
@@ -27,8 +30,10 @@ from ..obs import spans as _spans
 from ..obs import trace as _trc
 from ..utils import errors
 from .datatypes import DiskInfo, FileInfo, VolInfo
+from .durability import (durable_replace, durable_replace_dir,
+                         fsync_after_write)
 from .interface import StorageAPI
-from .xlmeta import XL_META_FILE, XLMeta
+from .xlmeta import XL_META_CORRUPT_FILE, XL_META_FILE, XLMeta
 
 #: Reserved system volume (reference minioMetaBucket ".minio.sys").
 META_BUCKET = ".minio.sys"
@@ -37,12 +42,55 @@ META_MULTIPART = f"{META_BUCKET}/multipart"
 META_BUCKETS = f"{META_BUCKET}/buckets"
 FORMAT_FILE = "format.json"
 
+#: Registered crash points (docs/durability.md): each is a named step in
+#: the commit choreography where a ``crash`` or ``torn`` fault rule
+#: (``disk:<target>:<step>:crash``) can fire, and the crash matrix
+#: (tests/test_crash.py) proves all-or-nothing recovery for every one.
+WRITE_STEPS = (
+    "pre_replace",        # tmp written, about to become visible
+    "post_replace",       # rename landed, fsync policy applied
+    "pre_data_rename",    # rename_data: before the dataDir moves
+    "post_data_rename",   # dataDir visible, xl.meta not yet updated
+    "pre_meta_write",     # version journal about to be rewritten
+    "post_meta_write",    # journal committed, tmp/purge cleanup pending
+    "pre_rename_file",    # rename_file commit (multipart part promote)
+    "pre_append",         # append_file about to mutate in place
+)
+
 
 def _check_path(p: str):
     if p.startswith("/") or ".." in p.split("/"):
         raise errors.FileAccessDenied(p)
     if any(len(seg) > 255 for seg in p.split("/")):
         raise errors.FileNameTooLong(p)
+
+
+def new_tmp_id() -> str:
+    """pid-prefixed staging id for everything under ``.minio.sys/tmp``:
+    sweep_tmp skips entries minted by a DIFFERENT still-alive process
+    (shared-disk peer layers must not eat each other's in-flight
+    staging), while a restart — a new pid — reclaims everything the
+    dead process left behind."""
+    return f"{os.getpid()}-{uuid.uuid4()}"
+
+
+def _minted_by_live_peer(name: str) -> bool:
+    """True when a tmp entry carries another LIVE process's pid prefix.
+    Legacy/unprefixed names (plain uuids) parse as absent or absurd pids
+    and sweep exactly as before."""
+    pid_s = name.split("-", 1)[0]
+    if not pid_s.isdigit():
+        return False
+    pid = int(pid_s)
+    if pid == os.getpid():
+        return False
+    try:
+        os.kill(pid, 0)
+        return True
+    except (ProcessLookupError, OverflowError):
+        return False
+    except OSError:
+        return True  # EPERM etc.: exists under another uid — alive
 
 
 class _FileWriter:
@@ -63,6 +111,18 @@ class _FileWriter:
 
     def close(self):
         self._f.close()
+        # shard bytes land under the fsync policy too: a commit
+        # (rename_data) of dirents whose file CONTENT never hit media is
+        # exactly the torn-shard case the durability plane exists for.
+        # ``always`` fsyncs here, pre-rename (strongest ordering);
+        # ``batched`` must NOT enqueue this soon-to-be-renamed tmp path
+        # — rename_data enqueues the files at their committed location
+        # instead (durable_replace_dir's tree marker)
+        from .durability import FSYNC_ALWAYS, fsync_mode, fsync_path
+        if fsync_mode() == FSYNC_ALWAYS:
+            # strict: a failed shard writeback fails THIS disk's write;
+            # quorum routes around it instead of committing air
+            fsync_path(self._path, kind="file", strict=True)
 
     def abort(self):
         self._f.close()
@@ -183,7 +243,9 @@ class XLStorage(StorageAPI):
         self.base = os.path.abspath(base_dir)
         self._endpoint = endpoint or self.base
         self._disk_id = ""
-        self._meta_lock = threading.Lock()
+        # RLock: _quarantine_meta re-verifies under the lock and is
+        # reached from _load_meta calls that may already hold it
+        self._meta_lock = threading.RLock()
         os.makedirs(self.base, exist_ok=True)
         os.makedirs(self._abs(META_TMP), exist_ok=True)
         os.makedirs(self._abs(META_MULTIPART), exist_ok=True)
@@ -203,6 +265,28 @@ class XLStorage(StorageAPI):
             in_bytes: int = 0) -> _OpSpan:
         return _OpSpan(self._endpoint, op,
                        f"{volume}/{path}" if path else volume, in_bytes)
+
+    def _write_step(self, step: str, tmp: str | None = None) -> None:
+        """Named crash point in the commit choreography (WRITE_STEPS):
+        a ``crash`` rule raises SimulatedCrash here (no cleanup runs —
+        in-process kill -9), a ``torn`` rule truncates the pending tmp
+        file at a random offset before it becomes visible. One armed-
+        flag check when no chaos is running."""
+        if not _fault.armed("disk"):
+            return
+        res = _fault.inject("disk", self._endpoint, step)
+        if isinstance(res, _fault._Torn):
+            if tmp:
+                _fault.torn_truncate(tmp, res.rng)
+            else:
+                # the rule fired (and spent its hit budget) but this
+                # step owns no pending tmp — a silently green chaos
+                # test is worse than a loud misconfiguration
+                from ..obs.logger import log_sys
+                log_sys().log_once(
+                    f"torn-no-tmp:{step}", "warning", "fault",
+                    f"torn rule fired at step {step!r} which owns no "
+                    f"pending tmp file — nothing was torn")
 
     def get_disk_id(self) -> str:
         return self._disk_id
@@ -336,17 +420,21 @@ class XLStorage(StorageAPI):
         if not os.path.isdir(self._abs(volume)):
             raise errors.VolumeNotFound(volume)
         os.makedirs(os.path.dirname(dst), exist_ok=True)
-        tmp = self._abs(META_TMP, str(uuid.uuid4()))
+        tmp = self._abs(META_TMP, new_tmp_id())
         with open(tmp, "wb") as f:
             f.write(data)
-        os.replace(tmp, dst)
+        self._write_step("pre_replace", tmp=tmp)
+        durable_replace(tmp, dst)
+        self._write_step("post_replace")
 
     def append_file(self, volume: str, path: str, data: bytes) -> None:
         with self._op("append_file", volume, path, in_bytes=len(data)):
             dst = self._abs(volume, path)
             os.makedirs(os.path.dirname(dst), exist_ok=True)
+            self._write_step("pre_append")
             with open(dst, "ab") as f:
                 f.write(data)
+            fsync_after_write(dst)
 
     def create_file_writer(self, volume: str, path: str):
         if _fault.armed("disk"):
@@ -366,7 +454,8 @@ class XLStorage(StorageAPI):
             if not os.path.exists(src):
                 raise errors.FileNotFound(src_path)
             os.makedirs(os.path.dirname(dst), exist_ok=True)
-            os.replace(src, dst)
+            self._write_step("pre_rename_file", tmp=src)
+            durable_replace(src, dst)
 
     def delete_path(self, volume: str, path: str, recursive: bool = False
                     ) -> None:
@@ -423,7 +512,42 @@ class XLStorage(StorageAPI):
             blob = self._read_all_inner(volume, f"{path}/{XL_META_FILE}")
         except errors.FileNotFound:
             raise errors.FileNotFound(path) from None
-        return XLMeta.load(blob)
+        try:
+            return XLMeta.load(blob)
+        except errors.FileCorrupt:
+            self._quarantine_meta(volume, path)
+            raise
+
+    def _quarantine_meta(self, volume: str, path: str) -> bool:
+        """Move an unparseable/torn xl.meta aside to xl.meta.corrupt:
+        forensics survive, and the slot reads FileNotFound from now on —
+        which heal classifies as MISSING and rebuilds from quorum
+        (leaving the torn journal in place would wedge every write path
+        that loads-then-stores it).
+
+        Re-verifies under ``_meta_lock`` before renaming: the lockless
+        read paths (read_version/read_versions) reach here too, and
+        between their torn read and this rename a writer or heal may
+        have committed a VALID journal at the same path — quarantining
+        that would re-degrade a just-healed disk."""
+        src = self._meta_path(volume, path)
+        dst = self._abs(volume, path, XL_META_CORRUPT_FILE)
+        with self._meta_lock:
+            try:
+                XLMeta.load(self._read_all_inner(
+                    volume, f"{path}/{XL_META_FILE}"))
+                return False  # valid now — a concurrent commit won
+            except errors.FileCorrupt:
+                pass
+            except (errors.StorageError, OSError):
+                return False  # gone/unreadable: nothing to move aside
+            try:
+                durable_replace(src, dst)
+            except OSError:
+                return False
+        from ..obs import metrics as mx
+        mx.inc("minio_tpu_durability_quarantined_meta_total")
+        return True
 
     def _store_meta(self, volume: str, path: str, meta: XLMeta) -> None:
         if not meta.versions:
@@ -452,23 +576,44 @@ class XLStorage(StorageAPI):
                 os.makedirs(os.path.dirname(dst), exist_ok=True)
                 if os.path.isdir(dst):
                     shutil.rmtree(dst)
-                os.replace(src, dst)
+                # tmp=src: a torn rule here tears a shard inside the
+                # staged dataDir before it becomes visible
+                self._write_step("pre_data_rename", tmp=src)
+                # dir commit: batched mode enqueues ONE tree marker
+                # covering the shard files' CONTENT at the committed
+                # location (their tmp paths are gone after the rename),
+                # dst itself, and the parent dirent
+                durable_replace_dir(src, dst)
+                self._write_step("post_data_rename")
+            self._write_step("pre_meta_write")
             old_ddirs = meta.add_version(fi)
             self._store_meta(dst_volume, dst_path, meta)
+            self._write_step("post_meta_write")
             self._purge_ddirs(dst_volume, dst_path, old_ddirs)
-        # clean the tmp parent dir
+        # clean the tmp parent dir; a failure here leaks tmp space until
+        # the janitor reclaims it — make that visible, not silent
+        # (already-gone is success: a prior call or the janitor won)
         try:
             shutil.rmtree(self._abs(src_volume, src_path.split("/")[0]))
-        except OSError:
+        except FileNotFoundError:
             pass
+        except OSError:
+            from ..obs import metrics as mx
+            mx.inc("minio_tpu_durability_purge_failed_total", kind="tmp")
 
     def _purge_ddirs(self, volume: str, path: str, ddirs: list[str]):
-        """Remove data dirs of replaced versions (overwrite cleanup)."""
+        """Remove data dirs of replaced versions (overwrite cleanup).
+        Failures count in ``minio_tpu_durability_purge_failed_total`` so
+        leaked space is visible before the janitor reclaims it."""
         for ddir in ddirs:
             try:
                 shutil.rmtree(self._abs(volume, path, ddir))
-            except OSError:
+            except FileNotFoundError:
                 pass
+            except OSError:
+                from ..obs import metrics as mx
+                mx.inc("minio_tpu_durability_purge_failed_total",
+                       kind="ddir")
 
     def write_metadata(self, volume: str, path: str, fi: FileInfo) -> None:
         with self._op("write_metadata", volume, path), self._meta_lock:
@@ -569,6 +714,224 @@ class XLStorage(StorageAPI):
                     off += n
             finally:
                 src.close()
+
+    # --- crash recovery -----------------------------------------------------
+
+    def sweep_tmp(self, age_s: float = 0.0) -> int:
+        """Reclaim ``.minio.sys/tmp`` entries older than ``age_s``
+        (reference: formatting tmp wholesale at startup, the scanner
+        reaping strays later). Crash-stranded upload staging is the only
+        thing that lives here; age 0 sweeps everything minted by this or
+        any DEAD process. Entries pid-prefixed by a different still-LIVE
+        process are always skipped: a second ObjectLayer booting over
+        shared disk dirs (the peer-layer pattern) must not eat a live
+        peer's in-flight PUT staging."""
+        with self._op("sweep_tmp", META_TMP):
+            base = self._abs(META_TMP)
+            try:
+                names = os.listdir(base)
+            except OSError:
+                return 0
+            now = time.time()
+            swept = 0
+            for name in names:
+                p = os.path.join(base, name)
+                if _minted_by_live_peer(name):
+                    continue
+                try:
+                    if age_s > 0 and now - os.stat(p).st_mtime < age_s:
+                        continue
+                    if os.path.isdir(p):
+                        shutil.rmtree(p)
+                    else:
+                        os.unlink(p)
+                    swept += 1
+                except OSError:
+                    continue  # raced with a concurrent commit/clean
+            if swept:
+                from ..obs import metrics as mx
+                mx.inc("minio_tpu_durability_recovered_tmp_total", swept)
+            return swept
+
+    @staticmethod
+    def _subtree_has_meta(p: str) -> bool:
+        """True when any descendant carries a version journal (xl.meta,
+        or a quarantined one awaiting heal) — the dir is object
+        namespace, never dataDir residue."""
+        for _root, _dirs, files in os.walk(p):
+            if XL_META_FILE in files or XL_META_CORRUPT_FILE in files:
+                return True
+        return False
+
+    def reconcile_object(self, volume: str, path: str,
+                         age_s: float = 0.0) -> dict:
+        """Reconcile one object dir against its version journal
+        (recovery janitor): quarantine a torn xl.meta (via _load_meta),
+        then remove data dirs no version references — the residue of a
+        crash between ``post_data_rename`` and the journal commit, or of
+        a failed purge. ``age_s`` guards in-flight overwrites (their
+        dataDir lands moments before the journal does)."""
+        out = {"orphan_ddirs": 0, "quarantined": 0, "has_meta": False}
+        with self._op("reconcile", volume, path):
+            obj_dir = self._abs(volume, path)
+            now = time.time()
+            # phase 1 (locked, fast): load/quarantine the journal,
+            # snapshot referenced ddirs, list the dir
+            with self._meta_lock:
+                referenced = self._reconcile_refs(volume, path, out,
+                                                  age_s, now)
+            try:
+                names = os.listdir(obj_dir)
+            except OSError:
+                return out
+            # phase 2 (lock-FREE): the expensive subtree walks. Nested
+            # namespaces ('a' and 'a/b' both exist: 'b' is a NAMESPACE
+            # dir under 'a''s object dir, holding live objects) are only
+            # SKIPPED here, so walking them without the lock is safe —
+            # holding _meta_lock across O(subtree) IO would stall every
+            # foreground commit on the disk for the walk's duration
+            candidates = []
+            for name in names:
+                p = os.path.join(obj_dir, name)
+                if not os.path.isdir(p) or name in referenced:
+                    continue
+                if self._subtree_has_meta(p):
+                    continue
+                try:
+                    if age_s > 0 and now - os.stat(p).st_mtime < age_s:
+                        continue
+                except OSError:
+                    continue
+                candidates.append(name)
+            # phase 3 (locked, per-candidate, rare): re-verify against a
+            # FRESH journal + subtree (a commit may have raced phase 2 —
+            # rename_data holds the same lock, so this is race-free),
+            # then atomically move the orphan into META_TMP; the actual
+            # rmtree runs outside the lock (a crash mid-way leaves it in
+            # tmp, which the startup sweep reclaims)
+            trash: list[str] = []
+            for name in candidates:
+                p = os.path.join(obj_dir, name)
+                with self._meta_lock:
+                    fresh: dict = {"orphan_ddirs": 0, "quarantined": 0,
+                                   "has_meta": False}
+                    refs = self._reconcile_refs(volume, path, fresh,
+                                                0.0, now)
+                    if name in refs or self._subtree_has_meta(p):
+                        continue
+                    t = self._abs(META_TMP, new_tmp_id())
+                    try:
+                        os.replace(p, t)  # graftlint: disable=GL009
+                    except OSError:
+                        continue
+                    trash.append(t)
+                    out["orphan_ddirs"] += 1
+            for t in trash:
+                shutil.rmtree(t, ignore_errors=True)
+            if out["orphan_ddirs"]:
+                from ..obs import metrics as mx
+                mx.inc("minio_tpu_durability_orphan_ddirs_total",
+                       out["orphan_ddirs"])
+            if not out["has_meta"]:
+                # journal-less slot: fold the dir away so walks stop
+                # yielding a phantom object — immediately when empty,
+                # and after age_s when only the quarantined journal
+                # remains (keeps forensics through the heal window; an
+                # all-disks-corrupt object would otherwise re-walk
+                # forever with no quorum to rebuild it from)
+                with self._meta_lock:
+                    try:
+                        entries = os.listdir(obj_dir)
+                        if not entries:
+                            self._delete_path_inner(volume, path)
+                        elif entries == [XL_META_CORRUPT_FILE] \
+                                and age_s > 0:
+                            cp = os.path.join(obj_dir,
+                                              XL_META_CORRUPT_FILE)
+                            if now - os.stat(cp).st_mtime >= age_s:
+                                self._delete_path_inner(
+                                    volume, path, recursive=True)
+                    except (OSError, errors.StorageError):
+                        pass
+        return out
+
+    def _reconcile_refs(self, volume: str, path: str, out: dict,
+                        age_s: float, now: float) -> set:
+        """Locked journal snapshot for reconcile_object: referenced
+        ddirs, quarantine side effects, and reclamation of a stale
+        ``xl.meta.corrupt`` left beside a journal heal has since
+        rebuilt (forensics are kept for age_s, then they are just a
+        leaked file per torn event)."""
+        referenced: set = set()
+        try:
+            meta = self._load_meta(volume, path)
+            out["has_meta"] = True
+            for d in meta.versions:
+                ddir = d.get("V", {}).get("ddir", "")
+                if ddir:
+                    referenced.add(ddir)
+            cp = self._abs(volume, path, XL_META_CORRUPT_FILE)
+            try:
+                if age_s > 0 and now - os.stat(cp).st_mtime >= age_s:
+                    os.unlink(cp)
+            except OSError:
+                pass
+        except errors.FileCorrupt:
+            out["quarantined"] = 1  # _load_meta moved it aside
+        except errors.FileNotFound:
+            pass
+        return referenced
+
+    def walk_unjournaled(self, volume: str) -> Iterator[str]:
+        """Object dirs holding shard residue but NO xl.meta — the
+        residue of a crash between the dataDir rename and the FIRST
+        journal write of a brand-new object. walk_dir keys on
+        XL_META_FILE and so never yields these; the recovery janitor
+        unions this walk in so reconcile_object can reclaim them. A dir
+        qualifies when it carries a quarantined journal or any child dir
+        with ``part.N`` files; non-qualifying dirs recurse as prefixes."""
+        # eager entry point (not a generator): validation + chaos hook
+        # fire at CALL time, before first next()
+        _fault.inject("disk", self._endpoint, "walk_unjournaled")
+        base = self._abs(volume)
+        if not os.path.isdir(base):
+            raise errors.VolumeNotFound(volume)
+        return self._walk_unjournaled_inner(base)
+
+    @staticmethod
+    def _walk_unjournaled_inner(base: str) -> Iterator[str]:
+
+        def qualifies(d: str, names: list[str]) -> bool:
+            if XL_META_CORRUPT_FILE in names:
+                return True
+            for n in names:
+                sub = os.path.join(d, n)
+                if not os.path.isdir(sub):
+                    continue
+                try:
+                    if any(s.startswith("part.")
+                           for s in os.listdir(sub)):
+                        return True
+                except OSError:
+                    continue
+            return False
+
+        def walk(d: str, rel: str) -> Iterator[str]:
+            try:
+                names = sorted(os.listdir(d))
+            except OSError:
+                return
+            if XL_META_FILE in names:
+                return  # journaled: walk_dir territory
+            if rel and qualifies(d, names):
+                yield rel
+                return
+            for n in names:
+                sub = os.path.join(d, n)
+                if os.path.isdir(sub):
+                    yield from walk(sub, f"{rel}/{n}" if rel else n)
+
+        yield from walk(base, "")
 
     # --- walk ---------------------------------------------------------------
 

@@ -113,6 +113,17 @@ def test_top_api(adm, srv):
     assert any("p50_ms" in v for v in out.values())
 
 
+def test_durability_status(adm):
+    """Durability admin surface (docs/durability.md): policy, flusher
+    state, the registered crash-step catalogue, recovery counters."""
+    st = adm.durability_status()
+    assert st["fsync"] in ("always", "batched", "off")
+    assert isinstance(st["pending"], int)
+    assert "pre_replace" in st["write_steps"]
+    assert len(st["write_steps"]) >= 6
+    assert isinstance(st["counters"], dict)
+
+
 def test_server_update_honest_stub(adm):
     """`mc admin update` surface (reference cmd/update.go): reports the
     running version and says plainly that source deployments have no

@@ -43,7 +43,12 @@ def test_mrf_heals_degraded_object(tmp_path):
     shutil.rmtree(os.path.join(disks[2].base, "b", "o"))
     assert obj.get_object_bytes("b", "o") == data
     mrf.drain()
-    time.sleep(0.3)
+    # drain() only empties the queue; the dequeued heal may still be
+    # running — and the FIRST reconstruct in the process can pay tens of
+    # seconds of kernel compile, so poll instead of a fixed sleep
+    deadline = time.monotonic() + 60.0
+    while mrf.healed + mrf.failed < 1 and time.monotonic() < deadline:
+        time.sleep(0.05)
     assert mrf.healed >= 1
     disks[2].read_version("b", "o")  # healed back
     mrf.stop()

@@ -1,5 +1,5 @@
 """Tier-1 gate for graftlint (docs/static-analysis.md): the tree must
-carry zero unbaselined findings, all eight checkers must be active, and
+carry zero unbaselined findings, all nine checkers must be active, and
 the suppression/baseline machinery must behave deterministically —
 checked here against synthetic sources so a checker regression fails
 loudly instead of silently passing a dirty tree."""
@@ -39,8 +39,8 @@ def test_tree_is_clean():
         f.render() for f in fresh)
 
 
-def test_eight_checkers_active():
-    assert len(checkers.PER_FILE) + len(checkers.PROJECT) >= 8
+def test_all_checkers_active():
+    assert len(checkers.PER_FILE) + len(checkers.PROJECT) >= 9
 
 
 def test_cli_clean_tree_exits_zero(capsys):
@@ -243,6 +243,32 @@ def test_gl007_bare_except_and_daemon_swallow():
     found = checkers.check_swallowed_exceptions(ctx)
     assert len(found) == 2
     assert {f.token for f in found} == {"swallow:_loop", "bare-except"}
+
+
+def test_gl009_bare_replace_flagged():
+    ctx = ctx_for("""
+        import os
+        def commit(tmp, dst):
+            os.replace(tmp, dst)
+        def legacy(a, b):
+            os.rename(a, b)
+    """)
+    found = checkers.check_bare_replace(ctx)
+    assert len(found) == 2
+    assert all(f.checker == "GL009" for f in found)
+    assert {f.scope for f in found} == {"commit", "legacy"}
+
+
+def test_gl009_helper_module_and_foreign_paths_exempt():
+    src = """
+        import os
+        def durable_replace(tmp, dst):
+            os.replace(tmp, dst)
+    """
+    assert checkers.check_bare_replace(
+        ctx_for(src, path="minio_tpu/storage/durability.py")) == []
+    assert checkers.check_bare_replace(
+        ctx_for(src, path="tools/somewhere.py")) == []
 
 
 def test_gl008_undocumented_dynamic_key_flagged():

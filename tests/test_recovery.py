@@ -1,0 +1,482 @@
+"""Restart-recovery coverage (ISSUE 6 satellites): the durable commit
+primitive and fsync modes, the XLMeta torn-write checksum, quarantine on
+read, QueueStore/MRF journals surviving reconstruction, stale multipart
+expiry, and the janitor's orphan-dataDir reconcile."""
+import io
+import json
+import os
+import sys
+import time
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(__file__))
+
+from minio_tpu.event.queuestore import QueueStore  # noqa: E402
+from minio_tpu.objectlayer import ErasureObjects  # noqa: E402
+from minio_tpu.scanner.janitor import DurabilityJanitor  # noqa: E402
+from minio_tpu.scanner.mrf import MRFHealer  # noqa: E402
+from minio_tpu.storage import XLStorage  # noqa: E402
+from minio_tpu.storage import durability  # noqa: E402
+from minio_tpu.storage.xlmeta import (XL_HEADER, XLMeta)  # noqa: E402
+from minio_tpu.utils import errors  # noqa: E402
+
+OBJ = 256 << 10
+
+
+def _body(seed=0, n=OBJ):
+    return np.random.default_rng(seed).integers(
+        0, 256, n, dtype=np.uint8).tobytes()
+
+
+def _layer(root, n=6, parity=2, make=True):
+    disks = [XLStorage(os.path.join(root, f"d{i:02d}")) for i in range(n)]
+    ol = ErasureObjects(disks, default_parity=parity)
+    if make:
+        ol.make_bucket("b")
+    return ol
+
+
+# --- durable_replace / fsync policy -----------------------------------------
+
+
+@pytest.mark.parametrize("mode", ["off", "batched", "always"])
+def test_durable_replace_modes(tmp_path, mode):
+    tmp, dst = str(tmp_path / "t"), str(tmp_path / "dst")
+    with open(tmp, "wb") as f:
+        f.write(b"payload")
+    durability.durable_replace(tmp, dst, mode=mode)
+    if mode == "batched":
+        assert durability.flusher().flush(timeout=10.0)
+    with open(dst, "rb") as f:
+        assert f.read() == b"payload"
+    assert not os.path.exists(tmp)
+
+
+def test_batched_put_fsyncs_shard_content(tmp_path, monkeypatch):
+    """Batched mode must fsync the shard files' CONTENT at their
+    committed location — the pre-rename tmp paths are gone by flush
+    time, so enqueuing those would silently no-op (the durability
+    window would be a lie)."""
+    from minio_tpu.obs.metrics import counters_snapshot
+    monkeypatch.setenv("MINIO_TPU_FSYNC", "batched")
+    ol = _layer(str(tmp_path))
+
+    def file_fsyncs():
+        return counters_snapshot().get(
+            'minio_tpu_durability_fsync_total{kind="file"}', 0)
+
+    before = file_fsyncs()
+    ol.put_object("b", "o", io.BytesIO(_body(9)), OBJ)
+    assert durability.flusher().flush(timeout=10.0)
+    # 6 disks x (part.1 at its committed path + xl.meta) = >= 12
+    # SUCCESSFUL file fsyncs (fsync_path only counts opens that worked —
+    # stale tmp paths would not score)
+    assert file_fsyncs() - before >= 12
+    assert ol.get_object_bytes("b", "o") == _body(9)
+
+
+def test_fsync_mode_resolution(monkeypatch):
+    monkeypatch.setenv("MINIO_TPU_FSYNC", "always")
+    assert durability.fsync_mode() == "always"
+    monkeypatch.setenv("MINIO_TPU_FSYNC", "nonsense")
+    assert durability.fsync_mode() == "off"  # unknown -> safe default
+    monkeypatch.delenv("MINIO_TPU_FSYNC")
+    st = durability.status()
+    assert set(st) >= {"fsync", "pending", "flushed_total"}
+
+
+# --- XLMeta trailing checksum ------------------------------------------------
+
+
+def _meta_blob():
+    from minio_tpu.storage.datatypes import FileInfo
+    m = XLMeta()
+    m.add_version(FileInfo(volume="b", name="o", data_dir="dd-1",
+                           mod_time=123.0, size=7,
+                           metadata={"etag": "x"}))
+    return m.dump()
+
+
+def test_xlmeta_checksum_roundtrip_and_legacy():
+    blob = _meta_blob()
+    m = XLMeta.load(blob)
+    assert m.versions and m.versions[0]["V"]["ddir"] == "dd-1"
+    # legacy pre-PR-6 blob (v1 header, no trailer) still loads
+    import msgpack
+    legacy = XL_HEADER + msgpack.packb(
+        {"Versions": [], "Data": {}}, use_bin_type=True)
+    assert XLMeta.load(legacy).versions == []
+    # ... even when its inlined data coincidentally ends with the
+    # trailer magic — the header version, not tail-sniffing, decides
+    tricky = XL_HEADER + msgpack.packb(
+        {"Versions": [], "Data": {"dd": b"payload-XLC1abcd"}},
+        use_bin_type=True)
+    assert tricky[-8:-4] == b"XLC1"
+    assert XLMeta.load(tricky).data["dd"].endswith(b"XLC1abcd")
+
+
+def test_xlmeta_rejects_torn_and_tampered():
+    blob = _meta_blob()
+    # EVERY truncation point is detected: the v2 header requires the
+    # trailer, so even a tear that removes exactly the trailer bytes
+    # cannot masquerade as a legacy blob
+    for cut in range(1, len(blob)):
+        with pytest.raises(errors.FileCorrupt):
+            XLMeta.load(blob[:cut])
+    # a flipped byte under an intact trailer is detected
+    flipped = bytearray(blob)
+    flipped[len(blob) // 2] ^= 0xFF
+    with pytest.raises(errors.FileCorrupt):
+        XLMeta.load(bytes(flipped))
+
+
+def test_quarantine_reverifies_under_lock(tmp_path):
+    """A racing reader that saw a torn blob must NOT quarantine a
+    journal that a concurrent writer/heal has since made valid:
+    _quarantine_meta re-reads under _meta_lock before renaming."""
+    body = _body(3)
+    ol = _layer(str(tmp_path))
+    ol.put_object("b", "o", io.BytesIO(body), OBJ)
+    d = ol.disks[0]
+    meta_path = os.path.join(d.base, "b", "o", "xl.meta")
+    # the reader's stale "it was torn" conclusion vs a now-valid file
+    assert d._quarantine_meta("b", "o") is False
+    assert os.path.exists(meta_path)
+    assert not os.path.exists(meta_path + ".corrupt")
+    # and an actually-torn journal still quarantines
+    with open(meta_path, "rb") as f:
+        blob = f.read()
+    with open(meta_path, "wb") as f:
+        f.write(blob[: len(blob) // 2])
+    assert d._quarantine_meta("b", "o") is True
+    assert not os.path.exists(meta_path)
+    assert os.path.exists(meta_path + ".corrupt")
+
+
+def test_durable_write_reaps_dead_pid_tmps(tmp_path):
+    """Crash-stranded durable_write tmps (they live beside their
+    destinations, invisible to the .minio.sys/tmp janitor) are reclaimed
+    on this process's first write into the directory; a live pid's
+    in-flight tmp is left alone."""
+    import subprocess
+    d = str(tmp_path)
+    proc = subprocess.Popen(["true"])  # a real, guaranteed-dead pid
+    proc.wait()
+    dead = os.path.join(d, f".graft-tmp.j.json.{proc.pid}.123")
+    live = os.path.join(d, f".graft-tmp.j.json.{os.getpid()}.456")
+    # a USER-named destination that merely resembles a tmp must survive
+    # (TierFS stores raw S3 key names — the reaper only trusts its own
+    # magic prefix)
+    decoy = os.path.join(d, f"backup.tmp.{proc.pid}.99")
+    for p in (dead, live, decoy):
+        with open(p, "wb") as f:
+            f.write(b"stranded")
+    old = time.time() - 120
+    for p in (dead, decoy):
+        os.utime(p, (old, old))  # past the reaper's min-age guard
+    durability._reaped_dirs.discard(d)  # once-per-process gate
+    durability.durable_write(os.path.join(d, "j.json"), b"{}")
+    assert not os.path.exists(dead)
+    assert os.path.exists(live)
+    assert os.path.exists(decoy)
+    with open(os.path.join(d, "j.json"), "rb") as f:
+        assert f.read() == b"{}"
+
+
+def test_torn_rule_tears_staged_datadir(tmp_path):
+    """pre_data_rename owns the staged dataDir, not a single tmp file —
+    a torn rule there must tear a shard inside it (and the object still
+    serves from quorum, with the torn shard detected by bitrot)."""
+    from minio_tpu import fault
+    body = _body(8)
+    ol = _layer(str(tmp_path))
+    victim = ol.disks[0]
+    fault.arm(f"disk:{victim.endpoint()}:pre_data_rename:torn")
+    try:
+        ol.put_object("b", "t", io.BytesIO(body), OBJ)
+    finally:
+        fault.clear()
+    sizes = {}
+    for d in ol.disks:
+        odir = os.path.join(d.base, "b", "t")
+        dd = [n for n in os.listdir(odir) if n != "xl.meta"][0]
+        part = os.path.join(odir, dd, "part.1")
+        sizes[d.endpoint()] = os.path.getsize(part)
+    healthy = {v for k, v in sizes.items() if k != victim.endpoint()}
+    assert len(healthy) == 1  # siblings agree
+    assert sizes[victim.endpoint()] < healthy.pop()  # the tear happened
+    assert ol.get_object_bytes("b", "t") == body  # quorum still serves
+
+
+def test_corrupt_meta_quarantined_on_read_and_healed(tmp_path):
+    body = _body(1)
+    ol = _layer(str(tmp_path))
+    ol.put_object("b", "o", io.BytesIO(body), OBJ)
+    victim = ol.disks[0]
+    meta_path = os.path.join(victim.base, "b", "o", "xl.meta")
+    with open(meta_path, "rb") as f:
+        blob = f.read()
+    with open(meta_path, "wb") as f:
+        f.write(blob[:len(blob) // 2])  # torn
+    # quorum still serves; the read quarantines the torn journal
+    assert ol.get_object_bytes("b", "o") == body
+    assert not os.path.exists(meta_path)
+    assert os.path.exists(meta_path + ".corrupt")
+    res = ol.heal_object("b", "o")
+    assert all(s == "ok" for s in res.after_state)
+    assert os.path.exists(meta_path)
+
+
+# --- QueueStore restart recovery ---------------------------------------------
+
+
+def test_queuestore_events_survive_restart(tmp_path):
+    d = str(tmp_path / "q")
+    qs1 = QueueStore(d, send=lambda r: (_ for _ in ()).throw(
+        RuntimeError("target down")))
+    for i in range(3):
+        assert qs1.put({"i": i})
+    # 'crash': qs1 never started/drained; rebuild over the same dir
+    got = []
+    qs2 = QueueStore(d, send=got.append).start()
+    deadline = time.monotonic() + 5
+    while qs2.delivered < 3 and time.monotonic() < deadline:
+        time.sleep(0.02)
+    qs2.stop()
+    assert sorted(r["i"] for r in got) == [0, 1, 2]
+    assert qs2._pending() == []
+
+
+def test_queuestore_failed_put_unlinks_tmp(tmp_path, monkeypatch):
+    d = str(tmp_path / "q2")
+    qs = QueueStore(d, send=lambda r: None)
+
+    def boom(tmp, dst, mode=None):
+        raise OSError("disk full")
+
+    monkeypatch.setattr(durability, "durable_replace", boom)
+    assert qs.put({"x": 1}) is False
+    assert qs.failed_puts == 1
+    assert os.listdir(d) == []  # no orphaned .tmp leaked
+    assert qs._count == 0
+
+
+# --- MRF journal restart recovery --------------------------------------------
+
+
+class _HealStub:
+    def __init__(self):
+        self.calls = []
+
+    def heal_object(self, bucket, object, version_id="", dry_run=False,
+                    remove_dangling=False, scan_mode="normal"):
+        self.calls.append((bucket, object, version_id, scan_mode))
+
+
+def test_mrf_journal_survives_restart(tmp_path):
+    path = str(tmp_path / "mrf.json")
+    m1 = MRFHealer(_HealStub())
+    m1.attach_persistence(path)
+    m1.add_partial("b", "o1", "", scan_mode="normal")
+    m1.add_partial("b", "o2", "v7", scan_mode="deep")
+    m1.flush_journal()
+    with open(path, encoding="utf-8") as f:
+        assert len(json.load(f)["entries"]) == 2
+    # 'crash' m1 (never started); reconstruct and drain
+    stub = _HealStub()
+    m2 = MRFHealer(stub)
+    assert m2.attach_persistence(path) == 2
+    m2.start()
+    deadline = time.monotonic() + 5
+    while len(stub.calls) < 2 and time.monotonic() < deadline:
+        time.sleep(0.02)
+    m2.stop()
+    assert ("b", "o1", "", "normal") in stub.calls
+    assert ("b", "o2", "v7", "deep") in stub.calls
+    with open(path, encoding="utf-8") as f:
+        assert json.load(f)["entries"] == []  # healed debt settled
+
+
+# --- stale multipart expiry --------------------------------------------------
+
+
+def test_stale_multipart_uploads_reaped(tmp_path):
+    ol = _layer(str(tmp_path))
+    ol.new_multipart_upload("b", "m1")
+    ol.new_multipart_upload("b", "m2")
+    assert len(ol.list_multipart_uploads("b").uploads) == 2
+    j = DurabilityJanitor(ol)
+    # fresh uploads survive the default (24 h) window
+    j.sweep(tmp_age_s=1e9, multipart_expiry_s=None, reconcile=False)
+    assert len(ol.list_multipart_uploads("b").uploads) == 2
+    time.sleep(0.05)
+    # past the window they are reaped on every disk
+    stats = j.sweep(tmp_age_s=1e9, multipart_expiry_s=0.01,
+                    reconcile=False)
+    assert stats["uploads_expired"] == 2
+    assert ol.list_multipart_uploads("b").uploads == []
+
+
+# --- janitor: orphan ddirs + startup tmp sweep -------------------------------
+
+
+def test_heal_survives_one_writer_close_failure(tmp_path):
+    """close() can raise under fsync=always (strict writeback errors):
+    one target disk's EIO must stay that disk's vote — the other
+    targets' rebuild commits, and the failing disk does NOT commit its
+    incomplete shard (its rename_data is skipped)."""
+    import shutil
+
+    body = _body(9)
+    ol = _layer(str(tmp_path))
+    ol.put_object("b", "h", io.BytesIO(body), OBJ)
+    for d in ol.disks[:2]:
+        shutil.rmtree(os.path.join(d.base, "b", "h"))
+    victim = ol.disks[0]
+    orig = victim.create_file_writer
+
+    class _BadClose:
+        def __init__(self, inner):
+            self._w = inner
+
+        def __getattr__(self, name):
+            return getattr(self._w, name)
+
+        def close(self):
+            self._w.close()
+            raise OSError(5, "EIO: lost writeback")
+
+    victim.create_file_writer = \
+        lambda *a, **kw: _BadClose(orig(*a, **kw))
+    res = ol.heal_object("b", "h")
+    assert res.after_state[1] == "ok"  # the healthy target converged
+    assert res.after_state[0] != "ok"  # the EIO disk did not commit
+    assert not os.path.exists(os.path.join(victim.base, "b", "h"))
+    assert ol.get_object_bytes("b", "h") == body
+
+
+def test_janitor_preserves_nested_object_namespaces(tmp_path):
+    """Object keys nest: 'a' and 'a/b' coexist, so 'b' is a NAMESPACE
+    dir inside 'a''s object dir — the reconcile pass must never treat it
+    as an orphan dataDir and rmtree the nested objects away."""
+    body_a, body_ab, body_abc = _body(4), _body(5), _body(6)
+    ol = _layer(str(tmp_path))
+    ol.put_object("b", "a", io.BytesIO(body_a), OBJ)
+    ol.put_object("b", "a/b", io.BytesIO(body_ab), OBJ)
+    ol.put_object("b", "a/x/c", io.BytesIO(body_abc), OBJ)  # 2 deep
+    DurabilityJanitor(ol).sweep(tmp_age_s=1e9, reconcile=True,
+                                ddir_age_s=0.0)
+    assert ol.get_object_bytes("b", "a") == body_a
+    assert ol.get_object_bytes("b", "a/b") == body_ab
+    assert ol.get_object_bytes("b", "a/x/c") == body_abc
+
+
+def test_config_boot_with_persisted_config_no_deadlock(tmp_path):
+    """First get_config_sys(objlayer) with a PERSISTED config: load()
+    runs inside the module _global_lock and refreshes the durability
+    mode cache — which must use the ConfigSys instance it was handed,
+    not re-enter get_config_sys() (a re-entrant acquire of the
+    non-reentrant _global_lock hangs server boot forever)."""
+    import threading
+
+    from minio_tpu.config import kvs
+    ol = _layer(str(tmp_path))
+    kvs.ConfigSys(ol).set("durability", "fsync", "batched")  # persists
+    old = kvs._global
+    kvs._global = None
+    try:
+        done = []
+        t = threading.Thread(
+            target=lambda: done.append(kvs.get_config_sys(ol)),
+            daemon=True)
+        t.start()
+        t.join(10)
+        assert done, "get_config_sys(objlayer) deadlocked on " \
+                     "persisted config"
+        assert done[0].get_stored_or_default(
+            "durability", "fsync") == "batched"
+    finally:
+        kvs._global = old
+        durability.refresh_mode_cache()
+
+
+def test_janitor_removes_orphan_ddirs_only(tmp_path):
+    body = _body(2)
+    ol = _layer(str(tmp_path))
+    ol.put_object("b", "o", io.BytesIO(body), OBJ)
+    d0 = ol.disks[0]
+    odir = os.path.join(d0.base, "b", "o")
+    stray = os.path.join(odir, "0000dead-beef-4000-8000-000000000000")
+    os.makedirs(stray)
+    with open(os.path.join(stray, "part.1"), "wb") as f:
+        f.write(b"junk")
+    stats = DurabilityJanitor(ol).sweep(tmp_age_s=1e9, reconcile=True,
+                                        ddir_age_s=0.0)
+    assert stats["orphan_ddirs"] == 1
+    assert not os.path.exists(stray)
+    assert ol.get_object_bytes("b", "o") == body  # referenced ddir kept
+
+
+def test_reconcile_folds_aged_corrupt_only_dirs(tmp_path):
+    """A dir holding ONLY a quarantined journal (all-disks-corrupt,
+    never-committed object — no quorum will ever rebuild it) folds away
+    after the age window; fresh forensics survive the heal window."""
+    ol = _layer(str(tmp_path))
+    d = ol.disks[0]
+    odir = os.path.join(d.base, "b", "phantom")
+    os.makedirs(odir)
+    with open(os.path.join(odir, "xl.meta.corrupt"), "wb") as f:
+        f.write(b"torn")
+    d.reconcile_object("b", "phantom", age_s=120.0)
+    assert os.path.exists(odir)  # young forensics retained
+    time.sleep(0.05)
+    d.reconcile_object("b", "phantom", age_s=0.01)
+    assert not os.path.exists(odir)  # aged phantom folded
+
+
+def test_startup_recovery_sweeps_tmp(tmp_path):
+    import subprocess
+    root = str(tmp_path)
+    ol = _layer(root)
+    base = os.path.join(ol.disks[0].base, ".minio.sys", "tmp")
+    stray = os.path.join(base, "stray")  # legacy/unprefixed name
+    os.makedirs(stray)
+    with open(os.path.join(stray, "part.1"), "wb") as f:
+        f.write(b"junk")
+    dead_proc = subprocess.Popen(["true"])
+    dead_proc.wait()
+    dead = os.path.join(base, f"{dead_proc.pid}-aaaa")  # crashed peer
+    os.makedirs(dead)
+    live_proc = subprocess.Popen(["sleep", "30"])  # a LIVE peer process
+    live = os.path.join(base, f"{live_proc.pid}-bbbb")
+    os.makedirs(live)
+    try:
+        # 'reboot': rebuilding over the same dirs sweeps all tmp EXCEPT
+        # a different live process's in-flight staging
+        ol2 = _layer(root, make=False)
+        assert not os.path.exists(stray)
+        assert not os.path.exists(dead)
+        assert os.path.exists(live), \
+            "a live peer's in-flight staging was destroyed"
+        assert ol2.disks[0].list_dir(".minio.sys/tmp", "") == \
+            [f"{live_proc.pid}-bbbb/"]
+    finally:
+        live_proc.kill()
+        live_proc.wait()
+
+
+def test_scanner_cycle_runs_janitor(tmp_path):
+    from minio_tpu.obs.metrics import counters_snapshot
+    from minio_tpu.scanner.scanner import DataScanner
+
+    def runs():
+        return counters_snapshot().get(
+            'minio_tpu_durability_recovery_runs_total{phase="sweep"}', 0)
+
+    ol = _layer(str(tmp_path))
+    before = runs()
+    sc = DataScanner(ol, interval_s=9999, sleep_per_object=0)
+    sc.scan_cycle()
+    assert runs() == before + 1

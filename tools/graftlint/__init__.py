@@ -6,7 +6,8 @@ Python analogue for the invariants PRs 1-4 established by convention:
 monotonic clocks for durations, no blocking I/O under a lock, ``with``-
 only lock usage, documented metrics and config keys, span-context
 handoff across pool submits, fault-injection hooks on every op entry
-point, and no silently-swallowed exceptions in daemon threads.
+point, no silently-swallowed exceptions in daemon threads, and (PR 6)
+no bare ``os.replace``/``os.rename`` outside the durable commit helper.
 
 Checkers are AST passes (no imports of the checked code, so a broken
 module still lints). Findings carry ``file:line`` + a checker id and a
@@ -41,7 +42,7 @@ class Finding:
     """One violation. ``key`` (not line) is the baseline identity."""
     path: str          # repo-relative, posix separators
     line: int
-    checker: str       # "GL001".."GL008"
+    checker: str       # "GL001".."GL009"
     message: str
     token: str = ""    # stable site token (symbol/metric/key name)
     scope: str = ""    # enclosing function qualname ("" = module)

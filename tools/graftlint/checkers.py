@@ -1,4 +1,4 @@
-"""The eight graftlint checkers (GL001-GL008).
+"""The nine graftlint checkers (GL001-GL009).
 
 Each per-file checker takes a ``FileCtx`` and yields ``Finding``s; the
 project-wide checkers take the full list of parsed files (cross-file
@@ -17,6 +17,8 @@ text — nothing in the checked tree is imported.
 | GL006 | storage/rpc/kernel op entry points carry a fault-inject hook |
 | GL007 | no bare ``except:`` / swallowed exceptions in daemon threads |
 | GL008 | every dynamic config KVS key documented in docs/             |
+| GL009 | no bare ``os.replace``/``os.rename`` — commits go through    |
+|       | ``storage.durability.durable_replace`` (fsync policy)        |
 """
 from __future__ import annotations
 
@@ -565,6 +567,38 @@ def check_config_keys_documented(ctx: FileCtx) -> list[Finding]:
     return out
 
 
+# --------------------------------------------------------------------------
+# GL009 — bare os.replace/os.rename outside the durable commit helper
+
+#: the one module allowed to rename directly — it IS the policy point
+_DURABILITY_HELPER = "minio_tpu/storage/durability.py"
+
+
+def check_bare_replace(ctx: FileCtx) -> list[Finding]:
+    """Every commit-by-rename in minio_tpu/ must ride
+    ``storage.durability.durable_replace`` so the dynamic fsync policy
+    (``durability.fsync`` / ``MINIO_TPU_FSYNC``) applies to it — a bare
+    ``os.replace`` silently opts its data out of the durability plane
+    (docs/durability.md)."""
+    if not ctx.path.startswith("minio_tpu/") or \
+            ctx.path == _DURABILITY_HELPER:
+        return []
+    out = []
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        d = dotted(node.func)
+        if d not in ("os.replace", "os.rename"):
+            continue
+        out.append(Finding(
+            ctx.path, node.lineno, "GL009",
+            f"bare {d}() — commit through storage.durability."
+            "durable_replace so the fsync policy (durability.fsync / "
+            "MINIO_TPU_FSYNC) covers this write",
+            token=_unparse(node, 40), scope=ctx.scope_at(node.lineno)))
+    return out
+
+
 PER_FILE = [
     check_wall_duration,
     check_blocking_under_lock,
@@ -573,5 +607,6 @@ PER_FILE = [
     check_fault_hooks,
     check_swallowed_exceptions,
     check_config_keys_documented,
+    check_bare_replace,
 ]
 PROJECT = [check_metrics_documented]
