@@ -181,8 +181,10 @@ def device_configs(rng) -> dict:
                        data[b].reshape(K * nc, C)).reshape(K, nc * 32)
             for b in range(B)])
         digs = jnp.asarray(digs_np.view(np.uint32).reshape(B, K, nc * 8))
-        fused_fn = fused_mod._jitted(key_fn(HIGHWAY_KEY), C,
-                                     mm_batch_per, algo_id)
+        # the PRODUCTION kernel resolution (fused_fn_for): mur3 rides the
+        # Pallas hash kernel unless pipeline.device_hash=jnp routes back
+        fused_fn = fused_mod.fused_fn_for(HIGHWAY_KEY, shard,
+                                          mm_batch_per, C, algo_id)
 
         def body_fused(c, ms, xs, dg, fused_fn=fused_fn):
             # the hash verify is jnp (not pallas), and xs/dg are loop
@@ -200,6 +202,19 @@ def device_configs(rng) -> dict:
             B * BLOCK, body_fused, rec_masks_b, w, digs)
     out["fused_verify_reconstruct_16p4_b128"] = \
         out["fused_verify_reconstruct_16p4_b128_mur3"]
+
+    # PUT-side device hash lane: fused encode+hash (parity + per-chunk
+    # digests of all k+m shards in one launch — what the dispatch queue's
+    # encode_hashed flush runs)
+    enc_hash_fn = fused_mod.encode_hashed_fn_for(
+        HIGHWAY_KEY, shard, codec.encode_words_batch, C, 1)
+
+    def body_enc_hash(c, xs):
+        par, dg = enc_hash_fn(xs ^ c)
+        return par.reshape(-1)[0] + jnp.sum(dg.astype(jnp.uint32))
+
+    out["fused_encode_hash_16p4_b128"] = bench_loop(
+        f"tpu FUSED encode+hash 16+4 x{B}", B * BLOCK, body_enc_hash, w)
 
     # config 5: batched heal rebuild — per-element masks, mixed loss
     heal_masks = np.stack([
@@ -275,12 +290,16 @@ def host_profile(rng) -> dict:
 def e2e_put(rng) -> dict:
     """Config 1: end-to-end PutObject through object layer -> erasure ->
     bitrot writers -> local disks, 4+2 and 16+4, serial and 8-way
-    parallel. Each block runs the fused native pipeline
-    (split+encode+hash+frame in one GIL-releasing mt_put_block call) with
-    the MD5/ETag chain on its own thread; single-stream is therefore
-    bounded by the slowest pipeline stage (typically the MD5 ingest the S3
-    ETag contract demands), parallel streams by cores."""
+    parallel. Each block reads into a pooled buffer (zero-copy ingest)
+    and runs the fused native pipeline (split+encode+hash+frame+pwrite in
+    one GIL-releasing mt_put_block_fds call); the ETag is the fused
+    pipeline hash (md5 over the bitrot digest stream, ~0.2% of payload),
+    so no host stage hashes payload bytes — the ceiling is the native
+    block rate and the file-write bound, not the old single-CPU MD5.
+    ``put_stage_breakdown`` attributes one serial PUT's seconds per
+    stage."""
     import threading
+    from minio_tpu.obs import stages as obstages
     from minio_tpu.objectlayer import ErasureObjects
     from minio_tpu.storage import XLStorage
     out = {}
@@ -300,8 +319,19 @@ def e2e_put(rng) -> dict:
                 ol.put_object("b", f"o{r}", io.BytesIO(body), obj_size)
             dt = time.perf_counter() - t0
             gibs = obj_size * reps / dt / (1 << 30)
+            # stage attribution for ONE serial PUT (satellite of ROADMAP
+            # item 1): seconds spent in body-read / ETag / encode+hash /
+            # shard-write, so pipeline wins are explainable stage by
+            # stage across BENCH rounds (overlapped stages each charge
+            # their own wall, so the sum may exceed the PUT wall)
+            with obstages.collect() as stc:
+                t0 = time.perf_counter()
+                ol.put_object("b", "staged", io.BytesIO(body), obj_size)
+                put_wall = time.perf_counter() - t0
+            stage_brk = {"wall_s": round(put_wall, 4), **stc.snapshot()}
+            log(f"e2e {k}+{m} put stages: {stage_brk}")
             t0 = time.perf_counter()
-            assert ol.get_object_bytes("b", "o0") == body
+            assert ol.get_object_buffer("b", "o0") == body
             get_gibs = obj_size / (time.perf_counter() - t0) / (1 << 30)
 
             def worker(j):
@@ -320,7 +350,10 @@ def e2e_put(rng) -> dict:
 
             def reader(j):
                 try:
-                    if ol.get_object_bytes("b", f"p{j}") != body:
+                    # zero-copy accessor: compares equal without the
+                    # final full-object tobytes pass (get_object_bytes'
+                    # GIL-held copy was a residual par8 serializer)
+                    if ol.get_object_buffer("b", f"p{j}") != body:
                         raise AssertionError(f"p{j} bytes mismatch")
                 except BaseException as e:  # noqa: BLE001
                     read_errs.append(e)
@@ -340,7 +373,8 @@ def e2e_put(rng) -> dict:
             out[f"{k}p{m}"] = {"put": round(gibs, 2),
                                "get": round(get_gibs, 2),
                                "put_par8": round(par, 2),
-                               "get_par8": round(gpar, 2)}
+                               "get_par8": round(gpar, 2),
+                               "put_stage_breakdown": stage_brk}
         finally:
             shutil.rmtree(root, ignore_errors=True)
     return out

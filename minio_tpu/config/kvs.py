@@ -52,6 +52,19 @@ SUB_SYSTEMS: dict[str, dict[str, KV]] = {
         "chunk": KV("16384", env="MINIO_TPU_BITROT_CHUNK",
                     help="streaming bitrot chunk bytes"),
     },
+    "pipeline": {
+        "etag": KV("fused", env="MINIO_TPU_PIPELINE_ETAG",
+                   help="fused: ETag folded from the encode path's "
+                        "bitrot digests (no host MD5 over payload); "
+                        "md5: classic host MD5 for every PUT"),
+        "etag_min_bytes": KV(
+            str(1 << 20), env="MINIO_TPU_PIPELINE_ETAG_MIN",
+            help="bodies below this keep the compat MD5 ETag"),
+        "device_hash": KV(
+            "pallas", env="MINIO_TPU_MUR3_PALLAS",
+            help="pallas|jnp MUR3X256 kernel for the fused device "
+                 "hash lanes"),
+    },
     "dispatch": {
         "enable": KV("1", env="MINIO_TPU_DISPATCH"),
         "mode": KV("auto", env="MINIO_TPU_DISPATCH_MODE",
@@ -249,7 +262,7 @@ SUB_SYSTEMS: dict[str, dict[str, KV]] = {
 #: config.go:132) — consumers read the registry at call time or register
 #: an apply callback.
 DYNAMIC = {"api", "scanner", "heal", "dispatch", "bitrot", "qos", "fault",
-           "durability"}
+           "durability", "pipeline"}
 
 
 class ConfigSys:

@@ -175,6 +175,76 @@ def native_batch_hasher(algo_id: int):
     return highwayhash.hash256_batch
 
 
+#: native ALGO_* ids duplicated here so pure-hash helpers need not import
+#: the native package (which may be unavailable without a toolchain)
+ALGO_ID_HIGHWAY = 0
+ALGO_ID_MUR3 = 1
+
+
+def _algo_for_native_id(algo_id: int) -> BitrotAlgorithm:
+    return BitrotAlgorithm.MUR3X256S if algo_id == ALGO_ID_MUR3 \
+        else BitrotAlgorithm.HIGHWAYHASH256S
+
+
+def shard_chunk_digests(shards: "np.ndarray", chunk: int,
+                        algo_id: int = 0) -> "np.ndarray":
+    """Per-chunk digests of each row of uint8 [k, shard_len] as uint8
+    [k, n_chunks*32]: full ``chunk``-size pieces batched through the
+    native hasher, a short tail piece (shard_len % chunk) digested last —
+    exactly the [digest][chunk] framing order of the shard files and of
+    mt_put_block, so this is the host half of both the fused-ETag
+    reference and the host-fallback digest path."""
+    shards = np.ascontiguousarray(shards, dtype=np.uint8)
+    k, shard_len = shards.shape
+    n_full = shard_len // chunk
+    tail = shard_len - n_full * chunk
+    nc = n_full + (1 if tail else 0)
+    out = np.empty((k, nc * 32), dtype=np.uint8)
+    algo = _algo_for_native_id(algo_id)
+    if n_full:
+        full = _batch_digests(
+            algo, shards[:, : n_full * chunk].tobytes(), k * n_full, chunk)
+        out[:, : n_full * 32] = full.reshape(k, n_full * 32)
+    if tail:
+        for i in range(k):
+            h = algo.new()
+            h.update(shards[i, n_full * chunk:].tobytes())
+            out[i, n_full * 32:] = np.frombuffer(h.digest(), dtype=np.uint8)
+    return out
+
+
+def frame_block_shards(shards: "np.ndarray", digs: "np.ndarray",
+                       chunk: int, out: "np.ndarray | None" = None
+                       ) -> "np.ndarray":
+    """Interleave precomputed digests with shard payloads into the
+    on-disk [digest][chunk] framing: uint8 [k, shard_len] + [k, nc*32]
+    -> uint8 [k, framed_len]. One strided gather per block — the host's
+    only payload pass when the hash side ran on device (the dispatch
+    PUT path's framing step). ``out``, when given, is the [k, framed_len]
+    destination (callers framing data+parity rows into one buffer)."""
+    shards = np.ascontiguousarray(shards, dtype=np.uint8)
+    k, shard_len = shards.shape
+    n_full = shard_len // chunk
+    tail = shard_len - n_full * chunk
+    nc = n_full + (1 if tail else 0)
+    fl = nc * 32 + shard_len
+    if out is None:
+        out = np.empty((k, fl), dtype=np.uint8)
+    elif out.shape != (k, fl):
+        raise ValueError("frame_block_shards: out shape mismatch")
+    h = 32
+    if n_full:
+        span = out[:, : n_full * (h + chunk)].reshape(k, n_full, h + chunk)
+        span[:, :, :h] = digs[:, : n_full * h].reshape(k, n_full, h)
+        span[:, :, h:] = shards[:, : n_full * chunk].reshape(
+            k, n_full, chunk)
+    if tail:
+        pos = n_full * (h + chunk)
+        out[:, pos: pos + h] = digs[:, n_full * h:]
+        out[:, pos + h:] = shards[:, n_full * chunk:]
+    return out
+
+
 def default_bitrot_algo() -> BitrotAlgorithm:
     """HighwayHash256S when the native library is built — the reference's
     own default (cmd/bitrot.go:51), so digest-level parity comes free —

@@ -157,6 +157,46 @@ class Erasure:
                     for i in range(self.parity_blocks)]
         return _chain(fut, finish)
 
+    def encode_hashed_async(self, data, chunk_size: int, algo: int = 0
+                            ) -> Future:
+        """Fused encode+hash for one block (ROADMAP item 1's device-side
+        hash lane): Future[(data uint8 [k, S], parity uint8 [m, S],
+        digests uint8 [k+m, nc*32])] — per-``chunk_size``-chunk bitrot
+        digests of every data AND parity shard computed in the same
+        flush as the parity, so the PUT path frames [digest][chunk]
+        shard files without hashing OR restacking payload bytes on the
+        host (2-D arrays, not per-shard lists: the framing gather is the
+        host's single payload pass). The caller must gate on
+        ``shard_len % chunk_size == 0`` (full blocks; tail blocks take
+        the host-hash fallback)."""
+        buf = np.frombuffer(data, dtype=np.uint8) if not isinstance(
+            data, np.ndarray) else np.asarray(data, dtype=np.uint8)
+        true_shard = ceil_div(buf.size, self.data_blocks)
+        if buf.size == 0 or true_shard % chunk_size:
+            raise ValueError("encode_hashed needs chunk-aligned shards")
+        shards = self.codec.split(buf, true_shard)
+        from ..runtime.dispatch import dispatch_enabled, global_queue
+        if not dispatch_enabled():
+            # host fallback: native batch hash over data+parity — same
+            # digests, no queue (MINIO_TPU_DISPATCH=0 deployments)
+            from .bitrot import shard_chunk_digests
+            parity = self.codec.encode(shards)
+            digs = np.concatenate([
+                shard_chunk_digests(shards, chunk_size, algo),
+                shard_chunk_digests(parity, chunk_size, algo)])
+            return _done((shards, parity, digs))
+        from .bitrot import HIGHWAY_KEY
+        fut = global_queue().encode_hashed(
+            self.codec, pack_shards(shards), HIGHWAY_KEY, chunk_size, algo)
+
+        def finish(res):
+            parity_words, digs = res
+            parity = unpack_shards(parity_words)
+            return shards, parity, \
+                np.ascontiguousarray(digs).view(np.uint8).reshape(
+                    self.data_blocks + self.parity_blocks, -1)
+        return _chain(fut, finish)
+
     def rebuild_targets_async(self, shards: list[np.ndarray | None],
                               targets: tuple[int, ...]) -> Future:
         """Rebuild the ``targets`` shard indices (<= parity count, data or

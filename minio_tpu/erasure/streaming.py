@@ -23,7 +23,9 @@ from dataclasses import dataclass, field
 import numpy as np
 
 from .. import fault as _fault
+from ..obs import metrics as _mx
 from ..obs import spans as _spans
+from ..obs import stages as _stages
 from ..utils import errors
 from .codec import Erasure, ceil_div
 
@@ -76,31 +78,43 @@ def shutdown_pools() -> None:
 def _native_put_eligible(erasure: Erasure, writers: list) -> bool:
     """True when the whole block pipeline (split+encode+hash+frame) can run
     as one native GIL-releasing call per block (native/pipeline.cpp
-    mt_put_block) with on-disk output bit-identical to the Python path."""
+    mt_put_block) with on-disk output bit-identical to the Python path.
+    The chunk-divides-shard condition (via _framed_writers) makes
+    per-block framing equal stream framing (pick_bitrot_chunk guarantees
+    it for new objects)."""
     if os.environ.get("MINIO_TPU_PUT_PATH", "auto") == "dispatch":
         return False
     if _fault.armed("disk"):
         # chaos runs take the interpretable Python path: the native
         # pwrite pipeline bypasses the per-op injection points
         return False
-    from .bitrot import StreamingBitrotWriter, native_algo_id
-    live = [w for w in writers if w is not None]
-    if not live:
-        return False
-    if not all(isinstance(w, StreamingBitrotWriter)
-               and native_algo_id(w.algo) is not None
-               and not w._buf for w in live):
-        return False
-    chunks = {w.shard_size for w in live}
-    if len(chunks) != 1:
-        return False
-    (chunk,) = chunks
-    # chunk must divide the full-block shard so per-block framing equals
-    # stream framing (pick_bitrot_chunk guarantees this for new objects)
-    if erasure.shard_size() % chunk:
+    if _framed_writers(erasure, writers) is None:
         return False
     from .. import native
     return native.available()
+
+
+def _framed_writers(erasure: Erasure, writers: list):
+    """(chunk, algo_id) when every live writer is a StreamingBitrotWriter
+    on one native-id algorithm with one chunk size dividing the full-block
+    shard — the precondition for digest-reuse framing (write_framed with
+    digests from the native call, the dispatch encode+hash flush, or the
+    host fallback helper). None otherwise."""
+    from .bitrot import StreamingBitrotWriter, native_algo_id
+    live = [w for w in writers if w is not None]
+    if not live:
+        return None
+    if not all(isinstance(w, StreamingBitrotWriter)
+               and native_algo_id(w.algo) is not None
+               and not w._buf for w in live):
+        return None
+    chunks = {w.shard_size for w in live}
+    if len(chunks) != 1:
+        return None
+    (chunk,) = chunks
+    if erasure.shard_size() % chunk:
+        return None
+    return chunk, native_algo_id(live[0].algo)
 
 
 def _native_get_eligible(erasure: Erasure, readers: list) -> bool:
@@ -327,7 +341,7 @@ class _OrderedWriter:
 
 
 def erasure_encode(erasure: Erasure, stream, writers: list,
-                   write_quorum: int) -> int:
+                   write_quorum: int, etag=None) -> int:
     """Read the stream block by block, erasure-encode on device, fan shards
     out to ``writers`` (bitrot writers or None for offline disks). Returns
     total bytes consumed (reference Erasure.Encode,
@@ -339,28 +353,47 @@ def erasure_encode(erasure: Erasure, stream, writers: list,
     barrier on each other between blocks; write-quorum errors are harvested
     per block as its writes drain.
 
+    Block bodies are read into POOLED buffers via the stream's readinto
+    (zero-copy ingest: no per-block ``bytes`` materialization between the
+    socket and the encode call); streams without readinto keep the legacy
+    bytes path.
+
     When every live writer is HighwayHash-framed and the native library is
     built, each block instead runs as ONE GIL-releasing mt_put_block call
     (split+encode+hash+frame fused, native/pipeline.cpp) on encode_pool —
     block-level pipelining then scales across cores, which the per-stage
-    Python path cannot (the round-2 e2e wall)."""
+    Python path cannot (the round-2 e2e wall). Without the native build,
+    framed writers route through the dispatch queue's fused encode+hash
+    flush (device-side hash lane) and the host only interleaves the
+    returned digests; only tail/unaligned blocks fall back to host
+    hashing (counted in minio_tpu_pipeline_host_fallback_total).
+
+    ``etag``, when given, is a utils.hashreader.PipelineETag collector:
+    every block's data-shard chunk digests are folded into it IN STREAM
+    ORDER no matter which path produced them, so the fused ETag is
+    deterministic across native/device/fallback execution. Callers arm it
+    only when _framed_writers matches (the object layer's eligibility
+    gate)."""
     total = 0
     owriters = [None if w is None else _OrderedWriter(w) for w in writers]
-    enc_window: deque = deque()   # (kind, Future, shard_len) per block
-    write_window: deque = deque()  # per-block {writer idx: write Future}
+    # per-block entries: [kind, fut, shard_len, buf, digs]
+    enc_window: deque = deque()
+    write_window: deque = deque()  # per-block (kind, payload)
+    stc = _stages.active()
 
+    from ..runtime.bufpool import global_pool
+    pool = global_pool()
+    k, m = erasure.data_blocks, erasure.parity_blocks
     native_path = _native_put_eligible(erasure, writers)
+    framed = _framed_writers(erasure, writers)
+    chunk = algo_id = None
+    if framed is not None:
+        from .bitrot import HIGHWAY_KEY
+        chunk, algo_id = framed
     fd_path = False
     if native_path:
         from .. import native
-        from ..runtime.bufpool import global_pool
-        from .bitrot import HIGHWAY_KEY, native_algo_id
-        k, m = erasure.data_blocks, erasure.parity_blocks
         pmat = np.ascontiguousarray(erasure.codec.parity_rows)
-        live0 = next(w for w in writers if w is not None)
-        chunk = live0.shard_size
-        algo_id = native_algo_id(live0.algo)
-        pool = global_pool()
         # fused-write eligibility: every live sink is a local file (has a
         # real fd) — then the whole block, shard writes included, runs as
         # ONE native call and Python never touches the framed bytes
@@ -373,62 +406,193 @@ def erasure_encode(erasure: Erasure, stream, writers: list,
                 break
         fd_path = bool(fds)
         fd_offset = 0
+    # dispatch-framed path: the device (or CPU completer) computes parity
+    # AND per-chunk digests in one coalesced flush; eligibility per block
+    # checked in encode_block (full chunk-aligned shards only)
+    dispatch_framed = (not native_path) and framed is not None \
+        and not _fault.armed("disk")
 
-    def fd_block(buf: bytes, shard_len: int, offset: int):
-        scratch = pool.get((k + m) * native.framed_len(shard_len, chunk))
+    def _collect(digs: np.ndarray) -> None:
+        """Fold one block's data-shard digests into the fused-ETag
+        collector (stream order is the caller's responsibility)."""
+        if etag is not None:
+            with _stages.timed(stc, "etag"):
+                etag.add_digests(np.ascontiguousarray(digs[:k]).data)
+
+    def _extract_digests(fr2d: np.ndarray, shard_len: int) -> np.ndarray:
+        """Data-shard digest slots out of framed shard spans
+        (uint8 [k, framed_len]) — one strided gather, ~0.2% of payload."""
+        h = 32
+        n_full = shard_len // chunk
+        tail = shard_len - n_full * chunk
+        nc = n_full + (1 if tail else 0)
+        digs = np.empty((k, nc * h), dtype=np.uint8)
+        if n_full:
+            digs[:, : n_full * h] = fr2d[:k, : n_full * (h + chunk)] \
+                .reshape(k, n_full, h + chunk)[:, :, :h].reshape(k, -1)
+        if tail:
+            pos = n_full * (h + chunk)
+            digs[:, n_full * h:] = fr2d[:k, pos: pos + h]
+        return digs
+
+    def fd_block(buf, buf_len: int, shard_len: int, offset: int):
+        fl = native.framed_len(shard_len, chunk)
+        scratch = pool.get((k + m) * fl)
         try:
             use = [fds[i] if writers[i] is not None else -1
                    for i in range(len(writers))]
-            return native.put_block_fds(buf, len(buf), pmat, k, m,
-                                        shard_len, chunk, HIGHWAY_KEY, use,
-                                        offset, algo_id, scratch=scratch)
+            t0 = time.monotonic() if stc is not None else 0.0
+            times = np.zeros(2, dtype=np.float64) if stc is not None \
+                else None
+            codes = native.put_block_fds(
+                buf, buf_len, pmat, k, m, shard_len, chunk, HIGHWAY_KEY,
+                use, offset, algo_id, scratch=scratch, times=times)
+            if stc is not None:
+                if times is not None and times[0] > 0.0:
+                    stc.add("encode_hash", float(times[0]))
+                    stc.add("shard_write", float(times[1]))
+                else:
+                    stc.add("encode_hash", time.monotonic() - t0)
+            digs = _extract_digests(scratch.reshape(k + m, fl), shard_len) \
+                if etag is not None else None
+            return codes, digs
         finally:
             pool.put(scratch)
 
-    def encode_block(buf: bytes):
-        if not native_path:
-            return ("py", erasure.encode_data_async(buf), 0)
-        if not buf:
-            return ("nat", None, 0)
-        shard_len = ceil_div(len(buf), k)
-        if fd_path:
-            nonlocal fd_offset
-            off = fd_offset
-            fd_offset += native.framed_len(shard_len, chunk)
-            # pure CPU kernel work — records no spans, so no ctx handoff
-            return ("fd", encode_pool().submit(fd_block, buf, shard_len,  # graftlint: disable=GL005
-                                               off), shard_len)
-        fut = encode_pool().submit(  # graftlint: disable=GL005 — pure kernel compute
-            native.put_block, buf, len(buf), pmat, k, m, shard_len, chunk,
-            HIGHWAY_KEY, algo_id,
-            out=pool.get((k + m) * native.framed_len(shard_len, chunk)))
-        return ("nat", fut, shard_len)
+    def nat_block(buf, buf_len: int, shard_len: int, out: np.ndarray):
+        with _stages.timed(stc, "encode_hash"):
+            return native.put_block(buf, buf_len, pmat, k, m, shard_len,
+                                    chunk, HIGHWAY_KEY, algo_id, out=out)
+
+    def _plain_writes_fallback(shards, shard_len: int) -> dict:
+        """Sanctioned host fallback (GL010): non-framed writers (whole-
+        file bitrot, no-native blake2b) take per-shard bytes writes —
+        the writers hash internally — and an armed ETag collector is fed
+        host-computed digests so the fused ETag stays defined."""
+        if etag is not None and shard_len and chunk:
+            from .bitrot import shard_chunk_digests
+            _collect(shard_chunk_digests(
+                np.stack(shards[:k]), chunk, algo_id))
+        futs = {}
+        for i, ow in enumerate(owriters):
+            if ow is None or writers[i] is None:
+                continue
+            futs[i] = ow.write_async(shards[i].tobytes())
+        return futs
+
+    def encode_block(buf, buf_arr=None):
+        """One block into the pipeline; ``buf_arr`` is the pooled backing
+        buffer to recycle once the block's bytes are consumed."""
+        buf_len = len(buf) if not isinstance(buf, np.ndarray) else buf.size
+        if native_path:
+            if not buf_len:
+                return ["nat", None, 0, buf_arr, None]
+            shard_len = ceil_div(buf_len, k)
+            if fd_path:
+                nonlocal fd_offset
+                off = fd_offset
+                fd_offset += native.framed_len(shard_len, chunk)
+                # pure CPU kernel work — records no spans, no ctx handoff
+                return ["fd", encode_pool().submit(fd_block, buf, buf_len,  # graftlint: disable=GL005
+                                                   shard_len, off),
+                        shard_len, buf_arr, None]
+            fut = encode_pool().submit(  # graftlint: disable=GL005 — pure kernel compute
+                nat_block, buf, buf_len, shard_len,
+                pool.get((k + m) * native.framed_len(shard_len, chunk)))
+            return ["nat", fut, shard_len, buf_arr, None]
+        shard_len = ceil_div(buf_len, k) if buf_len else 0
+        align = 16 if algo_id == 1 else 4  # device-hash chunk quantum
+        if dispatch_framed and buf_len and shard_len % chunk == 0 \
+                and chunk % align == 0:
+            # device-side hash lane: parity + all-shard digests in one
+            # coalesced flush; the host only interleaves frames
+            fut = erasure.encode_hashed_async(buf, chunk, algo_id)
+            entry = ["pyh", fut, shard_len, buf_arr, None]
+        elif framed is not None and buf_len:
+            # framed writers but an ineligible block (tail / unaligned /
+            # chaos run): host digest fallback, framing still reuses the
+            # digests so nothing is hashed twice. The reason label keeps
+            # the cases apart: a short final block vs a chunk failing the
+            # device-hash quantum (every block, a config smell) vs the
+            # non-dispatch (chaos) route
+            if not dispatch_framed:
+                reason = "path"
+            elif shard_len % chunk:
+                reason = "tail_block"
+            else:
+                reason = "unaligned_chunk"
+            _mx.inc("minio_tpu_pipeline_host_fallback_total",
+                    reason=reason)
+            entry = ["pyf", erasure.encode_data_async(buf), shard_len,
+                     buf_arr, None]
+        else:
+            entry = ["py", erasure.encode_data_async(buf), shard_len,
+                     buf_arr, None]
+        # the async encode paths copied the payload during split():
+        # the pooled block buffer is free the moment submit returns
+        if buf_arr is not None:
+            pool.put(buf_arr)
+            entry[3] = None
+        return entry
 
     def start_writes(entry):
-        kind, fut, shard_len = entry
+        kind, fut, shard_len, buf_arr, digs = entry
         futs = {}
-        framed = None
+        framed_buf = None
         if kind == "fd":
             # shard writes already ride inside the native call
-            write_window.append(("fd", fut))
+            write_window.append(("fd", (fut, buf_arr)))
             return
-        if kind == "py":
-            shards = fut.result()
-            for i, ow in enumerate(owriters):
-                if ow is None or writers[i] is None:
-                    continue
-                futs[i] = ow.write_async(shards[i].tobytes())
-        else:
-            framed = fut.result() if fut is not None else None
+        if kind in ("py", "pyf", "pyh"):
+            with _stages.timed(stc, "encode_hash"):
+                res = fut.result()
+            if kind == "pyh":
+                # 2-D data/parity straight from the flush: framing below
+                # is the host's ONLY payload pass (no restack)
+                data2d, parity2d, digs = res
+            elif kind == "pyf":
+                shards = res
+                # host digest fallback over ALL k+m shards (parity
+                # frames need digests too), in the framing order
+                from .bitrot import shard_chunk_digests
+                with _stages.timed(stc, "encode_hash"):
+                    data2d = np.stack(shards[:k])
+                    parity2d = np.stack(shards[k:])
+                    digs = np.concatenate([
+                        shard_chunk_digests(data2d, chunk, algo_id),
+                        shard_chunk_digests(parity2d, chunk, algo_id)])
+            if kind in ("pyh", "pyf"):
+                _collect(digs)
+                from .bitrot import frame_block_shards
+                fl = digs.shape[1] + data2d.shape[1]
+                framed_all = np.empty((k + m, fl), dtype=np.uint8)
+                frame_block_shards(data2d, digs[:k], chunk,
+                                   out=framed_all[:k])
+                frame_block_shards(parity2d, digs[k:], chunk,
+                                   out=framed_all[k:])
+                for i, ow in enumerate(owriters):
+                    if ow is None or writers[i] is None:
+                        continue
+                    futs[i] = ow.write_framed_async(framed_all[i])
+            else:
+                futs = _plain_writes_fallback(res, shard_len)
+        else:  # "nat"
+            framed_buf = fut.result() if fut is not None else None
             fl = native.framed_len(shard_len, chunk) \
-                if framed is not None else 0
+                if framed_buf is not None else 0
+            if framed_buf is not None and etag is not None:
+                _collect(_extract_digests(
+                    framed_buf.reshape(k + m, fl), shard_len))
+            if buf_arr is not None:
+                pool.put(buf_arr)  # native call done: block buffer free
+                entry[3] = None
             for i, ow in enumerate(owriters):
                 if ow is None or writers[i] is None:
                     continue
-                span = framed[i * fl:(i + 1) * fl] \
-                    if framed is not None else b""
+                span = framed_buf[i * fl:(i + 1) * fl] \
+                    if framed_buf is not None else b""
                 futs[i] = ow.write_framed_async(span)
-        write_window.append(("w", (futs, framed)))
+        write_window.append(("w", (futs, framed_buf)))
 
     def harvest_writes():
         kind, payload = write_window.popleft()
@@ -437,8 +601,11 @@ def erasure_encode(erasure: Erasure, stream, writers: list,
             if writers[i] is None:
                 errs[i] = errors.DiskNotFound()
         if kind == "fd":
+            fut, buf_arr = payload
             try:
-                codes = payload.result()
+                codes, digs = fut.result()
+                if digs is not None:
+                    _collect(digs)
             except Exception as e:  # noqa: BLE001 — whole block failed:
                 # every live disk gets a vote, quorum math decides
                 codes = None
@@ -446,6 +613,7 @@ def erasure_encode(erasure: Erasure, stream, writers: list,
                     if writers[i] is not None:
                         errs[i] = errors.FaultyDisk(str(e))
                         writers[i] = None
+            pool.put(buf_arr)  # native call done: block buffer free
             if codes is not None:
                 for i, code in enumerate(codes):
                     if code and writers[i] is not None:
@@ -454,22 +622,42 @@ def erasure_encode(erasure: Erasure, stream, writers: list,
                             if code > 0 else "pwrite: short write")
                         writers[i] = None
         else:
-            futs, framed = payload
-            for i, f in futs.items():
-                try:
-                    f.result()
-                except Exception as e:  # noqa: BLE001 — disk errors are votes
-                    errs[i] = e if isinstance(e, errors.StorageError) \
-                        else errors.FaultyDisk(str(e))
-                    writers[i] = None
-            if native_path:
+            futs, framed_buf = payload
+            with _stages.timed(stc, "shard_write"):
+                for i, f in futs.items():
+                    try:
+                        f.result()
+                    except Exception as e:  # noqa: BLE001 — errors are votes
+                        errs[i] = e if isinstance(e, errors.StorageError) \
+                            else errors.FaultyDisk(str(e))
+                        writers[i] = None
+            if framed_buf is not None:
                 # all shard writes for this block are done (results
                 # harvested above); its framed buffer can carry the next
-                pool.put(framed)
+                pool.put(framed_buf)
         err = errors.reduce_write_quorum_errs(
             errs, errors.BASE_IGNORED_ERRS, write_quorum)
         if err is not None:
             raise err
+
+    bs = erasure.block_size
+    use_readinto = hasattr(stream, "readinto")
+
+    def read_block():
+        """One block's payload: (buf, backing pooled array or None)."""
+        if not use_readinto:
+            with _stages.timed(stc, "body_read"):
+                b = _read_full(stream, bs)
+            return b, None
+        arr = pool.get(bs)
+        with _stages.timed(stc, "body_read"):
+            got = _read_full_into(stream, arr)
+        if got == 0:
+            pool.put(arr)
+            return b"", None
+        _mx.inc("minio_tpu_pipeline_zero_copy_bytes_total", got,
+                path="put")
+        return arr[:got], arr
 
     win = native_window_for(erasure.block_size) if native_path \
         else ENCODE_WINDOW
@@ -477,17 +665,20 @@ def erasure_encode(erasure: Erasure, stream, writers: list,
     try:
         while not eof or enc_window or write_window:
             while not eof and len(enc_window) < win:
-                buf = _read_full(stream, erasure.block_size)
-                if not buf:
+                buf, buf_arr = read_block()
+                blen = len(buf) if not isinstance(buf, np.ndarray) \
+                    else buf.size
+                if not blen:
                     eof = True
                     if total == 0 and not enc_window:
-                        # empty object: one empty block for quorum accounting
+                        # empty object: one empty block for quorum
+                        # accounting
                         enc_window.append(encode_block(b""))
                     break
-                if len(buf) < erasure.block_size:
+                if blen < bs:
                     eof = True
-                total += len(buf)
-                enc_window.append(encode_block(buf))
+                total += blen
+                enc_window.append(encode_block(buf, buf_arr))
             if enc_window:
                 start_writes(enc_window.popleft())
             while len(write_window) > (win if enc_window or not eof
@@ -498,16 +689,16 @@ def erasure_encode(erasure: Erasure, stream, writers: list,
         # abort/close the writers, and a background write racing an abort
         # corrupts writer state (or, on the fd path, pwrites into a
         # recycled file descriptor)
-        for kind, fut, _sl in enc_window:
-            if kind == "fd" and fut is not None:
+        for entry in enc_window:
+            if entry[0] == "fd" and entry[1] is not None:
                 try:
-                    fut.result()
+                    entry[1].result()
                 except Exception:  # noqa: BLE001
                     pass
         for kind, payload in write_window:
             if kind == "fd":
                 try:
-                    payload.result()
+                    payload[0].result()
                 except Exception:  # noqa: BLE001
                     pass
                 continue
@@ -531,6 +722,22 @@ def _read_full(stream, n: int) -> bytes:
         chunks.append(b)
         got += len(b)
     return b"".join(chunks)
+
+
+def _read_full_into(stream, arr: np.ndarray) -> int:
+    """readinto form of _read_full: fill ``arr`` from the stream, looping
+    over short reads; returns bytes read. The zero-copy ingest leg —
+    block payloads land directly in pooled buffers, no intermediate
+    ``bytes`` object per block."""
+    mv = memoryview(arr)
+    got = 0
+    n = len(mv)
+    while got < n:
+        r = stream.readinto(mv[got:])
+        if not r:
+            break
+        got += r
+    return got
 
 
 class _ParallelReader:
@@ -790,6 +997,10 @@ def erasure_decode(erasure: Erasure, writer, readers: list, offset: int,
             out_dest = dest if dest is not None and boff == 0 and \
                 blen == k * shard_len and \
                 dest.flags["C_CONTIGUOUS"] else None
+            if out_dest is not None:
+                # block assembles straight into the caller's final buffer
+                _mx.inc("minio_tpu_pipeline_zero_copy_bytes_total", blen,
+                        path="get")
             try:
                 fds = [preader.readers[i].fileno() for i in range(k)]
                 offs = [preader.readers[i].phys_offset(shard_offset)
@@ -797,6 +1008,8 @@ def erasure_decode(erasure: Erasure, writer, readers: list, offset: int,
             except (AttributeError, OSError):
                 fds = None
             if fds is not None:
+                _mx.inc("minio_tpu_pipeline_get_blocks_total",
+                        route="native_fd")
                 # pure CPU kernel work — records no spans
                 fut = encode_pool().submit(pread_block, fds, offs,  # graftlint: disable=GL005
                                            shard_len, out_dest)
@@ -804,6 +1017,8 @@ def erasure_decode(erasure: Erasure, writer, readers: list, offset: int,
                         dest]
             framed = read_framed_k(shard_offset, shard_len)
             if framed is not None:
+                _mx.inc("minio_tpu_pipeline_get_blocks_total",
+                        route="native")
                 fut = encode_pool().submit(  # graftlint: disable=GL005 — pure kernel compute
                     native.get_block, framed, k, shard_len, fuse_chunk,
                     HIGHWAY_KEY, get_algo_id,
@@ -820,11 +1035,13 @@ def erasure_decode(erasure: Erasure, writer, readers: list, offset: int,
         # missing in the fused case and the rebuild is never wasted.
         degraded = any(preader.readers[i] is None for i in range(k))
         if degraded and preader.fusable(shard_len):
+            _mx.inc("minio_tpu_pipeline_get_blocks_total", route="fused")
             shards = preader.read_block(shard_offset, shard_len, raw=True)
             fut = erasure.decode_data_blocks_verified_async(
                 shards, preader.last_digests, preader.fuse_chunk(),
                 preader.fuse_algo())
             return ["fused", fut, b, block_data_len, boff, blen, dest]
+        _mx.inc("minio_tpu_pipeline_get_blocks_total", route="plain")
         shards = preader.read_block(shard_offset, shard_len)
         return ["plain", erasure.decode_data_blocks_async(shards), b,
                 block_data_len, boff, blen, dest]
@@ -1120,6 +1337,15 @@ class PreallocSink:
         if self.arr is None:
             return b""
         return self.arr[: self.pos].tobytes()
+
+    def getbuffer(self) -> memoryview:
+        """Zero-copy view of the filled buffer — getvalue() without the
+        full-object GIL-held tobytes() pass (the last per-object copy
+        the round-5 parallel-GET collapse left on this path; callers
+        that only compare/stream/slice should prefer this)."""
+        if self.arr is None:
+            return memoryview(b"")
+        return memoryview(self.arr)[: self.pos]
 
 
 class BufferSource:

@@ -121,7 +121,8 @@ def _load_native_locked() -> ctypes.CDLL:
             c_u8p, ctypes.c_long, ctypes.c_char_p, ctypes.c_int,
             ctypes.c_int, ctypes.c_long, ctypes.c_long, ctypes.c_char_p,
             c_u8p, ctypes.c_int, ctypes.POINTER(ctypes.c_int),
-            ctypes.c_long, ctypes.POINTER(ctypes.c_int)]
+            ctypes.c_long, ctypes.POINTER(ctypes.c_int),
+            ctypes.POINTER(ctypes.c_double)]
         lib.mt_put_block_fds.restype = None
         lib.mt_get_block.argtypes = [
             ctypes.POINTER(ctypes.c_void_p), ctypes.c_int, ctypes.c_long,
@@ -238,11 +239,14 @@ def put_block(data, data_len: int, pmat: np.ndarray, k: int, m: int,
 def put_block_fds(data, data_len: int, pmat: np.ndarray, k: int, m: int,
                   shard_len: int, chunk: int, key: bytes, fds: list[int],
                   offset: int, algo: int = ALGO_HIGHWAY,
-                  scratch: np.ndarray | None = None) -> list[int]:
+                  scratch: np.ndarray | None = None,
+                  times: np.ndarray | None = None) -> list[int]:
     """Fused split+encode+hash+frame+pwrite for one erasure block: shard
     i's framed bytes go to fds[i] at byte ``offset`` (fds[i] < 0 skips).
     Returns the per-shard error list (0 ok / errno / -1 short write).
-    ``scratch`` is the (k+m)*framed_len staging buffer (bufpool)."""
+    ``scratch`` is the (k+m)*framed_len staging buffer (bufpool);
+    ``times``, when a float64[2] array, receives (encode+hash seconds,
+    pwrite seconds) for stage attribution."""
     lib = load_native()
     if k + m > 256 or k <= 0 or m < 0 or chunk <= 0:
         raise ValueError(f"unsupported geometry k={k} m={m} chunk={chunk}")
@@ -257,10 +261,15 @@ def put_block_fds(data, data_len: int, pmat: np.ndarray, k: int, m: int,
     pmat = np.ascontiguousarray(pmat, dtype=np.uint8)
     cfds = (ctypes.c_int * (k + m))(*fds)
     errs = (ctypes.c_int * (k + m))()
+    tptr = None
+    if times is not None:
+        if times.dtype != np.float64 or times.size != 2:
+            raise ValueError("put_block_fds: times must be float64[2]")
+        tptr = times.ctypes.data_as(ctypes.POINTER(ctypes.c_double))
     lib.mt_put_block_fds(
         src.ctypes.data_as(_u8p), data_len,
         pmat.ctypes.data_as(ctypes.c_char_p), k, m, shard_len, chunk, key,
-        scratch.ctypes.data_as(_u8p), algo, cfds, offset, errs)
+        scratch.ctypes.data_as(_u8p), algo, cfds, offset, errs, tptr)
     return list(errs)
 
 

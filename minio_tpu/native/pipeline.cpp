@@ -29,7 +29,16 @@
 #include <cerrno>
 #include <cstdint>
 #include <cstring>
+#include <ctime>
 #include <unistd.h>
+
+namespace {
+inline double mono_s() {
+  struct timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return (double)ts.tv_sec + (double)ts.tv_nsec * 1e-9;
+}
+}  // namespace
 
 namespace {
 
@@ -165,13 +174,19 @@ void mt_put_block(const uint8_t* data, long data_len, const uint8_t* pmat,
 // the per-shard Python write chain (6+ futures per block) with zero
 // Python-level writes — the reference leans on per-disk goroutines for
 // the same fan-out (cmd/erasure-encode.go:36-54).
+// `times`, when non-NULL, returns {encode+hash seconds, pwrite seconds}
+// for this call (bench.py's put_stage_breakdown attribution; two
+// clock_gettime calls, negligible against a ~0.5 ms block).
 void mt_put_block_fds(const uint8_t* data, long data_len, const uint8_t* pmat,
                       int k, int m, long shard_len, long chunk,
                       const uint64_t key[4], uint8_t* scratch, int algo,
-                      const int* fds, long offset, int* errs) {
+                      const int* fds, long offset, int* errs,
+                      double* times) {
   if (k + m > 256 || k <= 0 || m < 0 || chunk <= 0) return;
+  const double t0 = times ? mono_s() : 0.0;
   mt_put_block(data, data_len, pmat, k, m, shard_len, chunk, key, scratch,
                algo);
+  const double t1 = times ? mono_s() : 0.0;
   const long framed_len = mt_framed_len(shard_len, chunk);
   for (int i = 0; i < k + m; i++) {
     errs[i] = 0;
@@ -192,6 +207,10 @@ void mt_put_block_fds(const uint8_t* data, long data_len, const uint8_t* pmat,
       }
       done += w;
     }
+  }
+  if (times) {
+    times[0] = t1 - t0;
+    times[1] = mono_s() - t1;
   }
 }
 

@@ -362,6 +362,14 @@ class ErasureObjects(MultipartMixin, ObjectLayer):
 
         hr = stream if isinstance(stream, HashReader) else \
             HashReader(stream, size)
+        user_defined = dict(opts.user_defined)  # never mutate caller's opts
+        etag_known = bool(user_defined.get("etag")) or \
+            (opts.etag_source is not None and opts.etag_source is not hr)
+        # etag_source IS the ingest reader: its MD5 must keep running
+        collector = None if opts.etag_source is hr else \
+            self._arm_pipeline_etag(hr, size, etag_known,
+                                    chunk=bitrot_chunk,
+                                    shard_size=er.shard_size())
         tmp_id = new_tmp_id()
         shuffled = shuffle_disks_by_distribution(disks, distribution)
         writers = []
@@ -378,7 +386,8 @@ class ErasureObjects(MultipartMixin, ObjectLayer):
                 writers.append(None)
 
         try:
-            total = erasure_encode(er, hr, writers, write_quorum)
+            total = erasure_encode(er, hr, writers, write_quorum,
+                                   etag=collector)
         except Exception as e:  # noqa: BLE001
             for w in writers:
                 if w is not None:
@@ -397,11 +406,19 @@ class ErasureObjects(MultipartMixin, ObjectLayer):
             self._cleanup_tmp(tmp_id)
             raise dt.IncompleteBody(bucket, object)
 
-        user_defined = dict(opts.user_defined)  # never mutate caller's opts
         etag = user_defined.pop("etag", "")
         if not etag and opts.etag_source is not None:
             etag = opts.etag_source.etag()
-        etag = etag or hr.etag()
+        if not etag:
+            if collector is not None and collector.blocks == 0 and total:
+                # armed but never fed — an eligibility-gate bug, and the
+                # MD5 chain was disabled: fail loudly, never serve the
+                # constant empty-stream ETag for a non-empty object
+                self._cleanup_tmp(tmp_id)
+                raise dt.ObjectAPIError(
+                    bucket, object, "fused ETag collector starved")
+            etag = collector.etag() if collector is not None \
+                else hr.etag()
         fi.size = total
         fi.parts = [ObjectPartInfo(number=1, etag=etag, size=total,
                                    actual_size=hr.actual_size
@@ -468,6 +485,64 @@ class ErasureObjects(MultipartMixin, ObjectLayer):
         self.metacache.on_write(bucket)
         oi = ObjectInfo.from_file_info(fi, bucket, object, opts.versioned)
         return oi
+
+    def _arm_pipeline_etag(self, hr: HashReader, size: int,
+                           etag_known: bool = False, algo=None,
+                           chunk: int = 0, shard_size: int = 0):
+        """Fused-pipeline ETag gate (ROADMAP item 1): when the `pipeline`
+        config allows it and nothing demands a payload MD5, turn OFF the
+        HashReader's payload hashing and hand erasure_encode a
+        PipelineETag collector fed from the bitrot digests the encode
+        path computes anyway. Returns the armed collector or None (host
+        MD5 stays the ETag). Ineligibility reasons land in
+        minio_tpu_pipeline_host_fallback_total."""
+        from ..erasure.bitrot import native_algo_id
+        from ..obs import metrics as mx
+        from ..utils.hashreader import PipelineETag
+
+        def fallback(reason: str):
+            if etag_known:
+                # a supplied/etag-source ETag: the wrapper's MD5 is dead
+                # weight either way — drop it when digests don't forbid
+                hr.disable_payload_hash()
+                return None
+            mx.inc("minio_tpu_pipeline_host_fallback_total",
+                   reason=reason)
+            mx.inc("minio_tpu_pipeline_etag_total", mode="md5")
+            return None
+
+        try:
+            from ..config import get_config_sys
+            cs = get_config_sys()
+            mode = cs.get("pipeline", "etag")
+            min_b = cs.get_int("pipeline", "etag_min_bytes", 1 << 20)
+        except Exception:  # noqa: BLE001 — registry unavailable
+            mode, min_b = "fused", 1 << 20
+        if mode != "fused":
+            return fallback("config")
+        algo = algo if algo is not None else self.bitrot_algo
+        if not algo.streaming or native_algo_id(algo) is None:
+            return fallback("algo")
+        if chunk and shard_size and shard_size % chunk:
+            # framing-ineligible geometry (a stored multipart chunk that
+            # doesn't divide this upload's shard): erasure_encode would
+            # never feed the collector — keep the MD5 chain instead
+            return fallback("unaligned_chunk")
+        from .. import native
+        from ..runtime.dispatch import dispatch_enabled
+        if not (native.available() or dispatch_enabled()):
+            return fallback("no_engine")
+        if size < min_b:  # unknown sizes (-1) fall back too: the small-
+            return fallback("small_object")  # object MD5 is the compat tax
+        if etag_known:
+            hr.disable_payload_hash()
+            return None
+        if not hr.disable_payload_hash():
+            # client sent Content-MD5 / signed SHA256: the payload MUST
+            # be hashed to verify — it doubles as the ETag
+            return fallback("content_digest")
+        mx.inc("minio_tpu_pipeline_etag_total", mode="fused")
+        return PipelineETag()
 
     def _cleanup_tmp(self, tmp_id: str):
         for d in self.disks:
@@ -631,6 +706,18 @@ class ErasureObjects(MultipartMixin, ObjectLayer):
         sink = PreallocSink()
         self.get_object(bucket, object, sink, opts=opts)
         return sink.getvalue()
+
+    def get_object_buffer(self, bucket: str, object: str,
+                          opts: ObjectOptions = None) -> memoryview:
+        """get_object_bytes without the final full-object copy: the
+        PreallocSink's buffer is handed out as a zero-copy memoryview.
+        Callers that only compare/slice/stream (bench, server-side copy,
+        tiering) save one GIL-held pass per object — the last residual
+        serializer of the round-5 parallel-GET collapse."""
+        from ..erasure.streaming import PreallocSink
+        sink = PreallocSink()
+        self.get_object(bucket, object, sink, opts=opts)
+        return sink.getbuffer()
 
     # --- delete ------------------------------------------------------------
 
@@ -900,7 +987,7 @@ class ErasureObjects(MultipartMixin, ObjectLayer):
             return ObjectInfo.from_file_info(
                 fi, dst_bucket, dst_object, bool(fi.version_id))
         import io
-        data = self.get_object_bytes(src_bucket, src_object, src_opts)
+        data = self.get_object_buffer(src_bucket, src_object, src_opts)
         return self.put_object(dst_bucket, dst_object, io.BytesIO(data),
                                len(data), dst_opts)
 

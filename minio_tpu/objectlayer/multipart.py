@@ -140,6 +140,14 @@ class MultipartMixin:
 
         hr = stream if isinstance(stream, HashReader) else \
             HashReader(stream, size)
+        # parts ride the fused-ETag pipeline exactly like single PUTs
+        # (etag_from_parts folds the per-part hexes, so the final
+        # multipart ETag composes either way); the stored bitrot chunk
+        # is validated against THIS upload's shard geometry — a foreign
+        # chunk that doesn't divide the shard keeps the MD5 chain
+        collector = self._arm_pipeline_etag(hr, size, algo=algo,
+                                            chunk=bitrot_chunk,
+                                            shard_size=er.shard_size())
         tmp_id = new_tmp_id()
         shuffled = shuffle_disks_by_distribution(
             disks, fi.erasure.distribution)
@@ -155,7 +163,8 @@ class MultipartMixin:
             except Exception:  # noqa: BLE001
                 writers.append(None)
         try:
-            total = erasure_encode(er, hr, writers, write_quorum)
+            total = erasure_encode(er, hr, writers, write_quorum,
+                                   etag=collector)
         except Exception as e:  # noqa: BLE001
             for w in writers:
                 if w is not None:
@@ -170,7 +179,14 @@ class MultipartMixin:
         if size >= 0 and total != size:
             raise dt.IncompleteBody(bucket, object)
 
-        etag = hr.etag()
+        if collector is not None and collector.blocks == 0 and total:
+            # armed but never fed (eligibility-gate bug): loud failure
+            # beats serving the constant empty-stream ETag; reclaim the
+            # staged part shards like every other abort path
+            self._cleanup_tmp(tmp_id)
+            raise dt.ObjectAPIError(bucket, object,
+                                    "fused ETag collector starved")
+        etag = collector.etag() if collector is not None else hr.etag()
         # commit part shard + sidecar meta on each surviving disk
         part_meta = msgpack.packb({
             "etag": etag, "size": total,

@@ -332,6 +332,26 @@ def _g_qos(server) -> list[str]:
     return lines
 
 
+def _g_pipeline(server) -> list[str]:
+    """Zero-copy pipeline plane (docs/ARCHITECTURE.md data path): the
+    buffer pool's working set — the recycling pool every pooled block
+    body/framed buffer rides — so ingest pressure and pool thrash
+    (miss rate) are observable next to the pipeline counters the hot
+    paths inc() directly."""
+    from ..runtime import bufpool
+    if bufpool._global is None:
+        return []
+    st = bufpool._global.stats()
+    return [
+        "# TYPE minio_tpu_pipeline_bufpool_retained_bytes gauge",
+        f"minio_tpu_pipeline_bufpool_retained_bytes {st['retained']}",
+        "# TYPE minio_tpu_pipeline_bufpool_hits_total counter",
+        f"minio_tpu_pipeline_bufpool_hits_total {st['hits']}",
+        "# TYPE minio_tpu_pipeline_bufpool_misses_total counter",
+        f"minio_tpu_pipeline_bufpool_misses_total {st['misses']}",
+    ]
+
+
 def _g_process(server) -> list[str]:
     """Node process resources (reference getMinioProcMetrics:
     /proc/self/io rchar/wchar, fds, rss)."""
@@ -617,6 +637,8 @@ _GROUPS = [
     # qos reads in-memory scheduler/admission state — interval 0 keeps
     # overload tests (and scrapes mid-incident) fresh
     MetricsGroup("qos", "node", _g_qos, interval=0),
+    # pipeline reads in-memory bufpool counters — interval 0, trivial
+    MetricsGroup("pipeline", "node", _g_pipeline, interval=0),
     # disk health reads in-memory tracker state — interval 0 so a trip
     # is visible on the very next scrape (and in chaos tests)
     MetricsGroup("disk_health", "node", _g_disk_health, interval=0),

@@ -1,17 +1,25 @@
-"""Fused bitrot-verify + reconstruct: ONE device launch hashes every source
-shard (HighwayHash-256, hh_jax) AND rebuilds the requested target shards
-(bit-sliced GF(256), rs_jax/rs_pallas).
+"""Fused device launches combining the GF(256) shard math with the bitrot
+hash lanes — ONE XLA program each for:
 
-This is BASELINE config 4 — the TPU-native replacement for the reference's
-streaming bitrot read path (cmd/bitrot-streaming.go:115-151), where every
-shard chunk is hashed on the CPU before the SIMD reconstruct. Here a
-degraded read or heal ships raw [digest][chunk] shard data to the device;
-hash verification of all k source shards and the GF(256) rebuild of up to m
-targets happen in the same XLA program, so corruption detection costs no
-extra launch and no host round-trip in the common (clean) case. The host
-inspects the returned validity mask and only re-dispatches when a digest
-actually mismatched (the reference handles bitrot the same way: an error
-return triggers replacement reads).
+- **verify + reconstruct** (BASELINE config 4): hash every source shard
+  (per-chunk digests) AND rebuild the requested target shards. The
+  TPU-native replacement for the reference's streaming bitrot read path
+  (cmd/bitrot-streaming.go:115-151), where every chunk is hashed on the
+  CPU before the SIMD reconstruct. A degraded read or heal ships raw
+  [digest][chunk] shard data to the device; corruption detection costs no
+  extra launch and no host round-trip in the clean case. The host inspects
+  the returned validity mask and only re-dispatches when a digest actually
+  mismatched (the reference's replacement-read pattern).
+- **encode + hash** (the PUT flush): compute the m parity shards AND the
+  per-chunk bitrot digests of all k+m shards, so a PUT through the
+  dispatch queue never hashes payload bytes on the host — the digests come
+  back with the parity and the host only interleaves them into the framed
+  shard files (ROADMAP item 1's device-side hash lane).
+
+Device hash kernels by wire id (matches minio_tpu.native ALGO_*):
+0 = HighwayHash-256 (u64-emulated jnp — reference-compatible), 1 = MUR3X256
+(u32-native; the Pallas kernel by default, mur3_jax behind
+``pipeline.device_hash=jnp``).
 """
 from __future__ import annotations
 
@@ -22,9 +30,33 @@ import jax.numpy as jnp
 
 from . import hh_jax, mur3_jax
 
-#: Device hash kernels by wire id (matches minio_tpu.native ALGO_*):
-#: 0 = HighwayHash-256 (u64-emulated — reference-compatible), 1 = MUR3X256
-#: (u32-native — the TPU-first default, ~4x the fused rate).
+
+def _hash_impl(algo: int) -> tuple:
+    """(key_fn, impl_tag) for a native ALGO_* id; resolved per
+    fused_fn_for call so the pallas/jnp choice lands in the jit-cache
+    key."""
+    if algo == 1:
+        from . import mur3_pallas
+        if mur3_pallas.enabled():
+            return mur3_pallas._key_words, "pallas"
+        return mur3_jax._key_words, "jnp"
+    return hh_jax._key_words, "jnp"
+
+
+def _hash_fn(algo: int, impl: str):
+    """Deterministic kernel for (algo, impl) — selected FROM the cache
+    key, never re-resolved from dynamic config, so a cached entry can
+    never disagree with the key it is stored under (a device_hash flip
+    mid-process takes effect on the next fused_fn_for resolution)."""
+    if algo == 1:
+        if impl == "pallas":
+            from . import mur3_pallas
+            return mur3_pallas.hash256_device_words
+        return mur3_jax.hash256_device_words
+    return hh_jax.hash256_device_words
+
+
+#: back-compat view used by bench/tests to reach the raw kernels
 _DEVICE_HASHES = {
     0: (hh_jax._key_words, hh_jax.hash256_device_words),
     1: (mur3_jax._key_words, mur3_jax.hash256_device_words),
@@ -33,9 +65,10 @@ _DEVICE_HASHES = {
 
 @functools.lru_cache(maxsize=64)
 def _jitted(key_words: tuple[int, ...], chunk_nbytes: int, backend_mm,
-            algo: int = 0):
-    """Compile cache per (hash key, chunk bytes, matmul kernel, algo)."""
-    hash_fn = _DEVICE_HASHES[algo][1]
+            algo: int = 0, impl: str = ""):
+    """Compile cache per (hash key, chunk bytes, matmul kernel, algo,
+    hash impl)."""
+    hash_fn = _hash_fn(algo, impl) if impl else _DEVICE_HASHES[algo][1]
 
     def fused(masks, words, digests):
         # words [B, k, W] uint32; masks [B, 8, m, k]; digests [B, k, nc*8]
@@ -54,15 +87,16 @@ def _jitted(key_words: tuple[int, ...], chunk_nbytes: int, backend_mm,
 
 def fused_fn_for(key: bytes, shard_nbytes: int, backend_mm,
                  chunk_nbytes: int | None = None, algo: int = 0):
-    """Validated + cached fused kernel for one (key, shard, chunk, algo):
-    the single entry both the plain and mesh-sharded dispatch flushes go
-    through, so the chunk-divisibility guard can't be bypassed."""
+    """Validated + cached fused verify+reconstruct kernel for one (key,
+    shard, chunk, algo): the single entry both the plain and mesh-sharded
+    dispatch flushes go through, so the chunk-divisibility guard can't be
+    bypassed."""
     if not chunk_nbytes:
         chunk_nbytes = shard_nbytes
     if shard_nbytes % chunk_nbytes:
         raise ValueError("shard length is not a bitrot-chunk multiple")
-    key_fn = _DEVICE_HASHES[algo][0]
-    return _jitted(key_fn(key), chunk_nbytes, backend_mm, algo)
+    key_fn, impl = _hash_impl(algo)
+    return _jitted(key_fn(key), chunk_nbytes, backend_mm, algo, impl)
 
 
 def fused_rebuild(key: bytes, masks, words, digests, backend_mm,
@@ -75,3 +109,39 @@ def fused_rebuild(key: bytes, masks, words, digests, backend_mm,
     fn = fused_fn_for(key, int(words.shape[-1]) * 4, backend_mm,
                       chunk_nbytes, algo)
     return fn(masks, words, digests)
+
+
+# --- fused encode + hash (the PUT flush's device-side hash lane) -------------
+
+
+@functools.lru_cache(maxsize=64)
+def _jitted_encode_hashed(key_words: tuple[int, ...], chunk_nbytes: int,
+                          encode_mm, algo: int, impl: str):
+    hash_fn = _hash_fn(algo, impl)
+
+    def fused(words):
+        # words [B, k, W] -> (parity [B, m, W], digests [B, k+m, nc*8]);
+        # parity is hashed in the SAME launch, so the host interleaves
+        # ready-made [digest][chunk] frames without touching a hash
+        B, k, W = words.shape
+        parity = encode_mm(words)
+        both = jnp.concatenate([words, parity], axis=1)  # [B, k+m, W]
+        nc = W * 4 // chunk_nbytes
+        digs = hash_fn(key_words, chunk_nbytes,
+                       both.reshape(B, k + parity.shape[1], nc, W // nc))
+        return parity, digs.reshape(B, k + parity.shape[1], nc * 8)
+
+    return jax.jit(fused)
+
+
+def encode_hashed_fn_for(key: bytes, shard_nbytes: int, encode_mm,
+                         chunk_nbytes: int, algo: int = 0):
+    """Cached fused encode+hash kernel: ``encode_mm`` is the codec's
+    batched [B,k,W] -> [B,m,W] encode (static pallas kernel or masked
+    jnp); the launch also digests every ``chunk_nbytes`` chunk of all
+    k+m shards with the device hash for ``algo``."""
+    if not chunk_nbytes or shard_nbytes % chunk_nbytes:
+        raise ValueError("shard length is not a bitrot-chunk multiple")
+    key_fn, impl = _hash_impl(algo)
+    return _jitted_encode_hashed(key_fn(key), chunk_nbytes, encode_mm,
+                                 algo, impl)

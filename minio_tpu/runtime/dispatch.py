@@ -59,7 +59,8 @@ log = logging.getLogger("minio_tpu.dispatch")
 
 #: dispatch op -> the kernel-metrics op name exported as
 #: minio_tpu_kernel_op_latency_seconds{op=...}
-_OP_NAME = {"encode": "encode", "masked": "reconstruct", "fused": "fused"}
+_OP_NAME = {"encode": "encode", "masked": "reconstruct", "fused": "fused",
+            "encode_hashed": "encode_hashed"}
 
 MAX_BATCH = int(os.environ.get("MINIO_TPU_DISPATCH_BATCH", "128"))
 MAX_DELAY_S = float(os.environ.get("MINIO_TPU_DISPATCH_DELAY_MS", "1.0")) / 1e3
@@ -281,7 +282,13 @@ class DispatchQueue:
         if p.masks is not None:
             bytes_in += p.masks.nbytes
             out_rows = p.masks.shape[1]
-        return bytes_in, out_rows * p.words.shape[-1] * 4
+        bytes_out = out_rows * p.words.shape[-1] * 4
+        if b.op == "encode_hashed":
+            # the digests ride the downlink too: 32 B per chunk of all
+            # k+m shards
+            nc = p.words.shape[-1] * 4 // b.chunk_size
+            bytes_out += (b.codec.k + b.codec.m) * nc * 32
+        return bytes_in, bytes_out
 
     def masked(self, codec, words: np.ndarray, masks: np.ndarray) -> Future:
         """words uint32 [k, W] + masks uint32 [8, o, k] -> Future[[o, W]].
@@ -293,6 +300,22 @@ class DispatchQueue:
         rows ride the link."""
         key = ("masked", codec.k, masks.shape[1], words.shape[-1])
         return self._submit(key, codec, "masked", words, masks)
+
+    def encode_hashed(self, codec, words: np.ndarray, hash_key: bytes,
+                      chunk_size: int, hash_algo: int = 0) -> Future:
+        """Fused encode+hash (the PUT flush's device-side hash lane):
+        words uint32 [k, W] -> Future[(parity uint32 [m, W], digests
+        uint32 [k+m, nc*8])] — the per-``chunk_size``-chunk bitrot
+        digests of every data AND parity shard come back with the
+        parity, so the PUT path interleaves ready-made [digest][chunk]
+        frames without hashing payload bytes on the host. Coalesces
+        across concurrent PUTs exactly like 'encode' (same bucket
+        mechanics, QoS class tagging included)."""
+        key = ("encode_hashed", codec.k, codec.m, words.shape[-1],
+               id(codec.matrix), hash_key, chunk_size, hash_algo)
+        return self._submit(key, codec, "encode_hashed", words, None,
+                            hash_key=hash_key, chunk_size=chunk_size,
+                            hash_algo=hash_algo)
 
     def fused(self, codec, words: np.ndarray, masks: np.ndarray,
               digests: np.ndarray, hash_key: bytes,
@@ -476,13 +499,8 @@ class DispatchQueue:
     def _flush_bytes(self, b: _Bucket, items: list[_Pending]
                      ) -> tuple[int, int]:
         n = len(items)
-        w = items[0].words
-        bytes_in = n * w.nbytes
-        out_rows = b.codec.m
-        if items[0].masks is not None:
-            out_rows = items[0].masks.shape[1]
-            bytes_in += n * items[0].masks.nbytes
-        return bytes_in, n * out_rows * w.shape[-1] * 4
+        bytes_in, bytes_out = self._item_bytes(b, items[0])
+        return n * bytes_in, n * bytes_out
 
     def _plan_flush(self, b: _Bucket, items: list[_Pending]) -> int:
         """Per-item consultation of the QoS scheduler (replaces the old
@@ -544,13 +562,25 @@ class DispatchQueue:
         def one(p: _Pending):
             try:
                 u8 = np.ascontiguousarray(p.words).view(np.uint8)
-                if b.op == "encode":
+                if b.op in ("encode", "encode_hashed"):
                     rows = b.codec.parity_rows
                 else:
                     rows = self._rows_from_masks(p.masks)
                 out = native.cpu_encode(rows, u8, rows.shape[0])
                 out_words = np.ascontiguousarray(out).view(np.uint32)
-                if b.op == "fused":
+                if b.op == "encode_hashed":
+                    # digest data + parity shards with the native batch
+                    # hasher — bit-identical to the device hash lane
+                    from ..erasure.bitrot import native_batch_hasher
+                    batch_hash = native_batch_hasher(b.hash_algo)
+                    both = np.concatenate([u8, out], axis=0)
+                    digs = batch_hash(
+                        b.hash_key, both.reshape(-1, b.chunk_size))
+                    n_sh = both.shape[0]
+                    p.future.set_result(
+                        (out_words,
+                         digs.reshape(n_sh, -1).view(np.uint32)))
+                elif b.op == "fused":
                     from ..erasure.bitrot import native_batch_hasher
                     batch_hash = native_batch_hasher(b.hash_algo)
                     k = u8.shape[0]
@@ -769,6 +799,19 @@ class DispatchQueue:
                 out_dev = fn(replicated_for(
                     b.codec, "_mesh_enc_masks", b.codec._enc_masks, mesh),
                     stack)
+        elif b.op == "encode_hashed":
+            from ..obs import metrics as _mx
+            from ..ops.fused import encode_hashed_fn_for
+            inner = encode_hashed_fn_for(b.hash_key, stack.shape[-1] * 4,
+                                         b.codec.encode_words_batch,
+                                         b.chunk_size, b.hash_algo)
+            _mx.inc("minio_tpu_pipeline_fused_hash_flushes_total",
+                    op="encode_hashed")
+            if mesh is None:
+                out_dev = inner(jnp.asarray(stack))
+            else:
+                fn = sharded_batched(inner, mesh, (True,), out_batch=2)
+                out_dev = fn(stack)
         elif b.op == "masked":
             masks = np.stack([p.masks for p in items] +
                              [items[0].masks] * (bsz - n))
@@ -780,7 +823,10 @@ class DispatchQueue:
                                      (True, True))
                 out_dev = fn(masks, stack)
         else:  # 'fused': verify source digests + rebuild in one launch
+            from ..obs import metrics as _mx
             from ..ops.fused import fused_fn_for
+            _mx.inc("minio_tpu_pipeline_fused_hash_flushes_total",
+                    op="fused")
             masks = np.stack([p.masks for p in items] +
                              [items[0].masks] * (bsz - n))
             digs = np.stack([p.digests for p in items] +
@@ -854,11 +900,11 @@ class DispatchQueue:
     def _finish_readback(self, b: _Bucket, out_dev,
                          items: list[_Pending], span_done=None):
         try:
-            if b.op == "fused":
+            if b.op in ("fused", "encode_hashed"):
                 out = np.asarray(out_dev[0])
-                valid = np.asarray(out_dev[1])
+                extra = np.asarray(out_dev[1])  # valid mask / digests
                 for i, p in enumerate(items):
-                    p.future.set_result((out[i], valid[i]))
+                    p.future.set_result((out[i], extra[i]))
             else:
                 out = np.asarray(out_dev)
                 for i, p in enumerate(items):

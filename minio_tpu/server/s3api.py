@@ -2669,3 +2669,20 @@ class _CappedReader:
         if self.handler is not None:
             self.handler._consumed += len(b)
         return b
+
+    def readinto(self, view) -> int:
+        """Zero-copy leg of the PUT ingest: the erasure pipeline's pooled
+        block buffers reach the socket's BufferedReader directly, so body
+        bytes are never materialized as per-block ``bytes`` objects."""
+        if self.remaining == 0:
+            return 0
+        view = memoryview(view).cast("B")
+        if 0 < self.remaining < len(view):
+            view = view[: self.remaining]
+        got = self.raw.readinto(view)
+        got = got or 0
+        if self.remaining > 0:
+            self.remaining -= got
+        if self.handler is not None:
+            self.handler._consumed += got
+        return got

@@ -8,7 +8,16 @@ lanes, which is where the reference gets its concurrent-ingest throughput.
 Bodies that also need SHA256 (signed payloads) or whose size is unknown
 keep the per-reader worker thread below — hashlib releases the GIL for
 buffers >2 KiB, so the digest chain still overlaps the erasure-encode
-pipeline instead of serializing with it."""
+pipeline instead of serializing with it.
+
+This module is ALSO the sanctioned home of host payload hashing for the
+zero-copy pipeline (graftlint GL010): when the fused-pipeline ETag is
+eligible (``pipeline`` config KVS, no Content-MD5/SHA256 contract), the
+object layer calls :meth:`HashReader.disable_payload_hash` and derives the
+ETag from the per-chunk bitrot digests the encode pipeline computes anyway
+(:class:`PipelineETag`); the MD5 machinery here remains the compat
+fallback. :func:`pipeline_etag_reference` is the from-raw-bytes reference
+implementation the device/native paths are property-tested against."""
 from __future__ import annotations
 
 import binascii
@@ -18,6 +27,7 @@ import threading
 import weakref
 
 from . import errors
+from ..obs import stages as _stages
 
 #: Bodies at least this large hash on a worker thread; smaller ones inline
 #: (thread hop costs more than the digest).
@@ -133,6 +143,7 @@ class HashReader:
         self._eof = False
         self._async: _AsyncDigest | None = None
         self._lane = False  # md5 runs on the shared lane server
+        self._payload_hash = True  # False: fused-ETag pipeline owns it
         self._active_token: dict = {}
         if size >= ASYNC_DIGEST_MIN:
             already_active = _enter_large()
@@ -157,6 +168,36 @@ class HashReader:
     def _hashes(self) -> list:
         return [self._md5] + (
             [self._sha256] if self._sha256 is not None else [])
+
+    def disable_payload_hash(self) -> bool:
+        """Stop hashing payload bytes — the fused pipeline will derive
+        the ETag from the encode path's bitrot digests instead. Refused
+        (returns False) when the client sent digests to verify
+        (Content-MD5 / signed SHA256): those MUST be checked over the
+        payload, so the compat path keeps hashing. Legal mid-stream
+        (already-hashed bytes are simply abandoned with the rest of the
+        digest state)."""
+        if self.want_md5 or self.want_sha256:
+            return False
+        self._payload_hash = False
+        if self._async is not None:
+            self._async.drain()
+            self._async = None
+        return True
+
+    def _ingest(self, b) -> None:
+        """Charge one block's bytes to the digest chain (the sanctioned
+        host-hash fallback — skipped entirely in fused-ETag mode).
+        stages.timed no-ops when no collector is armed."""
+        if not self._payload_hash:
+            return
+        with _stages.timed(_stages.active(), "etag"):
+            if self._async is not None:
+                self._async.update(b)
+            else:
+                self._md5.update(b)
+                if self._sha256 is not None:
+                    self._sha256.update(b)
 
     def read(self, n: int = -1) -> bytes:
         if self._eof:
@@ -194,15 +235,64 @@ class HashReader:
                 # mid-stream hashlib state, and the worker hop only adds
                 # a queue round-trip there.
                 self._async = _AsyncDigest(self._hashes())
-        if self._async is not None:
-            self._async.update(b)
-        else:
-            self._md5.update(b)
-            if self._sha256 is not None:
-                self._sha256.update(b)
+        self._ingest(b)
         if self.size >= 0 and self._read == self.size:
             pass  # digests checked on the EOF read
         return b
+
+    def readinto(self, view) -> int:
+        """Read up to ``len(view)`` bytes straight into a caller buffer
+        (the zero-copy PUT ingest: the erasure pipeline hands pooled
+        block buffers down here, so no per-block ``bytes`` object is
+        materialized). Loops over short reads like io.ReadFull. Deferred
+        digest engines (worker thread / AVX2 lane server) retain their
+        input until hashed, which would race the caller recycling the
+        buffer — those fall back to read()+copy; the fused-ETag mode
+        (payload hashing disabled) and the plain inline-hash mode take
+        the true zero-copy path."""
+        view = memoryview(view).cast("B")
+        want = len(view)
+        if self._payload_hash and (self._async is not None or self._lane):
+            got = 0
+            while got < want:
+                b = self.read(want - got)
+                if not b:
+                    break
+                view[got: got + len(b)] = b
+                got += len(b)
+            return got
+        if self._eof:
+            return 0
+        if self.size >= 0:
+            remaining = self.size - self._read
+            if remaining <= 0:
+                if self.stream.read(1):
+                    raise errors.MoreData()
+                self._finish()
+                return 0
+            want = min(want, remaining)
+        got = 0
+        inner = getattr(self.stream, "readinto", None)
+        while got < want:
+            if inner is not None:
+                n = inner(view[got:want])
+                if not n:
+                    break
+                got += n
+            else:
+                b = self.stream.read(want - got)
+                if not b:
+                    break
+                view[got: got + len(b)] = b
+                got += len(b)
+        if got == 0:
+            if self.size >= 0 and self._read < self.size:
+                raise errors.LessData()
+            self._finish()
+            return 0
+        self._read += got
+        self._ingest(view[:got])
+        return got
 
     def _drain(self):
         if self._async is not None:
@@ -242,3 +332,65 @@ def etag_from_parts(part_etags: list[str]) -> str:
     for e in part_etags:
         h.update(binascii.unhexlify(e.split("-")[0]))
     return f"{h.hexdigest()}-{len(part_etags)}"
+
+
+# --- fused-pipeline ETag ------------------------------------------------------
+
+
+class PipelineETag:
+    """Content ETag derived from the per-chunk bitrot digests of the DATA
+    shards, in stream order (block-major, shard-major within a block,
+    chunk order within a shard) — the digests every eligible PUT path
+    already computes (native mt_put_block, the dispatch queue's fused
+    encode+hash flush). The host folds only the digest stream (32 B per
+    bitrot chunk, ~0.2% of payload at the 16 KiB default) through MD5, so
+    PUT never runs host MD5 over payload bytes.
+
+    Deterministic given (payload, k, block_size, bitrot chunk, algo) — the
+    same tuple xl.meta already records — and identical across the native,
+    dispatch-device and host-fallback paths (property-locked against
+    :func:`pipeline_etag_reference` in tests/test_pipeline.py). The empty
+    object folds an empty digest stream, so its ETag equals the classic
+    empty-body MD5. Rendered as 32 hex chars like a plain ETag: S3 makes
+    no cross-object promise that an ETag is a body MD5 (multipart and SSE
+    objects already aren't), and If-Match/CopySource comparisons are
+    string-equality."""
+
+    def __init__(self):
+        self._md5 = hashlib.md5()
+        self.blocks = 0
+
+    def add_digests(self, dig_bytes) -> None:
+        """Fold one block's data-shard digest stream (bytes/buffer, shard
+        major)."""
+        self._md5.update(dig_bytes)
+        self.blocks += 1
+
+    def etag(self) -> str:
+        return self._md5.hexdigest()
+
+
+def pipeline_etag_reference(payload: bytes, k: int, block_size: int,
+                            chunk: int, algo_id: int = 0) -> str:
+    """From-raw-bytes reference for :class:`PipelineETag` — what the
+    device/native digest extraction must reproduce byte-for-byte. Pure
+    host math: split each block into k zero-padded ``ceil(len/k)`` shards
+    (the reference Split semantics, cmd/erasure-coding.go:74), digest each
+    shard's ``chunk``-size pieces (short tail piece last), fold the
+    data-shard digests through MD5 in stream order."""
+    import numpy as np
+
+    from ..erasure import bitrot
+    md5 = hashlib.md5()
+    n = len(payload)
+    off = 0
+    while off < n:
+        block = payload[off: off + block_size]
+        off += block_size
+        shard_len = -(-len(block) // k)
+        arr = np.zeros(k * shard_len, dtype=np.uint8)
+        arr[: len(block)] = np.frombuffer(block, dtype=np.uint8)
+        digs = bitrot.shard_chunk_digests(
+            arr.reshape(k, shard_len), chunk, algo_id)
+        md5.update(digs.tobytes())
+    return md5.hexdigest()

@@ -17,6 +17,17 @@ Root causes fixed with this test (see the PR that added it):
   PreallocSink/reserve() protocol scatters native block output straight
   into the final buffer.
 
+Provenance note (PR 7 investigation): the BENCH_r05 numbers were
+measured at the round-5 SEED — BEFORE the fixes above landed (the
+r05 BENCH commit predates this test's PR in git history), so the 0.17
+was the pre-fix state, not a surviving regression. What the PR-7 sweep
+did find and remove: ``getvalue()`` still paid one full-object GIL-held
+``tobytes`` per GET — ``get_object_buffer``/``PreallocSink.getbuffer``
+now hand out a zero-copy view (pinned in tests/test_pipeline.py), and
+``minio_tpu_pipeline_get_blocks_total{route}`` attributes every GET
+block's execution route so any future collapse is explainable from the
+BENCH extras alone.
+
 Measurement: serial and parallel rounds interleave, and the gate takes
 the BEST per-round ratio — a real collapse (0.3x) fails every round,
 while one noisy-neighbor burst on a busy CI host cannot fail the test.
@@ -63,7 +74,8 @@ def test_parallel_get_no_collapse(k, m):
             ol.put_object("b", f"p{j}", io.BytesIO(body), OBJ_SIZE)
 
         def read_one(j):
-            got = ol.get_object_bytes("b", f"p{j}")
+            # the zero-copy accessor — the path bench.py's par8 GET uses
+            got = ol.get_object_buffer("b", f"p{j}")
             assert got == body, f"payload mismatch on p{j}"
 
         def serial_round() -> float:

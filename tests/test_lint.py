@@ -1,5 +1,5 @@
 """Tier-1 gate for graftlint (docs/static-analysis.md): the tree must
-carry zero unbaselined findings, all nine checkers must be active, and
+carry zero unbaselined findings, all checkers must be active, and
 the suppression/baseline machinery must behave deterministically —
 checked here against synthetic sources so a checker regression fails
 loudly instead of silently passing a dirty tree."""
@@ -40,7 +40,7 @@ def test_tree_is_clean():
 
 
 def test_all_checkers_active():
-    assert len(checkers.PER_FILE) + len(checkers.PROJECT) >= 9
+    assert len(checkers.PER_FILE) + len(checkers.PROJECT) >= 10
 
 
 def test_cli_clean_tree_exits_zero(capsys):
@@ -269,6 +269,42 @@ def test_gl009_helper_module_and_foreign_paths_exempt():
         ctx_for(src, path="minio_tpu/storage/durability.py")) == []
     assert checkers.check_bare_replace(
         ctx_for(src, path="tools/somewhere.py")) == []
+
+
+def test_gl010_host_hash_and_copies_flagged():
+    ctx = ctx_for("""
+        import hashlib
+        def erasure_encode(stream, writers):
+            h = hashlib.md5()
+            def start_writes(shards):
+                return shards[0].tobytes()
+            def emit(x):
+                return bytes(x), x.digest()
+        def unrelated():
+            return hashlib.sha256().hexdigest()
+    """, path="minio_tpu/erasure/streaming.py")
+    found = checkers.check_hot_path_host_copies(ctx)
+    assert {f.checker for f in found} == {"GL010"}
+    # md5() + tobytes() + bytes() + digest() inside the hot scope; the
+    # module-level `unrelated` function is NOT registered -> not flagged
+    assert len(found) == 4
+    assert all(f.scope.startswith("erasure_encode") for f in found)
+
+
+def test_gl010_sanctioned_fallback_and_foreign_paths_exempt():
+    src = """
+        import hashlib
+        def erasure_encode(stream):
+            def _plain_writes_fallback(shards):
+                return hashlib.md5(shards[0].tobytes()).digest()
+            return _plain_writes_fallback
+    """
+    assert checkers.check_hot_path_host_copies(
+        ctx_for(src, path="minio_tpu/erasure/streaming.py")) == []
+    # the same constructs in an unregistered module are free
+    assert checkers.check_hot_path_host_copies(
+        ctx_for(src.replace("erasure_encode", "whatever"),
+                path="minio_tpu/erasure/bitrot.py")) == []
 
 
 def test_gl008_undocumented_dynamic_key_flagged():

@@ -1,4 +1,4 @@
-"""The nine graftlint checkers (GL001-GL009).
+"""The graftlint checkers (GL001-GL010).
 
 Each per-file checker takes a ``FileCtx`` and yields ``Finding``s; the
 project-wide checkers take the full list of parsed files (cross-file
@@ -19,6 +19,9 @@ text — nothing in the checked tree is imported.
 | GL008 | every dynamic config KVS key documented in docs/             |
 | GL009 | no bare ``os.replace``/``os.rename`` — commits go through    |
 |       | ``storage.durability.durable_replace`` (fsync policy)        |
+| GL010 | no host hashing / bytes copies on the PUT/GET hot path       |
+|       | outside the sanctioned ``*_fallback`` helpers (zero-copy     |
+|       | pipeline invariant)                                          |
 """
 from __future__ import annotations
 
@@ -599,6 +602,83 @@ def check_bare_replace(ctx: FileCtx) -> list[Finding]:
     return out
 
 
+# --------------------------------------------------------------------------
+# GL010 — the zero-copy invariant: no host hashing / bytes copies on the
+# PUT/GET hot path
+
+#: The registered data-plane hot functions (nested defs inherit via
+#: qualname prefix). The zero-copy PUT/GET pipeline's contract is that
+#: these never construct a hashlib object, call .digest()/.hexdigest(),
+#: or materialize payload copies via bytes()/.tobytes() — payload hashing
+#: belongs to the device/native pipeline, and the ONLY host escape is a
+#: helper whose name carries the ``_fallback`` marker (or HashReader's
+#: _ingest compat funnel), which this checker exempts by construction.
+_HOT_PATH_FUNCS: dict[str, tuple[str, ...]] = {
+    "minio_tpu/erasure/streaming.py": (
+        "erasure_encode", "erasure_decode", "_read_full",
+        "_read_full_into", "_ParallelReader.read_block",
+    ),
+    "minio_tpu/utils/hashreader.py": (
+        "HashReader.read", "HashReader.readinto",
+    ),
+    "minio_tpu/objectlayer/erasure_objects.py": (
+        "ErasureObjects._put_object_inner",
+        "ErasureObjects._get_object_inner",
+    ),
+    "minio_tpu/objectlayer/multipart.py": (
+        "MultipartMixin.put_object_part",
+    ),
+}
+
+
+def check_hot_path_host_copies(ctx: FileCtx) -> list[Finding]:
+    """GL010: the zero-copy PUT/GET invariant is enforced, not
+    conventional — host-side ``hashlib`` constructions, ``.digest()`` /
+    ``.hexdigest()`` calls, and ``bytes()`` / ``.tobytes()`` payload
+    copies are banned inside the registered hot-path functions. Host
+    hashing lives in the sanctioned fallback helpers (``*_fallback``
+    nested helpers, HashReader's ``_ingest`` funnel, the bitrot module)
+    which stay OUTSIDE the registry (docs/static-analysis.md)."""
+    hot = _HOT_PATH_FUNCS.get(ctx.path)
+    if not hot:
+        return []
+    out = []
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        scope = ctx.scope_at(node.lineno)
+        if not scope or not any(
+                scope == h or scope.startswith(h + ".") for h in hot):
+            continue
+        if "_fallback" in scope.rsplit(".", 1)[-1] or "_fallback." in scope:
+            continue  # sanctioned nested fallback helper
+        bad = None
+        if isinstance(node.func, ast.Attribute):
+            # attr-name match, not dotted(): the receiver may be a
+            # subscript (shards[i].tobytes()) dotted() can't resolve
+            attr = node.func.attr
+            d = dotted(node.func) or f"….{attr}"
+            if d.startswith("hashlib."):
+                bad = f"host hash construction {d}()"
+            elif attr in ("digest", "hexdigest"):
+                bad = f"host digest call {d}()"
+            elif attr == "tobytes":
+                bad = f"{d}() payload copy"
+        else:
+            d = dotted(node.func)
+            if d == "bytes" and node.args:
+                bad = "bytes() payload copy"
+        if bad is None:
+            continue
+        out.append(Finding(
+            ctx.path, node.lineno, "GL010",
+            f"{bad} on the PUT/GET hot path — hash/copy work belongs to "
+            "the device/native pipeline; host escapes go through a "
+            "sanctioned *_fallback helper (docs/static-analysis.md)",
+            token=_unparse(node, 40), scope=scope))
+    return out
+
+
 PER_FILE = [
     check_wall_duration,
     check_blocking_under_lock,
@@ -608,5 +688,6 @@ PER_FILE = [
     check_swallowed_exceptions,
     check_config_keys_documented,
     check_bare_replace,
+    check_hot_path_host_copies,
 ]
 PROJECT = [check_metrics_documented]
