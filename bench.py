@@ -667,6 +667,124 @@ def chaos_profile(rng) -> dict:
     return out
 
 
+class _NullWriter:
+    def write(self, b):
+        return len(b)
+
+
+def select_scan_bench(rng) -> dict:
+    """Device-workloads config A (ISSUE 8 / docs/select.md): batched
+    Select scan GiB/s on a numeric-predicate CSV at 1 MiB blocks x 128
+    batch, against the classic per-row interpreter on a sample of the
+    SAME data (the row loop runs ~MB/s, so it gets a slice and the
+    ratio extrapolates — both numbers are decoded-bytes/sec)."""
+    from minio_tpu.s3select import S3SelectRequest, run_select
+    mb = int(os.environ.get("MINIO_TPU_BENCH_SCAN_MB", "128"))
+    # ~26 B/row numeric CSV: id,v,w
+    n = mb * (1 << 20) // 26
+    ids = np.arange(n) % 10_000_000
+    v = rng.integers(0, 1_000_000, n)
+    w = rng.integers(0, 100, n)
+    body = ("\n".join(f"{a},{b},{c}" for a, b, c in
+                      zip(ids, v, w)) + "\n").encode()
+    sql = ("SELECT _1 FROM S3Object "
+           "WHERE _2 BETWEEN 990000 AND 1000000 AND _3 < 8")
+    req = S3SelectRequest()
+    req.expression = sql
+    req.csv_header = "NONE"
+
+    def run_with(mode: str, data: bytes) -> float:
+        prev = os.environ.get("MINIO_TPU_SCAN")
+        os.environ["MINIO_TPU_SCAN"] = mode
+        try:
+            t0 = time.perf_counter()
+            run_select(req, data, _NullWriter())
+            return len(data) / (time.perf_counter() - t0) / (1 << 30)
+        finally:
+            if prev is None:
+                os.environ.pop("MINIO_TPU_SCAN", None)
+            else:
+                os.environ["MINIO_TPU_SCAN"] = prev
+
+    run_with("auto", body[: 4 << 20])    # warm (jit compile)
+    scan_gibs = run_with("auto", body)
+    sample = body[: body.rfind(b"\n", 0, 8 << 20) + 1]
+    rowloop_gibs = run_with("off", sample)
+    log(f"select_scan {mb}MiB: scan {scan_gibs:.3f} GiB/s vs rowloop "
+        f"{rowloop_gibs:.4f} GiB/s ({scan_gibs / rowloop_gibs:.1f}x)")
+    return {"select_scan_gibs": round(scan_gibs, 3),
+            "select_scan_rowloop_gibs": round(rowloop_gibs, 4),
+            "select_scan_speedup": round(scan_gibs / rowloop_gibs, 1)}
+
+
+def sse_put_bench(rng) -> dict:
+    """Device-workloads config B (ISSUE 8 / docs/sse.md): SSE PUT
+    overhead %% vs plaintext at 16+4 par8 (1 MiB bodies), per package
+    cipher. AES-GCM reports null without the cryptography wheel."""
+    import threading
+
+    from minio_tpu.crypto.sse import (CIPHER_AESGCM, CIPHER_CHACHA20,
+                                      HAVE_CRYPTOGRAPHY, EncryptReader,
+                                      enc_size)
+    from minio_tpu.objectlayer import ErasureObjects
+    from minio_tpu.storage import XLStorage
+    K, M, OBJ = 16, 4, 1 << 20
+    N_PER = int(os.environ.get("MINIO_TPU_BENCH_SSE_NPER", "8"))
+    body = rng.integers(0, 256, OBJ, dtype=np.uint8).tobytes()
+    oek, iv = b"\x42" * 32, b"\x07" * 12
+    root = tempfile.mkdtemp(prefix="benchsse-", dir=bench_dir())
+    out: dict = {}
+    try:
+        disks = [XLStorage(os.path.join(root, f"d{i}"))
+                 for i in range(K + M)]
+        ol = ErasureObjects(disks, default_parity=M)
+        ol.make_bucket("b")
+
+        def par8(tag: str, cipher: str | None) -> float:
+            def worker(j):
+                for r in range(N_PER):
+                    name = f"{tag}-{j}-{r}"
+                    if cipher is None:
+                        ol.put_object("b", name, io.BytesIO(body), OBJ)
+                    else:
+                        ol.put_object(
+                            "b", name,
+                            EncryptReader(io.BytesIO(body), oek, iv,
+                                          cipher=cipher),
+                            enc_size(OBJ))
+            threads = [threading.Thread(target=worker, args=(j,))
+                       for j in range(8)]
+            t0 = time.perf_counter()
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            return time.perf_counter() - t0
+
+        par8("warm", None)
+        # warm the chacha lane too (first full-package kernel compile
+        # is ~20-40 s on the TPU host — must not land in the timed run)
+        EncryptReader(io.BytesIO(body), oek, iv,
+                      cipher=CIPHER_CHACHA20).read()
+        t_plain = par8("plain", None)
+        t_cha = par8("cha", CIPHER_CHACHA20)
+        cha_pct = (t_cha - t_plain) / t_plain * 100
+        out = {"sse_put_overhead_pct": {
+            "chacha20": round(cha_pct, 1),
+            "aes-gcm": None,
+        }, "sse_put_plain_gibs": round(
+            8 * N_PER * OBJ / t_plain / (1 << 30), 3)}
+        if HAVE_CRYPTOGRAPHY:
+            t_aes = par8("aes", CIPHER_AESGCM)
+            out["sse_put_overhead_pct"]["aes-gcm"] = round(
+                (t_aes - t_plain) / t_plain * 100, 1)
+        log(f"sse_put par8 16+4: plain {t_plain:.2f}s "
+            f"overhead {out['sse_put_overhead_pct']}")
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+    return out
+
+
 def finish(payload: dict) -> None:
     """Print the one-line result, quiesce framework threads, and exit 0
     deterministically. The axon JAX client's teardown intermittently aborts
@@ -698,6 +816,9 @@ def main() -> None:
     cha = chaos_profile(rng) if chaos else None
     dev = device_configs(rng)
     lat = heal_latency(rng)
+    # device workloads (ISSUE 8): Select scan + SSE package crypto
+    scan = select_scan_bench(rng)
+    sse = sse_put_bench(rng)
 
     enc = dev["encode_16p4_1MiB_b128"]
     extra_chaos = {"chaos": cha} if cha is not None else {}
@@ -723,6 +844,8 @@ def main() -> None:
             "heal_shard_latency": lat,                # north-star p99 half
             "reconstruct_vs_cpu": round(
                 dev["reconstruct_2loss_16p4_b128"] / cpu_gibs, 2),
+            **scan,                  # device workloads A (docs/select.md)
+            **sse,                   # device workloads B (docs/sse.md)
             **extra_chaos,                        # --chaos degraded run
         },
     })

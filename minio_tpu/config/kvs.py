@@ -65,6 +65,30 @@ SUB_SYSTEMS: dict[str, dict[str, KV]] = {
             help="pallas|jnp MUR3X256 kernel for the fused device "
                  "hash lanes"),
     },
+    "workloads": {
+        "scan": KV("auto", env="MINIO_TPU_SCAN",
+                   help="auto|dispatch|cpu|off S3 Select device scan "
+                        "lane: auto = dispatch on a TPU backend / off "
+                        "elsewhere, dispatch forces the lane, cpu runs "
+                        "the bit-identical reference without dispatch, "
+                        "off keeps the classic row interpreter "
+                        "(docs/select.md)"),
+        "scan_block_bytes": KV(
+            str(1 << 20), env="MINIO_TPU_SCAN_BLOCK",
+            help="CSV scan block size (newline-aligned, padded)"),
+        "sse_cipher": KV(
+            "auto", env="MINIO_TPU_SSE_CIPHER",
+            help="auto|aes-gcm|chacha20 package cipher for NEW "
+                 "encrypted objects; auto = AES-GCM when the "
+                 "cryptography wheel is present, else ChaCha20 "
+                 "(docs/sse.md)"),
+        "sse_device": KV(
+            "auto", env="MINIO_TPU_SSE_DEVICE",
+            help="auto|1|0 ChaCha20 package crypto through the "
+                 "dispatch plane (QoS-routed device flushes with CPU "
+                 "salvage): auto engages only on a TPU backend, 1 "
+                 "forces the lane, 0 = numpy host lane, same bytes"),
+    },
     "dispatch": {
         "enable": KV("1", env="MINIO_TPU_DISPATCH"),
         "mode": KV("auto", env="MINIO_TPU_DISPATCH_MODE",
@@ -262,7 +286,7 @@ SUB_SYSTEMS: dict[str, dict[str, KV]] = {
 #: config.go:132) — consumers read the registry at call time or register
 #: an apply callback.
 DYNAMIC = {"api", "scanner", "heal", "dispatch", "bitrot", "qos", "fault",
-           "durability", "pipeline"}
+           "durability", "pipeline", "workloads"}
 
 
 class ConfigSys:

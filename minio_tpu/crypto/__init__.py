@@ -7,8 +7,10 @@ alignment."""
 from .kms import (KESClient, KMS, KMSError, KMSUnreachable, LocalKMS,
                   VaultClient,
                   get_kms, set_kms)
-from .sse import (META_SCHEME, PKG_SIZE, DecryptWriter, EncryptReader,
-                  SSEInfo, decrypt_range_bounds, enc_size,
+from .sse import (CIPHER_AESGCM, CIPHER_CHACHA20, META_CIPHER, META_SCHEME,
+                  PKG_SIZE, DecryptWriter, EncryptReader,
+                  SSEInfo, cipher_of, decrypt_range_bounds, default_cipher,
+                  enc_size, package_cipher,
                   parse_sse_headers, plain_size_of, seal_object_key,
                   sse_kms_context, unseal_object_key)
 
@@ -16,8 +18,10 @@ __all__ = [
     "KESClient", "KMS", "KMSError", "KMSUnreachable", "LocalKMS",
     "VaultClient",
     "get_kms", "set_kms",
+    "CIPHER_AESGCM", "CIPHER_CHACHA20", "META_CIPHER",
     "META_SCHEME", "PKG_SIZE", "DecryptWriter", "EncryptReader", "SSEInfo",
-    "decrypt_range_bounds", "enc_size", "parse_sse_headers",
+    "cipher_of", "decrypt_range_bounds", "default_cipher",
+    "enc_size", "package_cipher", "parse_sse_headers",
     "plain_size_of", "seal_object_key", "sse_kms_context",
     "unseal_object_key",
 ]

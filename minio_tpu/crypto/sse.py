@@ -1,14 +1,31 @@
 """SSE core: header parsing, envelope key sealing, and the package cipher
 stream (reference cmd/crypto/sse-c.go, sse-s3.go, metadata.go and the DARE
-stream the reference gets from sio; re-designed here as explicit AES-GCM
+stream the reference gets from sio; re-designed here as explicit AEAD
 packages so ranged reads stay simple and auditable).
 
 Stream format: plaintext split into PKG_SIZE packages; package i is
-``AESGCM(OEK).encrypt(nonce_i, pkg, aad_i)`` = ciphertext||16-byte tag with
+``AEAD(OEK).seal(nonce_i, pkg, aad_i)`` = ciphertext||16-byte tag with
 ``nonce_i = base_iv[0:8] || BE32(seq0+i)`` and ``aad_i = "minio-tpu-sse-v1"
 || BE32(seq0+i)``. Encrypted length = plain + 16*ceil(plain/PKG_SIZE).
 Binding the sequence number into nonce AND AAD rejects package reordering
-or truncation-with-splice."""
+or truncation-with-splice.
+
+Two package ciphers share that framing (ISSUE 8 / ROADMAP item 4):
+
+- **AES-256-GCM** — the CPU-native scheme (AES-NI via the optional
+  ``cryptography`` wheel; raises at use when absent, as since PR 1).
+- **ChaCha20-Poly1305** — 32-bit add/xor/rotl, the VPU-native scheme: a
+  whole PUT/GET block's packages are sealed/opened in ONE coalesced
+  flush through the dispatch plane (runtime/dispatch.py op ``sse_xor``,
+  kernel ops/chacha_pallas.py) with QoS class + byte accounting, the
+  kernel-layer fault hook and CPU salvage; the numpy host lane
+  (crypto/chacha20poly1305.py) is bit-identical and needs no native
+  crypto dependency at all.
+
+The object's cipher is recorded in internal metadata (META_CIPHER);
+absent = AES-256-GCM (legacy objects). The OEK envelope seal follows the
+package cipher, so an SSE-C ChaCha object is readable with zero optional
+dependencies. docs/sse.md has the wire formats and routing rules."""
 from __future__ import annotations
 
 import base64
@@ -16,6 +33,8 @@ import hashlib
 import secrets
 import struct
 from dataclasses import dataclass, field
+
+import numpy as np
 
 try:
     from cryptography.exceptions import InvalidTag
@@ -39,6 +58,13 @@ PKG_SIZE = 64 << 10
 TAG = 16
 _AAD = b"minio-tpu-sse-v1"
 
+#: package cipher wire names (META_CIPHER values)
+CIPHER_AESGCM = "AES256-GCM"
+CIPHER_CHACHA20 = "CHACHA20-POLY1305"
+#: packages per coalesced seal/open flush (1 MiB of 64 KiB packages —
+#: the PUT/GET block quantum the dispatch lane batches on)
+FLUSH_PKGS = 16
+
 # internal metadata keys (reference: X-Minio-Internal-Server-Side-Encryption-*)
 META_SCHEME = "x-minio-internal-sse-scheme"          # "C" | "S3" | "KMS"
 META_SEALED = "x-minio-internal-sse-sealed-key"      # b64 sealed OEK
@@ -48,10 +74,34 @@ META_KMS_BLOB = "x-minio-internal-sse-kms-blob"      # S3/KMS sealed data key
 META_KMS_KEY_ID = "x-minio-internal-sse-kms-key-id"  # SSE-KMS master key id
 META_KMS_CONTEXT = "x-minio-internal-sse-kms-context"  # b64 JSON context
 META_PLAIN_SIZE = "x-minio-internal-sse-plain-size"
+META_CIPHER = "x-minio-internal-sse-cipher"  # package cipher; absent = GCM
 
 SSE_META_KEYS = (META_SCHEME, META_SEALED, META_IV, META_KEY_MD5,
                  META_KMS_BLOB, META_KMS_KEY_ID, META_KMS_CONTEXT,
-                 META_PLAIN_SIZE)
+                 META_PLAIN_SIZE, META_CIPHER)
+
+
+def default_cipher() -> str:
+    """The package cipher for NEW objects: ``workloads.sse_cipher``
+    (docs/sse.md). ``auto`` picks AES-GCM when the ``cryptography``
+    wheel (AES-NI) is present, else the self-contained ChaCha20 lane."""
+    v = "auto"
+    try:
+        from ..config import get_config_sys
+        v = (get_config_sys().get("workloads", "sse_cipher") or
+             "auto").lower()
+    except Exception:  # noqa: BLE001 — registry unavailable: auto
+        pass
+    if v in ("aes-gcm", "aes", "aes256-gcm"):
+        return CIPHER_AESGCM
+    if v in ("chacha20", "chacha", "chacha20-poly1305"):
+        return CIPHER_CHACHA20
+    return CIPHER_AESGCM if HAVE_CRYPTOGRAPHY else CIPHER_CHACHA20
+
+
+def cipher_of(meta: dict) -> str:
+    """The package cipher an existing object was written with."""
+    return meta.get(META_CIPHER, "") or CIPHER_AESGCM
 
 
 @dataclass
@@ -119,26 +169,38 @@ def sse_kms_context(bucket: str, object: str, user_ctx: str) -> str:
     return f"{bucket}/{object}|{user_ctx}"
 
 
-def _kek(scheme_key: bytes, bucket: str, object: str) -> AESGCM:
+def _kek(scheme_key: bytes, bucket: str, object: str) -> bytes:
     """Key-encryption key bound to the object path (unseal of a blob copied
     to another path fails)."""
-    kek = hashlib.sha256(
+    return hashlib.sha256(
         b"minio-tpu-sse-kek:" + scheme_key +
         f":{bucket}/{object}".encode()).digest()
-    return AESGCM(kek)
 
 
 def seal_object_key(oek: bytes, scheme_key: bytes, bucket: str,
-                    object: str) -> bytes:
+                    object: str, cipher: str = CIPHER_AESGCM) -> bytes:
+    """Seal the OEK under the path-bound KEK. The envelope AEAD follows
+    the object's package cipher, so a ChaCha object needs no optional
+    crypto dependency anywhere on its read path."""
     nonce = secrets.token_bytes(12)
-    return nonce + _kek(scheme_key, bucket, object).encrypt(nonce, oek, _AAD)
+    kek = _kek(scheme_key, bucket, object)
+    if cipher == CIPHER_CHACHA20:
+        from . import chacha20poly1305 as ccp
+        return nonce + ccp.seal_one(kek, nonce, _AAD, oek)
+    return nonce + AESGCM(kek).encrypt(nonce, oek, _AAD)
 
 
 def unseal_object_key(sealed: bytes, scheme_key: bytes, bucket: str,
-                      object: str) -> bytes:
+                      object: str, cipher: str = CIPHER_AESGCM) -> bytes:
+    kek = _kek(scheme_key, bucket, object)
+    if cipher == CIPHER_CHACHA20:
+        from . import chacha20poly1305 as ccp
+        try:
+            return ccp.open_one(kek, sealed[:12], _AAD, sealed[12:])
+        except ccp.BadTag:
+            raise dt.SSEKeyMismatch(bucket, object) from None
     try:
-        return _kek(scheme_key, bucket, object).decrypt(
-            sealed[:12], sealed[12:], _AAD)
+        return AESGCM(kek).decrypt(sealed[:12], sealed[12:], _AAD)
     except InvalidTag:
         raise dt.SSEKeyMismatch(bucket, object) from None
 
@@ -164,28 +226,243 @@ def _aad(seq: int) -> bytes:
     return _AAD + struct.pack(">I", seq)
 
 
-class EncryptReader:
-    """Wraps a plaintext stream (typically the HashReader that enforces
-    Content-MD5) and yields the encrypted package stream."""
+def _workload(op: str, cipher: str, route: str, pkgs: int, nbytes: int):
+    """workloads metric group feed (docs/observability.md)."""
+    try:
+        from ..obs import metrics as _mx
+        short = "chacha20" if cipher == CIPHER_CHACHA20 else "aes-gcm"
+        _mx.inc("minio_tpu_workloads_sse_packages_total", pkgs,
+                cipher=short, route=route)
+        _mx.inc("minio_tpu_workloads_sse_bytes_total", nbytes,
+                cipher=short, op=op)
+    except Exception:  # noqa: BLE001 — obs never breaks the path
+        pass
 
-    def __init__(self, stream, oek: bytes, base_iv: bytes):
-        self.stream = stream
+
+class _GCMPackages:
+    """AES-256-GCM package lane — the CPU-native scheme (AES-NI via the
+    ``cryptography`` wheel); seal/open loop per package on the host."""
+
+    name = CIPHER_AESGCM
+
+    def __init__(self, oek: bytes, base_iv: bytes):
         self._aead = AESGCM(oek)
         self.base_iv = base_iv
+
+    def seal_block(self, seq0: int, pkgs: list) -> list:
+        out = []
+        total = 0
+        for i, pkg in enumerate(pkgs):
+            total += len(pkg)
+            out.append(self._aead.encrypt(
+                _nonce(self.base_iv, seq0 + i), bytes(pkg),
+                _aad(seq0 + i)))
+        _workload("seal", self.name, "cpu", len(pkgs), total)
+        return out
+
+    def open_block(self, seq0: int, cts: list) -> list:
+        out = []
+        total = 0
+        for i, ct in enumerate(cts):
+            total += len(ct)
+            try:
+                out.append(self._aead.decrypt(
+                    _nonce(self.base_iv, seq0 + i), bytes(ct),
+                    _aad(seq0 + i)))
+            except InvalidTag:
+                raise _TagError from None
+        _workload("open", self.name, "cpu", len(cts), total)
+        return out
+
+
+class _TagError(Exception):
+    """Internal: package AEAD verification failed (mapped to
+    dt.SSEDecryptError by the stream wrappers, which know bucket/key)."""
+
+
+def _sse_device_route() -> bool:
+    """Whether ChaCha package crypto rides the dispatch plane
+    (``workloads.sse_device``, docs/sse.md): QoS-routed device flushes
+    with CPU salvage; off = the numpy host lane, same bytes. ``auto``
+    engages only on a real TPU backend — interpret-mode Pallas on a CPU
+    host is minutes per 1 MiB flush while the numpy lane is
+    bit-identical; ``1``/``dispatch`` forces the lane (tests, bench)."""
+    v = "auto"
+    try:
+        from ..config import get_config_sys
+        v = (get_config_sys().get("workloads", "sse_device") or
+             "auto").lower()
+    except Exception:  # noqa: BLE001
+        pass
+    if v in ("0", "off", "false"):
+        return False
+    from ..runtime import dispatch as _dsp
+    if not _dsp.dispatch_enabled():
+        return False
+    if v in ("1", "on", "dispatch", "force"):
+        return True
+    from ..ops.chacha_pallas import on_tpu
+    return on_tpu()
+
+
+class _ChaChaPackages:
+    """ChaCha20-Poly1305 package lane. Full packages of a block are
+    keystream-XORed in ONE coalesced flush (dispatch op ``sse_xor`` —
+    device kernel or bit-identical numpy salvage), Poly1305 tags ride
+    the batched numpy limb path; the short tail package (and the
+    envelope) use the scalar reference."""
+
+    name = CIPHER_CHACHA20
+
+    def __init__(self, oek: bytes, base_iv: bytes):
+        self._oek = oek
+        self.base_iv = base_iv
+
+    def _nonces(self, seq0: int, n: int) -> np.ndarray:
+        from .chacha20poly1305 import nonce_words
+        return np.stack([nonce_words(_nonce(self.base_iv, seq0 + i))
+                         for i in range(n)])
+
+    def _xor_full(self, seq0: int, data: np.ndarray
+                  ) -> tuple[np.ndarray, np.ndarray, str]:
+        """(xored u8 [P, L], poly_keys u8 [P, 32], route) for full
+        64-multiple packages."""
+        nonces = self._nonces(seq0, data.shape[0])
+        if _sse_device_route():
+            from ..runtime import dispatch as _dsp
+            ct_w, pk_w = _dsp.global_queue().sse_xor(
+                np.ascontiguousarray(data).view("<u4"), self._oek,
+                nonces).result()
+            return (np.ascontiguousarray(ct_w).view(np.uint8),
+                    np.ascontiguousarray(pk_w).view(np.uint8), "dispatch")
+        from .chacha20poly1305 import keystream_xor
+        out, pk = keystream_xor(self._oek, nonces,
+                                np.ascontiguousarray(data))
+        return out, pk, "host"
+
+    def seal_block(self, seq0: int, pkgs: list) -> list:
+        from . import chacha20poly1305 as ccp
+        nfull = 0
+        while nfull < len(pkgs) and len(pkgs[nfull]) == PKG_SIZE:
+            nfull += 1
+        out: list = []
+        if nfull:
+            data = np.stack([np.frombuffer(p, np.uint8) for p in
+                             pkgs[:nfull]])
+            ct, pk, route = self._xor_full(seq0, data)
+            aads = [_aad(seq0 + i) for i in range(nfull)]
+            tags = ccp.poly1305_tags(pk, ccp.mac_datas(aads, ct))
+            sealed = np.empty((nfull, PKG_SIZE + TAG), np.uint8)
+            sealed[:, :PKG_SIZE] = ct
+            sealed[:, PKG_SIZE:] = tags
+            out.extend(memoryview(sealed[i]) for i in range(nfull))
+            _workload("seal", self.name, route, nfull, nfull * PKG_SIZE)
+        for i in range(nfull, len(pkgs)):
+            out.append(ccp.seal_one(self._oek,
+                                    _nonce(self.base_iv, seq0 + i),
+                                    _aad(seq0 + i), bytes(pkgs[i])))
+            _workload("seal", self.name, "scalar", 1, len(pkgs[i]))
+        return out
+
+    def open_block(self, seq0: int, cts: list) -> list:
+        from . import chacha20poly1305 as ccp
+        nfull = 0
+        while nfull < len(cts) and len(cts[nfull]) == PKG_SIZE + TAG:
+            nfull += 1
+        out: list = []
+        if nfull:
+            sealed = np.stack([np.frombuffer(c, np.uint8)
+                               for c in cts[:nfull]])
+            ct = np.ascontiguousarray(sealed[:, :PKG_SIZE])
+            plain, pk, route = self._xor_full(seq0, ct)
+            aads = [_aad(seq0 + i) for i in range(nfull)]
+            tags = ccp.poly1305_tags(pk, ccp.mac_datas(aads, ct))
+            # verify-before-release: nothing is emitted unless EVERY
+            # package of the flush authenticates. Constant-time compare
+            # over the whole tag block — same rule the scalar path's
+            # _ct_eq applies (no early-exit timing oracle on tag bytes)
+            import hmac
+            want = np.ascontiguousarray(sealed[:, PKG_SIZE:])
+            if not hmac.compare_digest(tags.tobytes(), want.tobytes()):
+                raise _TagError
+            out.extend(memoryview(plain[i]) for i in range(nfull))
+            _workload("open", self.name, route, nfull,
+                      nfull * (PKG_SIZE + TAG))
+        for i in range(nfull, len(cts)):
+            try:
+                out.append(ccp.open_one(
+                    self._oek, _nonce(self.base_iv, seq0 + i),
+                    _aad(seq0 + i), bytes(cts[i])))
+            except ccp.BadTag:
+                raise _TagError from None
+            _workload("open", self.name, "scalar", 1, len(cts[i]))
+        return out
+
+
+def package_cipher(cipher: str, oek: bytes, base_iv: bytes):
+    """The package AEAD lane for a cipher wire name (META_CIPHER)."""
+    if cipher == CIPHER_CHACHA20:
+        return _ChaChaPackages(oek, base_iv)
+    if cipher == CIPHER_AESGCM:
+        return _GCMPackages(oek, base_iv)
+    raise ValueError(f"unknown SSE package cipher {cipher!r}")
+
+
+class EncryptReader:
+    """Wraps a plaintext stream (typically the HashReader that enforces
+    Content-MD5) and yields the encrypted package stream. Collects up to
+    FLUSH_PKGS packages of plaintext and seals them through the package
+    cipher's ONE coalesced flush (the ChaCha lane rides the dispatch
+    plane); supports ``readinto`` so SSE PUT bodies land in pooled block
+    buffers like plaintext ones (zero-copy ingest, GL010-registered)."""
+
+    def __init__(self, stream, oek: bytes, base_iv: bytes,
+                 cipher: str = CIPHER_AESGCM):
+        self.stream = stream
+        self.base_iv = base_iv
+        self.cipher = package_cipher(cipher, oek, base_iv)
         self._seq = 0
-        self._buf = bytearray()
+        self._chunks: list = []   # sealed buffers, consume-from-front
+        self._pos = 0             # read offset into _chunks[0]
+        self._avail = 0
         self._eof = False
 
     def _fill(self):
-        while not self._eof and len(self._buf) < (1 << 20):
-            pkg = _read_full(self.stream, PKG_SIZE)
-            if len(pkg) < PKG_SIZE:
-                self._eof = True
-            if not pkg:
+        while not self._eof and self._avail < (1 << 20):
+            pkgs = []
+            for _ in range(FLUSH_PKGS):
+                pkg = _read_full(self.stream, PKG_SIZE)
+                if len(pkg) < PKG_SIZE:
+                    self._eof = True
+                if pkg:
+                    pkgs.append(pkg)
+                if self._eof:
+                    break
+            if not pkgs:
                 break
-            self._buf += self._aead.encrypt(
-                _nonce(self.base_iv, self._seq), pkg, _aad(self._seq))
-            self._seq += 1
+            for sealed in self.cipher.seal_block(self._seq, pkgs):
+                self._chunks.append(memoryview(sealed))
+                self._avail += len(sealed)
+            self._seq += len(pkgs)
+
+    def readinto(self, buf) -> int:
+        mv = memoryview(buf).cast("B")
+        done = 0
+        while done < len(mv):
+            if not self._chunks:
+                self._fill()
+                if not self._chunks:
+                    break
+            head = self._chunks[0]
+            take = min(len(mv) - done, len(head) - self._pos)
+            mv[done:done + take] = head[self._pos:self._pos + take]
+            done += take
+            self._pos += take
+            self._avail -= take
+            if self._pos == len(head):
+                self._chunks.pop(0)
+                self._pos = 0
+        return done
 
     def read(self, n: int = -1) -> bytes:
         if n < 0:
@@ -196,62 +473,76 @@ class EncryptReader:
                     return bytes(out)
                 out += b
         self._fill()
-        out = bytes(self._buf[:n])
-        del self._buf[:n]
-        return out
+        n = min(n, self._avail)
+        out = bytearray(n)
+        got = self.readinto(out)
+        return bytes(out[:got])
 
 
 class DecryptWriter:
     """Writer wrapper decrypting a package-aligned ciphertext stream and
     emitting the plaintext sub-range [skip, skip+limit) of it (ranged GETs
-    read whole covering packages; the trim happens here)."""
+    read whole covering packages; the trim happens here). Full packages
+    accumulate up to FLUSH_PKGS and open through the package cipher's one
+    coalesced flush; nothing is emitted from a flush whose tags do not
+    ALL verify."""
 
     def __init__(self, writer, oek: bytes, base_iv: bytes, seq0: int,
-                 skip: int, limit: int, bucket: str = "", object: str = ""):
+                 skip: int, limit: int, bucket: str = "", object: str = "",
+                 cipher: str = CIPHER_AESGCM):
         self.writer = writer
-        self._aead = AESGCM(oek)
         self.base_iv = base_iv
+        self.cipher = package_cipher(cipher, oek, base_iv)
         self._seq = seq0
         self._skip = skip
         self._left = limit
         self._buf = bytearray()
         self._bo = (bucket, object)
 
-    def write(self, b: bytes):
+    def write(self, b):
         self._buf += b
-        while len(self._buf) >= PKG_SIZE + TAG:
-            self._emit(bytes(self._buf[:PKG_SIZE + TAG]))
-            del self._buf[:PKG_SIZE + TAG]
+        unit = PKG_SIZE + TAG
+        while len(self._buf) >= FLUSH_PKGS * unit:
+            n = (len(self._buf) // unit) * unit
+            self._open(memoryview(self._buf)[:n], n // unit)
+            del self._buf[:n]
 
-    def _emit(self, pkg_ct: bytes):
+    def _open(self, ct: memoryview, npkgs: int):
+        unit = PKG_SIZE + TAG
+        cts = [ct[i * unit: min((i + 1) * unit, len(ct))]
+               for i in range(npkgs)]
         try:
-            plain = self._aead.decrypt(
-                _nonce(self.base_iv, self._seq), pkg_ct, _aad(self._seq))
-        except InvalidTag:
+            plains = self.cipher.open_block(self._seq, cts)
+        except _TagError:
             raise dt.SSEDecryptError(*self._bo) from None
-        self._seq += 1
-        if self._skip:
-            drop = min(self._skip, len(plain))
-            plain = plain[drop:]
-            self._skip -= drop
-        if self._left >= 0:
-            plain = plain[:self._left]
-            self._left -= len(plain)
-        if plain:
-            self.writer.write(plain)
+        self._seq += npkgs
+        for plain in plains:
+            plain = memoryview(plain).cast("B")
+            if self._skip:
+                drop = min(self._skip, len(plain))
+                plain = plain[drop:]
+                self._skip -= drop
+            if self._left >= 0:
+                plain = plain[:self._left]
+                self._left -= len(plain)
+            if len(plain):
+                self.writer.write(plain)
+
+    def _drain(self):
+        if self._buf:
+            unit = PKG_SIZE + TAG
+            npkgs = -(-len(self._buf) // unit)
+            self._open(memoryview(self._buf), npkgs)
+            self._buf.clear()
 
     def close(self):
-        if self._buf:
-            self._emit(bytes(self._buf))
-            self._buf.clear()
+        self._drain()
         if hasattr(self.writer, "close"):
             self.writer.close()
 
     def finish(self):
-        """Flush the trailing short package without closing the sink."""
-        if self._buf:
-            self._emit(bytes(self._buf))
-            self._buf.clear()
+        """Flush the trailing packages without closing the sink."""
+        self._drain()
 
 
 def decrypt_range_bounds(offset: int, length: int, plain_size: int

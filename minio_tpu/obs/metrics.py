@@ -611,6 +611,32 @@ def _g_durability(server) -> list[str]:
     ]
 
 
+def _g_workloads(server) -> list[str]:
+    """Device data-plane workloads (ISSUE 8 / docs/select.md +
+    docs/sse.md): lane state for the S3 Select scan and the SSE package
+    ciphers. The per-op counters — minio_tpu_workloads_scan_blocks_total
+    {route}, minio_tpu_workloads_scan_rows_total{kind},
+    minio_tpu_workloads_scan_bytes_total{route},
+    minio_tpu_workloads_sse_packages_total{cipher,route} and
+    minio_tpu_workloads_sse_bytes_total{cipher,op} — ride the counter
+    store, incremented at the scan/seal/open sites."""
+    try:
+        from ..crypto.sse import CIPHER_CHACHA20, default_cipher
+        from ..s3select.device import scan_config
+        mode, _blk = scan_config()
+        cipher = "chacha20" if default_cipher() == CIPHER_CHACHA20 \
+            else "aes-gcm"
+    except Exception:  # noqa: BLE001 — workload modules unavailable
+        return []
+    return [
+        "# TYPE minio_tpu_workloads_scan_lane gauge",
+        f'minio_tpu_workloads_scan_lane{{mode="{_esc(mode)}"}} '
+        f'{0 if mode == "off" else 1}',
+        "# TYPE minio_tpu_workloads_sse_cipher gauge",
+        f'minio_tpu_workloads_sse_cipher{{cipher="{cipher}"}} 1',
+    ]
+
+
 def _g_locks(server) -> list[str]:
     locker = getattr(server, "local_locker", None)
     if locker is None:
@@ -645,6 +671,8 @@ _GROUPS = [
     # durability reads in-memory flusher/config state — interval 0 so a
     # policy flip or a growing fsync backlog shows immediately
     MetricsGroup("durability", "node", _g_durability, interval=0),
+    # workloads reads config/lane state — interval 0, trivial
+    MetricsGroup("workloads", "node", _g_workloads, interval=0),
     MetricsGroup("process", "node", _g_process),
     MetricsGroup("locks", "node", _g_locks),
     MetricsGroup("notification", "cluster", _g_notification),

@@ -1,0 +1,154 @@
+"""ChaCha20 keystream + XOR as a Pallas TPU kernel — the device lane of
+the SSE package cipher (ISSUE 8 / ROADMAP item 4).
+
+ChaCha20 is the VPU's home game: the whole cipher is 32-bit add/xor/rotl
+on a 16-word state, with zero multiplies and zero cross-lane traffic —
+every 64-byte block is an independent lane. The kernel seals/opens a
+whole PUT/GET block's packages in ONE launch: lanes are the packages'
+64-byte blocks plus one counter-0 lane per package whose keystream head
+is that package's Poly1305 one-time key (the tag itself is 130-bit
+arithmetic and stays on the host — crypto/chacha20poly1305.py batches it
+with numpy limbs).
+
+Layout: each of the 16 state words is a full (8, 128) vreg tile over
+block lanes (the mur3_pallas occupancy rule); the 20 rounds unroll to
+~960 vector ops per tile with no HBM traffic besides one payload read
+and one write. Key + the two shared nonce words ride SMEM; the per-lane
+nonce word (package sequence) is a [R, 128] VMEM input; the per-lane
+counter is derived in-kernel from the lane index (key lane first, then
+counters 1..nb per package).
+
+Bit-identical to crypto/chacha20poly1305.keystream_xor (pinned in
+tests/test_chacha.py). Interpreter mode off-TPU, same as mur3_pallas.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+#: lane-tile sublanes; full-vreg quantum is (8, 128)
+RT = 8
+_QUANTUM = RT * 128
+
+_CONSTS = (0x61707865, 0x3320646E, 0x79622D32, 0x6B206574)
+
+
+def on_tpu() -> bool:
+    return jax.default_backend() == "tpu"
+
+
+def _rotl(x, r: int):
+    return (x << np.uint32(r)) | (x >> np.uint32(32 - r))
+
+
+def _qr(s, a: int, b: int, c: int, d: int):
+    s[a] = s[a] + s[b]
+    s[d] = _rotl(s[d] ^ s[a], 16)
+    s[c] = s[c] + s[d]
+    s[b] = _rotl(s[b] ^ s[c], 12)
+    s[a] = s[a] + s[b]
+    s[d] = _rotl(s[d] ^ s[a], 8)
+    s[c] = s[c] + s[d]
+    s[b] = _rotl(s[b] ^ s[c], 7)
+
+
+def _make_kernel(lanes_per_pkg: int):
+    """Kernel over one (RT, 128) lane tile: scalars_ref SMEM [10] =
+    key words 0..7 + shared nonce words n0, n1; n2_ref VMEM (RT, 128)
+    per-lane nonce word; x_ref (16, RT, 128) payload words; out =
+    payload ^ keystream(counter(lane), nonce(lane))."""
+
+    def kernel(scalars_ref, n2_ref, x_ref, out_ref):
+        t = pl.program_id(0)
+        lane = (jax.lax.broadcasted_iota(jnp.int32, (RT, 128), 0) * 128 +
+                jax.lax.broadcasted_iota(jnp.int32, (RT, 128), 1) +
+                t * _QUANTUM)
+        # key lane first per package: counter 0 = the Poly1305 key block
+        ctr = jax.lax.rem(lane, np.int32(lanes_per_pkg)).astype(jnp.uint32)
+        full = lambda v: jnp.full((RT, 128), v, jnp.uint32)  # noqa: E731
+        init = [full(np.uint32(c)) for c in _CONSTS]
+        init += [full(scalars_ref[i]) for i in range(8)]
+        init.append(ctr)
+        init += [full(scalars_ref[8]), full(scalars_ref[9]), n2_ref[:]]
+        s = list(init)
+        for _ in range(10):
+            _qr(s, 0, 4, 8, 12)
+            _qr(s, 1, 5, 9, 13)
+            _qr(s, 2, 6, 10, 14)
+            _qr(s, 3, 7, 11, 15)
+            _qr(s, 0, 5, 10, 15)
+            _qr(s, 1, 6, 11, 12)
+            _qr(s, 2, 7, 8, 13)
+            _qr(s, 3, 4, 9, 14)
+        ks = [s[i] + init[i] for i in range(16)]
+        out_ref[:] = x_ref[:] ^ jnp.stack(ks)
+
+    return kernel
+
+
+@functools.lru_cache(maxsize=32)
+def _jitted(lanes_per_pkg: int, n_tiles: int, interpret: bool):
+    kernel = _make_kernel(lanes_per_pkg)
+    r = n_tiles * RT
+
+    @jax.jit
+    def run(scalars: jnp.ndarray, n2: jnp.ndarray, x: jnp.ndarray):
+        return pl.pallas_call(
+            kernel,
+            out_shape=jax.ShapeDtypeStruct((16, r, 128), jnp.uint32),
+            grid=(n_tiles,),
+            in_specs=[
+                pl.BlockSpec(memory_space=pltpu.SMEM),
+                pl.BlockSpec((RT, 128), lambda t: (t, 0),
+                             memory_space=pltpu.VMEM),
+                pl.BlockSpec((16, RT, 128), lambda t: (0, t, 0),
+                             memory_space=pltpu.VMEM),
+            ],
+            out_specs=pl.BlockSpec((16, RT, 128), lambda t: (0, t, 0),
+                                   memory_space=pltpu.VMEM),
+            interpret=interpret,
+        )(scalars, n2, x)
+
+    return run
+
+
+def xor_packages_device(key: bytes, nonces: np.ndarray, data: np.ndarray):
+    """Device twin of crypto/chacha20poly1305.keystream_xor: ``nonces``
+    uint32 [P, 3], ``data`` uint32 [P, L//4] (L a 64-multiple) ->
+    (xored uint32 [P, L//4], poly_keys uint32 [P, 8]) as DEVICE arrays
+    (the dispatch completer does the host readback)."""
+    pkgs, words = data.shape
+    if words % 16:
+        raise ValueError("chacha packages must be 64-byte multiples")
+    nb = words // 16
+    lanes_per_pkg = nb + 1
+    n0 = pkgs * lanes_per_pkg
+    npad = -(-n0 // _QUANTUM) * _QUANTUM
+    x = jnp.asarray(data).reshape(pkgs, nb, 16)
+    # counter-0 (poly key) lane FIRST per package — the in-kernel
+    # counter = lane % (nb+1) depends on this layout
+    x = jnp.pad(x, ((0, 0), (1, 0), (0, 0))).reshape(n0, 16)
+    if npad != n0:
+        x = jnp.pad(x, ((0, npad - n0), (0, 0)))
+    x = jnp.transpose(x, (1, 0)).reshape(16, npad // 128, 128)
+    n2 = np.zeros(npad, np.uint32)
+    n2[:n0] = np.repeat(nonces[:, 2].astype(np.uint32), lanes_per_pkg)
+    n2 = jnp.asarray(n2).reshape(npad // 128, 128)
+    if not (len(nonces) == pkgs and np.all(nonces[:, 0] == nonces[0, 0])
+            and np.all(nonces[:, 1] == nonces[0, 1])):
+        raise ValueError("packages of one flush share nonce words 0/1 "
+                         "(base_iv[:8]); only word 2 varies per package")
+    scalars = jnp.asarray(np.concatenate(
+        [np.frombuffer(key, "<u4"),
+         nonces[0, :2].astype(np.uint32)]))
+    out = _jitted(lanes_per_pkg, npad // _QUANTUM, not on_tpu())(
+        scalars, n2, x)
+    # [16, R, 128] -> [lanes, 16] -> per-package (key lane, data lanes)
+    flat = jnp.transpose(out.reshape(16, npad), (1, 0))[:n0]
+    grouped = flat.reshape(pkgs, lanes_per_pkg, 16)
+    return (grouped[:, 1:, :].reshape(pkgs, words), grouped[:, 0, :8])

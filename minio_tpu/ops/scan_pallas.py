@@ -1,0 +1,381 @@
+"""Batched S3 Select CSV predicate scan on device (ISSUE 8 / ROADMAP
+item 4): delimiter/newline structural indexing, numeric field parse and
+vectorized predicate evaluation over pooled CSV block buffers, producing
+a row-selection code per row.
+
+Pipeline (one jitted program per (program, cols, delim, L, max_rows)):
+
+1. **Structural index** (jnp, vmapped per block): newline/delimiter
+   masks -> per-byte (row, field) coordinates via cumsums, then
+   scatter/gather to per-(row, field) cell start/end offsets — one pass
+   over the block, no host parsing.
+2. **Cell gather** (jnp): the bytes of every referenced column's cell,
+   left-aligned into fixed ``CELL_W``-byte slots (overwide cells get a
+   poison byte so they fail the parse).
+3. **Parse + predicate** (Pallas kernel): a right-to-left integer-parse
+   automaton unrolled over the slot (mirroring Python ``int(str)`` after
+   ``strip()``: optional sign, digits, surrounding whitespace; at most 9
+   digits so int32 stays exact), then the compiled predicate program
+   (compare/AND/OR/NOT/BETWEEN/IN over int32 columns) evaluated as a
+   little stack machine — all full-vreg (8, 128) ops, rows are lanes.
+
+Per-row result codes: 0 = no match, ``MATCH`` (1) = predicate true with
+every referenced cell cleanly integer-parsed, ``RESIDUAL`` (2) = some
+referenced cell did not parse (floats, strings, missing fields, >9
+digits) — the caller re-evaluates ONLY those rows with the s3select
+interpreter, so semantics never change (s3select/device.py).
+
+``scan_blocks_reference`` is the pure-Python twin — bit-identical
+(pinned in tests/test_scan_pallas.py) and the dispatch CPU-salvage
+route. The predicate *program* is compiled from the SQL AST by
+s3select/device.py; this module only defines its execution.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+#: fixed parse-slot width: referenced cells wider than this (after any
+#: surrounding whitespace) cannot be 9-digit integers anyway — poisoned
+#: to RESIDUAL
+CELL_W = 16
+MATCH = 1
+RESIDUAL = 2
+
+RT = 8
+_QUANTUM = RT * 128
+
+#: bytes Python str.strip() removes that can legally appear inside a
+#: CSV cell (\n never can; the block splitter owns \r handling)
+_SPACES = (32, 9, 13, 11, 12)
+
+_T_TRAIL, _T_DIG, _T_SIGNED, _T_LEAD, _T_FAIL = range(5)
+
+
+def on_tpu() -> bool:
+    return jax.default_backend() == "tpu"
+
+
+# --------------------------------------------------------------------------
+# structural index + cell gather (jnp, per block)
+
+
+def _cells_one_block(x: jnp.ndarray, cols: tuple[int, ...], delim: int,
+                     max_rows: int) -> jnp.ndarray:
+    """One block's referenced cells: ``x`` int32 [L] byte values ->
+    int32 [max_rows, C, CELL_W] left-aligned cell bytes (0-padded;
+    missing cells all-pad, overwide cells poisoned)."""
+    L = x.shape[0]
+    F = max(cols) + 2          # fields tracked per row (scatter width)
+    big = np.int32(max_rows * F + F)  # out-of-range scatter index: drop
+    is_nl = x == 10
+    is_d = x == delim
+    sep = is_nl | is_d
+    n_cum = jnp.cumsum(is_nl.astype(jnp.int32))
+    row = n_cum - is_nl        # 0-based row of each byte
+    s_cum = jnp.cumsum(sep.astype(jnp.int32))
+    # seps strictly before each row's first byte: scattered from the
+    # newline that TERMINATES the previous row
+    base = jnp.zeros(max_rows + 2, jnp.int32).at[
+        jnp.where(is_nl, row + 1, max_rows + 1)].set(
+            s_cum, mode="drop")
+    field = (s_cum - sep) - base[jnp.minimum(row, max_rows + 1)]
+    pos = jnp.arange(L, dtype=jnp.int32)
+    ok_row = row < max_rows
+    # cell (r, f) ends at its terminating separator; starts after the
+    # previous one (field 0 starts after the previous row's newline)
+    end = jnp.full(max_rows * F, -1, jnp.int32).at[
+        jnp.where(sep & ok_row & (field < F), row * F + field, big)].set(
+            pos, mode="drop")
+    start = jnp.full(max_rows * F, L, jnp.int32).at[0].set(0)
+    start = start.at[
+        jnp.where(is_d & ok_row & (field + 1 < F),
+                  row * F + field + 1, big)].set(pos + 1, mode="drop")
+    start = start.at[
+        jnp.where(is_nl & (row + 1 < max_rows),
+                  (row + 1) * F, big)].set(pos + 1, mode="drop")
+    start = start.reshape(max_rows, F)
+    end = end.reshape(max_rows, F)
+    cidx = jnp.array(cols, jnp.int32)
+    st = start[:, cidx]                    # [max_rows, C]
+    ln = end[:, cidx] - st
+    off = jnp.arange(CELL_W, dtype=jnp.int32)
+    idx = st[:, :, None] + off
+    valid = off < ln[:, :, None]
+    raw = x[jnp.clip(idx, 0, L - 1)]
+    # a GENUINE NUL byte is indistinguishable from slot padding inside
+    # the parse kernel — poison it so the parse fails like the
+    # reference's does (review finding: '123\x00' must be RESIDUAL,
+    # not a parsed 123)
+    raw = jnp.where(raw == 0, np.int32(88), raw)
+    b = jnp.where(valid, raw, 0)
+    # a cell wider than the slot must FAIL the parse, not truncate
+    return jnp.where((ln > CELL_W)[:, :, None], np.int32(88), b)
+
+
+# --------------------------------------------------------------------------
+# parse + predicate kernel
+
+
+def _parse_col(cell_tiles: list) -> tuple:
+    """Right-to-left integer-parse automaton over one column's CELL_W
+    byte tiles (each (RT, 128) int32). Returns (value int32, fail bool)
+    — mirrors Python int(cell.strip()) for <= 9 digits."""
+    shape = cell_tiles[0].shape
+    val = jnp.zeros(shape, jnp.int32)
+    pw = jnp.ones(shape, jnp.int32)
+    ndig = jnp.zeros(shape, jnp.int32)
+    neg = jnp.zeros(shape, jnp.bool_)
+    phase = jnp.full(shape, _T_TRAIL, jnp.int32)
+    for j in reversed(range(len(cell_tiles))):
+        b = cell_tiles[j]
+        is_pad = b == 0
+        is_sp = jnp.zeros(shape, jnp.bool_)
+        for s in _SPACES:
+            is_sp = is_sp | (b == s)
+        is_dig = (b >= 48) & (b <= 57)
+        is_sign = (b == 45) | (b == 43)
+        in_trail = phase == _T_TRAIL
+        in_dig = phase == _T_DIG
+        in_signed = phase == _T_SIGNED
+        in_lead = phase == _T_LEAD
+        dig_step = is_dig & (in_trail | in_dig)
+        val = val + jnp.where(dig_step, (b - 48) * pw, 0)
+        pw = jnp.where(dig_step, pw * 10, pw)
+        ndig = ndig + dig_step.astype(jnp.int32)
+        neg = neg | (in_dig & (b == 45))
+        nxt = jnp.where(
+            in_trail,
+            jnp.where(is_pad | is_sp, _T_TRAIL,
+                      jnp.where(is_dig, _T_DIG, _T_FAIL)),
+            jnp.where(
+                in_dig,
+                jnp.where(is_dig, _T_DIG,
+                          jnp.where(is_sign, _T_SIGNED,
+                                    jnp.where(is_sp, _T_LEAD, _T_FAIL))),
+                jnp.where((in_signed | in_lead) & is_sp,
+                          _T_LEAD, _T_FAIL)))
+        phase = nxt.astype(jnp.int32)
+    ok = ((phase == _T_DIG) | (phase == _T_SIGNED) | (phase == _T_LEAD)) \
+        & (ndig >= 1) & (ndig <= 9)
+    val = jnp.where(neg, -val, val)
+    return val, ~ok
+
+
+def _eval_program(program: tuple, vals: list, shape) -> jnp.ndarray:
+    """The compiled predicate as a little stack machine over int32
+    column values (bool results). Mirrored exactly by the pure-Python
+    reference below."""
+    cmp = {"lt": lambda v, k: v < k, "le": lambda v, k: v <= k,
+           "gt": lambda v, k: v > k, "ge": lambda v, k: v >= k,
+           "eq": lambda v, k: v == k, "ne": lambda v, k: v != k}
+    stack = []
+    for op in program:
+        kind = op[0]
+        if kind == "num":
+            _, slot, o, k = op
+            stack.append(cmp[o](vals[slot], np.int32(k)))
+        elif kind == "between":
+            _, slot, lo, hi = op
+            stack.append((vals[slot] >= np.int32(lo)) &
+                         (vals[slot] <= np.int32(hi)))
+        elif kind == "in":
+            _, slot, opts = op
+            hit = jnp.zeros(shape, jnp.bool_)
+            for k in opts:
+                hit = hit | (vals[slot] == np.int32(k))
+            stack.append(hit)
+        elif kind == "and":
+            b, a = stack.pop(), stack.pop()
+            stack.append(a & b)
+        elif kind == "or":
+            b, a = stack.pop(), stack.pop()
+            stack.append(a | b)
+        elif kind == "not":
+            stack.append(~stack.pop())
+        elif kind == "const":
+            stack.append(jnp.full(shape, bool(op[1]), jnp.bool_))
+        else:  # pragma: no cover - compiler emits only the above
+            raise ValueError(f"unknown scan op {kind}")
+    if len(stack) != 1:
+        raise ValueError("unbalanced scan program")
+    return stack[0]
+
+
+def _make_scan_kernel(program: tuple, n_cols: int):
+    def kernel(cells_ref, out_ref):
+        vals, fails = [], []
+        for c in range(n_cols):
+            v, f = _parse_col([cells_ref[c, j] for j in range(CELL_W)])
+            vals.append(v)
+            fails.append(f)
+        fail_any = fails[0]
+        for f in fails[1:]:
+            fail_any = fail_any | f
+        match = _eval_program(program, vals, vals[0].shape)
+        out_ref[:] = jnp.where(
+            fail_any, np.int32(RESIDUAL),
+            jnp.where(match, np.int32(MATCH), np.int32(0)))
+
+    return kernel
+
+
+@functools.lru_cache(maxsize=64)
+def scan_fn_for(program: tuple, cols: tuple, delim: int, nbytes: int,
+                max_rows: int, interpret: bool | None = None):
+    """Jitted batched scan: blocks uint32 [B, nbytes//4] (newline-
+    terminated CSV bytes, '\\n'-padded) -> codes int32 [B, max_rows].
+    ``max_rows`` MUST be >= the newline count of every block (the
+    caller buckets it; rows beyond it would be silently dropped)."""
+    if nbytes % 4:
+        raise ValueError("scan blocks must be 4-byte multiples")
+    interp = (not on_tpu()) if interpret is None else interpret
+    kernel = _make_scan_kernel(program, len(cols))
+    cells_fn = jax.vmap(
+        lambda x: _cells_one_block(x, cols, delim, max_rows))
+
+    @jax.jit
+    def run(blocks_u32: jnp.ndarray) -> jnp.ndarray:
+        B = blocks_u32.shape[0]
+        w = blocks_u32.astype(jnp.uint32)
+        x = jnp.stack([(w >> np.uint32(8 * i)) & np.uint32(0xFF)
+                       for i in range(4)], axis=-1)
+        x = x.reshape(B, nbytes).astype(jnp.int32)
+        cells = cells_fn(x)                    # [B, max_rows, C, CELL_W]
+        n = B * max_rows
+        npad = -(-n // _QUANTUM) * _QUANTUM
+        lanes = jnp.transpose(cells.reshape(n, len(cols), CELL_W),
+                              (1, 2, 0))
+        if npad != n:
+            lanes = jnp.pad(lanes, ((0, 0), (0, 0), (0, npad - n)))
+        lanes = lanes.reshape(len(cols), CELL_W, npad // 128, 128)
+        codes = pl.pallas_call(
+            kernel,
+            out_shape=jax.ShapeDtypeStruct((npad // 128, 128), jnp.int32),
+            grid=(npad // _QUANTUM,),
+            in_specs=[pl.BlockSpec((len(cols), CELL_W, RT, 128),
+                                   lambda t: (0, 0, t, 0),
+                                   memory_space=pltpu.VMEM)],
+            out_specs=pl.BlockSpec((RT, 128), lambda t: (t, 0),
+                                   memory_space=pltpu.VMEM),
+            interpret=interp,
+        )(lanes)
+        return codes.reshape(npad)[:n].reshape(B, max_rows)
+
+    return run
+
+
+# --------------------------------------------------------------------------
+# pure-Python reference (pinned bit-identical; the CPU-salvage route)
+
+
+def _parse_cell_reference(cell: bytes) -> tuple[int, bool]:
+    """(value, fail) — the scalar twin of the kernel automaton."""
+    if len(cell) > CELL_W:
+        return 0, True
+    val, pw, ndig = 0, 1, 0
+    neg = False
+    phase = _T_TRAIL
+    for j in range(len(cell) - 1, -1, -1):
+        b = cell[j]
+        is_sp = b in _SPACES
+        is_dig = 48 <= b <= 57
+        if phase == _T_TRAIL:
+            if is_sp:
+                continue
+            if is_dig:
+                phase = _T_DIG
+            else:
+                phase = _T_FAIL
+                break
+        elif phase == _T_DIG:
+            if not is_dig:
+                if b in (45, 43):
+                    neg = b == 45
+                    phase = _T_SIGNED
+                    continue
+                if is_sp:
+                    phase = _T_LEAD
+                    continue
+                phase = _T_FAIL
+                break
+        else:  # SIGNED / LEAD
+            if is_sp:
+                phase = _T_LEAD
+                continue
+            phase = _T_FAIL
+            break
+        val += (b - 48) * pw
+        pw *= 10
+        ndig += 1
+    ok = phase in (_T_DIG, _T_SIGNED, _T_LEAD) and 1 <= ndig <= 9
+    return (-val if neg else val), not ok
+
+
+def eval_program_reference(program: tuple, vals: list[int]) -> bool:
+    cmp = {"lt": lambda v, k: v < k, "le": lambda v, k: v <= k,
+           "gt": lambda v, k: v > k, "ge": lambda v, k: v >= k,
+           "eq": lambda v, k: v == k, "ne": lambda v, k: v != k}
+    stack: list[bool] = []
+    for op in program:
+        kind = op[0]
+        if kind == "num":
+            stack.append(cmp[op[2]](vals[op[1]], op[3]))
+        elif kind == "between":
+            stack.append(op[2] <= vals[op[1]] <= op[3])
+        elif kind == "in":
+            stack.append(vals[op[1]] in op[2])
+        elif kind == "and":
+            b, a = stack.pop(), stack.pop()
+            stack.append(a and b)
+        elif kind == "or":
+            b, a = stack.pop(), stack.pop()
+            stack.append(a or b)
+        elif kind == "not":
+            stack.append(not stack.pop())
+        elif kind == "const":
+            stack.append(bool(op[1]))
+        else:
+            raise ValueError(f"unknown scan op {kind}")
+    if len(stack) != 1:
+        raise ValueError("unbalanced scan program")
+    return stack[0]
+
+
+def scan_block_reference(block: bytes, program: tuple, cols: tuple,
+                         delim: int, max_rows: int) -> np.ndarray:
+    """One block's row codes, pure Python — bit-identical to the device
+    path (and the dispatch CPU-salvage route). ``block`` must end with
+    a newline, like every device block."""
+    codes = np.zeros(max_rows, np.int32)
+    dbyte = bytes([delim])
+    rows = bytes(block).split(b"\n")[:-1]
+    for r, row in enumerate(rows[:max_rows]):
+        cells = row.split(dbyte)
+        vals, fail = [], False
+        for c in cols:
+            if c < len(cells):
+                v, f = _parse_cell_reference(cells[c])
+            else:
+                v, f = 0, True
+            vals.append(v)
+            fail = fail or f
+        if fail:
+            codes[r] = RESIDUAL
+        elif eval_program_reference(program, vals):
+            codes[r] = MATCH
+    return codes
+
+
+def scan_blocks_reference(blocks: np.ndarray, program: tuple, cols: tuple,
+                          delim: int, max_rows: int) -> np.ndarray:
+    """uint8 [B, L] -> codes int32 [B, max_rows] (CPU route)."""
+    return np.stack([
+        scan_block_reference(blocks[i].tobytes(), program, cols, delim,
+                             max_rows)
+        for i in range(blocks.shape[0])])

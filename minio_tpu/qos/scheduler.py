@@ -102,12 +102,20 @@ class QosScheduler:
 
     def plan(self, mode: str, profile, cls: str,
              sizes: list[tuple[int, int]], backlog_s: float,
-             cpu_workers: int, record: bool = True) -> int:
+             cpu_workers: int, record: bool = True,
+             cpu_scale: float = 1.0) -> int:
         """How many leading items of this flush take the device route;
         the rest spill to the CPU executor. ``sizes`` is per-item
         (bytes_in, bytes_out). ``record=False`` makes this a pure probe
         (the dispatch loop's hold gate asks \"would any of this go to
-        the device?\" without charging spill counters)."""
+        the device?\" without charging spill counters).
+
+        ``cpu_scale`` is how many times SLOWER than the profiled native
+        GF(256) rate this op's CPU route runs (1.0 for the erasure ops
+        the probe measured; the device workloads' CPU routes are pure
+        Python / numpy references and pass their own factor from
+        dispatch) — without it the model would spill a scan to a CPU
+        route it believes is 1000x faster than it is."""
         n = len(sizes)
         if mode == "cpu" or n == 0:
             return 0
@@ -122,7 +130,7 @@ class QosScheduler:
             t_out = sum(b for _, b in sizes)
             dev = backlog_s + self.cost.device_s(profile, t_in, t_out)
             cpu = self.cost.cpu_s(profile, t_in + t_out,
-                                  min(n, cpu_workers))
+                                  min(n, cpu_workers)) * cpu_scale
             if dev >= cpu:
                 return 0
         factor = spill_factor()
@@ -138,7 +146,7 @@ class QosScheduler:
                     self._note_spill(n - i, "bytes_cap")
                 return i
             dev_i = backlog_s + self.cost.device_s(profile, cum_in, cum_out)
-            cpu_i = self.cost.cpu_s(profile, b_in + b_out)
+            cpu_i = self.cost.cpu_s(profile, b_in + b_out) * cpu_scale
             # spill when the prediction blows the item's class budget
             # AND the CPU route is meaningfully (~N x) faster. The
             # budget floor keeps forced-device meaningful for small/fast

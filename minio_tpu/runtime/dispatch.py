@@ -58,9 +58,14 @@ from .. import qos as _qos
 log = logging.getLogger("minio_tpu.dispatch")
 
 #: dispatch op -> the kernel-metrics op name exported as
-#: minio_tpu_kernel_op_latency_seconds{op=...}
+#: minio_tpu_kernel_op_latency_seconds{op=...}. Every op string passed
+#: to _submit MUST appear here — graftlint GL006 enforces it, so a new
+#: dispatch entry point cannot dodge the fault-injection funnel (every
+#: flush passes the kernel-layer inject hook in _flush) or ship
+#: unnamed in the kernel metrics/trace planes.
 _OP_NAME = {"encode": "encode", "masked": "reconstruct", "fused": "fused",
-            "encode_hashed": "encode_hashed"}
+            "encode_hashed": "encode_hashed",
+            "select_scan": "select_scan", "sse_xor": "sse_xor"}
 
 MAX_BATCH = int(os.environ.get("MINIO_TPU_DISPATCH_BATCH", "128"))
 MAX_DELAY_S = float(os.environ.get("MINIO_TPU_DISPATCH_DELAY_MS", "1.0")) / 1e3
@@ -95,6 +100,17 @@ COMPLETERS = int(os.environ.get(
 
 def dispatch_enabled() -> bool:
     return os.environ.get("MINIO_TPU_DISPATCH", "1") != "0"
+
+
+#: how many times SLOWER than the profiled native GF(256) rate each
+#: op's CPU route runs — the QoS cost model's cpu estimate multiplies
+#: by this, or it would happily spill a Select scan to a pure-Python
+#: row loop it models as a 3 GiB/s kernel. Erasure ops are 1.0 (the
+#: probe measures exactly their native kernel); select_scan's CPU
+#: route is the pure-Python reference (~MB/s), sse_xor's the numpy
+#: ChaCha lane (~tens of MB/s). Rough, order-of-magnitude-right
+#: constants — the observed-vs-predicted EWMA corrects drift.
+_CPU_ROUTE_SCALE = {"select_scan": 2000.0, "sse_xor": 30.0}
 
 
 class LinkProfile:
@@ -185,6 +201,11 @@ class _Pending:
     #: links back to each item's context instead of pretending the
     #: batch belongs to one trace
     ctx: object | None = None
+    #: op-specific per-ITEM parameters (sse_xor: (key, nonces, seq0) —
+    #: package keys are per object, so they cannot live on the bucket;
+    #: select_scan: (program, cols, delim, max_rows), equal for every
+    #: item of a bucket because they ride the bucket key)
+    params: tuple | None = None
 
 
 class _Bucket:
@@ -277,6 +298,15 @@ class DispatchQueue:
     def _item_bytes(b: "_Bucket", p: _Pending) -> tuple[int, int]:
         """(bytes up the link, bytes back) for ONE pending item — the
         unit the QoS scheduler costs per-item routing on."""
+        if b.op == "select_scan":
+            # row codes come back: 4 B per tracked row
+            return p.words.nbytes, p.params[3] * 4
+        if b.op == "sse_xor":
+            # the whole payload rides back XORed, plus a 32 B Poly1305
+            # key per 64 KiB-class package (negligible) and the per-
+            # package nonce words up (ditto)
+            npkgs = p.words.shape[0]
+            return p.words.nbytes + npkgs * 12, p.words.nbytes + npkgs * 32
         bytes_in = p.words.nbytes
         out_rows = b.codec.m
         if p.masks is not None:
@@ -331,12 +361,39 @@ class DispatchQueue:
                             digests=digests, hash_key=hash_key,
                             chunk_size=chunk_size, hash_algo=hash_algo)
 
+    def select_scan(self, words: np.ndarray, program: tuple, cols: tuple,
+                    delim: int, max_rows: int) -> Future:
+        """Batched S3 Select predicate scan (ops/scan_pallas): one CSV
+        block as uint32 [1, L//4] -> Future[codes int32 [1, max_rows]].
+        Blocks of one request (and concurrent requests running the same
+        compiled program) bucket together into one device launch; the
+        CPU route/salvage runs the bit-identical pure-Python reference."""
+        key = ("select_scan", words.shape[-1], program, cols, delim,
+               max_rows)
+        return self._submit(key, None, "select_scan", words, None,
+                            params=(program, cols, delim, max_rows))
+
+    def sse_xor(self, words: np.ndarray, cipher_key: bytes,
+                nonces: np.ndarray) -> Future:
+        """SSE ChaCha20 package-crypto lane (ops/chacha_pallas): a whole
+        PUT/GET block's packages uint32 [P, pkg//4] -> Future[(xored
+        [P, pkg//4], poly_keys uint32 [P, 8])] under per-package nonces
+        uint32 [P, 3]. Package keys are per object, so items carry them
+        as params (one launch per item inside a shared flush); the CPU
+        route runs the numpy ChaCha20 reference — bit-identical either
+        way."""
+        key = ("sse_xor", words.shape)
+        return self._submit(key, None, "sse_xor", words, None,
+                            params=(cipher_key, nonces))
+
     def _submit(self, key, codec, op, words, masks, digests=None,
-                hash_key=None, chunk_size=0, hash_algo=0) -> Future:
+                hash_key=None, chunk_size=0, hash_algo=0,
+                params=None) -> Future:
         ctx = _sp.current()
         if ctx is not None and not ctx.sampled:
             ctx = None
-        p = _Pending(words=words, masks=masks, digests=digests, ctx=ctx)
+        p = _Pending(words=words, masks=masks, digests=digests, ctx=ctx,
+                     params=params)
         # QoS class rides the bucket key: interactive PUT/GET work and
         # background heal/scanner work never share a flush, so the loop
         # can order and spill them independently
@@ -517,7 +574,8 @@ class DispatchQueue:
             backlog = max(0.0, self._dev_busy_until - time.monotonic())
         sizes = [self._item_bytes(b, p) for p in items]
         return self.qos.plan(mode, prof, b.cls, sizes, backlog,
-                             self.completer_count)
+                             self.completer_count,
+                             cpu_scale=_CPU_ROUTE_SCALE.get(b.op, 1.0))
 
     @staticmethod
     def _rows_from_masks(masks: np.ndarray) -> np.ndarray:
@@ -546,7 +604,8 @@ class DispatchQueue:
             bytes_in, bytes_out = self._flush_bytes(b, items)
             predicted = self.qos.cost.cpu_s(
                 prof, bytes_in + bytes_out,
-                min(len(items), self.completer_count))
+                min(len(items), self.completer_count)) * \
+                _CPU_ROUTE_SCALE.get(b.op, 1.0)
             t0 = time.monotonic()
             left = [len(items)]
             llock = threading.Lock()
@@ -561,6 +620,24 @@ class DispatchQueue:
 
         def one(p: _Pending):
             try:
+                if b.op == "select_scan":
+                    # bit-identical pure-Python twin of the scan kernel
+                    from ..ops.scan_pallas import scan_blocks_reference
+                    program, cols, delim, max_rows = p.params
+                    blocks = np.ascontiguousarray(p.words).view(np.uint8)
+                    p.future.set_result(scan_blocks_reference(
+                        blocks, program, cols, delim, max_rows)[0])
+                    return
+                if b.op == "sse_xor":
+                    # numpy ChaCha20 reference — same bytes the kernel
+                    # produces (pinned), so a salvage changes nothing
+                    from ..crypto.chacha20poly1305 import keystream_xor
+                    cipher_key, nonces = p.params
+                    data = np.ascontiguousarray(p.words).view(np.uint8)
+                    out, pk = keystream_xor(cipher_key, nonces, data)
+                    p.future.set_result(
+                        (out.view("<u4"), pk.view("<u4")))
+                    return
                 u8 = np.ascontiguousarray(p.words).view(np.uint8)
                 if b.op in ("encode", "encode_hashed"):
                     rows = b.codec.parity_rows
@@ -729,7 +806,9 @@ class DispatchQueue:
             backlog = max(0.0, self._dev_busy_until - time.monotonic())
         sizes = [self._item_bytes(b, p) for p in b.items]
         return self.qos.plan(mode, prof, b.cls, sizes, backlog,
-                             self.completer_count, record=False) > 0
+                             self.completer_count, record=False,
+                             cpu_scale=_CPU_ROUTE_SCALE.get(b.op,
+                                                            1.0)) > 0
 
     def _flush(self, b: _Bucket, items: list[_Pending]):
         from .. import fault as _fault
@@ -789,9 +868,28 @@ class DispatchQueue:
         self.items += n
         self.device_batches += 1
         self.device_items += n
+        if b.op == "sse_xor":
+            # per-object package keys: one kernel launch per item inside
+            # this ONE flush (shared fault hook, kernel span, accounting)
+            from ..ops.chacha_pallas import xor_packages_device
+            out_dev = [xor_packages_device(p.params[0], p.params[1],
+                                           p.words) for p in items]
+            self._account_and_complete(b, out_dev, items, span_done,
+                                       trace_done)
+            return
         stack = np.stack([p.words for p in items] +
                          [items[0].words] * (bsz - n))
-        if b.op == "encode":
+        if b.op == "select_scan":
+            # every item of a select_scan bucket shares (program, cols,
+            # delim, max_rows) — they ride the bucket key. Single-device
+            # for now: the mesh-sharded route is ROADMAP item 2's
+            # extension point, same as the erasure ops grew theirs.
+            from ..ops.scan_pallas import scan_fn_for
+            program, cols, delim, max_rows = items[0].params
+            fn = scan_fn_for(program, cols, delim,
+                             stack.shape[-1] * 4, max_rows)
+            out_dev = fn(jnp.asarray(stack[:, 0, :]))
+        elif b.op == "encode":
             if mesh is None:
                 out_dev = b.codec.encode_words_batch(jnp.asarray(stack))
             else:
@@ -841,6 +939,16 @@ class DispatchQueue:
                 fn = sharded_batched(inner, mesh, (True, True, True),
                                      out_batch=2)
                 out_dev = fn(masks, stack, digs)
+        self._account_and_complete(b, out_dev, items, span_done,
+                                   trace_done)
+
+    def _account_and_complete(self, b: _Bucket, out_dev,
+                              items: list[_Pending], span_done,
+                              trace_done):
+        """Post-launch tail shared by every device flush: extend the
+        queue model, account queued bytes, attach trace/span callbacks
+        and hand host readback to a completer so the next batch launches
+        while this one's transfer is still in flight."""
         # queue model: extend the predicted drain deadline by this
         # flush's link+kernel estimate so the scheduler sees the backlog
         prof = self._profile
@@ -900,7 +1008,12 @@ class DispatchQueue:
     def _finish_readback(self, b: _Bucket, out_dev,
                          items: list[_Pending], span_done=None):
         try:
-            if b.op in ("fused", "encode_hashed"):
+            if b.op == "sse_xor":
+                # one (ct, poly_keys) device pair per item
+                for (ct_d, pk_d), p in zip(out_dev, items):
+                    p.future.set_result(
+                        (np.asarray(ct_d), np.asarray(pk_d)))
+            elif b.op in ("fused", "encode_hashed"):
                 out = np.asarray(out_dev[0])
                 extra = np.asarray(out_dev[1])  # valid mask / digests
                 for i, p in enumerate(items):

@@ -36,6 +36,12 @@ def encode_records(payload: bytes) -> bytes:
 
 
 def encode_progress(scanned: int, processed: int, returned: int) -> bytes:
+    """Progress event. The three byte counts are DISTINCT quantities
+    (reference pkg/s3select progress.go): ``scanned`` = input consumed
+    from storage (compressed/encrypted), ``processed`` = decoded bytes
+    the engine evaluated, ``returned`` = payload emitted in Records
+    frames. run_select wires them; tests/test_workloads.py locks the
+    framing."""
     xml = (f"<Progress><BytesScanned>{scanned}</BytesScanned>"
            f"<BytesProcessed>{processed}</BytesProcessed>"
            f"<BytesReturned>{returned}</BytesReturned></Progress>").encode()
@@ -47,6 +53,8 @@ def encode_progress(scanned: int, processed: int, returned: int) -> bytes:
 
 
 def encode_stats(scanned: int, processed: int, returned: int) -> bytes:
+    """Stats event — same distinct scanned/processed/returned contract
+    as encode_progress."""
     xml = (f"<Stats><BytesScanned>{scanned}</BytesScanned>"
            f"<BytesProcessed>{processed}</BytesProcessed>"
            f"<BytesReturned>{returned}</BytesReturned></Stats>").encode()

@@ -11,8 +11,11 @@ import json
 import xml.etree.ElementTree as ET
 from dataclasses import dataclass
 
+import numpy as np
+
 from .evaluate import Evaluator, Record, _truthy
-from .message import encode_end, encode_records, encode_stats
+from .message import (encode_end, encode_progress, encode_records,
+                      encode_stats)
 from .sql import Col, Select, SQLError, has_aggregates, parse_select
 
 _NS = "{http://s3.amazonaws.com/doc/2006-03-01/}"
@@ -50,6 +53,7 @@ class S3SelectRequest:
     out_delim: str = ","
     out_record_delim: str = "\n"
     out_quote_fields: str = "ASNEEDED"
+    progress_enabled: bool = False     # RequestProgress/Enabled
 
     @classmethod
     def parse(cls, xml_bytes: bytes) -> "S3SelectRequest":
@@ -59,6 +63,8 @@ class S3SelectRequest:
         et = _findtext(root, "ExpressionType", default="SQL")
         if et.upper() != "SQL":
             raise SQLError(f"unsupported ExpressionType {et}")
+        req.progress_enabled = _findtext(
+            root, "RequestProgress", "Enabled").lower() == "true"
         inp = _find(root, "InputSerialization")
         if inp is not None:
             req.compression = (_findtext(inp, "CompressionType")
@@ -97,12 +103,37 @@ class S3SelectRequest:
         return req
 
 
-def _records(req: S3SelectRequest, raw: bytes, alias: str):
+def _decode_payload(req: S3SelectRequest, raw: bytes) -> bytes:
+    """Decompress the stored payload per CompressionType. BytesProcessed
+    counts THESE bytes (decoded), BytesScanned counts the input consumed
+    (compressed/encrypted — the caller passes it when it differs)."""
     if req.input_format == "parquet":
         # parquet is its own container; AWS rejects CompressionType for
         # it (column chunks carry their own codec)
         if req.compression not in ("", "NONE"):
             raise SQLError("CompressionType must be NONE for Parquet")
+        return raw
+    if req.compression == "GZIP":
+        return gzip.decompress(raw)
+    if req.compression == "BZIP2":
+        import bz2
+        return bz2.decompress(raw)
+    if req.compression == "SNAPPY":
+        # the reference accepts snappy/s2-framed CSV+JSON inputs
+        from ..utils.snappy import SnappyError
+        from ..utils.snappy import decompress as snappy_decompress
+        try:
+            return snappy_decompress(raw)
+        except SnappyError as e:
+            raise SQLError(f"snappy: {e}") from None
+    if req.compression not in ("", "NONE"):
+        raise SQLError(f"unsupported CompressionType {req.compression}")
+    return raw
+
+
+def _records(req: S3SelectRequest, raw: bytes, alias: str):
+    """Records of the DECODED payload (see _decode_payload)."""
+    if req.input_format == "parquet":
         from .parquet import ParquetError, iter_parquet_rows
         try:
             for row in iter_parquet_rows(raw):
@@ -110,21 +141,6 @@ def _records(req: S3SelectRequest, raw: bytes, alias: str):
         except ParquetError as e:
             raise SQLError(f"parquet: {e}") from None
         return
-    if req.compression == "GZIP":
-        raw = gzip.decompress(raw)
-    elif req.compression == "BZIP2":
-        import bz2
-        raw = bz2.decompress(raw)
-    elif req.compression == "SNAPPY":
-        # the reference accepts snappy/s2-framed CSV+JSON inputs
-        from ..utils.snappy import SnappyError
-        from ..utils.snappy import decompress as snappy_decompress
-        try:
-            raw = snappy_decompress(raw)
-        except SnappyError as e:
-            raise SQLError(f"snappy: {e}") from None
-    elif req.compression not in ("", "NONE"):
-        raise SQLError(f"unsupported CompressionType {req.compression}")
     if req.input_format == "json":
         text = raw.decode("utf-8", "replace")
         if req.json_type == "DOCUMENT":
@@ -198,18 +214,74 @@ def _item_names(sel: Select) -> list[str]:
     return names
 
 
+def _device_rows(req: S3SelectRequest, sel: Select, decoded: bytes,
+                 alias: str):
+    """Try the device scan lane (s3select/device.py): returns
+    (names_map, base_offset, row iterator) or None when the query/input
+    is outside its coverage — the classic interpreter then runs
+    unchanged (docs/select.md has the fallback contract)."""
+    if req.input_format != "csv" or sel.where is None or not decoded:
+        return None
+    if len(req.csv_delim) != 1 or len(req.csv_quote) != 1 or \
+            req.csv_record_delim != "\n" or ord(req.csv_delim) > 127 or \
+            ord(req.csv_quote) > 127 or req.csv_delim == "\n":
+        return None
+    from . import device as dev
+    mode, block_bytes = dev.scan_config()
+    if mode == "off":
+        return None
+    if req.csv_quote.encode() in decoded or b"\r" in decoded or \
+            b"\x00" in decoded:
+        # query-level fallback: quoting glues rows/cells across raw
+        # newlines, and csv.reader errors whole-stream on bare CR and
+        # NUL bytes — byte-level row splitting cannot reproduce any of
+        # that, ANYWHERE in the data, so the classic path (and its
+        # exact error behavior) owns these payloads (review finding:
+        # per-block residual handling still split quoted records on
+        # embedded newlines)
+        return None
+    names_map: dict[str, int] = {}
+    base = 0
+    if req.csv_header in ("USE", "IGNORE"):
+        i = decoded.find(b"\n")
+        header = decoded[: i if i >= 0 else len(decoded)]
+        base = len(header) + 1 if i >= 0 else len(decoded)
+        if req.csv_header == "USE":
+            import csv as _csv
+            row = next(_csv.reader(
+                [header.decode("utf-8", "replace")],
+                delimiter=req.csv_delim, quotechar=req.csv_quote), [])
+            names_map = {c.strip().lower(): i for i, c in enumerate(row)}
+    compiled = dev.compile_where(sel.where, alias, names_map)
+    if compiled is None:
+        return None
+    program, cols = compiled
+    data = np.frombuffer(decoded, np.uint8)[base:]
+    scanner = dev.DeviceScan(data, program, cols, ord(req.csv_delim),
+                             mode, block_bytes)
+    return names_map, base, scanner.rows()
+
+
 def run_select(req: S3SelectRequest, raw: bytes, writer,
-               flush_every: int = 128 << 10, parsed: Select | None = None
-               ) -> dict:
+               flush_every: int = 128 << 10, parsed: Select | None = None,
+               scanned_bytes: int | None = None) -> dict:
     """Execute the select over the full object bytes, writing event-stream
     frames to ``writer``. Returns stats. Payload batches up to
     ``flush_every`` bytes per Records frame (the reference uses
-    maxRecordSize batches the same way)."""
+    maxRecordSize batches the same way).
+
+    ``scanned_bytes`` is the INPUT consumed (the stored — compressed or
+    encrypted — size); BytesProcessed reports the decoded size and
+    BytesReturned the emitted payload, all three distinct in the
+    Progress/Stats events (reference pkg/s3select progress.go)."""
     sel = parsed if parsed is not None else parse_select(req.expression)
     alias = sel.alias or ""
     ev = Evaluator()
     agg = has_aggregates(sel)
     names = _item_names(sel)
+    decoded = _decode_payload(req, raw)
+    scanned = len(raw) if scanned_bytes is None else scanned_bytes
+    processed = len(raw) if req.input_format == "parquet" else len(decoded)
     buf = bytearray()
     returned = 0
     matched = 0
@@ -221,14 +293,8 @@ def run_select(req: S3SelectRequest, raw: bytes, writer,
             returned += len(buf)
             buf.clear()
 
-    for rec in _records(req, raw, alias):
-        if sel.where is not None and not _truthy(ev.eval(sel.where, rec)):
-            continue
-        if agg:
-            ev.accumulate(sel.items, rec)
-            continue
-        if sel.limit >= 0 and matched >= sel.limit:
-            break  # checked BEFORE emitting so LIMIT 0 returns nothing
+    def emit(rec: Record):
+        nonlocal matched
         matched += 1
         if sel.items:
             fields = [ev.eval(item.expr, rec) for item in sel.items]
@@ -237,14 +303,50 @@ def run_select(req: S3SelectRequest, raw: bytes, writer,
             fields = rec.all_columns()
             names_row = [f"_{i + 1}" for i in range(len(fields))]
             buf.extend(_serialize(req, fields, names_row).encode())
-        if len(buf) >= flush_every:
-            flush()
+
+    dev_ctx = None if agg else _device_rows(req, sel, decoded, alias)
+    if dev_ctx is not None:
+        # device scan lane: the WHERE ran on the dispatch plane; only
+        # matching rows materialize, residual rows re-run the
+        # interpreter — identical output, row order preserved
+        import csv as _csv
+        names_map, base, rows = dev_ctx
+        for a, b, residual in rows:
+            if sel.limit >= 0 and matched >= sel.limit:
+                break
+            row_text = decoded[base + a: base + b].decode(
+                "utf-8", "replace")
+            cells = next(_csv.reader([row_text], delimiter=req.csv_delim,
+                                     quotechar=req.csv_quote), [])
+            rec = Record(values=cells, names=names_map, alias=alias)
+            if residual and not _truthy(ev.eval(sel.where, rec)):
+                continue
+            emit(rec)
+            if len(buf) >= flush_every:
+                flush()
+    else:
+        for rec in _records(req, decoded, alias):
+            if sel.where is not None and \
+                    not _truthy(ev.eval(sel.where, rec)):
+                continue
+            if agg:
+                ev.accumulate(sel.items, rec)
+                continue
+            if sel.limit >= 0 and matched >= sel.limit:
+                break  # checked BEFORE emitting: LIMIT 0 returns nothing
+            emit(rec)
+            if len(buf) >= flush_every:
+                flush()
     if agg:
         fields = ev.finish(sel.items)
         buf.extend(_serialize(req, fields, names).encode())
     flush()
-    stats = {"scanned": len(raw), "processed": len(raw),
+    stats = {"scanned": scanned, "processed": processed,
              "returned": returned}
+    if req.progress_enabled:
+        # end-of-stream Progress (the reference emits a final Progress
+        # before Stats when RequestProgress is enabled)
+        writer.write(encode_progress(scanned, processed, returned))
     writer.write(encode_stats(stats["scanned"], stats["processed"],
                               stats["returned"]))
     writer.write(encode_end())

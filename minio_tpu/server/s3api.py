@@ -1306,11 +1306,16 @@ class _S3Handler(BaseHTTPRequestHandler):
         from ..utils import compress as cz
         import io as iomod
         sink = iomod.BytesIO()
+        # BytesScanned = input consumed from storage (ciphertext /
+        # compressed); the engine reports the decoded size as
+        # BytesProcessed (s3select/message.py events)
+        scanned = oi.size
         if sse:
-            from ..crypto import DecryptWriter
-            oek, base_iv, plain_size, _ = sse
+            from ..crypto import DecryptWriter, enc_size
+            oek, base_iv, plain_size, _, cipher = sse
+            scanned = enc_size(plain_size)
             dw = DecryptWriter(sink, oek, base_iv, 0, 0, plain_size,
-                               self.bucket, self.key)
+                               self.bucket, self.key, cipher=cipher)
             self.s3.obj.get_object(self.bucket, self.key, dw, 0, -1, opts)
             dw.finish()
         elif oi.internal.get(cz.META_COMPRESSION):
@@ -1329,7 +1334,8 @@ class _S3Handler(BaseHTTPRequestHandler):
         self.end_headers()
         out = _ChunkedWriter(self.wfile)
         try:
-            run_select(req, raw, out, parsed=parsed)
+            run_select(req, raw, out, parsed=parsed,
+                       scanned_bytes=scanned)
         except Exception:  # noqa: BLE001 — mid-stream failure: cut the
             self.close_connection = True  # connection, the client sees EOF
             return
@@ -1934,16 +1940,20 @@ class _S3Handler(BaseHTTPRequestHandler):
 
         from ..crypto import (EncryptReader, enc_size, get_kms,
                               seal_object_key, sse_kms_context)
-        from ..crypto.sse import (META_IV, META_KEY_MD5, META_KMS_BLOB,
-                                  META_KMS_CONTEXT, META_KMS_KEY_ID,
-                                  META_PLAIN_SIZE, META_SCHEME, META_SEALED)
+        from ..crypto.sse import (META_CIPHER, META_IV, META_KEY_MD5,
+                                  META_KMS_BLOB, META_KMS_CONTEXT,
+                                  META_KMS_KEY_ID, META_PLAIN_SIZE,
+                                  META_SCHEME, META_SEALED, default_cipher)
         oek = secrets.token_bytes(32)
         base_iv = secrets.token_bytes(12)
+        cipher = default_cipher()
         user_defined[META_SCHEME] = sse.scheme
         user_defined[META_IV] = base64.b64encode(base_iv).decode()
         user_defined[META_PLAIN_SIZE] = str(size)
+        user_defined[META_CIPHER] = cipher
         if sse.scheme == "C":
-            sealed = seal_object_key(oek, sse.key, self.bucket, self.key)
+            sealed = seal_object_key(oek, sse.key, self.bucket, self.key,
+                                     cipher=cipher)
             user_defined[META_KEY_MD5] = sse.key_md5
             resp = {
                 "x-amz-server-side-encryption-customer-algorithm": "AES256",
@@ -1954,7 +1964,8 @@ class _S3Handler(BaseHTTPRequestHandler):
             key_id = sse.kms_key_id or kms.key_id
             ctx = sse_kms_context(self.bucket, self.key, sse.kms_context)
             dk, blob = self._kms_generate(kms, ctx, key_id)
-            sealed = seal_object_key(oek, dk, self.bucket, self.key)
+            sealed = seal_object_key(oek, dk, self.bucket, self.key,
+                                     cipher=cipher)
             user_defined[META_KMS_BLOB] = base64.b64encode(blob).decode()
             user_defined[META_KMS_KEY_ID] = key_id
             if sse.kms_context:
@@ -1965,11 +1976,13 @@ class _S3Handler(BaseHTTPRequestHandler):
         else:
             kms = get_kms()
             dk, blob = self._kms_generate(kms, f"{self.bucket}/{self.key}")
-            sealed = seal_object_key(oek, dk, self.bucket, self.key)
+            sealed = seal_object_key(oek, dk, self.bucket, self.key,
+                                     cipher=cipher)
             user_defined[META_KMS_BLOB] = base64.b64encode(blob).decode()
             resp = {"x-amz-server-side-encryption": "AES256"}
         user_defined[META_SEALED] = base64.b64encode(sealed).decode()
-        return EncryptReader(hr, oek, base_iv), enc_size(size), resp
+        return (EncryptReader(hr, oek, base_iv, cipher=cipher),
+                enc_size(size), resp)
 
     def _kms_generate(self, kms, ctx: str, key_id: str = ""):
         """generate_key with a KMS outage surfaced as a retryable 503
@@ -1984,16 +1997,19 @@ class _S3Handler(BaseHTTPRequestHandler):
     def _sse_read_ctx(self, oi):
         """For an encrypted object: unseal the OEK using this request's
         credentials and return (oek, base_iv, plain_size, response
-        headers); None for plaintext objects. SSE-C requires the customer
-        key headers on GET/HEAD (matching fingerprint), SSE-S3 unseals via
-        the KMS (cmd/encryption-v1.go DecryptRequest)."""
+        headers, package cipher); None for plaintext objects. SSE-C
+        requires the customer key headers on GET/HEAD (matching
+        fingerprint — a wrong key MD5 403s BEFORE any package is read or
+        opened), SSE-S3 unseals via the KMS (cmd/encryption-v1.go
+        DecryptRequest)."""
         import base64
 
         from ..crypto import (get_kms, parse_sse_headers, sse_kms_context,
                               unseal_object_key)
         from ..crypto.sse import (META_IV, META_KEY_MD5, META_KMS_BLOB,
                                   META_KMS_CONTEXT, META_KMS_KEY_ID,
-                                  META_PLAIN_SIZE, META_SCHEME, META_SEALED)
+                                  META_PLAIN_SIZE, META_SCHEME, META_SEALED,
+                                  cipher_of)
         from ..crypto import plain_size_of
         scheme = oi.internal.get(META_SCHEME, "")
         if not scheme:
@@ -2001,13 +2017,15 @@ class _S3Handler(BaseHTTPRequestHandler):
         sealed = base64.b64decode(oi.internal.get(META_SEALED, ""))
         base_iv = base64.b64decode(oi.internal.get(META_IV, ""))
         plain_size = plain_size_of(oi.internal, oi.size)
+        cipher = cipher_of(oi.internal)
         if scheme == "C":
             req = parse_sse_headers(self.hdr, self.bucket, self.key)
             if req is None or req.scheme != "C":
                 raise dt.SSEEncryptedObject(self.bucket, self.key)
             if req.key_md5 != oi.internal.get(META_KEY_MD5, ""):
                 raise dt.SSEKeyMismatch(self.bucket, self.key)
-            oek = unseal_object_key(sealed, req.key, self.bucket, self.key)
+            oek = unseal_object_key(sealed, req.key, self.bucket, self.key,
+                                    cipher=cipher)
             resp = {
                 "x-amz-server-side-encryption-customer-algorithm": "AES256",
                 "x-amz-server-side-encryption-customer-key-MD5":
@@ -2029,7 +2047,8 @@ class _S3Handler(BaseHTTPRequestHandler):
                                          extra=str(e)) from None
             except Exception:  # noqa: BLE001 — rotated/deleted master key
                 raise dt.SSEKeyMismatch(self.bucket, self.key) from None
-            oek = unseal_object_key(sealed, dk, self.bucket, self.key)
+            oek = unseal_object_key(sealed, dk, self.bucket, self.key,
+                                    cipher=cipher)
             resp = {"x-amz-server-side-encryption": "aws:kms",
                     "x-amz-server-side-encryption-aws-kms-key-id": key_id}
         else:
@@ -2042,9 +2061,10 @@ class _S3Handler(BaseHTTPRequestHandler):
                                          extra=str(e)) from None
             except Exception:  # noqa: BLE001 — rotated/wrong master key
                 raise dt.SSEKeyMismatch(self.bucket, self.key) from None
-            oek = unseal_object_key(sealed, dk, self.bucket, self.key)
+            oek = unseal_object_key(sealed, dk, self.bucket, self.key,
+                                    cipher=cipher)
             resp = {"x-amz-server-side-encryption": "AES256"}
-        return oek, base_iv, plain_size, resp
+        return oek, base_iv, plain_size, resp, cipher
 
     def _hash_reader(self, size: int) -> HashReader:
         """Body reader verifying Content-MD5 / x-amz-content-sha256 on the
@@ -2185,11 +2205,12 @@ class _S3Handler(BaseHTTPRequestHandler):
         if length > 0:
             if sse:
                 from ..crypto import DecryptWriter, decrypt_range_bounds
-                oek, base_iv, plain_size, _ = sse
+                oek, base_iv, plain_size, _, cipher = sse
                 enc_off, enc_len, seq0, skip = decrypt_range_bounds(
                     offset, length, plain_size)
                 dw = DecryptWriter(self.wfile, oek, base_iv, seq0, skip,
-                                   length, self.bucket, self.key)
+                                   length, self.bucket, self.key,
+                                   cipher=cipher)
                 if enc_len > 0:
                     self.s3.obj.get_object(self.bucket, self.key, dw,
                                            enc_off, enc_len, opts)

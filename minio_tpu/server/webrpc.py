@@ -513,9 +513,9 @@ def _write_logical(h, bucket: str, object: str, oi, sse, sink) -> None:
     compressed = oi.internal.get(cz.META_COMPRESSION, "")
     if sse:
         from ..crypto import DecryptWriter
-        oek, base_iv, psize, _ = sse
+        oek, base_iv, psize, _, cipher = sse
         dw = DecryptWriter(sink, oek, base_iv, 0, 0, psize,
-                           bucket, object)
+                           bucket, object, cipher=cipher)
         h.s3.obj.get_object(bucket, object, dw)
         dw.finish()
     elif compressed:

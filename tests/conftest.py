@@ -35,6 +35,13 @@ if os.environ["MINIO_TPU_LOCKRANK"] == "1":
     lockrank.install()
 
 
+def pytest_configure(config):
+    # tier-1 runs `-m 'not slow'`: heavyweight property/pin sweeps ride
+    # this marker so they run in full passes without taxing the gate
+    config.addinivalue_line(
+        "markers", "slow: heavyweight sweep excluded from tier-1")
+
+
 def pytest_terminal_summary(terminalreporter, exitstatus, config):
     """Surface accumulated lockrank reports at the end of the run so a
     newly-introduced lock-order inversion is visible even when no test

@@ -217,6 +217,40 @@ def test_gl006_storage_op_without_hook_flagged():
     assert [f.token for f in found] == ["read_all"]
 
 
+def test_gl006_dispatch_unregistered_op_flagged():
+    """ISSUE 8 extension: every op string submitted through _submit
+    must be registered in _OP_NAME — the flush-boundary inject hook,
+    kernel metrics and span naming all key on it."""
+    ctx = ctx_for("""
+        from .. import fault as _fault
+        _OP_NAME = {"encode": "encode", "select_scan": "select_scan"}
+        class DispatchQueue:
+            def encode(self, codec, words):
+                return self._submit(("k",), codec, "encode", words, None)
+            def select_scan(self, words):
+                return self._submit(("k",), None, "select_scan", words,
+                                    None)
+            def rogue_op(self, words):
+                return self._submit(("k",), None, "mystery", words, None)
+            def _flush(self, b, items):
+                _fault.inject("kernel", "device", b.op)
+    """, path="minio_tpu/runtime/dispatch.py")
+    found = checkers.check_fault_hooks(ctx)
+    assert [f.token for f in found] == ["mystery"]
+    assert found[0].scope.endswith("rogue_op")
+
+
+def test_gl006_dispatch_missing_inject_still_flagged():
+    ctx = ctx_for("""
+        _OP_NAME = {"encode": "encode"}
+        class DispatchQueue:
+            def encode(self, codec, words):
+                return self._submit(("k",), codec, "encode", words, None)
+    """, path="minio_tpu/runtime/dispatch.py")
+    found = checkers.check_fault_hooks(ctx)
+    assert [f.token for f in found] == ["kernel-flush"]
+
+
 def test_gl007_bare_except_and_daemon_swallow():
     ctx = ctx_for("""
         import threading
@@ -305,6 +339,44 @@ def test_gl010_sanctioned_fallback_and_foreign_paths_exempt():
     assert checkers.check_hot_path_host_copies(
         ctx_for(src.replace("erasure_encode", "whatever"),
                 path="minio_tpu/erasure/bitrot.py")) == []
+
+
+def test_gl010_workload_hot_paths_registered():
+    """The device-workloads hot paths (ISSUE 8) are in the GL010
+    registry: host hashing/copies inside them are findings."""
+    ctx = ctx_for("""
+        import hashlib
+        class DecryptWriter:
+            def write(self, b):
+                return hashlib.md5(bytes(b)).digest()
+        class EncryptReader:
+            def readinto(self, buf):
+                return self._chunks[0].tobytes()
+    """, path="minio_tpu/crypto/sse.py")
+    found = checkers.check_hot_path_host_copies(ctx)
+    assert len(found) == 4  # md5() + bytes() + .digest() + .tobytes()
+    assert {f.checker for f in found} == {"GL010"}
+    ctx = ctx_for("""
+        class DeviceScan:
+            def rows(self):
+                return bytes(self.data)
+            def other(self):
+                return bytes(self.data)   # unregistered — free
+    """, path="minio_tpu/s3select/device.py")
+    found = checkers.check_hot_path_host_copies(ctx)
+    assert len(found) == 1
+    assert found[0].scope == "DeviceScan.rows"
+
+
+def test_gl004_wrapper_fed_metric_literals_seen():
+    """GL004 recognizes families fed through the obs-shielded
+    _metric/_workload wrappers the workload paths use."""
+    ctx = ctx_for("""
+        def scan():
+            _metric("minio_tpu_fake_family_total", route="x")
+    """)
+    fams = [f for f, _ in checkers._metric_literals(ctx)]
+    assert "minio_tpu_fake_family_total" in fams
 
 
 def test_gl008_undocumented_dynamic_key_flagged():

@@ -208,22 +208,69 @@ def test_multipart_part_etags_fused(layer):
     assert layer.get_object_bytes("b", "mp") == b"".join(bodies)
 
 
-def test_sse_path_etag_matches_ciphertext_reference(layer):
+def _have_cryptography() -> bool:
+    import importlib.util
+    return importlib.util.find_spec("cryptography") is not None
+
+
+@pytest.mark.parametrize("cipher_name", [
+    "CHACHA20-POLY1305",   # self-contained — runs on EVERY build
+    pytest.param("AES256-GCM", marks=pytest.mark.skipif(
+        not _have_cryptography(), reason="cryptography wheel absent")),
+])
+def test_sse_path_etag_matches_ciphertext_reference(layer, cipher_name,
+                                                    monkeypatch):
     """SSE PUTs stream ciphertext into the erasure pipeline; the fused
     ETag must equal the reference computed over the SAME ciphertext
-    (deterministic EncryptReader: fixed OEK + IV)."""
-    pytest.importorskip("cryptography")
+    (deterministic EncryptReader: fixed OEK + IV). UNGATED by the
+    ChaCha20 package cipher (ISSUE 8): SSE rides the pipeline path with
+    no optional crypto dependency."""
     from minio_tpu.crypto import EncryptReader, enc_size
+    # numpy package lane: identical bytes, skips the full-package
+    # interpret kernel's one-off XLA compile on CPU hosts
+    monkeypatch.setenv("MINIO_TPU_SSE_DEVICE", "off")
     body = RNG.integers(0, 256, (1 << 20) + 777, dtype=np.uint8).tobytes()
     oek, iv = b"\x11" * 32, b"\x07" * 12
-    cipher = EncryptReader(io.BytesIO(body), oek, iv).read()
+    cipher = EncryptReader(io.BytesIO(body), oek, iv,
+                           cipher=cipher_name).read()
     assert len(cipher) == enc_size(len(body))
-    oi = layer.put_object("b", "sse", EncryptReader(io.BytesIO(body),
-                                                    oek, iv),
+    oi = layer.put_object("b", f"sse-{cipher_name}",
+                          EncryptReader(io.BytesIO(body), oek, iv,
+                                        cipher=cipher_name),
                           enc_size(len(body)))
     want = pipeline_etag_reference(cipher, 4, layer.block_size, 16384,
                                    _algo_id(layer))
     assert oi.etag == want
+    assert layer.get_object_bytes("b", f"sse-{cipher_name}") == cipher
+
+
+def test_sse_body_etag_mode_selection(layer, monkeypatch):
+    """Fused-vs-compat-MD5 selection is driven by the CIPHERTEXT size
+    like any body: a large encrypted body gets the fused ETag, a body
+    under pipeline.etag_min_bytes keeps the classic MD5 chain — over
+    the ciphertext either way (the stored bytes ARE the object)."""
+    from minio_tpu.crypto.sse import (CIPHER_CHACHA20, EncryptReader,
+                                      enc_size)
+    monkeypatch.setenv("MINIO_TPU_SSE_DEVICE", "off")
+    oek, iv = b"\x13" * 32, b"\x05" * 12
+    big = RNG.integers(0, 256, (2 << 20) + 99, dtype=np.uint8).tobytes()
+    ct_big = EncryptReader(io.BytesIO(big), oek, iv,
+                           cipher=CIPHER_CHACHA20).read()
+    oi = layer.put_object("b", "sse-big",
+                          EncryptReader(io.BytesIO(big), oek, iv,
+                                        cipher=CIPHER_CHACHA20),
+                          enc_size(len(big)))
+    assert oi.etag == pipeline_etag_reference(
+        ct_big, 4, layer.block_size, 16384, _algo_id(layer))
+    assert oi.etag != hashlib.md5(ct_big).hexdigest()   # really fused
+    small = big[:1000]
+    ct_small = EncryptReader(io.BytesIO(small), oek, iv,
+                             cipher=CIPHER_CHACHA20).read()
+    oi2 = layer.put_object("b", "sse-small",
+                           EncryptReader(io.BytesIO(small), oek, iv,
+                                         cipher=CIPHER_CHACHA20),
+                           enc_size(len(small)))
+    assert oi2.etag == hashlib.md5(ct_small).hexdigest()  # compat MD5
 
 
 def test_host_fallback_path_same_etag(layer, monkeypatch):

@@ -1,0 +1,227 @@
+"""SSE with the ChaCha20-Poly1305 package cipher over real HTTP
+(docs/sse.md) — NO optional crypto dependency needed: envelope and
+package crypto ride crypto/chacha20poly1305.py (+ the dispatch lane).
+Covers the ISSUE 8 satellites: SSE-C ranged GET at package boundaries
+(first/last partial package, exact boundary, single byte) and the
+wrong-key-MD5 403 BEFORE any package is opened."""
+import base64
+import hashlib
+import os
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(__file__))
+from s3client import S3Client  # noqa: E402
+
+from minio_tpu.crypto import sse as sse_mod  # noqa: E402
+from minio_tpu.crypto.sse import PKG_SIZE, enc_size  # noqa: E402
+from minio_tpu.objectlayer import ErasureObjects  # noqa: E402
+from minio_tpu.server import S3Server  # noqa: E402
+from minio_tpu.storage import XLStorage  # noqa: E402
+
+AK, SK = "chaak", "chask"
+KEY = bytes(range(32))
+KEY_B64 = base64.b64encode(KEY).decode()
+KEY_MD5 = base64.b64encode(hashlib.md5(KEY).digest()).decode()
+
+SSEC_HDRS = {
+    "x-amz-server-side-encryption-customer-algorithm": "AES256",
+    "x-amz-server-side-encryption-customer-key": KEY_B64,
+    "x-amz-server-side-encryption-customer-key-md5": KEY_MD5,
+}
+
+#: > 2 full packages + a partial tail, so ranges can hit first/last
+#: partial packages and exact boundaries
+BODY = np.random.default_rng(5).integers(
+    0, 256, 2 * PKG_SIZE + 70001, dtype=np.uint8).tobytes()
+
+
+@pytest.fixture(scope="module", autouse=True)
+def chacha_cipher():
+    os.environ["MINIO_TPU_SSE_CIPHER"] = "chacha20"
+    # numpy host lane: the full-package interpret kernel costs a ~60 s
+    # XLA compile on CPU hosts — the dispatch lane's e2e coverage lives
+    # in tests/test_workloads.py; bytes are identical either way
+    os.environ["MINIO_TPU_SSE_DEVICE"] = "off"
+    yield
+    os.environ.pop("MINIO_TPU_SSE_CIPHER", None)
+    os.environ.pop("MINIO_TPU_SSE_DEVICE", None)
+
+
+@pytest.fixture(scope="module")
+def srv(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("ssecha")
+    obj = ErasureObjects([XLStorage(str(tmp / f"d{i}")) for i in range(6)],
+                         default_parity=2)
+    server = S3Server(obj, "127.0.0.1", 0, access_key=AK, secret_key=SK)
+    server.start_background()
+    yield server
+    server.shutdown()
+
+
+@pytest.fixture(scope="module")
+def c(srv):
+    client = S3Client(srv.endpoint(), AK, SK)
+    assert client.request("PUT", "/cha").status_code == 200
+    client.request("PUT", "/cha/obj", body=BODY, headers=SSEC_HDRS)
+    return client
+
+
+def test_roundtrip_and_cipher_meta(c, srv):
+    r = c.request("GET", "/cha/obj", headers=SSEC_HDRS)
+    assert r.status_code == 200 and r.content == BODY
+    assert int(r.headers["Content-Length"]) == len(BODY)
+    # stored bytes are package ciphertext under the chacha cipher
+    stored = srv.obj.get_object_bytes("cha", "obj")
+    assert len(stored) == enc_size(len(BODY))
+    assert BODY[:64] not in stored
+    oi = srv.obj.get_object_info("cha", "obj")
+    assert oi.internal[sse_mod.META_CIPHER] == sse_mod.CIPHER_CHACHA20
+
+
+@pytest.mark.parametrize("lo,hi", [
+    (0, 10),                                  # first partial package
+    (100, PKG_SIZE - 1),                      # up to one before boundary
+    (0, PKG_SIZE - 1),                        # exact first package
+    (PKG_SIZE, 2 * PKG_SIZE - 1),             # exact middle package
+    (PKG_SIZE - 1, PKG_SIZE),                 # straddles the boundary
+    (PKG_SIZE, PKG_SIZE),                     # single byte at boundary
+    (123456, 123456),                         # single byte mid-package
+    (2 * PKG_SIZE + 5, None),                 # last partial package
+])
+def test_ssec_ranged_get_package_boundaries(c, lo, hi):
+    """Ranged GETs that start/end exactly on (and around) package
+    boundaries decrypt only the covering packages and trim right."""
+    end = len(BODY) - 1 if hi is None else hi
+    r = c.request("GET", "/cha/obj",
+                  headers={**SSEC_HDRS, "Range": f"bytes={lo}-{end}"})
+    assert r.status_code == 206, r.text
+    assert r.content == BODY[lo:end + 1]
+    assert r.headers["Content-Range"] == \
+        f"bytes {lo}-{end}/{len(BODY)}"
+
+
+def test_ssec_suffix_range(c):
+    r = c.request("GET", "/cha/obj",
+                  headers={**SSEC_HDRS, "Range": "bytes=-17"})
+    assert r.status_code == 206 and r.content == BODY[-17:]
+
+
+def test_wrong_key_md5_403_before_any_package_opened(c, monkeypatch):
+    """A wrong SSE-C key must 403 from the stored fingerprint BEFORE any
+    stored package is read or opened (satellite): instrument both
+    package-open paths and assert zero calls."""
+    opened = []
+    monkeypatch.setattr(
+        sse_mod._ChaChaPackages, "open_block",
+        lambda self, seq0, cts: opened.append(len(cts)) or [])
+    monkeypatch.setattr(
+        sse_mod._GCMPackages, "open_block",
+        lambda self, seq0, cts: opened.append(len(cts)) or [])
+    bad = bytes(reversed(KEY))
+    hdrs = {
+        "x-amz-server-side-encryption-customer-algorithm": "AES256",
+        "x-amz-server-side-encryption-customer-key":
+            base64.b64encode(bad).decode(),
+        "x-amz-server-side-encryption-customer-key-md5":
+            base64.b64encode(hashlib.md5(bad).digest()).decode(),
+    }
+    r = c.request("GET", "/cha/obj", headers=hdrs)
+    assert r.status_code == 403
+    assert opened == []
+    # ranged GET too: rejected before any ciphertext is touched
+    r = c.request("GET", "/cha/obj",
+                  headers={**hdrs, "Range": "bytes=0-9"})
+    assert r.status_code == 403
+    assert opened == []
+
+
+def test_missing_key_rejected_without_plaintext(c):
+    r = c.request("GET", "/cha/obj")
+    assert r.status_code == 400
+    assert BODY[:32] not in r.content
+
+
+def test_corrupt_package_fails_decrypt_and_emits_nothing():
+    """Flipping one ciphertext byte must fail the tag check with NO
+    plaintext emitted from the flush (verify-before-release)."""
+    import io
+
+    from minio_tpu.crypto.sse import (CIPHER_CHACHA20, DecryptWriter,
+                                      EncryptReader)
+    from minio_tpu.objectlayer.datatypes import SSEDecryptError
+    body = BODY[:100_000]
+    oek, iv = b"\x21" * 32, b"\x09" * 12
+    ct = EncryptReader(io.BytesIO(body), oek, iv,
+                       cipher=CIPHER_CHACHA20).read()
+    tampered = bytearray(ct)
+    tampered[50] ^= 1
+    sink = io.BytesIO()
+    dw = DecryptWriter(sink, oek, iv, 0, 0, len(body), "b", "o",
+                       cipher=CIPHER_CHACHA20)
+    with pytest.raises(SSEDecryptError):
+        dw.write(bytes(tampered))
+        dw.finish()
+    assert sink.getvalue() == b""
+    # untampered stream still opens
+    sink2 = io.BytesIO()
+    dw2 = DecryptWriter(sink2, oek, iv, 0, 0, len(body), "b", "o",
+                        cipher=CIPHER_CHACHA20)
+    dw2.write(ct)
+    dw2.finish()
+    assert sink2.getvalue() == body
+
+
+def test_empty_and_tiny_bodies(c):
+    for n in (0, 1, 15, 64):
+        body = bytes(range(n % 256))[:n]
+        r = c.request("PUT", f"/cha/tiny{n}", body=body,
+                      headers=SSEC_HDRS)
+        assert r.status_code == 200
+        r = c.request("GET", f"/cha/tiny{n}", headers=SSEC_HDRS)
+        assert r.content == body, n
+
+
+def test_select_over_encrypted_object_reports_ciphertext_scanned(c):
+    """SelectObjectContent on an SSE-C object: BytesScanned = the
+    ciphertext consumed, BytesProcessed = decrypted bytes, and the
+    device scan lane runs over the decrypted payload (docs/select.md +
+    docs/sse.md meet here: analytics over encrypted-by-default buckets
+    as a first-class workload)."""
+    from minio_tpu.s3select.message import decode_messages
+    csv_body = b"id,v\n" + b"".join(
+        b"%d,%d\n" % (i, i * 3) for i in range(2000))
+    c.request("PUT", "/cha/sel.csv", body=csv_body, headers=SSEC_HDRS)
+    xml = (b"<SelectObjectContentRequest>"
+           b"<Expression>SELECT id FROM S3Object WHERE v &gt;= 5994"
+           b"</Expression><ExpressionType>SQL</ExpressionType>"
+           b"<InputSerialization><CSV><FileHeaderInfo>USE"
+           b"</FileHeaderInfo></CSV></InputSerialization>"
+           b"<OutputSerialization><CSV/></OutputSerialization>"
+           b"</SelectObjectContentRequest>")
+    r = c.request("POST", "/cha/sel.csv", query={"select": "",
+                                                 "select-type": "2"},
+                  body=xml, headers=SSEC_HDRS)
+    assert r.status_code == 200, r.text
+    msgs = decode_messages(r.content)
+    recs = b"".join(p for h, p in msgs
+                    if h.get(":event-type") == "Records")
+    assert recs == b"1998\n1999\n"
+    stats = [p for h, p in msgs
+             if h.get(":event-type") == "Stats"][0].decode()
+    assert f"<BytesScanned>{enc_size(len(csv_body))}</BytesScanned>" \
+        in stats
+    assert f"<BytesProcessed>{len(csv_body)}</BytesProcessed>" in stats
+
+
+def test_multi_package_exact_multiple(c):
+    body = BODY[:2 * PKG_SIZE]     # no tail package
+    c.request("PUT", "/cha/exact", body=body, headers=SSEC_HDRS)
+    r = c.request("GET", "/cha/exact", headers=SSEC_HDRS)
+    assert r.content == body
+    r = c.request("GET", "/cha/exact",
+                  headers={**SSEC_HDRS,
+                           "Range": f"bytes={PKG_SIZE}-{PKG_SIZE + 9}"})
+    assert r.content == body[PKG_SIZE:PKG_SIZE + 10]

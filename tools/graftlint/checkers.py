@@ -294,7 +294,12 @@ def _metric_literals(ctx: FileCtx) -> list[tuple[str, int]]:
     for node in ast.walk(ctx.tree):
         if isinstance(node, ast.Call):
             fn = dotted(node.func)
-            if fn.rsplit(".", 1)[-1] in ("inc", "observe") and node.args:
+            # _metric is the obs-shielded wrapper the workload hot
+            # paths use — its literal first arg is an emitted family
+            # all the same (sse.py's _workload passes op strings, not
+            # families; its inner inc() calls are caught directly)
+            if fn.rsplit(".", 1)[-1] in ("inc", "observe",
+                                         "_metric") and node.args:
                 a0 = node.args[0]
                 if isinstance(a0, ast.Constant) and \
                         isinstance(a0.value, str):
@@ -443,6 +448,33 @@ def check_fault_hooks(ctx: FileCtx) -> list[Finding]:
                 "dispatch has no kernel-layer fault-injection hook "
                 "(_fault.inject('kernel', ...) at the flush boundary)",
                 token="kernel-flush"))
+        # every dispatch entry point funnels through _submit with an op
+        # registered in _OP_NAME — that is what guarantees the flush-
+        # boundary inject hook (and the kernel metrics/trace naming)
+        # covers it; an unregistered op string is a new entry point that
+        # dodged the funnel's contracts
+        op_names: set[str] = set()
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Assign) and \
+                    any(dotted(t) == "_OP_NAME" for t in node.targets) \
+                    and isinstance(node.value, ast.Dict):
+                op_names = {k.value for k in node.value.keys
+                            if isinstance(k, ast.Constant)}
+        for node in ast.walk(ctx.tree):
+            if not (isinstance(node, ast.Call) and
+                    dotted(node.func).endswith("_submit")):
+                continue
+            if len(node.args) >= 3 and \
+                    isinstance(node.args[2], ast.Constant) and \
+                    node.args[2].value not in op_names:
+                out.append(Finding(
+                    ctx.path, node.lineno, "GL006",
+                    f"dispatch entry point submits op "
+                    f"{node.args[2].value!r} that is not registered in "
+                    "_OP_NAME — fault-injection coverage, kernel "
+                    "metrics and trace naming all key on it",
+                    token=str(node.args[2].value),
+                    scope=ctx.scope_at(node.lineno)))
     return out
 
 
@@ -627,6 +659,16 @@ _HOT_PATH_FUNCS: dict[str, tuple[str, ...]] = {
     ),
     "minio_tpu/objectlayer/multipart.py": (
         "MultipartMixin.put_object_part",
+    ),
+    # device-workloads hot paths (ISSUE 8): SSE package streams and the
+    # Select scan consumer — crypto/hash work belongs to the dispatch
+    # lane (chacha kernel + batched numpy poly), not ad-hoc host calls
+    "minio_tpu/crypto/sse.py": (
+        "EncryptReader.readinto", "EncryptReader._fill",
+        "DecryptWriter.write", "DecryptWriter._open",
+    ),
+    "minio_tpu/s3select/device.py": (
+        "DeviceScan.rows", "DeviceScan._codes_for",
     ),
 }
 
