@@ -89,6 +89,22 @@ SUB_SYSTEMS: dict[str, dict[str, KV]] = {
                  "salvage): auto engages only on a TPU backend, 1 "
                  "forces the lane, 0 = numpy host lane, same bytes"),
     },
+    "timeline": {
+        "enable": KV("1", env="MINIO_TPU_TIMELINE",
+                     help="dispatch-plane flight recorder + standing "
+                          "attribution (docs/observability.md); 0 "
+                          "disables event recording and the per-op "
+                          "stage aggregates"),
+        "ring": KV("8192", env="MINIO_TPU_TIMELINE_RING",
+                   help="flight-recorder ring capacity (events); "
+                        "overflow drops oldest and counts "
+                        "minio_tpu_timeline_dropped_total"),
+        "sample": KV("1", env="MINIO_TPU_TIMELINE_SAMPLE",
+                     help="sampling fraction for high-frequency event "
+                          "types (enqueue/complete/bufpool); "
+                          "structural flush/plan/salvage events are "
+                          "always recorded"),
+    },
     "dispatch": {
         "enable": KV("1", env="MINIO_TPU_DISPATCH"),
         "mode": KV("auto", env="MINIO_TPU_DISPATCH_MODE",
@@ -286,7 +302,7 @@ SUB_SYSTEMS: dict[str, dict[str, KV]] = {
 #: config.go:132) — consumers read the registry at call time or register
 #: an apply callback.
 DYNAMIC = {"api", "scanner", "heal", "dispatch", "bitrot", "qos", "fault",
-           "durability", "pipeline", "workloads"}
+           "durability", "pipeline", "workloads", "timeline"}
 
 
 class ConfigSys:

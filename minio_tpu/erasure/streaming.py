@@ -907,6 +907,10 @@ def erasure_decode(erasure: Erasure, writer, readers: list, offset: int,
     stats.errs = preader.errs
     if length == 0:
         return stats
+    # standing GET attribution (obs/attribution.py): shard_read /
+    # decode / write_out charge the armed per-request collector; free
+    # when nothing is armed
+    stc = _stages.active()
 
     k = erasure.data_blocks
     bs = erasure.block_size
@@ -1015,7 +1019,8 @@ def erasure_decode(erasure: Erasure, writer, readers: list, offset: int,
                                            shard_len, out_dest)
                 return ["native", fut, b, block_data_len, boff, blen,
                         dest]
-            framed = read_framed_k(shard_offset, shard_len)
+            with _stages.timed(stc, "shard_read"):
+                framed = read_framed_k(shard_offset, shard_len)
             if framed is not None:
                 _mx.inc("minio_tpu_pipeline_get_blocks_total",
                         route="native")
@@ -1036,13 +1041,16 @@ def erasure_decode(erasure: Erasure, writer, readers: list, offset: int,
         degraded = any(preader.readers[i] is None for i in range(k))
         if degraded and preader.fusable(shard_len):
             _mx.inc("minio_tpu_pipeline_get_blocks_total", route="fused")
-            shards = preader.read_block(shard_offset, shard_len, raw=True)
+            with _stages.timed(stc, "shard_read"):
+                shards = preader.read_block(shard_offset, shard_len,
+                                            raw=True)
             fut = erasure.decode_data_blocks_verified_async(
                 shards, preader.last_digests, preader.fuse_chunk(),
                 preader.fuse_algo())
             return ["fused", fut, b, block_data_len, boff, blen, dest]
         _mx.inc("minio_tpu_pipeline_get_blocks_total", route="plain")
-        shards = preader.read_block(shard_offset, shard_len)
+        with _stages.timed(stc, "shard_read"):
+            shards = preader.read_block(shard_offset, shard_len)
         return ["plain", erasure.decode_data_blocks_async(shards), b,
                 block_data_len, boff, blen, dest]
 
@@ -1088,7 +1096,8 @@ def erasure_decode(erasure: Erasure, writer, readers: list, offset: int,
 
     def emit(entry):
         kind, fut, b, block_data_len, boff, blen, dest = entry
-        res = fut.result()
+        with _stages.timed(stc, "decode"):
+            res = fut.result()
         if kind == "native":
             out_arr, bad = res
             if bad == -1:
@@ -1097,11 +1106,14 @@ def erasure_decode(erasure: Erasure, writer, readers: list, offset: int,
                     # socket) copies once anyway — a bytes() here doubled
                     # the GIL-held memcpy work per block, the main cost
                     # of 8-way reads on few cores
-                    writer.write(memoryview(out_arr)[boff: boff + blen])
+                    with _stages.timed(stc, "write_out"):
+                        writer.write(
+                            memoryview(out_arr)[boff: boff + blen])
                 elif out_arr is not dest:
                     # reserved sink but a pooled buffer was used (tail /
                     # unaligned block): one copy into the final buffer
-                    dest[:] = out_arr[boff: boff + blen]
+                    with _stages.timed(stc, "write_out"):
+                        dest[:] = out_arr[boff: boff + blen]
                 # else: zero-copy — the native call assembled straight
                 # into the reserved view
                 if out_arr is not dest:
@@ -1127,10 +1139,11 @@ def erasure_decode(erasure: Erasure, writer, readers: list, offset: int,
         else:
             blocks = res
         block = np.concatenate(blocks[:k])
-        if dest is None:
-            writer.write(memoryview(block)[boff: boff + blen])
-        else:
-            dest[:] = block[boff: boff + blen]
+        with _stages.timed(stc, "write_out"):
+            if dest is None:
+                writer.write(memoryview(block)[boff: boff + blen])
+            else:
+                dest[:] = block[boff: boff + blen]
         stats.bytes_written += blen
 
     win = native_window_for(erasure.block_size) if native_get \
@@ -1173,6 +1186,9 @@ def erasure_heal(erasure: Erasure, writers: list, readers: list,
     n_blocks = ceil_div(total_length, bs)
 
     window: deque = deque()
+    # standing heal attribution (obs/attribution.py): shard_read /
+    # rebuild / shard_write; free when no collector is armed
+    stc = _stages.active()
 
     def submit(b: int):
         block_data_len = min(bs, total_length - b * bs)
@@ -1182,17 +1198,21 @@ def erasure_heal(erasure: Erasure, writers: list, readers: list,
             # fused verify+rebuild: source digests checked in the same
             # launch as the reconstruct (BASELINE config 4); a mismatch
             # falls back to CPU-verified replacement reads for that block
-            shards = preader.read_block(shard_offset, shard_len, raw=True)
+            with _stages.timed(stc, "shard_read"):
+                shards = preader.read_block(shard_offset, shard_len,
+                                            raw=True)
             fut = erasure.rebuild_targets_verified_async(
                 shards, preader.last_digests, targets, preader.fuse_chunk(),
                 preader.fuse_algo())
             return ["fused", fut, b]
-        shards = preader.read_block(shard_offset, shard_len)
+        with _stages.timed(stc, "shard_read"):
+            shards = preader.read_block(shard_offset, shard_len)
         return ["plain", erasure.rebuild_targets_async(shards, targets), b]
 
     def emit(entry):
         kind, fut, b = entry
-        res = fut.result()
+        with _stages.timed(stc, "rebuild"):
+            res = fut.result()
         if kind == "fused":
             rebuilt, corrupt = res
             if corrupt:
@@ -1218,7 +1238,8 @@ def erasure_heal(erasure: Erasure, writers: list, readers: list,
             if w is None:
                 continue
             try:
-                w.write(arr.tobytes())
+                with _stages.timed(stc, "shard_write"):
+                    w.write(arr.tobytes())
                 wrote += 1
             except Exception as e:  # noqa: BLE001
                 errs[t] = e

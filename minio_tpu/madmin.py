@@ -242,6 +242,25 @@ class AdminClient:
         per-class last-minute latency percentiles."""
         return self._json("GET", "qos")
 
+    def timeline(self, since: float = 0.0, count: int = 0,
+                 fmt: str = "", attribution: bool = False) -> dict:
+        """Dispatch-plane flight recorder (docs/observability.md):
+        event ring + per-lane utilization. ``since`` filters to events
+        newer than that monotonic timestamp (pair with the returned
+        ``now`` for incremental polls), ``count`` keeps the newest N,
+        ``fmt="chrome"`` returns Chrome-trace/Perfetto JSON,
+        ``attribution`` embeds the standing per-op stage breakdown."""
+        q: dict[str, str] = {}
+        if since:
+            q["since"] = str(since)
+        if count:
+            q["count"] = str(count)
+        if fmt:
+            q["fmt"] = fmt
+        if attribution:
+            q["attribution"] = "1"
+        return self._json("GET", "timeline", q)
+
     def trace(self, count: int = 50, timeout: float = 5.0,
               trace_type: str = "", threshold: str = "",
               errors_only: bool = False,

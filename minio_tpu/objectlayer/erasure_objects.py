@@ -16,6 +16,7 @@ from dataclasses import replace
 
 from ..erasure import (DEFAULT_BITROT_ALGO, Erasure, new_bitrot_reader,
                        new_bitrot_writer)
+from ..obs import attribution as _attr
 from ..obs import latency as _lat
 from ..obs import spans as _spans
 from ..obs import trace as _trc
@@ -332,7 +333,7 @@ class ErasureObjects(MultipartMixin, ObjectLayer):
     def put_object(self, bucket: str, object: str, stream, size: int,
                    opts: ObjectOptions = None) -> ObjectInfo:
         with _spans.span("objectlayer.put_object", bucket=bucket,
-                         object=object):
+                         object=object), _attr.observed("put"):
             return self._put_object_inner(bucket, object, stream, size,
                                           opts)
 
@@ -596,7 +597,7 @@ class ErasureObjects(MultipartMixin, ObjectLayer):
                    length: int = -1, opts: ObjectOptions = None
                    ) -> ObjectInfo:
         with _spans.span("objectlayer.get_object", bucket=bucket,
-                         object=object):
+                         object=object), _attr.observed("get"):
             return self._get_object_inner(bucket, object, writer, offset,
                                           length, opts)
 
@@ -1165,7 +1166,7 @@ class ErasureObjects(MultipartMixin, ObjectLayer):
             # a span tree and slow background heals tail-sample too
             with _spans.maybe_root("heal.object", cls="background",
                                    bucket=bucket, object=object,
-                                   mode=scan_mode):
+                                   mode=scan_mode), _attr.observed("heal"):
                 return self._heal_object_inner(bucket, object, version_id,
                                                dry_run, remove_dangling,
                                                scan_mode)

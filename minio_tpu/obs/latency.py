@@ -42,6 +42,11 @@ def _build_edges() -> tuple[float, ...]:
 EDGES = _build_edges()
 _NB = len(EDGES) + 1  # final bucket is +Inf
 
+#: coarsened edge subset for Prometheus histogram exposition
+#: (every 4th log-spaced edge, ~22 buckets — cumulative counts stay
+#: EXACT because each coarse bucket sums whole fine buckets)
+HIST_EDGES = EDGES[::4]
+
 
 class Window:
     """One sliding-window histogram: per-second slots recycled in place
@@ -132,6 +137,27 @@ class Window:
             "worst_s": worst,
             "worst_trace_id": worst_tid,
         }
+
+    def hist(self, now: float | None = None) -> dict:
+        """Prometheus-histogram view of the window: cumulative counts at
+        the coarse ``HIST_EDGES`` bounds (exact — each coarse bucket
+        sums whole fine buckets), total count, sum of observed seconds,
+        and the worst sample + its trace_id for OpenMetrics exemplars.
+        Feeds the ``*_duration_seconds`` histogram families promoted
+        from the p50/p99 summary gauges (ISSUE 9 satellite)."""
+        counts, n, total, _, _, worst, worst_tid = self._merge(now)
+        cum: list[int] = []
+        acc = 0
+        j = 0
+        for i, edge in enumerate(EDGES):
+            acc += counts[i]
+            if j < len(HIST_EDGES) and edge == HIST_EDGES[j]:
+                cum.append(acc)
+                j += 1
+        acc += counts[len(EDGES)]  # +Inf bucket
+        return {"edges": HIST_EDGES, "cum": cum, "count": n,
+                "sum": total, "worst_s": worst,
+                "worst_trace_id": worst_tid}
 
     def percentiles(self, qs: tuple[float, ...] = (0.5, 0.95, 0.99),
                     now: float | None = None) -> dict[float, float]:

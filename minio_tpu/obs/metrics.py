@@ -17,6 +17,7 @@ Two layers:
 from __future__ import annotations
 
 import os
+import re
 import threading
 import time
 
@@ -239,7 +240,10 @@ def _g_cache(server) -> list[str]:
 
 def _g_dispatch(server) -> list[str]:
     """TPU dispatch runtime — no reference analogue; this is the
-    device-side observability the TPU build adds."""
+    device-side observability the TPU build adds. queue_depth moved to
+    the scrape-time collector (_c_live_gauges): inside this group it
+    inherited the group cache, so a drained-then-idle queue kept
+    reporting its pre-drain depth for a whole cache interval."""
     from ..runtime.dispatch import _global
     if _global is None:
         return []
@@ -252,10 +256,65 @@ def _g_dispatch(server) -> list[str]:
         "# TYPE minio_tpu_dispatch_avg_batch gauge",
         f"minio_tpu_dispatch_avg_batch {st['avg_batch']:.2f}",
     ]
-    for k in ("cpu_batches", "device_batches", "queue_depth"):
+    for k in ("cpu_batches", "device_batches"):
         if k in st:
             lines.append(f"# TYPE minio_tpu_dispatch_{k} gauge")
             lines.append(f"minio_tpu_dispatch_{k} {st[k]}")
+    return lines
+
+
+def _g_device(server) -> list[str]:
+    """Per-device-lane utilization from the flight recorder
+    (obs/timeline.py): busy-ratio integration over the last minute,
+    lifetime flush/item/busy totals, batch-occupancy (fill vs capacity),
+    and the sampled dispatch queue-depth distribution — the numbers the
+    QoS scheduler and the mesh placement work (ROADMAP item 2) read.
+    Companion recorder-health counters ride the same group."""
+    from . import timeline as tl
+    util = tl.utilization()
+    lines = []
+    if util["lanes"]:
+        lines += ["# TYPE minio_tpu_device_busy_ratio gauge",
+                  "# TYPE minio_tpu_device_flushes_total counter",
+                  "# TYPE minio_tpu_device_items_total counter",
+                  "# TYPE minio_tpu_device_busy_seconds_total counter",
+                  "# TYPE minio_tpu_device_flush_bytes_total counter",
+                  "# TYPE minio_tpu_device_batch_fill_avg gauge"]
+        for lane, st in util["lanes"].items():
+            lab = f'{{lane="{_esc(lane)}"}}'
+            lines += [
+                f"minio_tpu_device_busy_ratio{lab} {st['busy_ratio']}",
+                f"minio_tpu_device_flushes_total{lab} {st['flushes']}",
+                f"minio_tpu_device_items_total{lab} {st['items']}",
+                f"minio_tpu_device_busy_seconds_total{lab} "
+                f"{st['busy_seconds_total']}",
+                f"minio_tpu_device_flush_bytes_total{lab} {st['bytes']}",
+                f"minio_tpu_device_batch_fill_avg{lab} "
+                f"{st['batch_fill_avg']}",
+            ]
+        lines.append("# TYPE minio_tpu_device_batch_fill_total counter")
+        for lane, st in util["lanes"].items():
+            for bucket, n in st["batch_fill_hist"].items():
+                lines.append(
+                    "minio_tpu_device_batch_fill_total"
+                    f'{{lane="{_esc(lane)}",fill="{bucket}"}} {n}')
+    qd = util["queue_depth"]
+    if qd["samples"]:
+        lines += [
+            "# TYPE minio_tpu_device_queue_depth gauge",
+            f'minio_tpu_device_queue_depth{{quantile="0.5"}} {qd["p50"]}',
+            f'minio_tpu_device_queue_depth{{quantile="0.99"}} '
+            f'{qd["p99"]}',
+        ]
+    st = tl.status()
+    lines += [
+        "# TYPE minio_tpu_timeline_enabled gauge",
+        f"minio_tpu_timeline_enabled {1 if st['enabled'] else 0}",
+        "# TYPE minio_tpu_timeline_events_total counter",
+        f"minio_tpu_timeline_events_total {st['events_total']}",
+        "# TYPE minio_tpu_timeline_dropped_total counter",
+        f"minio_tpu_timeline_dropped_total {st['dropped_total']}",
+    ]
     return lines
 
 
@@ -334,17 +393,16 @@ def _g_qos(server) -> list[str]:
 
 def _g_pipeline(server) -> list[str]:
     """Zero-copy pipeline plane (docs/ARCHITECTURE.md data path): the
-    buffer pool's working set — the recycling pool every pooled block
-    body/framed buffer rides — so ingest pressure and pool thrash
-    (miss rate) are observable next to the pipeline counters the hot
-    paths inc() directly."""
+    buffer pool's hit/miss counters — ingest pressure and pool thrash
+    next to the pipeline counters the hot paths inc() directly. The
+    retained-bytes GAUGE renders from the scrape-time collector
+    (_c_live_gauges) so it can never serve a stale between-mutations
+    value through a group cache."""
     from ..runtime import bufpool
     if bufpool._global is None:
         return []
     st = bufpool._global.stats()
     return [
-        "# TYPE minio_tpu_pipeline_bufpool_retained_bytes gauge",
-        f"minio_tpu_pipeline_bufpool_retained_bytes {st['retained']}",
         "# TYPE minio_tpu_pipeline_bufpool_hits_total counter",
         f"minio_tpu_pipeline_bufpool_hits_total {st['hits']}",
         "# TYPE minio_tpu_pipeline_bufpool_misses_total counter",
@@ -509,14 +567,62 @@ def _g_disk_latency(server) -> list[str]:
     return lines
 
 
+def _hist_lines(fam: str, label: str, h: dict,
+                exemplar_ok: bool) -> list[str]:
+    """Render one Window.hist() as a real Prometheus histogram
+    (`_bucket`/`_sum`/`_count`), with an OpenMetrics exemplar carrying
+    the window's worst sample's trace_id on the first bucket that
+    contains it — the promotion of the p50/p99 summary gauges the
+    dashboards keep (ISSUE 9 satellite). ``label`` is a pre-rendered
+    ``key="value",`` prefix ('' for unlabeled families)."""
+    from . import latency as lat
+    out = []
+    worst_s, worst_tid = h["worst_s"], h["worst_trace_id"]
+    exemplar_at = None
+    if exemplar_ok and worst_tid:
+        for i, edge in enumerate(lat.HIST_EDGES):
+            if worst_s <= edge:
+                exemplar_at = i
+                break
+        else:
+            exemplar_at = len(lat.HIST_EDGES)  # +Inf bucket
+    for i, (edge, cum) in enumerate(zip(h["edges"], h["cum"])):
+        ln = f'{fam}_bucket{{{label}le="{edge:.6g}"}} {cum}'
+        if i == exemplar_at:
+            ln += f' # {{trace_id="{_esc(worst_tid)}"}} {worst_s:.6f}'
+        out.append(ln)
+    inf = f'{fam}_bucket{{{label}le="+Inf"}} {h["count"]}'
+    if exemplar_at == len(h["edges"]):
+        inf += f' # {{trace_id="{_esc(worst_tid)}"}} {worst_s:.6f}'
+    out.append(inf)
+    base_label = f'{{{label[:-1]}}}' if label else ""
+    out.append(f'{fam}_sum{base_label} {h["sum"]:.6f}')
+    out.append(f'{fam}_count{base_label} {h["count"]}')
+    return out
+
+
+def _exemplar_fetchable(trace_id: str) -> bool:
+    """Only trace ids the slow-trace store will actually serve are
+    advertised as exemplars — same rule as the worst-sample gauge."""
+    if not trace_id:
+        return False
+    from . import spans as _sp
+    return _sp.store().contains(trace_id)
+
+
 def _g_kernel(server) -> list[str]:
     """Per-op dispatch/heal kernel latency percentiles + GiB/s — the
     paper's headline metric (erasure encode/reconstruct GiB/s, p99
-    heal-shard latency) served online instead of only by bench.py."""
+    heal-shard latency) served online instead of only by bench.py.
+    The p50/p99 gauges keep their names for dashboard compatibility;
+    the same windows ALSO render as real histograms
+    (minio_tpu_kernel_op_duration_seconds / minio_tpu_heal_shard_
+    duration_seconds) with OpenMetrics exemplars."""
     from . import latency as lat
     lines = ["# TYPE minio_tpu_kernel_op_latency_seconds gauge",
              "# TYPE minio_tpu_kernel_op_gibs gauge",
              "# TYPE minio_tpu_kernel_op_last_minute_total gauge"]
+    hist_lines = ["# TYPE minio_tpu_kernel_op_duration_seconds histogram"]
     for labels, w in lat.snapshot("kernel"):
         op = _esc(labels.get("op", ""))
         st = w.stats(tuple(q for q, _ in _QUANTILES))
@@ -528,6 +634,11 @@ def _g_kernel(server) -> list[str]:
                      f'{st["rate_gibs"]:.4f}')
         lines.append(f'minio_tpu_kernel_op_last_minute_total{{op="{op}"}} '
                      f'{st["count"]}')
+        h = w.hist()
+        hist_lines += _hist_lines(
+            "minio_tpu_kernel_op_duration_seconds", f'op="{op}",', h,
+            _exemplar_fetchable(h["worst_trace_id"]))
+    lines += hist_lines
     # the north-star number gets its own stable gauge (creating the
     # window on first scrape so the family is always present); ONE
     # stats() merge serves both the p99 and its worst-sample exemplar
@@ -537,6 +648,10 @@ def _g_kernel(server) -> list[str]:
     lines += ["# TYPE minio_tpu_heal_shard_latency_p99_seconds gauge",
               "minio_tpu_heal_shard_latency_p99_seconds "
               f"{hst['percentiles'][0.99]:.6f}"]
+    hh = heal.hist()
+    lines += ["# TYPE minio_tpu_heal_shard_duration_seconds histogram"]
+    lines += _hist_lines("minio_tpu_heal_shard_duration_seconds", "", hh,
+                         _exemplar_fetchable(hh["worst_trace_id"]))
     # exemplar-style link from the north-star metric to the span tree
     # behind its worst sample (trace_id rides a label — Prometheus text
     # format has no native exemplars; fetch via admin trace?trace_id=).
@@ -652,6 +767,9 @@ def _g_locks(server) -> list[str]:
 _GROUPS = [
     MetricsGroup("software", "node", _g_software, interval=0),
     MetricsGroup("capacity", "cluster", _g_capacity),
+    # device lanes read in-memory flight-recorder accounting —
+    # interval 0 so a lane's busy ratio is live on every scrape
+    MetricsGroup("device", "node", _g_device, interval=0),
     MetricsGroup("usage", "cluster", _g_usage),
     MetricsGroup("replication", "cluster", _g_replication),
     MetricsGroup("cache", "node", _g_cache),
@@ -679,6 +797,89 @@ _GROUPS = [
     MetricsGroup("ilm", "cluster", _g_ilm),
     MetricsGroup("heal", "cluster", _g_heal),
 ]
+
+
+# -- scrape-time collectors ---------------------------------------------------
+#
+# Gauges that sample live state must be read AT SCRAPE TIME, not through
+# a MetricsGroup cache: a queue that drained right after the last cache
+# fill would keep reporting its pre-drain depth for a whole interval
+# (the stale-between-mutations bug ISSUE 9 fixes). Collectors run
+# uncached on every render_prometheus call.
+
+_COLLECTORS: list = []
+
+
+def register_collector(fn) -> None:
+    """Register a ``(server) -> list[str]`` callback rendered fresh on
+    every scrape, bypassing all group caching."""
+    _COLLECTORS.append(fn)
+
+
+def _c_live_gauges(server) -> list[str]:
+    """The live gauges previously pinned by group caches: dispatch
+    queue depth and bufpool retained bytes."""
+    lines = []
+    from ..runtime.dispatch import _global as _dq
+    if _dq is not None:
+        with _dq._cv:
+            qdepth = sum(len(b.items) for b in _dq._buckets.values())
+        lines += ["# TYPE minio_tpu_dispatch_queue_depth gauge",
+                  f"minio_tpu_dispatch_queue_depth {qdepth}"]
+    from ..runtime import bufpool
+    if bufpool._global is not None:
+        st = bufpool._global.stats()
+        lines += ["# TYPE minio_tpu_pipeline_bufpool_retained_bytes gauge",
+                  "minio_tpu_pipeline_bufpool_retained_bytes "
+                  f"{st['retained']}"]
+    return lines
+
+
+register_collector(_c_live_gauges)
+
+
+def _attribution_lines() -> list[str]:
+    """Standing per-op stage attribution (obs/attribution.py) as
+    Prometheus families — rendered only on ``?attribution=1`` scrapes
+    (the report is also served as JSON by the admin timeline
+    endpoint)."""
+    from . import attribution as attr
+    rep = attr.report()
+    if not rep:
+        return []
+    lines = ["# TYPE minio_tpu_stage_latency_seconds gauge",
+             "# TYPE minio_tpu_stage_seconds_total counter",
+             "# TYPE minio_tpu_stage_share_of_wall gauge",
+             "# TYPE minio_tpu_stage_op_wall_seconds_total counter",
+             "# TYPE minio_tpu_stage_op_total counter"]
+    for op, ent in sorted(rep.items()):
+        lab_op = _esc(op)
+        lines.append(
+            f'minio_tpu_stage_op_wall_seconds_total{{op="{lab_op}"}} '
+            f'{ent["wall_seconds_total"]}')
+        lines.append(
+            f'minio_tpu_stage_op_total{{op="{lab_op}"}} {ent["count"]}')
+        # whole-op wall percentiles ride the same family as a "wall"
+        # stage row (the share denominators' latency twin)
+        lines += [
+            f'minio_tpu_stage_latency_seconds{{op="{lab_op}",'
+            f'stage="wall",quantile="0.5"}} {ent["wall_p50_s"]}',
+            f'minio_tpu_stage_latency_seconds{{op="{lab_op}",'
+            f'stage="wall",quantile="0.99"}} {ent["wall_p99_s"]}',
+        ]
+        for stage, st in sorted(ent["stages"].items()):
+            lab = f'op="{lab_op}",stage="{_esc(stage)}"'
+            lines += [
+                f'minio_tpu_stage_latency_seconds{{{lab},'
+                f'quantile="0.5"}} {st["p50_s"]}',
+                f'minio_tpu_stage_latency_seconds{{{lab},'
+                f'quantile="0.99"}} {st["p99_s"]}',
+                f'minio_tpu_stage_seconds_total{{{lab}}} '
+                f'{st["seconds_total"]}',
+                f'minio_tpu_stage_share_of_wall{{{lab}}} '
+                f'{st["share_of_wall"]}',
+            ]
+    return lines
 
 
 def _store_lines() -> list[str]:
@@ -770,14 +971,40 @@ def _annotate(lines: list[str]) -> list[str]:
     return out
 
 
-def render_prometheus(server, scope: str = "") -> bytes:
+#: exemplar suffix as _hist_lines appends it: ' # {labels} value' at
+#: end of a sample line — anchored so no legal label value can match
+_EXEMPLAR_RE = re.compile(r" # \{[^}]*\} [0-9.eE+-]+$")
+
+
+def render_prometheus(server, scope: str = "", attribution: bool = False,
+                      openmetrics: bool = False) -> bytes:
     """Text exposition. scope "" or "cluster" renders every group;
     "node" renders only node-scoped groups (reference mounts
-    /minio/v2/metrics/cluster and /minio/v2/metrics/node)."""
+    /minio/v2/metrics/cluster and /minio/v2/metrics/node). Scrape-time
+    collectors render after the groups, UNCACHED. ``attribution=True``
+    (the ``?attribution=1`` query) appends the standing per-op stage
+    breakdown families. ``openmetrics=True`` (Accept-negotiated by the
+    handler) keeps the histogram exemplar suffixes and terminates with
+    ``# EOF``; the classic text format has NO exemplar syntax — a
+    trailing ``#`` would read as an invalid timestamp and fail the
+    ENTIRE scrape — so they are stripped otherwise."""
     lines: list[str] = []
     for g in _GROUPS:
         if scope == "node" and g.scope != "node":
             continue
         lines.extend(g.lines(server))
+    for fn in list(_COLLECTORS):
+        try:
+            lines.extend(fn(server))
+        except Exception:  # noqa: BLE001 — one collector must never
+            pass  # take down the whole exposition (same rule as groups)
+    if attribution:
+        lines.extend(_attribution_lines())
     lines.extend(_store_lines())
-    return ("\n".join(_annotate(lines)) + "\n").encode()
+    out = _annotate(lines)
+    if openmetrics:
+        out.append("# EOF")
+    else:
+        out = [_EXEMPLAR_RE.sub("", ln) if " # {" in ln else ln
+               for ln in out]
+    return ("\n".join(out) + "\n").encode()

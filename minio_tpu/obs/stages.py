@@ -29,15 +29,21 @@ _current: contextvars.ContextVar = contextvars.ContextVar(
 class StageTimes:
     """Float seconds per stage name; adds are GIL-atomic enough for the
     data plane (worst case a lost update skews attribution, never
-    correctness)."""
+    correctness). ``parent`` chains collectors: the always-on
+    attribution layer (obs/attribution.py) arms a per-request collector
+    INSIDE whatever an outer caller (bench) armed, and every charge
+    flows to both — arming never starves the outer one."""
 
-    def __init__(self):
+    def __init__(self, parent: "StageTimes | None" = None):
         self.seconds: dict[str, float] = {}
         self.counts: dict[str, int] = {}
+        self.parent = parent
 
     def add(self, stage: str, dt: float) -> None:
         self.seconds[stage] = self.seconds.get(stage, 0.0) + dt
         self.counts[stage] = self.counts.get(stage, 0) + 1
+        if self.parent is not None:
+            self.parent.add(stage, dt)
 
     def snapshot(self) -> dict[str, float]:
         return {k: round(v, 6) for k, v in sorted(self.seconds.items())}

@@ -97,6 +97,10 @@ class QosScheduler:
             self.spilled_batches += 1
             self.spill_reasons[reason] = \
                 self.spill_reasons.get(reason, 0) + 1
+        # flight recorder: spill REASONS land on the timeline next to
+        # the plan events (ISSUE 9; recorded outside the stats lock)
+        from ..obs import timeline as _tl
+        _tl.record("spill", reason=reason, n=n)
 
     # -- the per-item routing decision ---------------------------------------
 

@@ -21,6 +21,8 @@ from collections import defaultdict
 
 import numpy as np
 
+from ..obs import timeline as _tl
+
 #: Retained-bytes cap across all buckets (not a cap on live buffers).
 MAX_RETAINED = int(os.environ.get("MINIO_TPU_BUFPOOL_BYTES",
                                   str(256 << 20)))
@@ -49,8 +51,16 @@ class BufferPool:
                 if lst:
                     self._retained -= nbytes
                     self.hits += 1
-                    return lst.pop()
-                self.misses += 1
+                    arr = lst.pop()
+                else:
+                    self.misses += 1
+                    arr = None
+            # flight recorder: pool pressure on the timeline (sampled
+            # event type, recorded outside the pool lock)
+            _tl.record("buf_acquire", bytes=nbytes,
+                       hit=arr is not None)
+            if arr is not None:
+                return arr
         return np.empty(nbytes, dtype=np.uint8)
 
     def put(self, arr: np.ndarray | None) -> None:
@@ -60,6 +70,7 @@ class BufferPool:
         if arr is None or arr.nbytes < self.min_pooled \
                 or not arr.flags.owndata:
             return
+        _tl.record("buf_release", bytes=arr.nbytes)
         with self._lock:
             if self._retained + arr.nbytes > self.max_retained:
                 return

@@ -52,6 +52,7 @@ import numpy as np
 from ..obs import latency as _lat
 from ..obs import lockrank as _lr
 from ..obs import spans as _sp
+from ..obs import timeline as _tl
 from ..obs import trace as _trc
 from .. import qos as _qos
 
@@ -420,6 +421,10 @@ class DispatchQueue:
                 _lat.observe("qos", wall, nbytes, trace_id=tid,
                              **{"class": cls})
                 self.qos.note_deadline(cls, wall)
+                # flight recorder: the completion callback closes the
+                # item's enqueue→...→complete chain (sampled event type)
+                _tl.record("complete", op=op_name, trace_id=tid,
+                           wall=round(wall, 6), **{"class": cls})
             except Exception:  # noqa: BLE001 — obs never breaks the path
                 pass
 
@@ -431,7 +436,12 @@ class DispatchQueue:
                                                  chunk_size, hash_algo,
                                                  cls=cls)
             b.items.append(p)
+            depth = len(b.items)
             self._cv.notify()
+        # flight recorder: item entered its bucket (sampled event type;
+        # recorded OUTSIDE the dispatch cv lock)
+        _tl.record("enqueue", op=op_name, trace_id=tid, bytes=nbytes,
+                   bucket_depth=depth, **{"class": cls})
         return p.future
 
     # --- dispatcher ---------------------------------------------------------
@@ -439,6 +449,7 @@ class DispatchQueue:
     def _loop(self):
         while True:
             to_flush: list[tuple[tuple, _Bucket, list[_Pending]]] = []
+            qdepth = -1
             with self._cv:
                 while not self._stop:
                     now = time.monotonic()
@@ -484,6 +495,11 @@ class DispatchQueue:
                         # ones collected in the same pass (QoS priority)
                         to_flush.sort(key=lambda e: _qos.CLASS_PRIORITY.get(
                             e[1].cls, 1))
+                        # queue-depth sample per flush pass (items still
+                        # waiting after this pass's extraction) for the
+                        # minio_tpu_device_queue_depth distribution
+                        qdepth = sum(len(bb.items)
+                                     for bb in self._buckets.values())
                         break
                     timeout = None if deadline is None \
                         else max(0.0, deadline - time.monotonic())
@@ -497,6 +513,8 @@ class DispatchQueue:
                                 b.items[self.max_batch:]
                             to_flush.append((key, b, items))
                     self._buckets.clear()
+            if qdepth >= 0:
+                _tl.note_queue_depth(qdepth)
             for key, b, items in to_flush:
                 try:
                     self._flush(b, items)
@@ -568,14 +586,24 @@ class DispatchQueue:
         budget, or the device queued-bytes cap."""
         mode = os.environ.get("MINIO_TPU_DISPATCH_MODE", "auto")
         if mode == "cpu":
-            return 0
-        prof = self._get_profile()
-        with self._profile_lock:
-            backlog = max(0.0, self._dev_busy_until - time.monotonic())
-        sizes = [self._item_bytes(b, p) for p in items]
-        return self.qos.plan(mode, prof, b.cls, sizes, backlog,
-                             self.completer_count,
-                             cpu_scale=_CPU_ROUTE_SCALE.get(b.op, 1.0))
+            n_dev = 0
+        else:
+            prof = self._get_profile()
+            with self._profile_lock:
+                backlog = max(0.0,
+                              self._dev_busy_until - time.monotonic())
+            sizes = [self._item_bytes(b, p) for p in items]
+            n_dev = self.qos.plan(mode, prof, b.cls, sizes, backlog,
+                                  self.completer_count,
+                                  cpu_scale=_CPU_ROUTE_SCALE.get(b.op,
+                                                                 1.0))
+        # flight recorder: the routing decision for this flush (always
+        # recorded — a timeline without its plans is not a timeline;
+        # spill REASONS ride the scheduler's own "spill" events)
+        _tl.record("plan", op=_OP_NAME.get(b.op, b.op), n=len(items),
+                   device=n_dev, spilled=len(items) - n_dev,
+                   **{"class": b.cls})
+        return n_dev
 
     @staticmethod
     def _rows_from_masks(masks: np.ndarray) -> np.ndarray:
@@ -596,6 +624,7 @@ class DispatchQueue:
         self.cpu_items += len(items)
         trace_done = self._flush_trace_cb(b, items, "cpu")
         span_done = self._flush_span_cb(b, items, "cpu")
+        tl_done = self._tl_flush_cb(b, items, "cpu", ("cpu",))
         # observed CPU flush wall corrects the route cost EWMA (only
         # meaningful once a link profile provides the base estimate)
         prof = self._profile
@@ -682,6 +711,8 @@ class DispatchQueue:
                 p.future.add_done_callback(span_done)
             if cost_done is not None:
                 p.future.add_done_callback(cost_done)
+            if tl_done is not None:
+                p.future.add_done_callback(tl_done)
             self._completers.submit(one, p)
 
     def _flush_trace_cb(self, b: _Bucket, items: list[_Pending],
@@ -786,6 +817,67 @@ class DispatchQueue:
         done.cancel = lambda: cancelled.__setitem__(0, True)
         return done
 
+    def _device_lanes(self) -> tuple[str, ...]:
+        """Lane names a device flush occupies: one ``dev<i>`` per mesh
+        device (an SPMD launch runs on every chip at once), or the
+        default device's lane for single-chip launches. Cached — the
+        device topology cannot change within a process."""
+        lanes = getattr(self, "_lanes_cache", None)
+        if lanes is not None:
+            return lanes
+        try:
+            from .mesh import object_mesh
+            mesh = object_mesh()
+            if mesh is not None:
+                lanes = tuple(f"dev{d.id}"
+                              for d in mesh.devices.flatten())
+            else:
+                import jax
+                lanes = (f"dev{jax.devices()[0].id}",)
+        except Exception:  # noqa: BLE001 — no backend: nominal lane
+            lanes = ("dev0",)
+        self._lanes_cache = lanes
+        return lanes
+
+    def _tl_flush_cb(self, b: _Bucket, items: list[_Pending], route: str,
+                     lanes: tuple[str, ...] = ("cpu",)):
+        """Paired flight-recorder flush events (graftlint GL011: every
+        CPU/device flush route emits these): ``flush_start`` now,
+        ``flush_end`` once the flush's last item resolves — the end
+        event also feeds the per-lane utilization accounting (busy
+        ratio, batch occupancy). Returns the future-done callback (with
+        a ``.cancel`` hook for the readback-salvage path, whose CPU
+        re-flush records its own truthful pair), or None while the
+        recorder is off — zero hot-path cost."""
+        if not _tl.enabled():
+            return None
+        bytes_in, bytes_out = self._flush_bytes(b, items)
+        fid = _tl.next_flush_id()
+        op_name = _OP_NAME.get(b.op, b.op)
+        _tl.record("flush_start", op=op_name, lane=lanes, flush_id=fid,
+                   batch=len(items), capacity=self.max_batch,
+                   bytes=bytes_in + bytes_out, route=route,
+                   **{"class": b.cls})
+        t0 = time.monotonic()
+        remaining = [len(items)]
+        rlock = threading.Lock()
+        cancelled = [False]
+
+        def done(_f):
+            with rlock:
+                remaining[0] -= 1
+                if remaining[0]:
+                    return
+            if cancelled[0]:
+                return
+            _tl.record("flush_end", op=op_name, lane=lanes, flush_id=fid,
+                       batch=len(items), capacity=self.max_batch,
+                       bytes=bytes_in + bytes_out, route=route,
+                       dur=round(time.monotonic() - t0, 6))
+
+        done.cancel = lambda: cancelled.__setitem__(0, True)
+        return done
+
     def _device_saturated(self) -> bool:
         with self._profile_lock:
             return self._dev_inflight >= DEVICE_PIPELINE
@@ -820,6 +912,9 @@ class DispatchQueue:
             try:
                 _fault.inject("kernel", "device", b.op)
             except Exception:  # noqa: BLE001 — injected device failure
+                _tl.record("salvage", op=_OP_NAME.get(b.op, b.op),
+                           lane=("cpu",), reason="injected",
+                           batch=len(items))
                 self._flush_cpu(b, items)
                 return
         n_dev = self._plan_flush(b, items)
@@ -835,6 +930,9 @@ class DispatchQueue:
                 self.items -= len(dev_items)
                 self.device_batches -= 1  # the flush never completed
                 self.device_items -= len(dev_items)
+                _tl.record("salvage", op=_OP_NAME.get(b.op, b.op),
+                           lane=("cpu",), reason="device_flush_failed",
+                           batch=len(dev_items))
                 self._flush_cpu(b, dev_items)
         if cpu_items:
             self._flush_cpu(b, cpu_items)
@@ -851,6 +949,8 @@ class DispatchQueue:
         _lr.note_blocking(f"device_flush:{b.op}")
         trace_done = self._flush_trace_cb(b, items, "device")
         span_done = self._flush_span_cb(b, items, "device")
+        tl_done = self._tl_flush_cb(b, items, "device",
+                                    self._device_lanes())
         import jax.numpy as jnp
         from .mesh import object_mesh, replicated_for, sharded_batched
         n = len(items)
@@ -875,7 +975,7 @@ class DispatchQueue:
             out_dev = [xor_packages_device(p.params[0], p.params[1],
                                            p.words) for p in items]
             self._account_and_complete(b, out_dev, items, span_done,
-                                       trace_done)
+                                       trace_done, tl_done)
             return
         stack = np.stack([p.words for p in items] +
                          [items[0].words] * (bsz - n))
@@ -940,11 +1040,11 @@ class DispatchQueue:
                                      out_batch=2)
                 out_dev = fn(masks, stack, digs)
         self._account_and_complete(b, out_dev, items, span_done,
-                                   trace_done)
+                                   trace_done, tl_done)
 
     def _account_and_complete(self, b: _Bucket, out_dev,
                               items: list[_Pending], span_done,
-                              trace_done):
+                              trace_done, tl_done=None):
         """Post-launch tail shared by every device flush: extend the
         queue model, account queued bytes, attach trace/span callbacks
         and hand host readback to a completer so the next batch launches
@@ -970,11 +1070,13 @@ class DispatchQueue:
                 p.future.add_done_callback(trace_done)
             if span_done is not None:
                 p.future.add_done_callback(span_done)
+            if tl_done is not None:
+                p.future.add_done_callback(tl_done)
         try:
             self._completers.submit(self._complete, b, out_dev, items,
                                     accounted, bytes_in + bytes_out,
                                     predicted_s, time.monotonic(),
-                                    span_done)
+                                    span_done, tl_done)
         except BaseException:  # submit refused (shutdown): the paired
             self.qos.device_completed(bytes_in + bytes_out)  # decrement
             if accounted:  # and the pipeline slot must not stay occupied
@@ -985,9 +1087,9 @@ class DispatchQueue:
     def _complete(self, b: _Bucket, out_dev, items: list[_Pending],
                   accounted: bool = True, qbytes: int = 0,
                   predicted_s: float = 0.0, t0: float = 0.0,
-                  span_done=None):
+                  span_done=None, tl_done=None):
         try:
-            self._finish_readback(b, out_dev, items, span_done)
+            self._finish_readback(b, out_dev, items, span_done, tl_done)
         finally:
             self.qos.device_completed(qbytes)
             if predicted_s > 0.0 and t0 > 0.0:
@@ -1006,7 +1108,8 @@ class DispatchQueue:
                     self._cv.notify()
 
     def _finish_readback(self, b: _Bucket, out_dev,
-                         items: list[_Pending], span_done=None):
+                         items: list[_Pending], span_done=None,
+                         tl_done=None):
         try:
             if b.op == "sse_xor":
                 # one (ct, poly_keys) device pair per item
@@ -1030,12 +1133,20 @@ class DispatchQueue:
                 # the device launch delivered nothing — the CPU
                 # re-flush below records the truthful kernel span
                 span_done.cancel()
+            if tl_done is not None:
+                # ditto for the flight recorder: the CPU re-flush emits
+                # its own truthful flush pair; a device flush_end here
+                # would integrate salvage time into device busy-ratio
+                tl_done.cancel()
             pending = [p for p in items if not p.future.done()]
             if pending:
                 self.batches -= 1
                 self.items -= len(pending)
                 self.device_batches -= 1  # readback never delivered
                 self.device_items -= len(pending)
+                _tl.record("salvage", op=_OP_NAME.get(b.op, b.op),
+                           lane=("cpu",), reason="readback_failed",
+                           batch=len(pending))
                 self._flush_cpu(b, pending)
 
     def stop(self):

@@ -94,6 +94,8 @@ def _dispatch_admin(h, op: str) -> None:
             "application/json")
     if op == "trace":
         return _trace(h)
+    if op == "timeline":
+        return _timeline(h)
     if op == "top/locks":
         return _top_locks(h)
     if op == "top/api":
@@ -642,6 +644,41 @@ def _trace(h) -> None:
         h.close_connection = True
     finally:
         trace_pubsub.unsubscribe(sub)
+
+
+def _timeline(h) -> None:
+    """Dispatch-plane flight recorder (docs/observability.md "Flight
+    recorder & attribution"): GET serves the event ring + per-lane
+    utilization. Query params: ``since=<monotonic seconds>`` filters to
+    newer events (pair with the returned ``now`` for incremental
+    polls), ``count=N`` truncates to the newest N,
+    ``fmt=chrome`` exports Chrome-trace/Perfetto JSON instead,
+    ``attribution=1`` embeds the standing per-op stage breakdown."""
+    import time as _t
+
+    from ..obs import attribution, timeline
+    q = {k: v[0] for k, v in h.query.items()}
+    try:
+        since = float(q.get("since", "0"))
+    except ValueError:
+        return h._error("InvalidArgument",
+                        f"bad since {q.get('since')!r}", 400)
+    try:
+        count = int(q.get("count", "0"))
+    except ValueError:
+        count = 0
+    if q.get("fmt") == "chrome":
+        out = timeline.export_chrome(since, count)
+    else:
+        out = {
+            "now": _t.monotonic(),
+            **timeline.status(),
+            "utilization": timeline.utilization(),
+            "events": timeline.snapshot(since, count),
+        }
+        if q.get("attribution") == "1":
+            out["attribution"] = attribution.report()
+    h._send(200, json.dumps(out).encode(), "application/json")
 
 
 def _top_api(h) -> None:

@@ -783,8 +783,26 @@ class _S3Handler(BaseHTTPRequestHandler):
             from ..obs.metrics import render_prometheus
             scope = "node" if self.url_path.rstrip("/").endswith("/node") \
                 else "cluster"
-            return self._send(200, render_prometheus(self.s3, scope),
-                              "text/plain; version=0.0.4")
+            # ?attribution=1 appends the standing per-op stage
+            # breakdown families (minio_tpu_stage_*, ISSUE 9)
+            attribution = self.query.get("attribution", [""])[0] == "1"
+            # exemplars are OpenMetrics-only syntax: emit them (and the
+            # matching content type + # EOF) only on EXPLICIT
+            # ?openmetrics=1 request. Not Accept-negotiated on purpose:
+            # modern Prometheus lists openmetrics-text in its default
+            # Accept, and this exposition keeps classic counter naming
+            # ('X_total' declared as-is), which a STRICT OM parser
+            # rejects wholesale — sniffing Accept would break scrapers
+            # that parse the classic form fine today. A classic parser
+            # conversely reads a trailing exemplar '#' as an invalid
+            # timestamp, so the default form strips them.
+            om = self.query.get("openmetrics", [""])[0] == "1"
+            ctype = ("application/openmetrics-text; version=1.0.0; "
+                     "charset=utf-8") if om else \
+                "text/plain; version=0.0.4"
+            return self._send(200, render_prometheus(
+                self.s3, scope, attribution=attribution,
+                openmetrics=om), ctype)
         if self.url_path.startswith("/minio/admin/"):
             from .admin import handle_admin
             return handle_admin(self)

@@ -368,6 +368,75 @@ def test_gl010_workload_hot_paths_registered():
     assert found[0].scope == "DeviceScan.rows"
 
 
+def test_gl011_flush_route_without_pairing_flagged():
+    """ISSUE 9: every dispatch flush route must obtain the paired
+    flight-recorder flush start/end callback from _tl_flush_cb."""
+    ctx = ctx_for("""
+        _OP_NAME = {"encode": "encode"}
+        class DispatchQueue:
+            def _tl_flush_cb(self, b, items, route, lanes=("cpu",)):
+                _tl.record("flush_start", op=b.op)
+                def done(_f):
+                    _tl.record("flush_end", op=b.op)
+                return done
+            def _flush_cpu(self, b, items):
+                tl_done = self._tl_flush_cb(b, items, "cpu")
+            def _flush_device(self, b, items):
+                pass   # no pairing call — finding
+    """, path="minio_tpu/runtime/dispatch.py")
+    found = checkers.check_timeline_flush_pairs(ctx)
+    assert [f.token for f in found] == ["_flush_device"]
+    assert all(f.checker == "GL011" for f in found)
+
+
+def test_gl011_missing_helper_and_broken_pairing_flagged():
+    # no helper at all
+    ctx = ctx_for("""
+        _OP_NAME = {"encode": "encode"}
+        class DispatchQueue:
+            def _flush_cpu(self, b, items):
+                pass
+    """, path="minio_tpu/runtime/dispatch.py")
+    found = checkers.check_timeline_flush_pairs(ctx)
+    assert "_tl_flush_cb" in {f.token for f in found}
+    # helper present but emits only flush_start: pairing broken — and a
+    # DOCSTRING naming both events must not mask the missing record()
+    ctx = ctx_for('''
+        _OP_NAME = {"encode": "encode"}
+        class DispatchQueue:
+            def _tl_flush_cb(self, b, items, route, lanes=("cpu",)):
+                """Paired flush_start/flush_end events for GL011."""
+                _tl.record("flush_start", op=b.op)
+            def _flush_cpu(self, b, items):
+                tl_done = self._tl_flush_cb(b, items, "cpu")
+    ''', path="minio_tpu/runtime/dispatch.py")
+    found = checkers.check_timeline_flush_pairs(ctx)
+    assert [f.token for f in found] == ["_tl_flush_cb:flush_end"]
+
+
+def test_gl011_paired_routes_and_foreign_paths_ok():
+    src = """
+        _OP_NAME = {"encode": "encode", "sse_xor": "sse_xor"}
+        class DispatchQueue:
+            def _tl_flush_cb(self, b, items, route, lanes=("cpu",)):
+                _tl.record("flush_start", op=b.op)
+                def done(_f):
+                    _tl.record("flush_end", op=b.op)
+                return done
+            def _flush_cpu(self, b, items):
+                tl_done = self._tl_flush_cb(b, items, "cpu")
+            def _flush_device(self, b, items):
+                tl_done = self._tl_flush_cb(b, items, "device",
+                                            self._device_lanes())
+    """
+    assert not checkers.check_timeline_flush_pairs(
+        ctx_for(src, path="minio_tpu/runtime/dispatch.py"))
+    # the same shapes anywhere else are out of scope
+    assert not checkers.check_timeline_flush_pairs(
+        ctx_for("def _flush_cpu(): pass",
+                path="minio_tpu/runtime/other.py"))
+
+
 def test_gl004_wrapper_fed_metric_literals_seen():
     """GL004 recognizes families fed through the obs-shielded
     _metric/_workload wrappers the workload paths use."""

@@ -22,6 +22,10 @@ text — nothing in the checked tree is imported.
 | GL010 | no host hashing / bytes copies on the PUT/GET hot path       |
 |       | outside the sanctioned ``*_fallback`` helpers (zero-copy     |
 |       | pipeline invariant)                                          |
+| GL011 | every dispatch flush route (``_flush_device`` /              |
+|       | ``_flush_cpu``) emits paired flight-recorder flush           |
+|       | start/end events via ``_tl_flush_cb`` (keyed on the          |
+|       | ``_OP_NAME`` registry, like GL006)                           |
 """
 from __future__ import annotations
 
@@ -721,6 +725,89 @@ def check_hot_path_host_copies(ctx: FileCtx) -> list[Finding]:
     return out
 
 
+# --------------------------------------------------------------------------
+# GL011 — dispatch flush routes must emit paired timeline flush events
+
+#: the flush route functions every _OP_NAME op flows through — each
+#: must hand its items the paired flush_start/flush_end callback
+_FLUSH_ROUTES = ("_flush_cpu", "_flush_device")
+#: the sanctioned pairing helper (emits flush_start inline, flush_end
+#: from the last item's done callback)
+_TL_HELPER = "_tl_flush_cb"
+
+
+def check_timeline_flush_pairs(ctx: FileCtx) -> list[Finding]:
+    """GL011: the flight recorder's core invariant — every op registered
+    in ``_OP_NAME`` executes through ``_flush_cpu``/``_flush_device``,
+    so BOTH route functions must obtain the paired timeline callback
+    from ``_tl_flush_cb`` (which itself must emit the ``flush_start``
+    and ``flush_end`` literals). A route that skips the pairing leaves
+    holes in the exported timeline and under-integrates that lane's
+    busy ratio — silently wrong utilization, not a crash, which is why
+    it's a lint and not a test."""
+    if ctx.path != "minio_tpu/runtime/dispatch.py":
+        return []
+    out = []
+    op_names: set[str] = set()
+    helper: ast.FunctionDef | None = None
+    routes: dict[str, ast.FunctionDef] = {}
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Assign) and \
+                any(dotted(t) == "_OP_NAME" for t in node.targets) and \
+                isinstance(node.value, ast.Dict):
+            op_names = {k.value for k in node.value.keys
+                        if isinstance(k, ast.Constant)}
+        elif isinstance(node, ast.FunctionDef):
+            if node.name == _TL_HELPER:
+                helper = node
+            elif node.name in _FLUSH_ROUTES:
+                routes[node.name] = node
+    if not op_names:
+        return []  # no registry: GL006 reports the real problem
+    if helper is None:
+        out.append(Finding(
+            ctx.path, 1, "GL011",
+            f"dispatch has no {_TL_HELPER} helper — the flush routes "
+            "cannot emit paired timeline flush start/end events for "
+            f"the registered ops {sorted(op_names)}",
+            token=_TL_HELPER))
+    else:
+        # only literals passed to record()-shaped calls count — the
+        # helper's DOCSTRING mentions both event names, and a deleted
+        # record("flush_end", ...) must not hide behind it
+        lits: set[str] = set()
+        for n in ast.walk(helper):
+            if isinstance(n, ast.Call) and \
+                    dotted(n.func).rsplit(".", 1)[-1] == "record":
+                lits.update(a.value for a in n.args
+                            if isinstance(a, ast.Constant) and
+                            isinstance(a.value, str))
+        missing = {"flush_start", "flush_end"} - lits
+        if missing:
+            out.append(Finding(
+                ctx.path, helper.lineno, "GL011",
+                f"{_TL_HELPER} does not emit {sorted(missing)} — flush "
+                "pairing is broken for every route that relies on it",
+                token=f"{_TL_HELPER}:{'+'.join(sorted(missing))}",
+                scope=ctx.scope_at(helper.lineno + 1)))
+    for name in _FLUSH_ROUTES:
+        fn = routes.get(name)
+        if fn is None:
+            continue  # a missing route function is not this checker's
+        if any(isinstance(n, ast.Call) and
+               dotted(n.func).rsplit(".", 1)[-1] == _TL_HELPER
+               for n in ast.walk(fn)):
+            continue
+        out.append(Finding(
+            ctx.path, fn.lineno, "GL011",
+            f"flush route {name} never calls {_TL_HELPER} — its "
+            "flushes leave no paired flush_start/flush_end timeline "
+            "events, so the exported timeline has holes and the lane "
+            "busy-ratio under-integrates",
+            token=name, scope=ctx.scope_at(fn.lineno + 1)))
+    return out
+
+
 PER_FILE = [
     check_wall_duration,
     check_blocking_under_lock,
@@ -731,5 +818,6 @@ PER_FILE = [
     check_config_keys_documented,
     check_bare_replace,
     check_hot_path_host_copies,
+    check_timeline_flush_pairs,
 ]
 PROJECT = [check_metrics_documented]
