@@ -846,6 +846,39 @@ def timeline_extras() -> dict:
     }
 
 
+def scale_slo_extra() -> dict:
+    """ISSUE 10: the mixed-workload SLO scale harness (tools/loadgen)
+    as a standing bench extra for BENCH_r07+. Runs the tier-1 profile
+    (1k objects, 64 mixed closed-loop clients + an open-loop arrival
+    ramp, one scanner cycle forced mid-run, an admission overload
+    probe) against a fresh in-process server and ships the verdict
+    report minus its bulky embedded sections — the SLO verdicts,
+    per-class latency/availability and the scanner attribution are the
+    numbers the trajectory tracks. Scale up via MINIO_TPU_SCALE_*."""
+    import tempfile
+
+    from tools.loadgen import Profile, run_tier1_profile
+    profile = Profile(
+        objects=int(os.environ.get("MINIO_TPU_SCALE_OBJECTS", "1000")),
+        clients=int(os.environ.get("MINIO_TPU_SCALE_CLIENTS", "64")),
+        duration_s=float(os.environ.get("MINIO_TPU_SCALE_DURATION",
+                                        "6")),
+        open_rps=float(os.environ.get("MINIO_TPU_SCALE_OPEN_RPS",
+                                      "50")),
+    )
+    with tempfile.TemporaryDirectory(prefix="bench-slo-") as root:
+        rep = run_tier1_profile(root, profile)
+    slim = {k: v for k, v in rep.items()
+            if k not in ("health", "slo", "per_op")}
+    slim["slo_interactive_5m"] = \
+        rep["slo"]["classes"]["interactive"]["windows"]["5m"]
+    slim["slo_breach"] = {
+        cls: ent["breach"] for cls, ent in rep["slo"]["classes"].items()}
+    log(f"scale_slo: {rep['requests_total']} reqs @ {rep['rps']}/s, "
+        f"passed={rep['verdicts']['passed']}")
+    return {"scale_slo": slim}
+
+
 def finish(payload: dict) -> None:
     """Print the one-line result, quiesce framework threads, and exit 0
     deterministically. The axon JAX client's teardown intermittently aborts
@@ -880,6 +913,9 @@ def main() -> None:
     # device workloads (ISSUE 8): Select scan + SSE package crypto
     scan = select_scan_bench(rng)
     sse = sse_put_bench(rng)
+    # mixed-workload SLO scale harness (ISSUE 10) — after the kernel
+    # configs, before the timeline snapshot so its traffic shows there
+    scale = scale_slo_extra()
     # flight-recorder artifacts LAST so the truncated timeline +
     # attribution report cover every config above (ISSUE 9)
     tl = timeline_extras()
@@ -910,6 +946,7 @@ def main() -> None:
                 dev["reconstruct_2loss_16p4_b128"] / cpu_gibs, 2),
             **scan,                  # device workloads A (docs/select.md)
             **sse,                   # device workloads B (docs/sse.md)
+            **scale,      # mixed-workload SLO scale harness (ISSUE 10)
             **tl,     # flight-recorder timeline + attribution (ISSUE 9)
             **extra_chaos,                        # --chaos degraded run
         },

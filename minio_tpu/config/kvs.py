@@ -140,6 +140,44 @@ SUB_SYSTEMS: dict[str, dict[str, KV]] = {
             help="token-bucket refill for bucket/console requests "
                  "(0 = unlimited)"),
     },
+    "slo": {
+        "enable": KV("1", env="MINIO_TPU_SLO",
+                     help="per-class SLO evaluation (obs/slo.py); 0 "
+                          "stops recording outcomes into the SLO "
+                          "windows"),
+        "burn_alert": KV(
+            "14.4", env="MINIO_TPU_SLO_BURN_ALERT",
+            help="error-budget burn-rate factor that (in BOTH the 5m "
+                 "and 1h windows) marks a class in breach — 14.4 is "
+                 "the SRE-workbook page threshold"),
+        "interactive_availability": KV(
+            "99.9", env="MINIO_TPU_SLO_INTERACTIVE_AVAILABILITY",
+            help="percent of interactive requests that must not fail "
+                 "server-side (5xx, incl. admission 503)"),
+        "control_availability": KV(
+            "99.9", env="MINIO_TPU_SLO_CONTROL_AVAILABILITY"),
+        "background_availability": KV(
+            "99.0", env="MINIO_TPU_SLO_BACKGROUND_AVAILABILITY"),
+        "interactive_latency_ms": KV(
+            "", env="MINIO_TPU_SLO_INTERACTIVE_LATENCY_MS",
+            help="latency-SLO threshold; empty = seeded from "
+                 "qos.interactive_budget_ms so the SLO plane and the "
+                 "dispatch scheduler judge 'slow' identically"),
+        "control_latency_ms": KV(
+            "", env="MINIO_TPU_SLO_CONTROL_LATENCY_MS",
+            help="empty = seeded from qos.interactive_budget_ms"),
+        "background_latency_ms": KV(
+            "", env="MINIO_TPU_SLO_BACKGROUND_LATENCY_MS",
+            help="empty = seeded from qos.background_budget_ms"),
+        "interactive_latency_target": KV(
+            "99.0", env="MINIO_TPU_SLO_INTERACTIVE_LATENCY_TARGET",
+            help="percent of good requests that must finish under the "
+                 "class latency threshold"),
+        "control_latency_target": KV(
+            "99.0", env="MINIO_TPU_SLO_CONTROL_LATENCY_TARGET"),
+        "background_latency_target": KV(
+            "95.0", env="MINIO_TPU_SLO_BACKGROUND_LATENCY_TARGET"),
+    },
     "fault": {
         "enable": KV("1", help="honor KVS-armed fault-injection rules"),
         "rules": KV(
@@ -302,7 +340,7 @@ SUB_SYSTEMS: dict[str, dict[str, KV]] = {
 #: config.go:132) — consumers read the registry at call time or register
 #: an apply callback.
 DYNAMIC = {"api", "scanner", "heal", "dispatch", "bitrot", "qos", "fault",
-           "durability", "pipeline", "workloads", "timeline"}
+           "durability", "pipeline", "workloads", "timeline", "slo"}
 
 
 class ConfigSys:

@@ -131,6 +131,13 @@ class PeerRESTClient:
     def background_heal_status(self) -> dict:
         return json.loads(self.rpc.call("backgroundhealstatus"))
 
+    def health_snapshot(self) -> dict:
+        """The peer's node health snapshot (obs/health.node_snapshot):
+        disk states, lane utilization, QoS saturation, heal backlog,
+        SLO verdicts — the admin ``GET /minio/admin/v3/health``
+        aggregation fans this out."""
+        return json.loads(self.rpc.call("healthsnapshot"))
+
 
 def _stream_pubsub(pubsub, timeout_s: float, count: int, to_dict=None):
     """Generator of NDJSON event lines from a live pubsub subscription,
@@ -266,5 +273,10 @@ class PeerRESTService:
             return json.dumps(
                 background_heal_stats(srv) if srv is not None else {}
             ).encode()
+        if method == "healthsnapshot":
+            from ..obs.health import node_snapshot
+            srv = getattr(self.node, "server", None)
+            return json.dumps(
+                node_snapshot(srv) if srv is not None else {}).encode()
         from ..utils import errors
         raise errors.MethodNotSupported(method)

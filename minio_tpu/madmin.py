@@ -162,6 +162,22 @@ class AdminClient:
     def health_info(self) -> dict:
         return self._json("GET", "healthinfo")
 
+    def cluster_health(self, peers: bool = True) -> dict:
+        """Aggregated cluster health snapshot (`GET /minio/admin/v3/
+        health`): per-node disk health states + trip counts, dispatch
+        lane utilization, QoS admission saturation, MRF/heal backlog
+        and SLO verdicts, fanned out across dist peers, plus the
+        cluster rollup. ``peers=False`` keeps it to this node."""
+        return self._json("GET", "health",
+                          None if peers else {"peers": "0"})
+
+    def slo_report(self) -> dict:
+        """The standing per-class SLO verdict report: objectives,
+        5m/1h window compliance, error-budget burn rates, breach
+        verdicts and worst-breach trace links (docs/observability.md
+        "SLO plane & health snapshot")."""
+        return self._json("GET", "slo")
+
     def list_config_history(self) -> list:
         return self._json("GET", "list-config-history")
 

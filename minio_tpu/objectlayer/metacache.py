@@ -588,7 +588,17 @@ class MetacacheStore:
                 while True:
                     if bi < len(st.blocks):
                         break  # a new block appeared: outer loop reads it
-                    if st.pending:
+                    # only entries NEWER than what we already yielded
+                    # count as progress (pending is append-ordered, so
+                    # its last name is its max): a consumer that has
+                    # drained the frontier must WAIT here, not re-copy
+                    # the same entries in a busy spin until the builder
+                    # ends — on a sub-block namespace that spin burned
+                    # ~45k lock acquisitions per listing (9M across one
+                    # scanner cycle at 200 objects) and starved the
+                    # builder it was waiting on
+                    if st.pending and (not marker or
+                                       st.pending[-1][0] > marker):
                         pend = list(st.pending)
                         break
                     if st.ended:

@@ -1,0 +1,140 @@
+"""Cluster health snapshot — the ``mc admin top`` / madmin HealthInfo
+analogue for this runtime, one JSON document answering "is every node
+healthy, are the device lanes busy, is anything burning error budget".
+
+``node_snapshot`` samples ONE node's live planes (no probes, no I/O
+beyond in-memory state):
+
+* disk health tracker states + trip counts (PR 4 ``storage/health.py``),
+* dispatch lane utilization + queue depth from the flight recorder
+  (PR 9 ``obs/timeline.py``),
+* QoS saturation — admission inflight vs capacity, per-class rejects,
+  scheduler spill counters (PR 2),
+* MRF/autoheal backlog (``scanner.background_heal_stats``),
+* scanner cycle progress,
+* the standing SLO verdicts (``obs/slo.py``).
+
+``cluster_snapshot`` merges the local snapshot with every dist peer's
+(``PeerRESTClient.health_snapshot`` — a peer down becomes an ``error``
+row, never a failed call) and rolls the per-node state up into cluster
+verdicts: disks online/faulty, heal backlog, any class in SLO breach.
+Served by ``GET /minio/admin/v3/health`` and
+``madmin.cluster_health()`` (docs/observability.md "SLO plane & health
+snapshot")."""
+from __future__ import annotations
+
+import time
+
+
+def _disk_rows(server) -> list[dict]:
+    from .metrics import _all_disks
+    rows = []
+    for d in _all_disks(server.obj):
+        stats_fn = getattr(d, "health_stats", None)
+        if stats_fn is None:
+            rows.append({"endpoint": d.endpoint(), "state": "untracked"})
+            continue
+        try:
+            rows.append({"endpoint": d.endpoint(), **stats_fn()})
+        except Exception:  # noqa: BLE001 — one disk row must not kill
+            continue      # the snapshot
+    return rows
+
+
+def node_snapshot(server) -> dict:
+    """One node's live health planes as a JSON-able dict."""
+    from . import slo, timeline
+    from ..scanner import background_heal_stats
+    out: dict = {
+        "endpoint": f"{getattr(server, 'address', '')}:"
+                    f"{getattr(server, 'port', 0)}",
+        "ts": time.time(),
+    }
+    disks = _disk_rows(server)
+    out["disks"] = {
+        "rows": disks,
+        "total": len(disks),
+        "faulty": sum(1 for d in disks if d.get("state") == "faulty"),
+        "trips_total": sum(int(d.get("trips", 0)) for d in disks),
+    }
+    util = timeline.utilization()
+    out["lanes"] = util["lanes"]
+    out["queue_depth"] = util["queue_depth"]
+    qos: dict = {}
+    adm = getattr(server, "qos_admission", None)
+    if adm is not None:
+        st = adm.stats()
+        st["saturation"] = round(
+            st["inflight_total"] / max(1, st["max_requests"]), 4)
+        qos["admission"] = st
+    from ..runtime import dispatch as dp
+    if dp._global is not None and getattr(dp._global, "qos",
+                                          None) is not None:
+        qos["scheduler"] = dp._global.qos.stats()
+    out["qos"] = qos
+    out["heal"] = background_heal_stats(server)
+    scanner = getattr(server, "scanner", None)
+    if scanner is not None:
+        out["scanner"] = {
+            "cycle": getattr(scanner, "cycle", 0),
+            "interval_s": getattr(scanner, "interval", 0),
+        }
+    out["slo"] = slo.report()
+    return out
+
+
+def _rollup(nodes: list[dict]) -> dict:
+    """Cluster verdict over every reachable node's snapshot. Disk
+    counts are deduplicated by endpoint: every node's snapshot lists
+    ALL set disks it mounts (local + remote clients share the
+    ``http://host:port/path`` endpoint string), so summing node views
+    would multiply the physical totals by the node count. A disk is
+    faulty cluster-wide when ANY node's view says so; trips come from
+    the owning node's health wrapper (remote views are untracked and
+    report none)."""
+    disks: dict[str, dict] = {}   # endpoint -> merged row
+    heal_backlog = 0
+    breaches: list[dict] = []
+    for n in nodes:
+        if "error" in n:
+            continue
+        for row in n.get("disks", {}).get("rows", []):
+            ep = row.get("endpoint", "")
+            cur = disks.setdefault(ep, {"faulty": False, "trips": 0})
+            if row.get("state") == "faulty":
+                cur["faulty"] = True
+            cur["trips"] = max(cur["trips"], int(row.get("trips", 0)))
+        mrf = n.get("heal", {}).get("mrf", {})
+        heal_backlog += int(mrf.get("queued", 0))
+        for cls, ent in n.get("slo", {}).get("classes", {}).items():
+            for kind, hit in ent.get("breach", {}).items():
+                if hit:
+                    breaches.append({"node": n.get("endpoint", ""),
+                                     "class": cls, "slo": kind})
+    disks_faulty = sum(1 for d in disks.values() if d["faulty"])
+    return {
+        "nodes": len(nodes),
+        "nodes_offline": sum(1 for n in nodes if "error" in n),
+        "disks_total": len(disks),
+        "disks_faulty": disks_faulty,
+        "disk_trips_total": sum(d["trips"] for d in disks.values()),
+        "heal_backlog": heal_backlog,
+        "slo_breaches": breaches,
+        "healthy": disks_faulty == 0 and not breaches and
+        not any("error" in n for n in nodes),
+    }
+
+
+def cluster_snapshot(server, peers: bool = True) -> dict:
+    """The aggregated ``GET /minio/admin/v3/health`` payload: this
+    node's snapshot, every peer's (when ``peers``), and the cluster
+    rollup."""
+    nodes = [node_snapshot(server)]
+    if peers:
+        for peer in getattr(server, "peers", lambda: [])():
+            try:
+                nodes.append(peer.health_snapshot())
+            except Exception as e:  # noqa: BLE001 — peer down: report
+                nodes.append({"endpoint": getattr(peer, "url", ""),
+                              "error": str(e)})
+    return {"cluster": _rollup(nodes), "nodes": nodes}

@@ -752,6 +752,77 @@ def _g_workloads(server) -> list[str]:
     ]
 
 
+def _g_slo(server) -> list[str]:
+    """SLO plane (obs/slo.py, docs/observability.md "SLO plane & health
+    snapshot"): per-class objectives, fast/slow-window compliance and
+    error-budget burn rates, breach verdicts, worst-breach trace link.
+    The cumulative outcome counter
+    (minio_tpu_slo_requests_total{class,outcome}) rides the counter
+    store, incremented at record time."""
+    from . import slo
+    rep = slo.report()
+    if not rep["enabled"]:
+        return ["# TYPE minio_tpu_slo_enabled gauge",
+                "minio_tpu_slo_enabled 0"]
+    lines = [
+        "# TYPE minio_tpu_slo_enabled gauge",
+        "minio_tpu_slo_enabled 1",
+        "# TYPE minio_tpu_slo_availability_objective gauge",
+        "# TYPE minio_tpu_slo_latency_threshold_seconds gauge",
+        "# TYPE minio_tpu_slo_latency_objective gauge",
+        "# TYPE minio_tpu_slo_window_requests gauge",
+        "# TYPE minio_tpu_slo_window_errors gauge",
+        "# TYPE minio_tpu_slo_window_breaches gauge",
+        "# TYPE minio_tpu_slo_availability_ratio gauge",
+        "# TYPE minio_tpu_slo_latency_ratio gauge",
+        "# TYPE minio_tpu_slo_burn_rate gauge",
+        "# TYPE minio_tpu_slo_breach gauge",
+        "# TYPE minio_tpu_slo_worst_breach_seconds gauge",
+    ]
+    for cls, ent in sorted(rep["classes"].items()):
+        lab = f'class="{_esc(cls)}"'
+        obj = ent["objective"]
+        lines += [
+            f"minio_tpu_slo_availability_objective{{{lab}}} "
+            f"{obj['availability']}",
+            f"minio_tpu_slo_latency_threshold_seconds{{{lab}}} "
+            f"{obj['latency_threshold_s']}",
+            f"minio_tpu_slo_latency_objective{{{lab}}} "
+            f"{obj['latency_target']}",
+        ]
+        for win, w in sorted(ent["windows"].items()):
+            wlab = f'{lab},window="{win}"'
+            lines += [
+                f"minio_tpu_slo_window_requests{{{wlab}}} "
+                f"{w['requests']}",
+                f"minio_tpu_slo_window_errors{{{wlab}}} {w['errors']}",
+                f"minio_tpu_slo_window_breaches{{{wlab}}} {w['slow']}",
+                f"minio_tpu_slo_availability_ratio{{{wlab}}} "
+                f"{w['availability']}",
+                f"minio_tpu_slo_latency_ratio{{{wlab}}} "
+                f"{w['latency_ok_ratio']}",
+                f'minio_tpu_slo_burn_rate{{{lab},slo="availability",'
+                f'window="{win}"}} {w["availability_burn"]}',
+                f'minio_tpu_slo_burn_rate{{{lab},slo="latency",'
+                f'window="{win}"}} {w["latency_burn"]}',
+            ]
+        for kind, hit in sorted(ent["breach"].items()):
+            lines.append(
+                f'minio_tpu_slo_breach{{{lab},slo="{kind}"}} '
+                f"{1 if hit else 0}")
+        worst = ent["worst_breach"]
+        if worst["stored"]:
+            # exemplar rule shared with the heal worst gauge: only
+            # trace ids the slow-trace store will actually serve (the
+            # TYPE line lives in the header — per-class emission would
+            # duplicate it when several classes hold a stored breach)
+            lines.append(
+                f"minio_tpu_slo_worst_breach_seconds{{{lab},"
+                f'trace_id="{_esc(worst["trace_id"])}"}} '
+                f"{worst['seconds']}")
+    return lines
+
+
 def _g_locks(server) -> list[str]:
     locker = getattr(server, "local_locker", None)
     if locker is None:
@@ -791,6 +862,9 @@ _GROUPS = [
     MetricsGroup("durability", "node", _g_durability, interval=0),
     # workloads reads config/lane state — interval 0, trivial
     MetricsGroup("workloads", "node", _g_workloads, interval=0),
+    # slo reads in-memory windows — interval 0 so burn rates move on
+    # the very next scrape after an incident starts
+    MetricsGroup("slo", "node", _g_slo, interval=0),
     MetricsGroup("process", "node", _g_process),
     MetricsGroup("locks", "node", _g_locks),
     MetricsGroup("notification", "cluster", _g_notification),

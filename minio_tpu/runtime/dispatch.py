@@ -51,6 +51,7 @@ import numpy as np
 
 from ..obs import latency as _lat
 from ..obs import lockrank as _lr
+from ..obs import slo as _slo
 from ..obs import spans as _sp
 from ..obs import timeline as _tl
 from ..obs import trace as _trc
@@ -411,11 +412,18 @@ class DispatchQueue:
         def _record(_f, t=p.t, op_name=op_name, nbytes=nbytes, cls=cls,
                     tid=tid):
             try:
+                wall = time.monotonic() - t
                 if _f.exception() is not None:
                     # failed ops must not read as kernel throughput —
-                    # same rule the heal_shard window applies
+                    # same rule the heal_shard window applies — but a
+                    # failed background item DOES burn that class's
+                    # availability budget (the request plane feeds the
+                    # interactive/control SLO classes in s3api)
+                    if cls == _qos.CLASS_BACKGROUND:
+                        _slo.record(cls, wall, error=True, trace_id=tid)
                     return
-                wall = time.monotonic() - t
+                if cls == _qos.CLASS_BACKGROUND:
+                    _slo.record(cls, wall, trace_id=tid)
                 _lat.observe("kernel", wall, nbytes, op=op_name,
                              trace_id=tid)
                 _lat.observe("qos", wall, nbytes, trace_id=tid,

@@ -57,13 +57,9 @@ class DataScanner:
         return self
 
     def _loop(self):
-        from .. import qos
         while not self._stop.wait(self.interval):
             try:
-                # scanner work (incl. deep-scan bitrot verifies) is
-                # background class for the QoS dispatch scheduler
-                with qos.background():
-                    self.scan_cycle()
+                self.scan_cycle()
             except Exception as e:  # noqa: BLE001 — scanner must never
                 # die, but also never fail silently (graftlint GL007)
                 from ..obs.logger import log_sys
@@ -75,7 +71,18 @@ class DataScanner:
         """One crawl; returns the usage snapshot (also persisted). Buckets
         untouched since the last sweep (per the update tracker) reuse their
         previous stats instead of re-walking — the bloom-filter skip of
-        cmd/data-update-tracker.go. Deep-scan cycles always walk."""
+        cmd/data-update-tracker.go. Deep-scan cycles always walk.
+
+        Always runs as QoS class ``background`` — applied HERE rather
+        than in the periodic loop so a directly-forced cycle (admin
+        trigger, the loadgen scale harness, tests) gets the same
+        spill-first dispatch treatment as a scheduled one and can never
+        stall interactive traffic by omission."""
+        from .. import qos
+        with qos.background():
+            return self._scan_cycle_inner()
+
+    def _scan_cycle_inner(self) -> dict:
         from ..obs import metrics as mx
         from ..obs import trace as trc
         from .tracker import global_tracker

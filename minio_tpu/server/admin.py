@@ -50,6 +50,22 @@ def _dispatch_admin(h, op: str) -> None:
     if op == "storageinfo":
         return h._send(200, json.dumps(h.s3.obj.storage_info()).encode(),
                        "application/json")
+    if op == "health":
+        # aggregated cluster health snapshot (docs/observability.md
+        # "SLO plane & health snapshot"): per-node disk states, lane
+        # utilization, QoS saturation, heal backlog, SLO verdicts,
+        # fanned out across dist peers; ?peers=0 keeps it local
+        from ..obs.health import cluster_snapshot
+        q = {k: v[0] for k, v in h.query.items()}
+        snap = cluster_snapshot(h.s3, peers=q.get("peers") != "0")
+        return h._send(200, json.dumps(snap).encode(),
+                       "application/json")
+    if op == "slo":
+        # the standing SLO verdict report alone (the health snapshot
+        # embeds the same per-node)
+        from ..obs import slo
+        return h._send(200, json.dumps(slo.report()).encode(),
+                       "application/json")
     if op == "heal" or op.startswith("heal/"):
         return _heal(h, op)
     if op == "datausageinfo":

@@ -1466,7 +1466,8 @@ class _S3Handler(BaseHTTPRequestHandler):
         self._request_id = rid
         root = tok = None
         raw_path, _, raw_query = self.path.partition("?")
-        if sp.enabled() and not self._span_exempt(raw_path, raw_query):
+        span_exempt = self._span_exempt(raw_path, raw_query)
+        if sp.enabled() and not span_exempt:
             root, tok = sp.begin_request(rid)
         t0 = _time.perf_counter()
         release = None
@@ -1539,6 +1540,23 @@ class _S3Handler(BaseHTTPRequestHandler):
                     entry["request_id"] = rid
                     entry["api"] = api_detail
                     log_sys().audit(entry)
+                # SLO plane LAST (it may take the config-registry lock
+                # resolving objectives): admitted-class requests (and
+                # admission 503s) burn their class's error budget;
+                # exempt planes (health/metrics/admin/internal-RPC)
+                # carry no objective so qcls is None for them, and
+                # span-exempt long-polls (trace follows, event
+                # listens) stay out — their duration is CLIENT-chosen,
+                # so every poll would read as a multi-second latency
+                # breach on an idle server (same rule as the per-API
+                # window above, but independent of spans being on)
+                qcls = getattr(self, "_qos_class", None)
+                if qcls is not None and not span_exempt:
+                    from ..obs import slo as _slo
+                    _slo.record(
+                        qcls, dur, status=status,
+                        trace_id=rid if root is not None and
+                        root.sampled else "")
             except Exception:  # noqa: BLE001 — obs must never break serving
                 pass
             if root is not None:

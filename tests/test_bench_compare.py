@@ -1,0 +1,114 @@
+"""tools/bench_compare: flatten/direction/regression semantics and the
+CLI exit-code contract (ISSUE 10 satellite)."""
+import json
+
+import pytest
+
+from tools.bench_compare import compare, direction, flatten, main, render
+
+
+def test_flatten_numeric_leaves_only():
+    doc = {"value": 179.0, "extra": {"a_gibs": 2.5, "note": "text",
+                                     "ok": True, "list": [1, 2]},
+           "nested": {"deep": {"p99_s": 0.02}}}
+    flat = flatten(doc)
+    assert flat["value"] == 179.0
+    assert flat["extra.a_gibs"] == 2.5
+    assert flat["nested.deep.p99_s"] == 0.02
+    assert flat["extra.list[0]"] == 1.0
+    assert "extra.note" not in flat
+    assert "extra.ok" not in flat  # bools are not trajectories
+
+
+def test_direction_classification():
+    assert direction("extra.e2e_put_gibs") == "up"
+    assert direction("value") == "up"
+    assert direction("extra.scale_slo.rps") == "up"
+    assert direction("extra.heal_shard_latency.p99_s") == "down"
+    assert direction("extra.timeline_overhead.record_ns_on") == "down"
+    # last segment decides: latency under a gibs-named parent
+    assert direction("encode_gibs.p50_ms") == "down"
+    assert direction("extra.host.cpu_count") == ""
+    # burn rates are ALWAYS lower-better, even though 'availability'
+    # alone is higher-better; compliance ratios are higher-better even
+    # though 'latency' alone is lower-better (the scale_slo extras
+    # ship both shapes)
+    assert direction("slo_interactive_5m.availability_burn") == "down"
+    assert direction("slo_interactive_5m.latency_burn") == "down"
+    assert direction("slo_interactive_5m.latency_ok_ratio") == "up"
+    assert direction("slo_interactive_5m.availability") == "up"
+    # config/setup leaves describe the run, they are not trajectories:
+    # scaling the harness (MINIO_TPU_SCALE_DURATION) must not exit 1
+    assert direction("scale_slo.profile.duration_s") == ""
+    assert direction("scale_slo.preload_s") == ""
+    assert direction("scale_slo.wall_s") == ""
+
+
+def test_regression_flags_both_directions():
+    old = {"put_gibs": 10.0, "p99_s": 1.0, "cpu_count": 8}
+    # throughput -20% and latency +50%: both flagged
+    new = {"put_gibs": 8.0, "p99_s": 1.5, "cpu_count": 4}
+    rows = {r["path"]: r for r in compare(old, new)}
+    assert rows["put_gibs"]["regression"] is True
+    assert rows["put_gibs"]["delta_pct"] == -20.0
+    assert rows["p99_s"]["regression"] is True
+    # non-headline metrics never flag, whatever they do
+    assert rows["cpu_count"]["regression"] is False
+
+
+def test_improvements_and_small_moves_pass():
+    old = {"put_gibs": 10.0, "p99_s": 1.0}
+    new = {"put_gibs": 10.5, "p99_s": 0.5}      # both improved
+    assert not any(r["regression"] for r in compare(old, new))
+    new = {"put_gibs": 9.5, "p99_s": 1.05}      # within 10%
+    assert not any(r["regression"] for r in compare(old, new))
+    # custom threshold tightens the gate
+    assert any(r["regression"] for r in compare(old, new,
+                                                threshold_pct=2.0))
+
+
+def test_missing_metrics_reported_not_flagged():
+    rows = {r["path"]: r
+            for r in compare({"old_only_gibs": 1.0},
+                             {"new_only_gibs": 2.0})}
+    assert rows["old_only_gibs"]["new"] is None
+    assert rows["new_only_gibs"]["old"] is None
+    assert not any(r["regression"] for r in rows.values())
+    text = render(list(rows.values()))
+    assert "gone" in text and "new" in text
+
+
+def test_cli_exit_codes(tmp_path, capsys):
+    a = tmp_path / "a.json"
+    b = tmp_path / "b.json"
+    a.write_text(json.dumps({"value": 100.0,
+                             "extra": {"e2e_put_gibs": 0.34}}))
+    # clean diff: exit 0
+    b.write_text(json.dumps({"value": 101.0,
+                             "extra": {"e2e_put_gibs": 0.36}}))
+    assert main([str(a), str(b)]) == 0
+    out = capsys.readouterr().out
+    assert "0 regression(s)" in out
+    # >10% headline drop: exit 1 + the row is flagged
+    b.write_text(json.dumps({"value": 80.0,
+                             "extra": {"e2e_put_gibs": 0.36}}))
+    assert main([str(a), str(b)]) == 1
+    out = capsys.readouterr().out
+    assert "REGRESSION" in out and "value" in out
+    # --json emits machine-readable rows
+    assert main([str(a), str(b), "--json"]) == 1
+    rows = json.loads(capsys.readouterr().out)
+    assert any(r["regression"] and r["path"] == "value" for r in rows)
+
+
+@pytest.mark.parametrize("rel", ["BENCH_r04.json", "BENCH_r05.json"])
+def test_real_bench_artifacts_flatten(rel):
+    """The checked-in trajectory files parse and flatten (the tool must
+    keep working against the real artifact shape)."""
+    import os
+    path = os.path.join(os.path.dirname(__file__), "..", rel)
+    with open(path, encoding="utf-8") as f:
+        doc = json.load(f)
+    flat = flatten(doc)
+    assert flat, rel
+    assert any(direction(p) == "up" for p in flat)

@@ -425,3 +425,36 @@ def test_admin_peer_aggregation(cluster):
     assert "locks" in locks
     heal = adm._json("GET", "bg-heal-status")
     assert isinstance(heal, dict)
+
+
+def test_cluster_health_snapshot(cluster):
+    """`GET /minio/admin/v3/health` aggregates the node health snapshot
+    (disk states, lane utilization, QoS saturation, heal backlog, SLO
+    verdicts) across dist peers, plus the cluster rollup (ISSUE 10
+    acceptance: the >=2-node aggregated snapshot)."""
+    n0, n1 = cluster
+    from minio_tpu.madmin import AdminClient
+    adm = AdminClient(f"http://127.0.0.1:{n0.server.port}", AK, SK)
+    h = adm.cluster_health()
+    assert h["cluster"]["nodes"] >= 2, h["cluster"]
+    assert h["cluster"]["nodes_offline"] == 0
+    # each node's SNAPSHOT lists all 6 set disks it mounts (3 local +
+    # 3 remote clients), but the rollup dedupes by endpoint — the
+    # cluster has 6 physical disks, not 2 x 6 node views
+    assert h["cluster"]["disks_total"] == 6
+    assert all(n["disks"]["total"] == 6 for n in h["nodes"])
+    assert isinstance(h["cluster"]["healthy"], bool)
+    endpoints = {n.get("endpoint") for n in h["nodes"]}
+    assert len(endpoints) >= 2, endpoints
+    for node in h["nodes"]:
+        # every reachable node row carries the full plane set
+        assert "disks" in node and "qos" in node and "slo" in node
+        assert set(node["slo"]["classes"]) == {
+            "interactive", "control", "background"}
+    # ?peers=0 keeps it to the answering node
+    local = adm.cluster_health(peers=False)
+    assert local["cluster"]["nodes"] == 1
+    # the peer RPC serves the same snapshot shape directly
+    peer = n0.peers[0]
+    snap = peer.health_snapshot()
+    assert "disks" in snap and "slo" in snap

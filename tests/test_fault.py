@@ -177,11 +177,52 @@ def test_flaky_disk_reads_stay_correct(tmp_path):
 # --- hedged reads beat the injected straggler (acceptance criterion) --------
 
 
+def test_hedged_get_fires_deterministic(tmp_path, monkeypatch):
+    """Load-insensitive tier-1 hedging gate (ISSUE 10 satellite: the
+    3x-statistics variant below flaked under suite load since PR 9 —
+    its run-to-run medians swing 2x on a saturated host). This variant
+    is deterministic: with a FIVE-second delay injected on one data
+    shard and a 15 ms hedge threshold, the GET returning correct bytes
+    in under 4 s is only possible when the hedged parity read rescued
+    it — no distribution comparison, just an outcome the scheduler
+    cannot fake. The timing margin is 300x the hedge threshold, so CI
+    noise cannot flip it; a broken hedge path waits out the full 5 s
+    and fails both asserts."""
+    from minio_tpu.obs.metrics import counters_snapshot
+    ol = _layer(tmp_path)
+    body = _body()
+    ol.put_object("b", "o", io.BytesIO(body), len(body))
+    d = _shard_disk(ol, "o", 1)
+    # warm the GET path (jit/pool costs stay out of the gated read)
+    assert ol.get_object_bytes("b", "o") == body
+    monkeypatch.setenv("MINIO_TPU_HEDGE_MS", "15")
+
+    def fired() -> float:
+        return sum(v for k, v in counters_snapshot().items()
+                   if "minio_tpu_hedged_reads_total" in k
+                   and "fired" in k)
+
+    before = fired()
+    fault.arm(f"disk:{d.endpoint()}:read_at:delay(5000)")
+    try:
+        t0 = time.perf_counter()
+        assert ol.get_object_bytes("b", "o") == body
+        wall = time.perf_counter() - t0
+    finally:
+        fault.clear()
+    assert wall < 4.0, \
+        f"GET took {wall:.2f}s: the hedge did not rescue the read"
+    assert fired() > before
+
+
+@pytest.mark.slow
 def test_hedged_get_p99_beats_straggler_3x(tmp_path, monkeypatch):
     """delay(200ms) on ONE data shard: 1 MiB GET p99 with hedging is
     >= 3x better than without (the unhedged path must wait out the
     injected delay every time; the hedged path pays ~threshold +
-    reconstruct)."""
+    reconstruct). Timing-distribution statistics are load-sensitive on
+    a saturated CI host, so this runs outside tier-1 (`slow`); the
+    deterministic variant above keeps the tier-1 gate."""
     ol = _layer(tmp_path)
     body = _body()
     ol.put_object("b", "o", io.BytesIO(body), len(body))

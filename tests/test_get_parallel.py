@@ -121,3 +121,74 @@ def test_parallel_get_no_collapse(k, m):
             f"(per-round: {detail}; {nbytes:.2f} GiB per round)")
     finally:
         shutil.rmtree(root, ignore_errors=True)
+
+
+def test_small_geometry_get_par8_outlier_pinned():
+    """ISSUE 10 satellite: the BENCH_r05 `4p2 get_par8 = 0.17 GiB/s`
+    outlier (16p4 got 0.53 in the same run). Investigation (PR 7 and
+    re-confirmed here): the r05 artifact was measured at the round-5
+    SEED, before PR 2's parallel-GET fixes landed — the root cause is
+    not in-tree, and the parametrized gate above already holds 4+2 to
+    >= 0.8x serial at bench-sized objects. This variant pins the SMALL
+    geometry at a light weight tier-1 can always afford (8 x 4 MiB,
+    one round of interleaved serial/parallel pairs, best-of-rounds):
+    a genuine small-geometry concurrency collapse (the 3x shape r05
+    recorded) fails every round; CI noise cannot, because the gate
+    takes the best ratio."""
+    from minio_tpu.objectlayer import ErasureObjects
+    from minio_tpu.storage import XLStorage
+    k, m = 4, 2
+    obj_size = 4 << 20
+    rng = np.random.default_rng(11)
+    body = rng.integers(0, 256, obj_size, dtype=np.uint8).tobytes()
+    root = tempfile.mkdtemp(prefix="getpar-small-", dir=_bench_dir())
+    try:
+        disks = [XLStorage(os.path.join(root, f"d{i}"))
+                 for i in range(k + m)]
+        ol = ErasureObjects(disks, default_parity=m)
+        ol.make_bucket("b")
+        for j in range(N_OBJECTS):
+            ol.put_object("b", f"s{j}", io.BytesIO(body), obj_size)
+
+        def read_all_serial() -> float:
+            t0 = time.perf_counter()
+            for j in range(N_OBJECTS):
+                assert ol.get_object_buffer("b", f"s{j}") == body
+            return time.perf_counter() - t0
+
+        def read_all_parallel() -> float:
+            errs: list = []
+
+            def guard(j):
+                try:
+                    assert ol.get_object_buffer("b", f"s{j}") == body
+                except BaseException as e:  # noqa: BLE001
+                    errs.append(e)
+
+            ths = [threading.Thread(target=guard, args=(j,))
+                   for j in range(N_OBJECTS)]
+            t0 = time.perf_counter()
+            for t in ths:
+                t.start()
+            for t in ths:
+                t.join()
+            if errs:
+                raise errs[0]
+            return time.perf_counter() - t0
+
+        read_all_serial()      # warm pools/caches outside timed rounds
+        read_all_parallel()
+        ratios = []
+        for _ in range(3):
+            s = read_all_serial()
+            p = read_all_parallel()
+            ratios.append(s / p)
+        best = max(ratios)
+        detail = ", ".join(f"{r:.2f}" for r in ratios)
+        # the r05 outlier shape was ~0.3x; a healthy tree holds >= 0.8x
+        assert best >= MIN_RATIO, (
+            f"small-geometry {k}+{m} parallel-GET collapse: best "
+            f"par/serial ratio = {best:.2f} < {MIN_RATIO} "
+            f"(per-round: {detail})")
+    finally:
+        shutil.rmtree(root, ignore_errors=True)

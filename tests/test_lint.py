@@ -526,3 +526,73 @@ def test_real_baseline_file_is_sorted():
     doc = json.loads(open(graftlint.BASELINE_PATH).read())
     keys = [e["key"] for e in doc["findings"]]
     assert keys == sorted(keys)
+
+
+# --------------------------------------------------------------------------
+# GL012 — the SLO plane's method contract (ISSUE 10)
+
+
+def test_gl012_ad_hoc_percentile_math_flagged():
+    ctx = ctx_for("""
+        import statistics
+        from .latency import Window
+        CLASSES = ("interactive",)
+        def evaluate(samples):
+            return statistics.quantiles(samples, n=100)[98]
+    """, path="minio_tpu/obs/slo.py")
+    found = checkers.check_slo_plane(ctx)
+    assert any(f.token == "statistics.quantiles" for f in found)
+    assert all(f.checker == "GL012" for f in found)
+    # numpy spellings too
+    ctx = ctx_for("""
+        import numpy as np
+        from .latency import Window
+        CLASSES = ("interactive",)
+        def evaluate(samples):
+            return np.percentile(samples, 99)
+    """, path="minio_tpu/obs/slo.py")
+    assert any(f.token == "np.percentile"
+               for f in checkers.check_slo_plane(ctx))
+
+
+def test_gl012_window_shadow_and_missing_import_flagged():
+    ctx = ctx_for("""
+        CLASSES = ("interactive",)
+        class Window:
+            pass
+        def cell():
+            return Window()
+    """, path="minio_tpu/obs/slo.py")
+    tokens = {f.token for f in checkers.check_slo_plane(ctx)}
+    assert "Window" in tokens           # local shadow
+    assert "Window-import" in tokens    # Window() without .latency import
+
+
+def test_gl012_undocumented_class_and_missing_registry_flagged():
+    ctx = ctx_for("""
+        from .latency import Window
+        CLASSES = ("interactive", "totally-undocumented-class")
+    """, path="minio_tpu/obs/slo.py")
+    found = checkers.check_slo_plane(ctx)
+    assert [f.token for f in found] == ["totally-undocumented-class"]
+    # no CLASSES tuple at all: the taxonomy must be greppable
+    ctx = ctx_for("from .latency import Window",
+                  path="minio_tpu/obs/slo.py")
+    assert [f.token for f in checkers.check_slo_plane(ctx)] == \
+        ["CLASSES"]
+
+
+def test_gl012_real_module_and_foreign_paths_clean():
+    # the REAL obs/slo.py parses clean (CLASSES documented, windows
+    # from obs/latency)
+    real = graftlint.parse_file(os.path.join(
+        graftlint.REPO_ROOT, "minio_tpu", "obs", "slo.py"))
+    assert real is not None
+    assert not checkers.check_slo_plane(real)
+    # the same smells anywhere else are out of scope for GL012
+    ctx = ctx_for("""
+        import statistics
+        def pct(samples):
+            return statistics.quantiles(samples, n=100)
+    """, path="minio_tpu/obs/other.py")
+    assert not checkers.check_slo_plane(ctx)

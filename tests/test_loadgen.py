@@ -1,0 +1,53 @@
+"""Scale harness acceptance (ISSUE 10): the scaled-down tier-1 profile
+— >=1k objects, >=64 concurrent mixed GET/PUT/LIST/DELETE clients
+against a live in-process server, one scanner cycle forced mid-run —
+completes with an SLO verdict report showing interactive availability
+>= 99%, 503s carrying Retry-After, no hot-path SLO breach attributable
+to the scanner cycle, and the burn-rate family live on
+/minio/v2/metrics."""
+import json
+
+from tools.loadgen import Profile, run_tier1_profile
+
+
+def test_scale_slo_tier1_profile(tmp_path):
+    profile = Profile.tier1()
+    assert profile.objects >= 1000
+    assert profile.clients >= 64
+    report = run_tier1_profile(str(tmp_path), profile)
+    v = report["verdicts"]
+    # interactive-class availability >= 99% ...
+    inter = report["per_class"]["interactive"]
+    assert inter["availability"] >= 0.99, inter
+    assert v["interactive_availability_ok"], inter
+    # ... with 503s carrying Retry-After (the overload probe guarantees
+    # the contract is exercised every run)
+    assert v["overload_probe_fired"], report["overload_probe"]
+    assert report["overload_probe"]["retry_after_ok"], \
+        report["overload_probe"]
+    assert v["retry_after_on_503"], report
+    # zero hot-path SLO breach attributable to the scanner cycle,
+    # with the cycle actually overlapping the measured run
+    assert report["scanner"], "scanner cycle did not run"
+    assert report["scanner"]["window"]["start_s"] < \
+        profile.duration_s, report["scanner"]["window"]
+    assert not report["scanner"]["attributable_breach"], \
+        report["scanner"]
+    assert v["scanner_no_hot_path_breach"]
+    # lockrank + qos-class evidence rode along
+    assert v["lockrank_clean"]
+    assert report["qos_evidence"].get("admitted", {}).get(
+        "interactive", 0) > 0, report["qos_evidence"]
+    assert report["qos_evidence"]["scanner_cycles"], \
+        report["qos_evidence"]
+    # burn-rate metrics live on /minio/v2/metrics
+    assert v["burn_rate_metrics_live"]
+    # the embedded SLO report measured this run
+    w = report["slo"]["classes"]["interactive"]["windows"]["5m"]
+    assert w["requests"] > 0
+    assert report["requests_total"] > 100
+    # health snapshot embedded and the whole report JSON-serializable
+    # (bench.py ships it as the scale_slo extra)
+    assert report["health"]["cluster"]["nodes"] == 1
+    json.dumps(report)
+    assert v["passed"], v

@@ -237,11 +237,22 @@ def test_audit_entries_join_traces(c):
     """Audit entries carry trace_id/request_id + status/duration and
     mirror into the admin console plane on their own ring (flood-
     isolated from error-log history)."""
+    import time as _t
+
     from minio_tpu.obs.logger import log_sys
     c.put_bucket("audb")
     rid = c.put_object("audb", "o", b"z").headers["x-amz-request-id"]
-    ent = next(e for e in list(log_sys().audit_ring)
-               if e.get("trace_id") == rid)
+    # the audit entry lands in the handler's finally AFTER the response
+    # is on the wire — poll briefly instead of racing the server thread
+    # (loses only on a saturated suite host, but loses for real)
+    ent = None
+    deadline = _t.monotonic() + 5.0
+    while ent is None and _t.monotonic() < deadline:
+        ent = next((e for e in list(log_sys().audit_ring)
+                    if e.get("trace_id") == rid), None)
+        if ent is None:
+            _t.sleep(0.02)
+    assert ent is not None, "audit entry never appeared"
     assert ent["type"] == "audit"
     assert ent["request_id"] == rid
     assert ent["status"] == 200

@@ -1,0 +1,162 @@
+"""Perf-trajectory diff: compare two ``BENCH_r0x.json`` artifacts and
+print per-metric deltas with regression flags (ISSUE 10 satellite —
+CI-usable: a >10% drop on any headline metric exits nonzero).
+
+The bench payload is a nested dict of numeric leaves; this tool
+flattens both files into dotted paths, pairs them, and judges each pair
+by direction:
+
+* **higher-better** — throughput-shaped names (``*gibs*``, ``*rps*``,
+  the top-level ``value``, ``*availability*``, ``*ratio*``),
+* **lower-better** — latency/overhead-shaped names (``*p50*``/
+  ``*p95*``/``*p99*``, ``*latency*``, ``*_ms``/``*_s``/``*seconds*``,
+  ``*overhead*``, ``*_ns*``),
+* everything else is informational (printed with ``--all``, never
+  flagged).
+
+Only **headline** metrics gate: the throughput/latency families above.
+A metric present in one file only is reported but never fails the diff
+(bench extras grow PR over PR by design).
+
+Run::
+
+    python -m tools.bench_compare BENCH_r05.json BENCH_r06.json
+    python -m tools.bench_compare old.json new.json --threshold 5 --all
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import re
+
+#: name patterns that make a metric a gating headline, by direction.
+#: Precedence (direction() checks in this order): burn rates are
+#: ALWAYS lower-better (an "availability_burn" going up is budget
+#: vanishing), compliance ratios/throughput are higher-better even
+#: when 'latency' appears in the name ("latency_ok_ratio"), and the
+#: latency/overhead shapes are lower-better.
+#: configuration/setup leaves that merely DESCRIBE the run — never
+#: headline metrics, whatever their suffix looks like (duration_s is a
+#: knob, preload/wall scale with the configured object count)
+NON_HEADLINE = {"duration_s", "ramp_s", "preload_s", "wall_s",
+                "interval_s", "timeout_s", "ttl_s", "expiry_s",
+                "value_bytes", "objects", "clients", "open_rps"}
+BURN = re.compile(r"burn", re.IGNORECASE)
+HIGHER_BETTER = re.compile(
+    r"(gibs|rps|availability|_ratio|^value$|requests_total)",
+    re.IGNORECASE)
+LOWER_BETTER = re.compile(
+    r"(p50|p95|p99|latency|overhead|_ms$|_ns|seconds|_s$)",
+    re.IGNORECASE)
+
+#: default regression threshold: a >10% move in the bad direction flags
+DEFAULT_THRESHOLD_PCT = 10.0
+
+
+def flatten(doc, prefix: str = "") -> dict[str, float]:
+    """Numeric leaves of a nested dict/list as {dotted.path: value}.
+    Booleans are skipped (verdict flags are not trajectories)."""
+    out: dict[str, float] = {}
+    if isinstance(doc, dict):
+        for k, v in doc.items():
+            out.update(flatten(v, f"{prefix}.{k}" if prefix else str(k)))
+    elif isinstance(doc, list):
+        for i, v in enumerate(doc):
+            out.update(flatten(v, f"{prefix}[{i}]"))
+    elif isinstance(doc, (int, float)) and not isinstance(doc, bool):
+        out[prefix] = float(doc)
+    return out
+
+
+def direction(path: str) -> str:
+    """'up' (higher better), 'down' (lower better) or '' (not a
+    headline). The LAST path segment decides — a latency block nested
+    under a throughput-named parent is still a latency."""
+    leaf = path.rsplit(".", 1)[-1]
+    if leaf in NON_HEADLINE:
+        return ""
+    if BURN.search(leaf):
+        return "down"
+    if HIGHER_BETTER.search(leaf):
+        return "up"
+    if LOWER_BETTER.search(leaf):
+        return "down"
+    return ""
+
+
+def compare(old: dict, new: dict,
+            threshold_pct: float = DEFAULT_THRESHOLD_PCT) -> list[dict]:
+    """Row per metric present in either flattened file:
+    {path, old, new, delta_pct, direction, regression}. Sorted with
+    regressions first, then by |delta| descending."""
+    fo, fn = flatten(old), flatten(new)
+    rows: list[dict] = []
+    for path in sorted(set(fo) | set(fn)):
+        o, n = fo.get(path), fn.get(path)
+        d = direction(path)
+        row = {"path": path, "old": o, "new": n, "direction": d,
+               "delta_pct": None, "regression": False}
+        if o is not None and n is not None and o != 0:
+            delta = (n - o) / abs(o) * 100.0
+            row["delta_pct"] = round(delta, 2)
+            if d == "up":
+                row["regression"] = delta < -threshold_pct
+            elif d == "down":
+                row["regression"] = delta > threshold_pct
+        rows.append(row)
+    rows.sort(key=lambda r: (not r["regression"],
+                             -abs(r["delta_pct"] or 0.0)))
+    return rows
+
+
+def render(rows: list[dict], show_all: bool = False) -> str:
+    """Human/CI table: headline rows (and missing-side rows) by
+    default, everything with ``show_all``."""
+    out = [f"{'metric':<58} {'old':>12} {'new':>12} {'delta':>9}  flag"]
+    shown = 0
+    for r in rows:
+        if not show_all and not r["direction"] and not r["regression"]:
+            continue
+        flag = "REGRESSION" if r["regression"] else (
+            "new" if r["old"] is None else
+            "gone" if r["new"] is None else "")
+        delta = f"{r['delta_pct']:+.1f}%" if r["delta_pct"] is not None \
+            else "-"
+        fmt = lambda v: f"{v:.4g}" if v is not None else "-"  # noqa: E731
+        out.append(f"{r['path']:<58} {fmt(r['old']):>12} "
+                   f"{fmt(r['new']):>12} {delta:>9}  {flag}")
+        shown += 1
+    regressions = sum(1 for r in rows if r["regression"])
+    out.append(f"-- {shown} rows shown, {len(rows)} compared, "
+               f"{regressions} regression(s)")
+    return "\n".join(out)
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        description="diff two BENCH_r0x.json files; nonzero exit on a "
+                    ">threshold%% drop of any headline metric")
+    ap.add_argument("old")
+    ap.add_argument("new")
+    ap.add_argument("--threshold", type=float,
+                    default=DEFAULT_THRESHOLD_PCT,
+                    help="regression threshold in percent (default 10)")
+    ap.add_argument("--all", action="store_true",
+                    help="print non-headline rows too")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable rows instead of the table")
+    args = ap.parse_args(argv)
+    with open(args.old, encoding="utf-8") as f:
+        old = json.load(f)
+    with open(args.new, encoding="utf-8") as f:
+        new = json.load(f)
+    rows = compare(old, new, args.threshold)
+    if args.json:
+        print(json.dumps(rows, indent=1))
+    else:
+        print(render(rows, show_all=args.all))
+    return 1 if any(r["regression"] for r in rows) else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

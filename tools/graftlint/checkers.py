@@ -1,4 +1,4 @@
-"""The graftlint checkers (GL001-GL010).
+"""The graftlint checkers (GL001-GL012).
 
 Each per-file checker takes a ``FileCtx`` and yields ``Finding``s; the
 project-wide checkers take the full list of parsed files (cross-file
@@ -26,6 +26,11 @@ text — nothing in the checked tree is imported.
 |       | ``_flush_cpu``) emits paired flight-recorder flush           |
 |       | start/end events via ``_tl_flush_cb`` (keyed on the          |
 |       | ``_OP_NAME`` registry, like GL006)                           |
+| GL012 | the SLO plane's contract: every objective class in           |
+|       | ``obs/slo.py``'s ``CLASSES`` appears in                      |
+|       | docs/observability.md, and every SLO-evaluated window        |
+|       | comes from ``obs/latency.Window`` — no ad-hoc percentile     |
+|       | math (statistics/numpy quantiles, local Window shadows)      |
 """
 from __future__ import annotations
 
@@ -808,6 +813,97 @@ def check_timeline_flush_pairs(ctx: FileCtx) -> list[Finding]:
     return out
 
 
+# --------------------------------------------------------------------------
+# GL012 — the SLO plane's method contract
+
+#: the one module that evaluates SLOs
+_SLO_MODULE = "minio_tpu/obs/slo.py"
+#: call names that smell like ad-hoc percentile math — SLO evaluation
+#: must ride obs/latency.Window so the method can never diverge from
+#: every other online percentile in the tree. Matching is by call LEAF
+#: name (`statistics.quantiles`, `np.percentile`, a local `median`
+#: helper) — flagging every statistics/numpy call would be broader
+#: than the documented contract and fail unrelated math.
+_PERCENTILE_CALLS = {"quantiles", "quantile", "percentile", "median",
+                     "median_low", "median_high", "nanpercentile",
+                     "nanquantile"}
+
+
+def check_slo_plane(ctx: FileCtx) -> list[Finding]:
+    """GL012: (a) every objective class name in ``CLASSES`` must appear
+    in docs/observability.md — the SLO taxonomy is operator-facing and
+    an undocumented class renders as unexplained metric labels; (b) the
+    module must take its windows from ``obs/latency.Window`` (imported
+    from ``.latency``) and must not shadow it or compute percentiles
+    with statistics/numpy helpers — two percentile methods in one tree
+    means the SLO verdict and the latency metrics can disagree about
+    the same request."""
+    if ctx.path != _SLO_MODULE:
+        return []
+    out = []
+    classes: list[tuple[str, int]] = []
+    imports_latency_window = False
+    calls_window = None
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Assign) and \
+                any(dotted(t) == "CLASSES" for t in node.targets) and \
+                isinstance(node.value, (ast.Tuple, ast.List)):
+            classes = [(e.value, e.lineno) for e in node.value.elts
+                       if isinstance(e, ast.Constant) and
+                       isinstance(e.value, str)]
+        elif isinstance(node, ast.ImportFrom):
+            if (node.module or "").split(".")[-1] == "latency" and \
+                    any(a.name == "Window" for a in node.names):
+                imports_latency_window = True
+        elif isinstance(node, ast.ClassDef) and node.name == "Window":
+            out.append(Finding(
+                ctx.path, node.lineno, "GL012",
+                "local class Window shadows obs/latency.Window — SLO "
+                "windows must be the shared sliding-window histogram, "
+                "not a lookalike",
+                token="Window", scope=ctx.scope_at(node.lineno)))
+        elif isinstance(node, ast.Call):
+            fn = dotted(node.func)
+            leaf = fn.rsplit(".", 1)[-1]
+            if leaf in _PERCENTILE_CALLS:
+                out.append(Finding(
+                    ctx.path, node.lineno, "GL012",
+                    f"ad-hoc percentile math ({fn}) in the SLO plane — "
+                    "evaluate from obs/latency.Window so the SLO "
+                    "verdict and the latency metrics share one method",
+                    token=fn, scope=ctx.scope_at(node.lineno)))
+            elif fn == "Window" and calls_window is None:
+                calls_window = node.lineno
+    if not classes:
+        out.append(Finding(
+            ctx.path, 1, "GL012",
+            "obs/slo.py declares no module-level CLASSES tuple — the "
+            "objective taxonomy must be a greppable literal",
+            token="CLASSES"))
+    else:
+        doc_path = os.path.join(REPO_ROOT, "docs", "observability.md")
+        try:
+            with open(doc_path, encoding="utf-8") as f:
+                doc = f.read()
+        except OSError:
+            doc = ""
+        for name, line in classes:
+            if name not in doc:
+                out.append(Finding(
+                    ctx.path, line, "GL012",
+                    f"SLO objective class {name!r} is not documented "
+                    "in docs/observability.md",
+                    token=name, scope=ctx.scope_at(line)))
+    if calls_window is not None and not imports_latency_window:
+        out.append(Finding(
+            ctx.path, calls_window, "GL012",
+            "Window(...) used without importing Window from "
+            ".latency — SLO windows must come from obs/latency.py",
+            token="Window-import",
+            scope=ctx.scope_at(calls_window)))
+    return out
+
+
 PER_FILE = [
     check_wall_duration,
     check_blocking_under_lock,
@@ -819,5 +915,6 @@ PER_FILE = [
     check_bare_replace,
     check_hot_path_host_copies,
     check_timeline_flush_pairs,
+    check_slo_plane,
 ]
 PROJECT = [check_metrics_documented]

@@ -1,0 +1,635 @@
+"""Mixed-workload scale harness — closed+open-loop GET/PUT/LIST/DELETE
+load against a LIVE server, reported as SLO evidence (ROADMAP item 5:
+"thousands of concurrent mixed clients ... scanner/heal cycles provably
+never stalling the hot path, reported as evidence rather than vibes").
+
+Two load shapes compose:
+
+* **Closed loop** — ``clients`` worker threads, each a keep-alive
+  SigV4-signing session issuing one request after another (think-time
+  zero). Concurrency is the control variable; throughput is measured.
+* **Open loop** — a Poisson-ish arrival generator ramping from 0 to
+  ``open_rps`` over ``ramp_s`` and dispatching one-shot requests onto a
+  bounded executor. Arrival rate is the control variable; queueing is
+  measured. Thousands of *virtual clients* are modeled by the arrival
+  process, not by a thread each.
+
+Mid-run the harness forces one data-scanner cycle (always QoS class
+``background`` — the scanner applies it internally) and, after the
+measured phase, runs a small deliberate **overload probe** (admission
+capacity pinched to 1 for a burst) so the 503 SlowDown + ``Retry-After``
+contract is exercised on every run, not only on lucky ones.
+
+The report is the deliverable: per-op/per-class achieved throughput and
+latency percentiles, every 503's Retry-After compliance, the scanner
+window's hot-path impact vs the surrounding baseline (plus the QoS
+class-counter and lockrank evidence), the server's standing SLO verdict
+(``obs/slo.py``), the cluster health snapshot, and a ``verdicts`` block
+whose ``passed`` gates CI. ``bench.py`` embeds a run as the
+``scale_slo`` extra for BENCH_r07+; ``tests/test_loadgen.py`` runs the
+scaled-down tier-1 profile from ISSUE 10's acceptance criteria.
+
+Run standalone::
+
+    python -m tools.loadgen --objects 1000 --clients 64 --duration 6
+"""
+from __future__ import annotations
+
+import argparse
+import io
+import json
+import random
+import threading
+import time
+import urllib.parse
+from concurrent.futures import ThreadPoolExecutor
+from dataclasses import dataclass, field
+
+DEFAULT_MIX = {"get": 0.60, "put": 0.25, "list": 0.10, "delete": 0.05}
+
+#: op -> the QoS class the admission plane files it under
+OP_CLASS = {"get": "interactive", "put": "interactive",
+            "delete": "interactive", "list": "control"}
+
+
+@dataclass
+class Profile:
+    """One workload shape. The tier-1 profile (ISSUE 10 acceptance:
+    >=1k objects, >=64 concurrent mixed clients, one scanner cycle
+    forced mid-run) is ``Profile.tier1()``."""
+    objects: int = 1000
+    clients: int = 64
+    duration_s: float = 6.0
+    mix: dict = field(default_factory=lambda: dict(DEFAULT_MIX))
+    value_bytes: int = 4096
+    open_rps: float = 50.0      # open-loop arrival rate after the ramp
+    ramp_s: float = 2.0
+    bucket: str = "loadgen"
+    seed: int = 7
+    scanner_mid_run: bool = True
+    overload_probe: bool = True
+    preload_threads: int = 16
+
+    @classmethod
+    def tier1(cls) -> "Profile":
+        return cls()
+
+
+class _SigClient:
+    """Minimal SigV4 keep-alive client (one per worker thread)."""
+
+    def __init__(self, endpoint: str, ak: str, sk: str,
+                 region: str = "us-east-1"):
+        import requests
+        from minio_tpu.server.auth import UNSIGNED_PAYLOAD, SigV4Verifier
+        self.endpoint = endpoint.rstrip("/")
+        self.host = self.endpoint.split("//", 1)[1]
+        self.ak, self.sk = ak, sk
+        self.signer = SigV4Verifier(lambda a: None, region)
+        self.http = requests.Session()
+        self._unsigned = UNSIGNED_PAYLOAD
+
+    def request(self, method: str, path: str,
+                query: dict[str, str] | None = None, body: bytes = b""):
+        q = {k: [v] for k, v in (query or {}).items()}
+        h = {"host": self.host}
+        h["authorization"] = self.signer.sign_request(
+            self.ak, self.sk, method, path, q, h, self._unsigned)
+        qs = urllib.parse.urlencode({k: v for k, v in
+                                     (query or {}).items()})
+        url = self.endpoint + urllib.parse.quote(path) + \
+            (f"?{qs}" if qs else "")
+        return self.http.request(method, url, data=body or None,
+                                 headers=h, timeout=30)
+
+
+class _Recorder:
+    """Thread-safe sample sink: (rel_ts, op, status, dur_s,
+    retry_after_present) rows + running totals."""
+
+    def __init__(self, t0: float):
+        self.t0 = t0
+        self._lock = threading.Lock()
+        self.rows: list[tuple[float, str, int, float, bool]] = []
+
+    def note(self, op: str, status: int, dur_s: float,
+             retry_after: bool) -> None:
+        row = (time.monotonic() - self.t0, op, status, dur_s,
+               retry_after)
+        with self._lock:
+            self.rows.append(row)
+
+    def snapshot(self) -> list[tuple[float, str, int, float, bool]]:
+        with self._lock:
+            return list(self.rows)
+
+
+def _pcts(vals: list[float]) -> dict:
+    if not vals:
+        return {"p50_ms": 0.0, "p95_ms": 0.0, "p99_ms": 0.0,
+                "max_ms": 0.0}
+    vs = sorted(vals)
+    def at(q: float) -> float:
+        return vs[min(len(vs) - 1, int(q * len(vs)))] * 1e3
+    return {"p50_ms": round(at(0.5), 3), "p95_ms": round(at(0.95), 3),
+            "p99_ms": round(at(0.99), 3),
+            "max_ms": round(vs[-1] * 1e3, 3)}
+
+
+def _op_rollup(rows, window: tuple[float, float] | None = None) -> dict:
+    """Per-op + per-class stats over ``rows``, optionally restricted to
+    a [t_lo, t_hi) relative-time window."""
+    per_op: dict[str, dict] = {}
+    per_cls: dict[str, dict] = {}
+    for ts, op, status, dur, ra in rows:
+        if window is not None and not (window[0] <= ts < window[1]):
+            continue
+        o = per_op.setdefault(op, {"count": 0, "err5xx": 0, "s503": 0,
+                                   "s503_retry_after": 0, "lat": []})
+        o["count"] += 1
+        o["lat"].append(dur)
+        if status >= 500:
+            o["err5xx"] += 1
+        if status == 503:
+            o["s503"] += 1
+            if ra:
+                o["s503_retry_after"] += 1
+        c = per_cls.setdefault(OP_CLASS.get(op, "control"),
+                               {"count": 0, "err5xx": 0, "lat": []})
+        c["count"] += 1
+        c["lat"].append(dur)
+        if status >= 500:
+            c["err5xx"] += 1
+    for o in per_op.values():
+        o.update(_pcts(o.pop("lat")))
+    for c in per_cls.values():
+        lat = c.pop("lat")
+        c.update(_pcts(lat))
+        c["availability"] = round(
+            1.0 - c["err5xx"] / c["count"], 6) if c["count"] else 1.0
+    return {"ops": per_op, "classes": per_cls}
+
+
+class LoadGen:
+    """Drives one profile against a server. Build with ``inprocess()``
+    for the self-contained form (own ErasureObjects + S3Server over
+    temp dirs) or pass an endpoint + credentials for a remote target
+    (scanner forcing and the overload probe then need ``server``-less
+    fallbacks and are skipped)."""
+
+    def __init__(self, endpoint: str, access_key: str, secret_key: str,
+                 server=None, objlayer=None):
+        self.endpoint = endpoint
+        self.ak, self.sk = access_key, secret_key
+        self.server = server          # in-process S3Server (or None)
+        self.obj = objlayer
+        self._owned = False
+
+    # -- construction ---------------------------------------------------------
+
+    @classmethod
+    def inprocess(cls, root: str, disks: int = 6, parity: int = 2,
+                  access_key: str = "loadgen",
+                  secret_key: str = "loadgen-secret") -> "LoadGen":
+        import os
+
+        from minio_tpu.objectlayer import ErasureObjects
+        from minio_tpu.server import S3Server
+        from minio_tpu.storage import XLStorage
+        dd = [XLStorage(os.path.join(root, f"d{i}"))
+              for i in range(disks)]
+        obj = ErasureObjects(dd, default_parity=parity)
+        srv = S3Server(obj, "127.0.0.1", 0, access_key=access_key,
+                       secret_key=secret_key)
+        srv.start_background()
+        # background services with an effectively-infinite scan
+        # interval: cycles run only when the harness forces them
+        srv.start_background_services(scan_interval_s=1e9)
+        # forced cycles should spend their time walking, not sleeping:
+        # the throttle exists to protect production hot paths over
+        # minutes-long crawls, and the harness measures contention, not
+        # the sleep
+        srv.scanner.sleep_per_object = 0.0
+        lg = cls(srv.endpoint(), access_key, secret_key, server=srv,
+                 objlayer=obj)
+        lg._owned = True
+        return lg
+
+    def close(self) -> None:
+        if self._owned and self.server is not None:
+            self.server.shutdown()
+
+    # -- phases ---------------------------------------------------------------
+
+    def preload(self, profile: Profile) -> float:
+        """Populate the namespace (``objects`` keys) through the object
+        layer directly — setup, not measured workload. Returns wall
+        seconds."""
+        if self.obj is None:
+            raise RuntimeError("preload needs an in-process layer")
+        body = random.Random(profile.seed).randbytes(profile.value_bytes)
+        try:
+            self.obj.make_bucket(profile.bucket)
+        except Exception:  # noqa: BLE001 — exists from a prior phase
+            pass
+        t0 = time.monotonic()
+
+        def put_range(lo: int, hi: int) -> None:
+            for j in range(lo, hi):
+                self.obj.put_object(profile.bucket, f"o{j:07d}",
+                                    io.BytesIO(body), len(body))
+
+        nthreads = max(1, profile.preload_threads)
+        step = (profile.objects + nthreads - 1) // nthreads
+        with ThreadPoolExecutor(max_workers=nthreads) as ex:
+            futs = [ex.submit(put_range, lo, min(lo + step,
+                                                 profile.objects))
+                    for lo in range(0, profile.objects, step)]
+            for f in futs:
+                f.result()
+        return time.monotonic() - t0
+
+    def _one_op(self, cl: _SigClient, rng: random.Random,
+                profile: Profile, rec: _Recorder, body: bytes) -> None:
+        r = rng.random()
+        acc = 0.0
+        op = "get"
+        for name, w in profile.mix.items():
+            acc += w
+            if r <= acc:
+                op = name
+                break
+        b = profile.bucket
+        t0 = time.perf_counter()
+        try:
+            if op == "get":
+                resp = cl.request(
+                    "GET", f"/{b}/o{rng.randrange(profile.objects):07d}")
+            elif op == "put":
+                # churn range: PUT/DELETE share keys ABOVE the stable
+                # GET namespace so deletes never starve readers
+                key = f"c{rng.randrange(max(1, profile.objects // 4)):07d}"
+                resp = cl.request("PUT", f"/{b}/{key}", body=body)
+            elif op == "delete":
+                key = f"c{rng.randrange(max(1, profile.objects // 4)):07d}"
+                resp = cl.request("DELETE", f"/{b}/{key}")
+            else:  # list
+                resp = cl.request(
+                    "GET", f"/{b}",
+                    query={"max-keys": "64",
+                           "prefix": f"o{rng.randrange(10)}"})
+            status = resp.status_code
+            ra = "Retry-After" in resp.headers
+            resp.content  # drain keep-alive
+        except Exception:  # noqa: BLE001 — a transport error is an
+            status, ra = 599, False  # availability failure, not a crash
+        rec.note(op, status, time.perf_counter() - t0, ra)
+
+    def _closed_loop(self, profile: Profile, rec: _Recorder,
+                     deadline: float, body: bytes) -> list[threading.Thread]:
+        def worker(wid: int) -> None:
+            cl = _SigClient(self.endpoint, self.ak, self.sk)
+            rng = random.Random(profile.seed * 1000 + wid)
+            while time.monotonic() < deadline:
+                self._one_op(cl, rng, profile, rec, body)
+
+        ths = [threading.Thread(target=worker, args=(i,), daemon=True,
+                                name=f"loadgen-{i}")
+               for i in range(profile.clients)]
+        for t in ths:
+            t.start()
+        return ths
+
+    def _open_loop(self, profile: Profile, rec: _Recorder,
+                   deadline: float, body: bytes
+                   ) -> threading.Thread | None:
+        """Arrival generator: rate ramps 0 -> open_rps over ramp_s,
+        then holds; each arrival is one one-shot op on a bounded
+        executor (a saturated executor sheds arrivals — open-loop
+        overload shows up as queueing/shed, exactly as intended).
+        None when the profile disables the open loop."""
+        if profile.open_rps <= 0:
+            return None
+
+        ex = ThreadPoolExecutor(max_workers=min(32, profile.clients))
+        local = threading.local()
+
+        def one(rng_seed: int) -> None:
+            # open-loop arrivals that are still queued when the run
+            # ends are SHED, not drained: the backlog beyond the
+            # deadline is the overload signal, and draining it would
+            # stretch the run unboundedly on a saturated host
+            if time.monotonic() >= deadline:
+                return
+            cl = getattr(local, "cl", None)
+            if cl is None:
+                cl = local.cl = _SigClient(self.endpoint, self.ak,
+                                           self.sk)
+            self._one_op(cl, random.Random(rng_seed), profile, rec,
+                         body)
+
+        def gen() -> None:
+            rng = random.Random(profile.seed ^ 0xA77)
+            t_start = time.monotonic()
+            n = 0
+            while True:
+                now = time.monotonic()
+                if now >= deadline:
+                    break
+                frac = 1.0 if profile.ramp_s <= 0 else \
+                    min(1.0, (now - t_start) / profile.ramp_s)
+                rate = max(0.5, profile.open_rps * frac)
+                time.sleep(rng.expovariate(rate))
+                try:
+                    ex.submit(one, profile.seed * 7919 + n)
+                except RuntimeError:
+                    break
+                n += 1
+            ex.shutdown(wait=True)
+
+        t = threading.Thread(target=gen, daemon=True,
+                             name="loadgen-openloop")
+        t.start()
+        return t
+
+    def _force_scanner(self, rec_t0: float, out: dict) -> None:
+        """One scanner cycle mid-run (QoS background class applied by
+        the scanner itself); records its relative-time window into
+        ``out``. Runs on its own thread — on a saturated host the
+        cycle being CPU-starved by interactive traffic is the desired
+        outcome, and the run must not stretch to wait for it."""
+        scanner = getattr(self.server, "scanner", None)
+        if scanner is None:
+            return
+        out["start_s"] = round(time.monotonic() - rec_t0, 3)
+        scanner.scan_cycle()
+        out["end_s"] = round(time.monotonic() - rec_t0, 3)
+        out["cycle"] = scanner.cycle
+
+    def _overload_probe(self, profile: Profile) -> dict:
+        """Deliberately pinch the admission gate to capacity 1 and fire
+        a concurrent burst so the 503 SlowDown + Retry-After contract is
+        exercised every run. The handful of 503s burns a sliver of the
+        interactive error budget — by design: the SLO report must show
+        availability holding ABOVE target even with shedding active."""
+        import os
+        adm = getattr(self.server, "qos_admission", None)
+        if adm is None:
+            return {}
+        saved = adm.max_requests
+        saved_wait = os.environ.get("MINIO_TPU_QOS_MAX_WAIT_MS")
+        out = {"bursts": 8, "s503": 0, "retry_after_ok": True}
+        try:
+            adm.reconfigure(1)
+            # near-zero admission wait: with capacity 1 an 8-wide burst
+            # must shed ~7 requests instead of queueing them politely
+            # behind the bounded wait (in-process server reads the env
+            # per admit, so this applies immediately)
+            os.environ["MINIO_TPU_QOS_MAX_WAIT_MS"] = "1"
+            barrier = threading.Barrier(8)
+
+            lock = threading.Lock()
+
+            def burst(i: int) -> None:
+                cl = _SigClient(self.endpoint, self.ak, self.sk)
+                barrier.wait()
+                r = cl.request("GET",
+                               f"/{profile.bucket}/o{i:07d}")
+                if r.status_code == 503:
+                    with lock:
+                        out["s503"] += 1
+                        if "Retry-After" not in r.headers:
+                            out["retry_after_ok"] = False
+
+            ths = [threading.Thread(target=burst, args=(i,))
+                   for i in range(8)]
+            for t in ths:
+                t.start()
+            for t in ths:
+                t.join(timeout=30)
+        finally:
+            if saved_wait is None:
+                os.environ.pop("MINIO_TPU_QOS_MAX_WAIT_MS", None)
+            else:
+                os.environ["MINIO_TPU_QOS_MAX_WAIT_MS"] = saved_wait
+            adm.reconfigure(saved)
+        return out
+
+    # -- the run --------------------------------------------------------------
+
+    def run(self, profile: Profile) -> dict:
+        from minio_tpu.obs import slo
+        body = random.Random(profile.seed + 1).randbytes(
+            profile.value_bytes)
+        preload_s = self.preload(profile)
+        # the overload probe runs BEFORE the measured phase and the SLO
+        # reset: its ~7 deliberately-induced 503s prove the SlowDown +
+        # Retry-After contract without burning the measured run's
+        # availability (operators pinching capacity on purpose is not
+        # an SLO incident)
+        probe: dict = {}
+        if profile.overload_probe and self.server is not None:
+            probe = self._overload_probe(profile)
+        slo.reset()                      # measure THIS run, not setup
+        lockrank_before = self._lockrank_count()
+        rec = _Recorder(time.monotonic())
+        deadline = rec.t0 + profile.duration_s
+        ths = self._closed_loop(profile, rec, deadline, body)
+        open_t = self._open_loop(profile, rec, deadline, body)
+        scanner_win: dict = {}
+        scan_t: threading.Thread | None = None
+        if profile.scanner_mid_run and self.server is not None:
+            time.sleep(profile.duration_s / 2)
+            scan_t = threading.Thread(
+                target=self._force_scanner, args=(rec.t0, scanner_win),
+                daemon=True, name="loadgen-scanner")
+            scan_t.start()
+        for t in ths:
+            t.join(timeout=profile.duration_s + 60)
+        if open_t is not None:
+            open_t.join(timeout=profile.duration_s + 60)
+        wall_s = time.monotonic() - rec.t0
+        if scan_t is not None:
+            scan_t.join(timeout=180)
+        return self._report(profile, rec, wall_s, preload_s,
+                            scanner_win, probe, lockrank_before)
+
+    @staticmethod
+    def _lockrank_count() -> int | None:
+        try:
+            from minio_tpu.obs import lockrank
+            return len(lockrank.reports())
+        except Exception:  # noqa: BLE001 — lockrank not installed
+            return None
+
+    def _scrape_metrics(self) -> str:
+        try:
+            import requests
+            return requests.get(self.endpoint + "/minio/v2/metrics",
+                                timeout=10).text
+        except Exception:  # noqa: BLE001
+            return ""
+
+    def _report(self, profile: Profile, rec: _Recorder, wall_s: float,
+                preload_s: float, scanner_win: dict, probe: dict,
+                lockrank_before: int | None) -> dict:
+        from minio_tpu.obs import slo
+        from minio_tpu.obs.health import cluster_snapshot
+        rows = rec.snapshot()
+        overall = _op_rollup(rows)
+        total = sum(o["count"] for o in overall["ops"].values())
+        s503 = sum(o["s503"] for o in overall["ops"].values())
+        s503_ra = sum(o["s503_retry_after"]
+                      for o in overall["ops"].values())
+        # scanner attribution: the cycle window vs the surrounding
+        # baseline — a breach is "attributable" only when the hot path
+        # got materially worse INSIDE the window
+        scanner_impact: dict = {}
+        if scanner_win.get("start_s") is not None:
+            last_ts = max((r[0] for r in rows), default=0.0)
+            # clamp to the sampled range: a cycle that outlives the
+            # measured phase (CPU-starved behind interactive traffic —
+            # the desired priority) is judged on its in-run overlap
+            win = (scanner_win["start_s"],
+                   min(scanner_win.get("end_s", last_ts), last_ts))
+            during = _op_rollup(rows, win)["classes"].get(
+                "interactive", {})
+            # baseline = the STEADY half of the pre-scanner phase: the
+            # first seconds of a closed loop are queue ramp-up (64
+            # clients fire at once, latency climbs toward steady
+            # state), and comparing the scanner window against the
+            # ramp would misattribute that climb to the scanner
+            before = _op_rollup(
+                rows, (win[0] / 2, win[0]))["classes"].get(
+                "interactive", {})
+            thresh = slo.objective("interactive")["latency_threshold_s"]
+            d_avail = during.get("availability", 1.0)
+            # p50-based attribution: a scanner genuinely stalling the
+            # hot path (holding a namespace lock, hogging the dispatch
+            # queue) shifts the MEDIAN, while p99 on a contended CI
+            # host is pure tail noise at these sample counts
+            d_p50 = during.get("p50_ms", 0.0) / 1e3
+            b_p50 = before.get("p50_ms", 0.0) / 1e3
+            attributable = (
+                during.get("count", 0) >= 10 and (
+                    d_avail < min(0.99,
+                                  before.get("availability", 1.0)) or
+                    (d_p50 > max(thresh, 4.0 * b_p50))))
+            scanner_impact = {
+                "window": scanner_win,
+                "during": during, "before": before,
+                "latency_threshold_s": thresh,
+                "attributable_breach": attributable,
+            }
+        lockrank_after = self._lockrank_count()
+        # class evidence: the admission plane's per-class admit counts
+        # (interactive traffic WAS classed and gated), the scanner
+        # cycle counter (the background work DID run — scan_cycle
+        # itself applies qos.background()), and — when the payload size
+        # engages the dispatch queue — the scheduler's per-class item
+        # and spill counters
+        qos_evidence: dict = {}
+        if self.server is not None:
+            adm = getattr(self.server, "qos_admission", None)
+            if adm is not None:
+                qos_evidence["admitted"] = adm.stats().get("admitted", {})
+            from minio_tpu.obs.metrics import counters_snapshot
+            qos_evidence["scanner_cycles"] = {
+                k: v for k, v in counters_snapshot().items()
+                if k.startswith("minio_tpu_scanner_cycles_total")}
+            from minio_tpu.runtime import dispatch as dp
+            if dp._global is not None:
+                st = dp._global.qos.stats()
+                qos_evidence["class_items"] = st.get("class_items", {})
+                qos_evidence["spill_reasons"] = st.get(
+                    "spill_reasons", {})
+        metrics_text = self._scrape_metrics()
+        slo_rep = slo.report()
+        inter = overall["classes"].get("interactive", {})
+        verdicts = {
+            "interactive_availability_ok":
+                inter.get("availability", 1.0) >= 0.99,
+            "retry_after_on_503": s503 == 0 or s503_ra == s503,
+            "overload_probe_fired": not probe or probe.get("s503", 0) > 0,
+            "scanner_no_hot_path_breach":
+                not scanner_impact or
+                not scanner_impact["attributable_breach"],
+            "lockrank_clean": lockrank_before is None or
+                lockrank_after == lockrank_before,
+            "burn_rate_metrics_live":
+                "minio_tpu_slo_burn_rate" in metrics_text,
+        }
+        verdicts["passed"] = all(verdicts.values())
+        return {
+            "profile": {
+                "objects": profile.objects,
+                "clients": profile.clients,
+                "duration_s": profile.duration_s,
+                "mix": profile.mix,
+                "value_bytes": profile.value_bytes,
+                "open_rps": profile.open_rps,
+                "ramp_s": profile.ramp_s,
+            },
+            "wall_s": round(wall_s, 3),
+            "preload_s": round(preload_s, 3),
+            "requests_total": total,
+            "rps": round(total / wall_s, 1) if wall_s else 0.0,
+            "s503_total": s503,
+            "s503_with_retry_after": s503_ra,
+            "per_op": overall["ops"],
+            "per_class": overall["classes"],
+            "scanner": scanner_impact,
+            "overload_probe": probe,
+            "qos_evidence": qos_evidence,
+            "slo": slo_rep,
+            "health": cluster_snapshot(self.server, peers=False)
+            if self.server is not None else {},
+            "verdicts": verdicts,
+        }
+
+
+def run_tier1_profile(root: str, profile: Profile | None = None) -> dict:
+    """The ISSUE 10 acceptance profile: in-process server, >=1k objects,
+    >=64 concurrent mixed clients, one scanner cycle forced mid-run.
+    Returns the report (``report["verdicts"]["passed"]`` is the
+    gate)."""
+    lg = LoadGen.inprocess(root)
+    try:
+        return lg.run(profile or Profile.tier1())
+    finally:
+        lg.close()
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        description="mixed-workload SLO scale harness")
+    ap.add_argument("--objects", type=int, default=1000)
+    ap.add_argument("--clients", type=int, default=64)
+    ap.add_argument("--duration", type=float, default=6.0)
+    ap.add_argument("--value-bytes", type=int, default=4096)
+    ap.add_argument("--open-rps", type=float, default=50.0)
+    ap.add_argument("--ramp", type=float, default=2.0)
+    ap.add_argument("--no-scanner", action="store_true")
+    ap.add_argument("--no-probe", action="store_true")
+    ap.add_argument("--out", default="", help="write the report JSON")
+    args = ap.parse_args(argv)
+    import tempfile
+
+    profile = Profile(
+        objects=args.objects, clients=args.clients,
+        duration_s=args.duration, value_bytes=args.value_bytes,
+        open_rps=args.open_rps, ramp_s=args.ramp,
+        scanner_mid_run=not args.no_scanner,
+        overload_probe=not args.no_probe)
+    with tempfile.TemporaryDirectory(prefix="loadgen-") as root:
+        report = run_tier1_profile(root, profile)
+    blob = json.dumps(report, indent=1, sort_keys=True)
+    if args.out:
+        with open(args.out, "w", encoding="utf-8") as f:
+            f.write(blob + "\n")
+    print(blob)
+    return 0 if report["verdicts"]["passed"] else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
