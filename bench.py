@@ -545,6 +545,12 @@ def heal_latency(rng) -> dict:
         "spilled_batches": st["spilled_batches"],
         "spill_reasons": st["spill_reasons"],
         "deadline_misses": st["deadline_misses"],
+        # per-device flush lanes (ISSUE 11): diverts + residual queued
+        # bytes per lane; the full mesh scaling story is MULTICHIP's
+        # (__graft_entry__.multichip_bench), single-chip hosts report
+        # an empty lane map here
+        "lane_diverts": st["lane_diverts"],
+        "lane_queued_bytes": st["lane_queued_bytes"],
         "avg_batch": round(st["avg_batch"], 2),
         "device_pipeline": __import__(
             "minio_tpu.runtime.dispatch",

@@ -113,6 +113,11 @@ SUB_SYSTEMS: dict[str, dict[str, KV]] = {
         "delay_ms": KV("1.0", env="MINIO_TPU_DISPATCH_DELAY_MS"),
         "completers": KV("", env="MINIO_TPU_COMPLETERS"),
         "probe_ttl_s": KV("60", env="MINIO_TPU_PROBE_TTL_S"),
+        "lanes": KV("auto", env="MINIO_TPU_DISPATCH_LANES",
+                    help="per-device flush lanes: auto = one per local "
+                         "mesh device, N caps the count, 0/1 disables "
+                         "per-lane placement (every device flush shards "
+                         "SPMD across all lanes; read at process start)"),
     },
     "qos": {
         "spill_factor": KV(
@@ -122,6 +127,11 @@ SUB_SYSTEMS: dict[str, dict[str, KV]] = {
         "device_queue_bytes": KV(
             str(64 << 20), env="MINIO_TPU_QOS_DEVICE_QUEUE_BYTES",
             help="cap on bytes queued toward the device route"),
+        "lane_queue_bytes": KV(
+            "0", env="MINIO_TPU_QOS_LANE_QUEUE_BYTES",
+            help="per-flush-lane queued-bytes cap; 0 derives an even "
+                 "split of qos.device_queue_bytes — a saturated lane "
+                 "spills to sibling lanes before spilling to CPU"),
         "interactive_budget_ms": KV(
             "100", env="MINIO_TPU_QOS_INTERACTIVE_BUDGET_MS",
             help="latency budget for interactive dispatch items"),

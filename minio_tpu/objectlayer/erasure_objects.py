@@ -20,6 +20,7 @@ from ..obs import attribution as _attr
 from ..obs import latency as _lat
 from ..obs import spans as _spans
 from ..obs import trace as _trc
+from .. import qos as _qos
 from ..erasure.bitrot import (BITROT_CHUNK_KEY, BitrotAlgorithm,
                               pick_bitrot_chunk)
 from ..erasure.codec import ceil_div
@@ -121,6 +122,12 @@ class ErasureObjects(MultipartMixin, ObjectLayer):
         self.bitrot_algo = bitrot_algo
         self.set_index = set_index
         self.pool_index = pool_index
+        #: device flush-lane affinity: this set's dispatch work (encode,
+        #: rebuild, fused verify, SSE, scans riding its requests) lands
+        #: on hash(set) % lanes — the erasureServerPools → erasureSets
+        #: distribution mapped onto the chip mesh, so concurrent sets
+        #: fan out across device lanes instead of convoying on one
+        self._lane_key = _qos.set_affinity_key(pool_index, set_index)
         #: MRF hook — called with (bucket, object, version_id) when an op
         #: detects a partial/degraded state (cmd/erasure-object.go:1132).
         self.on_partial = None
@@ -333,7 +340,8 @@ class ErasureObjects(MultipartMixin, ObjectLayer):
     def put_object(self, bucket: str, object: str, stream, size: int,
                    opts: ObjectOptions = None) -> ObjectInfo:
         with _spans.span("objectlayer.put_object", bucket=bucket,
-                         object=object), _attr.observed("put"):
+                         object=object), _attr.observed("put"), \
+                _qos.lane_affinity(self._lane_key):
             return self._put_object_inner(bucket, object, stream, size,
                                           opts)
 
@@ -597,7 +605,8 @@ class ErasureObjects(MultipartMixin, ObjectLayer):
                    length: int = -1, opts: ObjectOptions = None
                    ) -> ObjectInfo:
         with _spans.span("objectlayer.get_object", bucket=bucket,
-                         object=object), _attr.observed("get"):
+                         object=object), _attr.observed("get"), \
+                _qos.lane_affinity(self._lane_key):
             return self._get_object_inner(bucket, object, writer, offset,
                                           length, opts)
 
@@ -1166,7 +1175,8 @@ class ErasureObjects(MultipartMixin, ObjectLayer):
             # a span tree and slow background heals tail-sample too
             with _spans.maybe_root("heal.object", cls="background",
                                    bucket=bucket, object=object,
-                                   mode=scan_mode), _attr.observed("heal"):
+                                   mode=scan_mode), _attr.observed("heal"), \
+                    _qos.lane_affinity(self._lane_key):
                 return self._heal_object_inner(bucket, object, version_id,
                                                dry_run, remove_dangling,
                                                scan_mode)

@@ -298,6 +298,19 @@ def _g_device(server) -> list[str]:
                 lines.append(
                     "minio_tpu_device_batch_fill_total"
                     f'{{lane="{_esc(lane)}",fill="{bucket}"}} {n}')
+    # per-lane queued bytes from the QoS scheduler's lane model (the
+    # per-device flush lanes, ISSUE 11): what each lane still has in
+    # flight toward its chip — the sibling-spill decision's input
+    from ..runtime.dispatch import _global
+    if _global is not None:
+        lane_q = _global.lane_queued_bytes()
+        if lane_q:
+            lines.append(
+                "# TYPE minio_tpu_device_lane_queued_bytes gauge")
+            for lane, v in sorted(lane_q.items()):
+                lines.append(
+                    "minio_tpu_device_lane_queued_bytes"
+                    f'{{lane="{_esc(lane)}"}} {v}')
     qd = util["queue_depth"]
     if qd["samples"]:
         lines += [
@@ -339,6 +352,8 @@ def _g_qos(server) -> list[str]:
             "# TYPE minio_tpu_qos_device_queued_bytes gauge",
             "minio_tpu_qos_device_queued_bytes "
             f"{sched['device_queued_bytes']}",
+            "# TYPE minio_tpu_qos_lane_diverts_total counter",
+            f"minio_tpu_qos_lane_diverts_total {sched['lane_diverts']}",
             "# TYPE minio_tpu_qos_queue_depth gauge",
             f"minio_tpu_qos_queue_depth {_global.stats()['queue_depth']}",
         ]

@@ -57,11 +57,12 @@ def _qr(s, a: int, b: int, c: int, d: int):
     s[b] = _rotl(s[b] ^ s[c], 7)
 
 
-def _make_kernel(lanes_per_pkg: int):
+def _make_kernel(lanes_per_pkg: int, unroll: bool = True):
     """Kernel over one (RT, 128) lane tile: scalars_ref SMEM [10] =
     key words 0..7 + shared nonce words n0, n1; n2_ref VMEM (RT, 128)
     per-lane nonce word; x_ref (16, RT, 128) payload words; out =
-    payload ^ keystream(counter(lane), nonce(lane))."""
+    payload ^ keystream(counter(lane), nonce(lane)). ``unroll`` as in
+    :func:`_keystream`."""
 
     def kernel(scalars_ref, n2_ref, x_ref, out_ref):
         t = pl.program_id(0)
@@ -75,25 +76,14 @@ def _make_kernel(lanes_per_pkg: int):
         init += [full(scalars_ref[i]) for i in range(8)]
         init.append(ctr)
         init += [full(scalars_ref[8]), full(scalars_ref[9]), n2_ref[:]]
-        s = list(init)
-        for _ in range(10):
-            _qr(s, 0, 4, 8, 12)
-            _qr(s, 1, 5, 9, 13)
-            _qr(s, 2, 6, 10, 14)
-            _qr(s, 3, 7, 11, 15)
-            _qr(s, 0, 5, 10, 15)
-            _qr(s, 1, 6, 11, 12)
-            _qr(s, 2, 7, 8, 13)
-            _qr(s, 3, 4, 9, 14)
-        ks = [s[i] + init[i] for i in range(16)]
-        out_ref[:] = x_ref[:] ^ jnp.stack(ks)
+        out_ref[:] = x_ref[:] ^ _keystream(init, unroll)
 
     return kernel
 
 
 @functools.lru_cache(maxsize=32)
 def _jitted(lanes_per_pkg: int, n_tiles: int, interpret: bool):
-    kernel = _make_kernel(lanes_per_pkg)
+    kernel = _make_kernel(lanes_per_pkg, unroll=not interpret)
     r = n_tiles * RT
 
     @jax.jit
@@ -115,6 +105,135 @@ def _jitted(lanes_per_pkg: int, n_tiles: int, interpret: bool):
         )(scalars, n2, x)
 
     return run
+
+
+def _double_round(s: list) -> None:
+    """One ChaCha20 double round over the 16 state tiles, in place."""
+    _qr(s, 0, 4, 8, 12)
+    _qr(s, 1, 5, 9, 13)
+    _qr(s, 2, 6, 10, 14)
+    _qr(s, 3, 7, 11, 15)
+    _qr(s, 0, 5, 10, 15)
+    _qr(s, 1, 6, 11, 12)
+    _qr(s, 2, 7, 8, 13)
+    _qr(s, 3, 4, 9, 14)
+
+
+def _keystream(init: list, unroll: bool):
+    """The 10 double rounds + feed-forward over one 16-tile state —
+    shared by the single-item and multi-item kernels so their
+    keystreams can never diverge. ``unroll=False`` runs the rounds as
+    a ``fori_loop``: same math, ~10x less to trace — the interpret-mode
+    (CPU host) path uses it because lowering the fully unrolled
+    ~960-op body costs tens of seconds of compile there; Mosaic on the
+    real TPU keeps the unrolled body it has always had."""
+    if unroll:
+        s = list(init)
+        for _ in range(10):
+            _double_round(s)
+        return jnp.stack([s[i] + init[i] for i in range(16)])
+
+    def body(_, st):
+        tiles = [st[i] for i in range(16)]
+        _double_round(tiles)
+        return jnp.stack(tiles)
+
+    init_st = jnp.stack(init)
+    return jax.lax.fori_loop(0, 10, body, init_st) + init_st
+
+
+def _make_multi_kernel(lanes_per_pkg: int, unroll: bool = True):
+    """Multi-OBJECT variant of the kernel: key + all three nonce words
+    ride per-lane VMEM tiles (``kn_ref`` (11, RT, 128) = key words 0..7
+    + nonce words 0..2) instead of shared SMEM scalars, so one launch
+    seals packages of MANY objects, each under its own package key —
+    the batched dispatch flush (and its mesh-sharded route) needs
+    per-item keys, which the SMEM layout cannot express. ``unroll`` as
+    in :func:`_keystream`."""
+
+    def kernel(kn_ref, x_ref, out_ref):
+        t = pl.program_id(0)
+        lane = (jax.lax.broadcasted_iota(jnp.int32, (RT, 128), 0) * 128 +
+                jax.lax.broadcasted_iota(jnp.int32, (RT, 128), 1) +
+                t * _QUANTUM)
+        ctr = jax.lax.rem(lane, np.int32(lanes_per_pkg)).astype(jnp.uint32)
+        full = lambda v: jnp.full((RT, 128), v, jnp.uint32)  # noqa: E731
+        init = [full(np.uint32(c)) for c in _CONSTS]
+        init += [kn_ref[i] for i in range(8)]
+        init.append(ctr)
+        init += [kn_ref[8], kn_ref[9], kn_ref[10]]
+        out_ref[:] = x_ref[:] ^ _keystream(init, unroll)
+
+    return kernel
+
+
+@functools.lru_cache(maxsize=32)
+def multi_fn_for(pkgs: int, words: int, interpret: bool | None = None):
+    """Traceable batched multi-object ChaCha20 XOR — the dispatch
+    plane's one-launch-per-flush sse_xor route, shard_map-able over the
+    ("objects",) mesh (the item axis shards; no cross-item math):
+
+    ``(keys uint32 [I, 8], nonces uint32 [I, P, 3], data uint32
+    [I, P, W]) -> (xored [I, P, W], poly_keys [I, P, 8])``
+
+    Per package the keystream layout, counter derivation and rounds are
+    IDENTICAL to :func:`xor_packages_device` — one item of the batch is
+    bit-identical to its own single-item launch (pinned in tests).
+    Callers validate the per-item shared-nonce-words invariant on the
+    host; this function is pure math so it can trace under shard_map."""
+    if words % 16:
+        raise ValueError("chacha packages must be 64-byte multiples")
+    interp = (not on_tpu()) if interpret is None else interpret
+    nb = words // 16
+    lpp = nb + 1
+    kernel = _make_multi_kernel(lpp, unroll=not interp)
+
+    def run(keys: jnp.ndarray, nonces: jnp.ndarray, data: jnp.ndarray):
+        items = data.shape[0]
+        n0 = items * pkgs * lpp
+        npad = -(-n0 // _QUANTUM) * _QUANTUM
+        x = data.reshape(items * pkgs, nb, 16)
+        # counter-0 (poly key) lane FIRST per package, same layout rule
+        # as the single-item launch
+        x = jnp.pad(x, ((0, 0), (1, 0), (0, 0))).reshape(n0, 16)
+        if npad != n0:
+            x = jnp.pad(x, ((0, npad - n0), (0, 0)))
+        x = jnp.transpose(x, (1, 0)).reshape(16, npad // 128, 128)
+        kl = jnp.repeat(keys.astype(jnp.uint32), pkgs * lpp, axis=0)
+        nl = jnp.repeat(nonces.astype(jnp.uint32).reshape(items * pkgs, 3),
+                        lpp, axis=0)
+        kn = jnp.concatenate([kl, nl], axis=1)          # [n0, 11]
+        if npad != n0:
+            kn = jnp.pad(kn, ((0, npad - n0), (0, 0)))
+        kn = jnp.transpose(kn, (1, 0)).reshape(11, npad // 128, 128)
+        out = pl.pallas_call(
+            kernel,
+            out_shape=jax.ShapeDtypeStruct((16, npad // 128, 128),
+                                           jnp.uint32),
+            grid=(npad // _QUANTUM,),
+            in_specs=[
+                pl.BlockSpec((11, RT, 128), lambda t: (0, t, 0),
+                             memory_space=pltpu.VMEM),
+                pl.BlockSpec((16, RT, 128), lambda t: (0, t, 0),
+                             memory_space=pltpu.VMEM),
+            ],
+            out_specs=pl.BlockSpec((16, RT, 128), lambda t: (0, t, 0),
+                                   memory_space=pltpu.VMEM),
+            interpret=interp,
+        )(kn, x)
+        flat = jnp.transpose(out.reshape(16, npad), (1, 0))[:n0]
+        grouped = flat.reshape(items, pkgs, lpp, 16)
+        return (grouped[:, :, 1:, :].reshape(items, pkgs, words),
+                grouped[:, :, 0, :8])
+
+    return run
+
+
+@functools.lru_cache(maxsize=32)
+def multi_jitted(pkgs: int, words: int, interpret: bool | None = None):
+    """jit of :func:`multi_fn_for` for single-device (or per-lane
+    pinned) launches; the mesh route wraps the raw fn in shard_map."""
+    return jax.jit(multi_fn_for(pkgs, words, interpret))
 
 
 def xor_packages_device(key: bytes, nonces: np.ndarray, data: np.ndarray):

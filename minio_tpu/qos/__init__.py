@@ -58,6 +58,40 @@ def background():
     return work_class(CLASS_BACKGROUND)
 
 
+#: device-lane affinity of the calling context: an erasure-set hash the
+#: dispatch queue keys its flush-lane placement on (None = no affinity;
+#: such flushes ride the SPMD all-lanes route). Mirrors the reference's
+#: erasureServerPools -> erasureSets distribution: one set's traffic
+#: lands on one lane, sets fan out across lanes.
+_affinity: contextvars.ContextVar[int | None] = contextvars.ContextVar(
+    "minio_tpu_qos_affinity", default=None)
+
+
+def current_affinity() -> int | None:
+    """The lane-affinity key of the calling context (None = unpinned)."""
+    return _affinity.get()
+
+
+@contextlib.contextmanager
+def lane_affinity(key: int | None):
+    """Run a block under a device-lane affinity key; dispatch items
+    submitted inside inherit it (the object layer wraps put/get/heal
+    with its erasure set's key)."""
+    tok = _affinity.set(key)
+    try:
+        yield
+    finally:
+        _affinity.reset(tok)
+
+
+def set_affinity_key(pool_index: int, set_index: int) -> int:
+    """Stable lane-affinity key for one erasure set. crc32 — not
+    Python hash() — so the set→lane mapping survives process restarts
+    and agrees across dist peers."""
+    import zlib
+    return zlib.crc32(f"{pool_index}:{set_index}".encode()) & 0x7FFFFFFF
+
+
 from .admission import AdmissionController, classify_request  # noqa: E402
 from .budget import CostModel  # noqa: E402
 from .scheduler import QosScheduler  # noqa: E402
@@ -65,6 +99,7 @@ from .scheduler import QosScheduler  # noqa: E402
 __all__ = [
     "CLASS_INTERACTIVE", "CLASS_BACKGROUND", "CLASS_PRIORITY",
     "current_class", "work_class", "background",
+    "current_affinity", "lane_affinity", "set_affinity_key",
     "CostModel", "QosScheduler", "AdmissionController",
     "classify_request", "qos_status",
 ]

@@ -6,7 +6,12 @@ bytes / native-kernel rate for the CPU route) and are corrected by an
 EWMA of observed-vs-predicted flush wall times, so the model tracks the
 link as it drifts instead of trusting one probe forever. Each dispatch
 item then gets a predicted completion time (route backlog + corrected
-flush estimate) and a latency budget derived from its QoS class.
+flush estimate) and a latency budget derived from its QoS class. On a
+multi-chip host the backlog half of that prediction is PER FLUSH LANE:
+the scheduler (``qos.scheduler``) keeps one busy-until + queued-bytes
+model per device lane and feeds ``plan()`` the chosen lane's backlog,
+while this module's route estimates stay lane-agnostic (every chip
+shares one link profile).
 
 Env/KVS knobs (config subsystem ``qos``):
 

@@ -27,6 +27,7 @@ Env/KVS knobs (config subsystem ``qos``):
 from __future__ import annotations
 
 import threading
+import time
 
 from . import CLASS_BACKGROUND, CLASS_INTERACTIVE
 from .budget import CostModel, _config_float
@@ -47,19 +48,38 @@ def device_queue_bytes_cap() -> int:
                              float(DEFAULT_DEVICE_QUEUE_BYTES)))
 
 
+def lane_queue_bytes_cap(lanes: int) -> int:
+    """Per-lane queued-bytes cap for the per-device flush lanes; the
+    0 default derives an even split of the global device cap — one
+    saturated lane then spills to SIBLING lanes long before the global
+    cap would spill the whole mesh to CPU."""
+    v = _config_float("qos", "lane_queue_bytes",
+                      "MINIO_TPU_QOS_LANE_QUEUE_BYTES", 0.0)
+    if v > 0:
+        return int(v)
+    return max(1, device_queue_bytes_cap() // max(1, lanes))
+
+
 class QosScheduler:
     """Owned by a DispatchQueue; thread-safe."""
 
-    def __init__(self, cost: CostModel | None = None):
+    def __init__(self, cost: CostModel | None = None, lanes: int = 1):
         self.cost = cost or CostModel()
         self._lock = threading.Lock()
         #: bytes dispatched toward the device and not yet read back
         self._dev_queued_bytes = 0
+        # per-device flush lanes (mesh placement, ISSUE 11): queued
+        # bytes + predicted busy-until per lane, so plan() can spill a
+        # saturated lane to its SIBLINGS before spilling the item to CPU
+        self._lane_count = max(1, lanes)
+        self._lane_queued = [0] * self._lane_count
+        self._lane_busy_until = [0.0] * self._lane_count
         # telemetry — the minio_tpu_qos_* metric group and the admin qos
         # op read these
         self.spilled_items = 0
         self.spilled_batches = 0
         self.spill_reasons: dict[str, int] = {}
+        self.lane_diverts = 0
         self.class_items: dict[str, int] = {CLASS_INTERACTIVE: 0,
                                             CLASS_BACKGROUND: 0}
         self.deadline_misses: dict[str, int] = {CLASS_INTERACTIVE: 0,
@@ -67,17 +87,99 @@ class QosScheduler:
 
     # -- device queue accounting ---------------------------------------------
 
-    def device_dispatched(self, nbytes: int) -> None:
+    def configure_lanes(self, lanes: int) -> None:
+        """Size the per-lane state to the device topology (called once,
+        lazily, by the dispatch queue when the mesh first carries a
+        flush — the topology cannot change within a process)."""
+        lanes = max(1, lanes)
+        with self._lock:
+            if lanes == self._lane_count:
+                return
+            self._lane_count = lanes
+            self._lane_queued = [0] * lanes
+            self._lane_busy_until = [0.0] * lanes
+
+    def lane_count(self) -> int:
+        with self._lock:
+            return self._lane_count
+
+    def device_dispatched(self, nbytes: int, lane: int | None = None,
+                          flush_s: float = 0.0) -> None:
+        """Charge one launched flush to the queue model. ``lane`` is the
+        flush lane it occupies (None = an SPMD all-lanes launch: its
+        bytes ride only the global counter, but its predicted wall
+        extends EVERY lane's busy-until — all chips are occupied)."""
+        now = time.monotonic()
         with self._lock:
             self._dev_queued_bytes += nbytes
+            if flush_s > 0.0:
+                targets = range(self._lane_count) if lane is None \
+                    else (lane % self._lane_count,)
+                for i in targets:
+                    self._lane_busy_until[i] = \
+                        max(self._lane_busy_until[i], now) + flush_s
+            if lane is not None:
+                self._lane_queued[lane % self._lane_count] += nbytes
 
-    def device_completed(self, nbytes: int) -> None:
+    def device_completed(self, nbytes: int, lane: int | None = None) -> None:
         with self._lock:
             self._dev_queued_bytes = max(0, self._dev_queued_bytes - nbytes)
+            if lane is not None:
+                i = lane % self._lane_count
+                self._lane_queued[i] = max(0, self._lane_queued[i] - nbytes)
+                if self._lane_queued[i] == 0:
+                    # drained ahead of (or behind) the model: resync the
+                    # lane the same way dispatch resyncs the global model
+                    self._lane_busy_until[i] = min(
+                        self._lane_busy_until[i], time.monotonic())
+            if self._dev_queued_bytes == 0:
+                # the whole pipeline drained: clamp EVERY lane — SPMD
+                # flushes (lane=None) extend all lanes on dispatch but
+                # have no per-lane completion to resync them, so
+                # without this the lane model only ever ratchets up
+                now = time.monotonic()
+                for i in range(self._lane_count):
+                    self._lane_busy_until[i] = min(
+                        self._lane_busy_until[i], now)
+
+    def max_lane_backlog_s(self) -> float:
+        """Predicted drain seconds of the BUSIEST lane — what an SPMD
+        all-lanes launch must wait for."""
+        with self._lock:
+            return max(0.0, max(self._lane_busy_until) - time.monotonic())
 
     def device_queued_bytes(self) -> int:
         with self._lock:
             return self._dev_queued_bytes
+
+    def lane_queued_bytes(self) -> list[int]:
+        with self._lock:
+            return list(self._lane_queued)
+
+    def lane_backlog_s(self, lane: int) -> float:
+        """Predicted drain seconds of one lane's dispatched flushes."""
+        with self._lock:
+            i = lane % self._lane_count
+            return max(0.0, self._lane_busy_until[i] - time.monotonic())
+
+    def pick_lane(self, affinity: int, record: bool = True) -> int:
+        """The flush lane for an affinity key: the preferred lane
+        (``affinity % lanes`` — the erasure-set hash distribution)
+        unless it is over its per-lane queued-bytes cap, in which case
+        the least-loaded SIBLING takes the flush. Spill order is
+        device-lane → sibling-lane → CPU; the CPU leg belongs to
+        plan(), which re-checks the chosen lane's cap per item."""
+        cap = lane_queue_bytes_cap(self.lane_count())
+        with self._lock:
+            pref = affinity % self._lane_count
+            if self._lane_queued[pref] < cap or self._lane_count == 1:
+                return pref
+            sib = min(range(self._lane_count),
+                      key=lambda i: (self._lane_queued[i],
+                                     self._lane_busy_until[i]))
+            if record and sib != pref:
+                self.lane_diverts += 1
+        return sib
 
     # -- bookkeeping ----------------------------------------------------------
 
@@ -107,7 +209,7 @@ class QosScheduler:
     def plan(self, mode: str, profile, cls: str,
              sizes: list[tuple[int, int]], backlog_s: float,
              cpu_workers: int, record: bool = True,
-             cpu_scale: float = 1.0) -> int:
+             cpu_scale: float = 1.0, lane: int | None = None) -> int:
         """How many leading items of this flush take the device route;
         the rest spill to the CPU executor. ``sizes`` is per-item
         (bytes_in, bytes_out). ``record=False`` makes this a pure probe
@@ -119,7 +221,13 @@ class QosScheduler:
         the probe measured; the device workloads' CPU routes are pure
         Python / numpy references and pass their own factor from
         dispatch) — without it the model would spill a scan to a CPU
-        route it believes is 1000x faster than it is."""
+        route it believes is 1000x faster than it is.
+
+        ``lane`` is the flush lane this plan targets (from pick_lane);
+        when set, the per-LANE queued-bytes cap applies on top of the
+        global one and ``backlog_s`` should be that lane's backlog —
+        the caller already exhausted the sibling-lane leg of the spill
+        order, so a cap hit here really does mean CPU."""
         n = len(sizes)
         if mode == "cpu" or n == 0:
             return 0
@@ -140,6 +248,11 @@ class QosScheduler:
         factor = spill_factor()
         cap = device_queue_bytes_cap()
         queued = self.device_queued_bytes()
+        lane_cap = lane_queued = 0
+        if lane is not None:
+            lane_cap = lane_queue_bytes_cap(self.lane_count())
+            lane_queued = self.lane_queued_bytes()[
+                lane % self.lane_count()]
         budget = self.cost.budget_s(cls)
         cum_in = cum_out = 0
         for i, (b_in, b_out) in enumerate(sizes):
@@ -148,6 +261,11 @@ class QosScheduler:
             if queued + cum_in + cum_out > cap:
                 if record:
                     self._note_spill(n - i, "bytes_cap")
+                return i
+            if lane is not None and \
+                    lane_queued + cum_in + cum_out > lane_cap:
+                if record:
+                    self._note_spill(n - i, "lane_cap")
                 return i
             dev_i = backlog_s + self.cost.device_s(profile, cum_in, cum_out)
             cpu_i = self.cost.cpu_s(profile, b_in + b_out) * cpu_scale
@@ -172,6 +290,12 @@ class QosScheduler:
         return n
 
     def stats(self) -> dict:
+        # config-registry reads stay OUTSIDE the scheduler lock (they
+        # take the process-global ConfigSys lock)
+        caps = {"spill_factor": spill_factor(),
+                "device_queue_bytes_cap": device_queue_bytes_cap(),
+                "lane_queue_bytes_cap": lane_queue_bytes_cap(
+                    self.lane_count())}
         with self._lock:
             return {
                 "spilled_items": self.spilled_items,
@@ -180,7 +304,9 @@ class QosScheduler:
                 "class_items": dict(self.class_items),
                 "deadline_misses": dict(self.deadline_misses),
                 "device_queued_bytes": self._dev_queued_bytes,
-                "spill_factor": spill_factor(),
-                "device_queue_bytes_cap": device_queue_bytes_cap(),
+                "lanes": self._lane_count,
+                "lane_queued_bytes": list(self._lane_queued),
+                "lane_diverts": self.lane_diverts,
+                **caps,
                 "cost": self.cost.stats(),
             }

@@ -69,6 +69,19 @@ _OP_NAME = {"encode": "encode", "masked": "reconstruct", "fused": "fused",
             "encode_hashed": "encode_hashed",
             "select_scan": "select_scan", "sse_xor": "sse_xor"}
 
+#: ops exempt from the mesh-route contract (graftlint GL013): every
+#: ``b.op`` branch in ``_flush_device`` must either call
+#: ``sharded_batched`` under a ``mesh``-guarded arm or appear here —
+#: EMPTY because all six registered ops now carry a mesh route; a new
+#: op PR that ships device-only (the way select_scan did in PR 8) must
+#: either grow its route or register itself here, visibly.
+_MESH_SINGLE_DEVICE_OPS: frozenset = frozenset()
+
+#: per-device flush lanes: "auto" = one lane per local mesh device,
+#: an integer caps the lane count, "1"/"0" disables per-lane placement
+#: (every device flush rides the SPMD all-lanes route again)
+DISPATCH_LANES = os.environ.get("MINIO_TPU_DISPATCH_LANES", "auto")
+
 MAX_BATCH = int(os.environ.get("MINIO_TPU_DISPATCH_BATCH", "128"))
 MAX_DELAY_S = float(os.environ.get("MINIO_TPU_DISPATCH_DELAY_MS", "1.0")) / 1e3
 #: Link profile age after which a background re-probe is kicked (a one-shot
@@ -213,7 +226,8 @@ class _Pending:
 class _Bucket:
     def __init__(self, codec, op: str, hash_key: bytes | None = None,
                  chunk_size: int = 0, hash_algo: int = 0,
-                 cls: str = _qos.CLASS_INTERACTIVE):
+                 cls: str = _qos.CLASS_INTERACTIVE,
+                 affinity: int | None = None):
         self.codec = codec
         self.op = op  # 'encode' | 'masked' | 'fused'
         self.hash_key = hash_key
@@ -221,6 +235,10 @@ class _Bucket:
         self.hash_algo = hash_algo  # native ALGO_* id for 'fused'
         self.cls = cls  # QoS class: buckets never mix classes, so the
         # loop can flush interactive work ahead of heal/scanner batches
+        #: erasure-set lane affinity (qos.current_affinity at submit
+        #: time; rides the bucket key, so one flush never mixes sets):
+        #: None = unpinned — such flushes shard SPMD across ALL lanes
+        self.affinity = affinity
         self.items: list[_Pending] = []
         #: set while the loop holds this bucket for coalescing (device
         #: pipeline saturated); cleared at flush — feeds hold telemetry
@@ -398,9 +416,13 @@ class DispatchQueue:
                      params=params)
         # QoS class rides the bucket key: interactive PUT/GET work and
         # background heal/scanner work never share a flush, so the loop
-        # can order and spill them independently
+        # can order and spill them independently. The erasure-set lane
+        # affinity rides it too — folded to its flush-lane SLOT, so a
+        # flush is one lane's traffic (sets sharing a lane coalesce)
+        # and single-chip hosts keep coalescing across sets entirely
         cls = _qos.current_class()
-        key = key + (cls,)
+        affinity = self._affinity_slot(_qos.current_affinity())
+        key = key + (cls, affinity)
         # per-item wall latency through the queue (what a caller sees:
         # queue wait + flush + readback) into the last-minute window
         # behind minio_tpu_kernel_op_latency_seconds — and the per-class
@@ -442,7 +464,8 @@ class DispatchQueue:
             if b is None:
                 b = self._buckets[key] = _Bucket(codec, op, hash_key,
                                                  chunk_size, hash_algo,
-                                                 cls=cls)
+                                                 cls=cls,
+                                                 affinity=affinity)
             b.items.append(p)
             depth = len(b.items)
             self._cv.notify()
@@ -585,33 +608,94 @@ class DispatchQueue:
         bytes_in, bytes_out = self._item_bytes(b, items[0])
         return n * bytes_in, n * bytes_out
 
-    def _plan_flush(self, b: _Bucket, items: list[_Pending]) -> int:
+    @staticmethod
+    def _effective_lanes(names: tuple[str, ...]) -> int:
+        """Lane count after the MINIO_TPU_DISPATCH_LANES cap."""
+        n = len(names)
+        if DISPATCH_LANES not in ("", "auto"):
+            try:
+                n = min(n, max(1, int(DISPATCH_LANES)))
+            except ValueError:
+                pass
+        return n
+
+    def _affinity_slot(self, affinity: int | None) -> int | None:
+        """Fold a raw erasure-set affinity key into its flush-lane slot
+        for bucket keying: None when per-lane placement is inactive
+        (routing off, or a single-device host once the topology is
+        known) — so those hosts keep coalescing ACROSS sets instead of
+        splitting every flush per crc32 key for a lane decision that
+        always lands on the same device. Before the first device flush
+        resolves the topology the raw key passes through (a transient
+        conservative split; submit must never be what initializes the
+        backend) — except in forced-CPU mode, where no device flush
+        will ever resolve it and lane placement can never apply."""
+        if affinity is None or DISPATCH_LANES in ("0", "1") or \
+                os.environ.get("MINIO_TPU_DISPATCH_MODE", "auto") == "cpu":
+            return None
+        names = getattr(self, "_lanes_cache", None)
+        if names is None:
+            return affinity
+        n = self._effective_lanes(names)
+        return affinity % n if n > 1 else None
+
+    def _lane_for(self, b: _Bucket, record: bool = True) -> int | None:
+        """The flush lane this bucket's device work occupies, or None
+        for the SPMD all-lanes route (no affinity, lane routing off, or
+        a single-device host). Consults the scheduler's pick_lane so a
+        saturated preferred lane diverts to the least-loaded sibling —
+        the device-lane → sibling-lane leg of the spill order."""
+        if b.affinity is None or DISPATCH_LANES in ("0", "1"):
+            return None
+        n = self._effective_lanes(self._device_lanes())
+        if n <= 1:
+            return None
+        self.qos.configure_lanes(n)
+        return self.qos.pick_lane(b.affinity, record=record)
+
+    def _backlog_s(self, lane: int | None) -> float:
+        """Predicted drain seconds ahead of a new flush: the chosen
+        lane's own busy-until when per-lane routed; for SPMD all-lanes
+        flushes the busiest single lane (an SPMD launch waits on every
+        chip, and pinned flushes occupy lanes the global serial model
+        knows nothing about) joined with the global model."""
+        if lane is not None:
+            return self.qos.lane_backlog_s(lane)
+        with self._profile_lock:
+            g = max(0.0, self._dev_busy_until - time.monotonic())
+        return max(g, self.qos.max_lane_backlog_s())
+
+    def _plan_flush(self, b: _Bucket, items: list[_Pending]
+                    ) -> tuple[int, int | None]:
         """Per-item consultation of the QoS scheduler (replaces the old
         flush-granular device_wins coin flip): how many leading items of
-        this flush take the device route; the rest SPILL to the CPU
-        executor — even in forced-device mode, when an item's predicted
-        device completion exceeds ~N x its CPU estimate, its class
-        budget, or the device queued-bytes cap."""
+        this flush take the device route — and WHICH flush lane they
+        occupy — the rest SPILL to the CPU executor. Even in
+        forced-device mode an item spills when its predicted device
+        completion exceeds ~N x its CPU estimate, its class budget, or
+        the device/lane queued-bytes caps; a saturated lane first
+        diverts to a sibling lane (pick_lane) and only then to CPU."""
         mode = os.environ.get("MINIO_TPU_DISPATCH_MODE", "auto")
+        lane = None
         if mode == "cpu":
             n_dev = 0
         else:
             prof = self._get_profile()
-            with self._profile_lock:
-                backlog = max(0.0,
-                              self._dev_busy_until - time.monotonic())
+            lane = self._lane_for(b)
+            backlog = self._backlog_s(lane)
             sizes = [self._item_bytes(b, p) for p in items]
             n_dev = self.qos.plan(mode, prof, b.cls, sizes, backlog,
                                   self.completer_count,
                                   cpu_scale=_CPU_ROUTE_SCALE.get(b.op,
-                                                                 1.0))
+                                                                 1.0),
+                                  lane=lane)
         # flight recorder: the routing decision for this flush (always
         # recorded — a timeline without its plans is not a timeline;
         # spill REASONS ride the scheduler's own "spill" events)
         _tl.record("plan", op=_OP_NAME.get(b.op, b.op), n=len(items),
                    device=n_dev, spilled=len(items) - n_dev,
                    **{"class": b.cls})
-        return n_dev
+        return n_dev, lane
 
     @staticmethod
     def _rows_from_masks(masks: np.ndarray) -> np.ndarray:
@@ -902,13 +986,13 @@ class DispatchQueue:
         prof = self._profile
         if mode != "device" and prof is None:
             return False
-        with self._profile_lock:
-            backlog = max(0.0, self._dev_busy_until - time.monotonic())
+        lane = self._lane_for(b, record=False)
+        backlog = self._backlog_s(lane)
         sizes = [self._item_bytes(b, p) for p in b.items]
         return self.qos.plan(mode, prof, b.cls, sizes, backlog,
                              self.completer_count, record=False,
-                             cpu_scale=_CPU_ROUTE_SCALE.get(b.op,
-                                                            1.0)) > 0
+                             cpu_scale=_CPU_ROUTE_SCALE.get(b.op, 1.0),
+                             lane=lane) > 0
 
     def _flush(self, b: _Bucket, items: list[_Pending]):
         from .. import fault as _fault
@@ -925,11 +1009,11 @@ class DispatchQueue:
                            batch=len(items))
                 self._flush_cpu(b, items)
                 return
-        n_dev = self._plan_flush(b, items)
+        n_dev, lane = self._plan_flush(b, items)
         dev_items, cpu_items = items[:n_dev], items[n_dev:]
         if dev_items:
             try:
-                self._flush_device(b, dev_items)
+                self._flush_device(b, dev_items, lane)
             except Exception:  # noqa: BLE001 — dead/hung device: degrade
                 log.warning("device flush failed; falling back to CPU "
                             "route", exc_info=True)
@@ -951,60 +1035,111 @@ class DispatchQueue:
             self._profile_failed = True
             self._probe_failed_at = time.monotonic()
 
-    def _flush_device(self, b: _Bucket, items: list[_Pending]):
+    def _flush_device(self, b: _Bucket, items: list[_Pending],
+                      lane: int | None = None):
         # a lock held across an XLA launch is a convoy generator even
         # when it never deadlocks — lockrank reports the holder's stack
         _lr.note_blocking(f"device_flush:{b.op}")
-        trace_done = self._flush_trace_cb(b, items, "device")
-        span_done = self._flush_span_cb(b, items, "device")
-        tl_done = self._tl_flush_cb(b, items, "device",
-                                    self._device_lanes())
+        import jax
         import jax.numpy as jnp
-        from .mesh import object_mesh, replicated_for, sharded_batched
+        from .mesh import (mesh_device, object_mesh, replicated_for,
+                           sharded_batched)
         n = len(items)
         bsz = _pad_batch(n)
-        # multi-chip: shard the batch (objects) axis across the local mesh
-        # via shard_map — EC math has no cross-object reduction, so this is
-        # one SPMD launch with zero collectives, each chip taking bsz/n_dev
-        # blocks (and pallas kernels run per-device, which bare sharded
-        # inputs could not express)
+        # multi-chip routing, per-lane first: an affinity-pinned flush
+        # occupies ONE device lane (its erasure set's — jax.device_put
+        # commits the inputs there, siblings stay free for other sets);
+        # unpinned flushes shard the batch (objects) axis across the
+        # whole mesh via shard_map — EC math has no cross-object
+        # reduction, so that is one SPMD launch with zero collectives,
+        # each chip taking bsz/n_dev blocks (and pallas kernels run
+        # per-device, which bare sharded inputs could not express)
         mesh = object_mesh()
-        if mesh is not None and bsz % mesh.devices.size:
+        pin = mesh_device(lane) if lane is not None else None
+        use_mesh = mesh is not None and pin is None
+        if use_mesh and bsz % mesh.devices.size:
             bsz += -bsz % mesh.devices.size
+        # the flight recorder gets the lane(s) the flush ACTUALLY
+        # occupies: the pinned device lane, every mesh lane for an SPMD
+        # launch, the default device otherwise
+        if pin is not None:
+            lanes = (f"dev{pin.id}",)
+        else:
+            lanes = self._device_lanes()
+        trace_done = self._flush_trace_cb(b, items, "device")
+        span_done = self._flush_span_cb(b, items, "device")
+        tl_done = self._tl_flush_cb(b, items, "device", lanes)
+
+        def dev(arr):
+            """Input placement for this flush's route: committed to the
+            pinned lane device, default placement otherwise."""
+            return jax.device_put(arr, pin) if pin is not None \
+                else jnp.asarray(arr)
+
         # count first so the fallback's decrement is always balanced
         self.batches += 1
         self.items += n
         self.device_batches += 1
         self.device_items += n
         if b.op == "sse_xor":
-            # per-object package keys: one kernel launch per item inside
-            # this ONE flush (shared fault hook, kernel span, accounting)
-            from ..ops.chacha_pallas import xor_packages_device
-            out_dev = [xor_packages_device(p.params[0], p.params[1],
-                                           p.words) for p in items]
+            # per-object package keys ride per-LANE kernel inputs now:
+            # the whole flush — many objects, each with its own key —
+            # is ONE padded multi-package launch (multi_fn_for) instead
+            # of a Python loop of per-item launches, and the item axis
+            # shards over the mesh like every other op
+            from ..ops.chacha_pallas import multi_fn_for, multi_jitted
+            pkgs, words = items[0].words.shape
+            for p in items:
+                nc = p.params[1]
+                if not (len(nc) == pkgs and np.all(nc[:, 0] == nc[0, 0])
+                        and np.all(nc[:, 1] == nc[0, 1])):
+                    raise ValueError(
+                        "packages of one item share nonce words 0/1 "
+                        "(base_iv[:8]); only word 2 varies per package")
+            keys = np.stack(
+                [np.frombuffer(p.params[0], "<u4") for p in items] +
+                [np.frombuffer(items[0].params[0], "<u4")] * (bsz - n))
+            nonces = np.stack(
+                [p.params[1].astype(np.uint32) for p in items] +
+                [items[0].params[1].astype(np.uint32)] * (bsz - n))
+            data = np.stack([p.words for p in items] +
+                            [items[0].words] * (bsz - n))
+            if use_mesh:
+                fn = sharded_batched(multi_fn_for(pkgs, words), mesh,
+                                     (True, True, True), out_batch=2)
+                out_dev = fn(keys, nonces, data)
+            else:
+                out_dev = multi_jitted(pkgs, words)(
+                    dev(keys), dev(nonces), dev(data))
+            if bsz != n:  # drop pad lanes ON DEVICE, not over the link
+                out_dev = (out_dev[0][:n], out_dev[1][:n])
             self._account_and_complete(b, out_dev, items, span_done,
-                                       trace_done, tl_done)
+                                       trace_done, tl_done, lane=lane)
             return
         stack = np.stack([p.words for p in items] +
                          [items[0].words] * (bsz - n))
         if b.op == "select_scan":
             # every item of a select_scan bucket shares (program, cols,
-            # delim, max_rows) — they ride the bucket key. Single-device
-            # for now: the mesh-sharded route is ROADMAP item 2's
-            # extension point, same as the erasure ops grew theirs.
+            # delim, max_rows) — they ride the bucket key; the block
+            # (batch) axis shards over the mesh exactly like the
+            # erasure ops' routes
             from ..ops.scan_pallas import scan_fn_for
             program, cols, delim, max_rows = items[0].params
             fn = scan_fn_for(program, cols, delim,
                              stack.shape[-1] * 4, max_rows)
-            out_dev = fn(jnp.asarray(stack[:, 0, :]))
-        elif b.op == "encode":
-            if mesh is None:
-                out_dev = b.codec.encode_words_batch(jnp.asarray(stack))
+            blocks = stack[:, 0, :]
+            if use_mesh:
+                out_dev = sharded_batched(fn, mesh, (True,))(blocks)
             else:
+                out_dev = fn(dev(blocks))
+        elif b.op == "encode":
+            if use_mesh:
                 fn = sharded_batched(b.codec._mm_batch, mesh, (False, True))
                 out_dev = fn(replicated_for(
                     b.codec, "_mesh_enc_masks", b.codec._enc_masks, mesh),
                     stack)
+            else:
+                out_dev = b.codec.encode_words_batch(dev(stack))
         elif b.op == "encode_hashed":
             from ..obs import metrics as _mx
             from ..ops.fused import encode_hashed_fn_for
@@ -1013,21 +1148,20 @@ class DispatchQueue:
                                          b.chunk_size, b.hash_algo)
             _mx.inc("minio_tpu_pipeline_fused_hash_flushes_total",
                     op="encode_hashed")
-            if mesh is None:
-                out_dev = inner(jnp.asarray(stack))
-            else:
+            if use_mesh:
                 fn = sharded_batched(inner, mesh, (True,), out_batch=2)
                 out_dev = fn(stack)
+            else:
+                out_dev = inner(dev(stack))
         elif b.op == "masked":
             masks = np.stack([p.masks for p in items] +
                              [items[0].masks] * (bsz - n))
-            if mesh is None:
-                out_dev = b.codec._mm_batch_per(jnp.asarray(masks),
-                                                jnp.asarray(stack))
-            else:
+            if use_mesh:
                 fn = sharded_batched(b.codec._mm_batch_per, mesh,
                                      (True, True))
                 out_dev = fn(masks, stack)
+            else:
+                out_dev = b.codec._mm_batch_per(dev(masks), dev(stack))
         else:  # 'fused': verify source digests + rebuild in one launch
             from ..obs import metrics as _mx
             from ..ops.fused import fused_fn_for
@@ -1040,38 +1174,57 @@ class DispatchQueue:
             inner = fused_fn_for(b.hash_key, stack.shape[-1] * 4,
                                  b.codec._mm_batch_per, b.chunk_size,
                                  b.hash_algo)
-            if mesh is None:
-                out_dev = inner(jnp.asarray(masks), jnp.asarray(stack),
-                                jnp.asarray(digs))
-            else:
+            if use_mesh:
                 fn = sharded_batched(inner, mesh, (True, True, True),
                                      out_batch=2)
                 out_dev = fn(masks, stack, digs)
+            else:
+                out_dev = inner(dev(masks), dev(stack), dev(digs))
+        if bsz != n:
+            # slice the padded batch tail to n ON DEVICE before the
+            # host readback: the completer used to down-link up to
+            # (mesh multiple - 1) copies of items[0] per flush and
+            # discard them on unpack — pad bytes never ride the link
+            # and never count in _flush_bytes' QoS accounting
+            out_dev = tuple(o[:n] for o in out_dev) \
+                if isinstance(out_dev, tuple) else out_dev[:n]
         self._account_and_complete(b, out_dev, items, span_done,
-                                   trace_done, tl_done)
+                                   trace_done, tl_done, lane=lane)
 
     def _account_and_complete(self, b: _Bucket, out_dev,
                               items: list[_Pending], span_done,
-                              trace_done, tl_done=None):
+                              trace_done, tl_done=None,
+                              lane: int | None = None):
         """Post-launch tail shared by every device flush: extend the
-        queue model, account queued bytes, attach trace/span callbacks
-        and hand host readback to a completer so the next batch launches
-        while this one's transfer is still in flight."""
+        queue model (the chosen LANE's busy-until for pinned flushes,
+        every lane's for SPMD), account queued bytes, attach trace/span
+        callbacks and hand host readback to a completer so the next
+        batch launches while this one's transfer is still in flight."""
         # queue model: extend the predicted drain deadline by this
         # flush's link+kernel estimate so the scheduler sees the backlog
         prof = self._profile
         accounted = prof is not None
         bytes_in, bytes_out = self._flush_bytes(b, items)
         predicted_s = 0.0
+        flush_s = 0.0
         if accounted:
             predicted_s = self.qos.cost.device_s(prof, bytes_in, bytes_out)
+            flush_s = prof.device_flush_s(bytes_in, bytes_out)
             now = time.monotonic()
             with self._profile_lock:
                 self._dev_inflight += 1
-                self._dev_busy_until = max(self._dev_busy_until, now) + \
-                    prof.device_flush_s(bytes_in, bytes_out)
-        # per-route queued-bytes accounting feeds the scheduler's cap
-        self.qos.device_dispatched(bytes_in + bytes_out)
+                if lane is None:
+                    # only SPMD flushes extend the global serial model:
+                    # a pinned flush occupies ONE lane (its wall lives
+                    # in the scheduler's per-lane busy-until) — summing
+                    # 8 parallel lanes' walls into one serial deadline
+                    # read as ~8x backlog and spilled idle-mesh work
+                    self._dev_busy_until = \
+                        max(self._dev_busy_until, now) + flush_s
+        # per-route queued-bytes accounting feeds the scheduler's caps
+        # (global + this flush's lane)
+        self.qos.device_dispatched(bytes_in + bytes_out, lane=lane,
+                                   flush_s=flush_s)
         # hand host readback to a completer so the next batch launches now
         for p in items:
             if trace_done is not None:
@@ -1084,10 +1237,10 @@ class DispatchQueue:
             self._completers.submit(self._complete, b, out_dev, items,
                                     accounted, bytes_in + bytes_out,
                                     predicted_s, time.monotonic(),
-                                    span_done, tl_done)
+                                    span_done, tl_done, lane)
         except BaseException:  # submit refused (shutdown): the paired
-            self.qos.device_completed(bytes_in + bytes_out)  # decrement
-            if accounted:  # and the pipeline slot must not stay occupied
+            self.qos.device_completed(bytes_in + bytes_out, lane=lane)
+            if accounted:  # the pipeline slot must not stay occupied
                 with self._profile_lock:
                     self._dev_inflight = max(0, self._dev_inflight - 1)
             raise  # must not leak into the queued-bytes cap
@@ -1095,11 +1248,11 @@ class DispatchQueue:
     def _complete(self, b: _Bucket, out_dev, items: list[_Pending],
                   accounted: bool = True, qbytes: int = 0,
                   predicted_s: float = 0.0, t0: float = 0.0,
-                  span_done=None, tl_done=None):
+                  span_done=None, tl_done=None, lane: int | None = None):
         try:
             self._finish_readback(b, out_dev, items, span_done, tl_done)
         finally:
-            self.qos.device_completed(qbytes)
+            self.qos.device_completed(qbytes, lane=lane)
             if predicted_s > 0.0 and t0 > 0.0:
                 # observed flush wall corrects the route cost EWMA
                 self.qos.cost.observe("device", predicted_s,
@@ -1120,10 +1273,15 @@ class DispatchQueue:
                          tl_done=None):
         try:
             if b.op == "sse_xor":
-                # one (ct, poly_keys) device pair per item
-                for (ct_d, pk_d), p in zip(out_dev, items):
-                    p.future.set_result(
-                        (np.asarray(ct_d), np.asarray(pk_d)))
+                # one batched (ct, poly_keys) pair for the whole flush.
+                # Each item gets a COPY, not a view: sse results are
+                # full payload bytes, and a view would pin the entire
+                # flush's batched array for as long as ANY consumer
+                # (e.g. one slow streaming writer) holds its slice
+                ct = np.asarray(out_dev[0])
+                pk = np.asarray(out_dev[1])
+                for i, p in enumerate(items):
+                    p.future.set_result((ct[i].copy(), pk[i].copy()))
             elif b.op in ("fused", "encode_hashed"):
                 out = np.asarray(out_dev[0])
                 extra = np.asarray(out_dev[1])  # valid mask / digests
@@ -1170,6 +1328,17 @@ class DispatchQueue:
             t.join(timeout=10)
         self._completers.shutdown(wait=True)
 
+    def lane_queued_bytes(self) -> dict:
+        """Per-lane queued bytes {lane_name: bytes} for the metrics
+        plane. Empty until a device flush resolved the lane topology —
+        a metrics scrape must never be what initializes the backend."""
+        names = getattr(self, "_lanes_cache", None)
+        if not names or len(names) <= 1:
+            return {}
+        queued = self.qos.lane_queued_bytes()
+        return {names[i]: (queued[i] if i < len(queued) else 0)
+                for i in range(len(names))}
+
     def stats(self) -> dict:
         with self._cv:
             qdepth = sum(len(b.items) for b in self._buckets.values())
@@ -1187,6 +1356,8 @@ class DispatchQueue:
                 "deadline_misses": dict(self.qos.deadline_misses),
                 "queue_depth": qdepth,
                 "device_queued_bytes": self.qos.device_queued_bytes(),
+                "lane_diverts": self.qos.lane_diverts,
+                "lane_queued_bytes": self.lane_queued_bytes(),
                 "avg_batch": self.items / self.batches if self.batches else 0}
 
 

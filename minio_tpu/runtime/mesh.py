@@ -23,6 +23,7 @@ before.
 from __future__ import annotations
 
 import threading
+import weakref
 
 import numpy as np
 
@@ -59,6 +60,18 @@ def mesh_size() -> int:
     return int(m.devices.size) if m is not None else 1
 
 
+def mesh_device(lane: int):
+    """The device backing flush lane ``lane`` (mesh order), or None when
+    no multi-device mesh exists — per-lane flushes pin their inputs here
+    via jax.device_put so one erasure set's traffic occupies exactly one
+    chip while siblings serve other sets."""
+    m = object_mesh()
+    if m is None:
+        return None
+    devs = m.devices.flatten()
+    return devs[lane % devs.size]
+
+
 def put_replicated(arr, mesh):
     import jax
     from jax.sharding import NamedSharding, PartitionSpec
@@ -78,7 +91,28 @@ def replicated_for(obj, attr: str, arr, mesh):
     return cached[1]
 
 
-_shard_cache: dict = {}
+#: jit(shard_map(fn)) wrappers are cached ON THE FUNCTION OBJECT
+#: itself (an attribute holding {(mesh, batch_args, out_batch): w}):
+#: the old module dict keyed on id(fn) served a stale jitted executable
+#: for a DIFFERENT function once the original was GC'd and its id
+#: reused, and grew without bound, pinning every compiled program it
+#: ever built (the same hazard replicated_for's docstring calls out for
+#: constants). The wrapper references fn, so the attribute forms a pure
+#: reference CYCLE — the gc frees both together when the last external
+#: reference drops (an lru-evicted kernel factory result takes its
+#: sharded wrappers with it). A WeakKeyDictionary could NOT express
+#: this: its values hold strong references, and value→key would pin
+#: every entry forever. ``_cached_fns`` (weak) only counts live owners
+#: for tests/telemetry.
+_CACHE_ATTR = "__mesh_shard_cache__"
+_cached_fns: "weakref.WeakSet" = weakref.WeakSet()
+_shard_cache_lock = threading.Lock()
+
+
+def shard_cache_len() -> int:
+    """Live functions owning sharded-wrapper caches (tests pin the GC
+    behavior: entries must die with their fn)."""
+    return len(_cached_fns)
 
 
 def sharded_batched(fn, mesh, batch_args: tuple[bool, ...],
@@ -91,10 +125,12 @@ def sharded_batched(fn, mesh, batch_args: tuple[bool, ...],
     lower to pallas_call, which XLA cannot auto-partition; under shard_map
     each device runs the kernel on its local block, which is exactly the
     semantics the objects axis needs (no cross-shard math)."""
-    key = (id(fn), mesh, batch_args, out_batch)
-    w = _shard_cache.get(key)
-    if w is not None:
-        return w
+    key = (mesh, batch_args, out_batch)
+    per_fn = getattr(fn, _CACHE_ATTR, None)
+    if per_fn is not None:
+        w = per_fn.get(key)
+        if w is not None:
+            return w
     import jax
     from jax.sharding import PartitionSpec as P
     in_specs = tuple(P("objects") if b else P() for b in batch_args)
@@ -107,7 +143,17 @@ def sharded_batched(fn, mesh, batch_args: tuple[bool, ...],
         from jax.experimental.shard_map import shard_map as _sm
         sm = _sm(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
                  check_rep=False)
-    w = _shard_cache[key] = jax.jit(sm)
+    w = jax.jit(sm)
+    try:  # bound methods / exotic callables: build uncached —
+        with _shard_cache_lock:  # correctness over reuse
+            per_fn = getattr(fn, _CACHE_ATTR, None)
+            if per_fn is None:
+                per_fn = {}
+                setattr(fn, _CACHE_ATTR, per_fn)
+            per_fn[key] = w
+        _cached_fns.add(fn)
+    except (AttributeError, TypeError):
+        pass
     return w
 
 

@@ -78,7 +78,12 @@ def test_scanner_usage_and_deep_scan(tmp_path):
     sc.cycle = 15  # next cycle is a deep one
     sc.scan_cycle()
     mrf.drain()
-    time.sleep(0.5)
+    # poll, don't sleep (same de-flake as test_mrf_heals_degraded_object):
+    # mid-suite the heal rebuild can ride a device-lane flush whose
+    # first per-device jit compile outruns any fixed sleep
+    deadline = time.monotonic() + 60.0
+    while mrf.healed < 1 and time.monotonic() < deadline:
+        time.sleep(0.05)
     assert mrf.healed >= 1
     # shard is repaired
     disks[0].verify_file("b2", "big", disks[0].read_version("b2", "big"))

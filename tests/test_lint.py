@@ -596,3 +596,104 @@ def test_gl012_real_module_and_foreign_paths_clean():
             return statistics.quantiles(samples, n=100)
     """, path="minio_tpu/obs/other.py")
     assert not checkers.check_slo_plane(ctx)
+
+
+# --------------------------------------------------------------------------
+# GL013 — every dispatch op branch in _flush_device carries a mesh route
+
+
+_GL013_OK = """
+    _OP_NAME = {"encode": "encode", "masked": "reconstruct",
+                "weird": "weird"}
+    _MESH_SINGLE_DEVICE_OPS = frozenset({"weird"})
+    class DispatchQueue:
+        def _flush_device(self, b, items, lane=None):
+            mesh = object_mesh()
+            use_mesh = mesh is not None and lane is None
+            if b.op == "weird":
+                out = weird_launch(items)    # exempt: registry entry
+            elif b.op == "encode":
+                if use_mesh:
+                    out = sharded_batched(b.codec._mm_batch, mesh,
+                                          (False, True))(m, stack)
+                else:
+                    out = b.codec.encode_words_batch(stack)
+            else:   # masked rides the else branch
+                if mesh is not None:
+                    out = sharded_batched(b.codec._mm_batch_per, mesh,
+                                          (True, True))(masks, stack)
+                else:
+                    out = b.codec._mm_batch_per(masks, stack)
+"""
+
+
+def test_gl013_routed_and_exempt_ops_clean():
+    ctx = ctx_for(_GL013_OK, path="minio_tpu/runtime/dispatch.py")
+    assert not checkers.check_mesh_routes(ctx)
+    # out of scope anywhere else
+    assert not checkers.check_mesh_routes(
+        ctx_for(_GL013_OK, path="minio_tpu/runtime/other.py"))
+
+
+def test_gl013_device_only_branch_flagged():
+    """The select_scan regression this checker exists for: an op branch
+    that launches device-only (no sharded_batched under a mesh arm) and
+    is NOT in the exemption registry."""
+    ctx = ctx_for("""
+        _OP_NAME = {"encode": "encode", "select_scan": "select_scan"}
+        _MESH_SINGLE_DEVICE_OPS = frozenset()
+        class DispatchQueue:
+            def _flush_device(self, b, items):
+                mesh = object_mesh()
+                if b.op == "select_scan":
+                    out = scan_fn(stack)     # device-only — finding
+                else:
+                    if mesh is not None:
+                        out = sharded_batched(f, mesh, (True,))(stack)
+                    else:
+                        out = f(stack)
+    """, path="minio_tpu/runtime/dispatch.py")
+    found = checkers.check_mesh_routes(ctx)
+    assert [f.token for f in found] == ["mesh-route:select_scan"]
+    assert all(f.checker == "GL013" for f in found)
+
+
+def test_gl013_unguarded_shard_call_and_missing_registry_flagged():
+    # sharded_batched NOT under a mesh-guarded arm does not count, and
+    # a dispatch module without the exemption registry is itself a
+    # finding — exemptions must be an explicit reviewable literal
+    ctx = ctx_for("""
+        _OP_NAME = {"encode": "encode"}
+        class DispatchQueue:
+            def _flush_device(self, b, items):
+                if b.op == "encode":
+                    out = sharded_batched(f, m, (True,))(stack)
+    """, path="minio_tpu/runtime/dispatch.py")
+    tokens = {f.token for f in checkers.check_mesh_routes(ctx)}
+    assert tokens == {"_MESH_SINGLE_DEVICE_OPS", "mesh-route:encode"}
+
+
+def test_gl013_unhandled_registry_op_flagged():
+    """An _OP_NAME op no branch (and no else) handles cannot have a
+    mesh route — the new-op-PR failure mode caught at lint time."""
+    ctx = ctx_for("""
+        _OP_NAME = {"encode": "encode", "new_op": "new_op"}
+        _MESH_SINGLE_DEVICE_OPS = frozenset()
+        class DispatchQueue:
+            def _flush_device(self, b, items):
+                mesh = object_mesh()
+                if b.op == "encode":
+                    if mesh is not None:
+                        out = sharded_batched(f, mesh, (True,))(stack)
+                    else:
+                        out = f(stack)
+    """, path="minio_tpu/runtime/dispatch.py")
+    found = checkers.check_mesh_routes(ctx)
+    assert [f.token for f in found] == ["mesh-route:new_op"]
+
+
+def test_gl013_real_dispatch_module_clean():
+    real = graftlint.parse_file(os.path.join(
+        graftlint.REPO_ROOT, "minio_tpu", "runtime", "dispatch.py"))
+    assert real is not None
+    assert not checkers.check_mesh_routes(real)

@@ -146,3 +146,235 @@ def test_build_sharded_step_matches_reference():
         want = gf256.gf_matmul_ref(enc[K:], data[i])
         got = rs_jax.unpack_shards(np.asarray(parity[i]))
         assert np.array_equal(np.stack(got), want)
+
+
+def test_dispatch_encode_hashed_sharded():
+    """Fused encode+hash rides the mesh (out_batch=2 shard_map): parity
+    AND per-chunk digests bit-exact vs the host reference, with a
+    non-multiple-of-8 batch so padded tail lanes exercise the on-device
+    slice before readback."""
+    from minio_tpu.erasure import bitrot
+    from minio_tpu.erasure.codec import Erasure
+    os.environ["MINIO_TPU_DISPATCH_MODE"] = "device"
+    try:
+        # interpret-mode fused-hash compiles are expensive on CPU
+        # hosts: <= 8 items all pad to ONE bsz=8 mesh shape, so the
+        # whole test pays a single jit (same budgeting rule as
+        # tests/test_chacha.py's tier-1 shape set)
+        er = Erasure(4, 2, 1 << 16)
+        C = 16384
+        rng = np.random.default_rng(5)
+        bufs = [rng.integers(0, 256, 1 << 16, dtype=np.uint8).tobytes()
+                for _ in range(5)]   # 5 % 8 != 0: pad tail sliced
+        # algo 0 (HighwayHash, jnp lane): the mur3-PALLAS hash lane in
+        # interpret mode costs a ~60 s trace — the mesh ROUTE under
+        # test is hash-impl-agnostic, and mur3 bit-identity is pinned
+        # in test_mur3/test_pipeline
+        futs = [er.encode_hashed_async(b, C, 0) for b in bufs]
+        for buf, f in zip(bufs, futs):
+            data2d, parity2d, digs = f.result(timeout=180)
+            both = np.concatenate([data2d, parity2d])
+            ref = er.encode_data(buf)
+            for i in range(6):
+                assert (both[i] == ref[i]).all()
+            assert (digs == bitrot.shard_chunk_digests(both, C, 0)).all()
+    finally:
+        del os.environ["MINIO_TPU_DISPATCH_MODE"]
+
+
+def test_dispatch_select_scan_sharded():
+    """The select_scan mesh route (the op PR 8 shipped device-only):
+    block batches shard over the objects axis, codes bit-identical to
+    the pure-Python reference — including an 11-block batch that pads
+    up to the mesh multiple."""
+    from minio_tpu.ops.scan_pallas import scan_blocks_reference
+    from minio_tpu.runtime.dispatch import DispatchQueue
+    os.environ["MINIO_TPU_DISPATCH_MODE"] = "device"
+    q = DispatchQueue()
+    try:
+        rng = np.random.default_rng(6)
+        program = (("num", 0, "gt", 500),)
+        cols, delim, max_rows, L = (1,), 44, 64, 4096
+        blocks = []
+        for _ in range(11):
+            body = b"".join(
+                b"%d,%d\n" % (i, rng.integers(0, 1000))
+                for i in range(40))
+            buf = np.full(L, 10, np.uint8)
+            buf[:len(body)] = np.frombuffer(body, np.uint8)
+            blocks.append(buf)
+        futs = [q.select_scan(blk.view("<u4").reshape(1, -1), program,
+                              cols, delim, max_rows) for blk in blocks]
+        for blk, f in zip(blocks, futs):
+            got = np.asarray(f.result(timeout=30)).reshape(-1)
+            want = scan_blocks_reference(blk.reshape(1, -1), program,
+                                         cols, delim, max_rows)[0]
+            assert np.array_equal(got, want)
+        assert q.cpu_batches == 0 and q.device_batches >= 1
+    finally:
+        q.stop()
+        del os.environ["MINIO_TPU_DISPATCH_MODE"]
+
+
+def test_dispatch_sse_xor_sharded_multi_key():
+    """sse_xor is ONE padded multi-package launch per flush now (no
+    per-item launch loop), sharded over the mesh — items with DISTINCT
+    package keys coalesce and stay bit-identical to the numpy
+    reference and to their own single-item device launches."""
+    from minio_tpu.crypto.chacha20poly1305 import keystream_xor
+    from minio_tpu.runtime.dispatch import DispatchQueue
+    os.environ["MINIO_TPU_DISPATCH_MODE"] = "device"
+    q = DispatchQueue()
+    try:
+        # ONE small shape → one interpret-mode kernel compile (the
+        # ~30 s/shape budget rule from tests/test_chacha.py); per-item
+        # bit-identity vs the single-item device launch is pinned in
+        # test_chacha — the numpy reference pins the same bytes here
+        rng = np.random.default_rng(8)
+        P, L = 2, 64
+        futs, refs = [], []
+        for i in range(5):   # 5 % 8 != 0: pad lanes sliced on device
+            key = bytes(rng.integers(0, 256, 32, dtype=np.uint8))
+            n01 = rng.integers(0, 2 ** 32, 2, dtype=np.uint32)
+            nonces = np.stack([np.array([n01[0], n01[1], s], np.uint32)
+                               for s in range(P)])
+            data = rng.integers(0, 256, (P, L), dtype=np.uint8)
+            words = np.ascontiguousarray(data).view("<u4")
+            futs.append(q.sse_xor(words, key, nonces))
+            refs.append(keystream_xor(key, nonces, data))
+        for f, (want_ct, want_pk) in zip(futs, refs):
+            ct, pk = f.result(timeout=180)
+            assert np.array_equal(
+                np.ascontiguousarray(ct).view(np.uint8), want_ct)
+            assert np.array_equal(
+                np.ascontiguousarray(pk).view(np.uint8), want_pk)
+        assert q.cpu_batches == 0 and q.device_batches >= 1
+    finally:
+        q.stop()
+        del os.environ["MINIO_TPU_DISPATCH_MODE"]
+
+
+def test_mesh_routes_salvage_on_injected_fault():
+    """Chaos leg of the acceptance criterion: an injected kernel fault
+    on the new mesh routes reroutes the flush to the CPU executor —
+    results stay bit-identical (select_scan's CPU twin, the numpy
+    ChaCha lane)."""
+    from minio_tpu import fault
+    from minio_tpu.crypto.chacha20poly1305 import keystream_xor
+    from minio_tpu.ops.scan_pallas import scan_blocks_reference
+    from minio_tpu.runtime.dispatch import DispatchQueue
+    os.environ["MINIO_TPU_DISPATCH_MODE"] = "device"
+    rid1 = fault.arm("kernel:device:select_scan:error(FaultyDisk)")
+    rid2 = fault.arm("kernel:device:sse_xor:error(FaultyDisk)")
+    q = DispatchQueue()
+    try:
+        rng = np.random.default_rng(9)
+        buf = np.full(4096, 10, np.uint8)
+        body = b"7,900\n1,100\n"
+        buf[:len(body)] = np.frombuffer(body, np.uint8)
+        program, cols = (("num", 1, "gt", 500),), (0, 1)
+        got = np.asarray(q.select_scan(
+            buf.view("<u4").reshape(1, -1), program, cols, 44,
+            16).result(timeout=30)).reshape(-1)
+        want = scan_blocks_reference(buf.reshape(1, -1), program, cols,
+                                     44, 16)[0]
+        assert np.array_equal(got, want)
+        key = bytes(rng.integers(0, 256, 32, dtype=np.uint8))
+        nonces = np.stack([np.array([1, 2, s], np.uint32)
+                           for s in range(4)])
+        data = rng.integers(0, 256, (4, 256), dtype=np.uint8)
+        ct, pk = q.sse_xor(np.ascontiguousarray(data).view("<u4"), key,
+                           nonces).result(timeout=30)
+        want_ct, want_pk = keystream_xor(key, nonces, data)
+        assert np.array_equal(
+            np.ascontiguousarray(ct).view(np.uint8), want_ct)
+        assert np.array_equal(
+            np.ascontiguousarray(pk).view(np.uint8), want_pk)
+        assert q.cpu_batches >= 2   # both flushes salvaged on CPU
+    finally:
+        q.stop()
+        fault.disarm(rid1)
+        fault.disarm(rid2)
+        del os.environ["MINIO_TPU_DISPATCH_MODE"]
+
+
+def test_shard_cache_keyed_on_function_identity():
+    """Satellite regression (mesh._shard_cache): wrappers cache per
+    LIVE function object — same fn returns the same jitted wrapper,
+    distinct fns never share one, and a GC'd fn's entries are evicted
+    (no unbounded growth, no stale executable after id reuse)."""
+    import gc
+
+    mesh = mesh_mod.object_mesh()
+
+    def f1(x):
+        return x + 1
+
+    w1 = mesh_mod.sharded_batched(f1, mesh, (True,))
+    assert mesh_mod.sharded_batched(f1, mesh, (True,)) is w1
+    base = mesh_mod.shard_cache_len()
+
+    def f2(x):
+        return x * 2
+
+    w2 = mesh_mod.sharded_batched(f2, mesh, (True,))
+    assert w2 is not w1
+    assert mesh_mod.shard_cache_len() == base + 1
+    out = np.asarray(w2(np.arange(16, dtype=np.int32)))
+    assert np.array_equal(out, np.arange(16, dtype=np.int32) * 2)
+    del f2, w2
+    gc.collect()
+    assert mesh_mod.shard_cache_len() == base, \
+        "dead fn's cache entry must die with it"
+    # the surviving wrapper still serves the right function
+    assert np.array_equal(
+        np.asarray(w1(np.arange(16, dtype=np.int32))),
+        np.arange(16, dtype=np.int32) + 1)
+
+
+def test_lane_affinity_pins_flush_to_one_device():
+    """Per-device flush lanes: affinity-tagged flushes occupy exactly
+    ONE lane (recorded truthfully by the flight recorder), distinct
+    affinities fan out to distinct lanes, unpinned flushes still ride
+    the SPMD all-lanes route — results bit-exact throughout."""
+    import time
+
+    from minio_tpu import qos
+    from minio_tpu.obs import timeline as tl
+    from minio_tpu.runtime.dispatch import DispatchQueue
+    K, M, W = 8, 4, 1024
+    codec = rs_jax.get_codec(K, M)
+    enc = gf256.build_matrix(K, M)
+    rng = np.random.default_rng(10)
+    data = rng.integers(0, 256, (12, K, W), dtype=np.uint8)
+    os.environ["MINIO_TPU_DISPATCH_MODE"] = "device"
+    q = DispatchQueue()
+    t0 = time.monotonic()
+    try:
+        futs = []
+        for i in range(12):
+            with qos.lane_affinity(qos.set_affinity_key(0, i % 4)):
+                futs.append(q.encode(codec, rs_jax.pack_shards(data[i])))
+        for i, f in enumerate(futs):
+            got = np.stack(rs_jax.unpack_shards(f.result(timeout=30))[:M])
+            assert np.array_equal(got, gf256.gf_matmul_ref(enc[K:],
+                                                           data[i]))
+        evs = [e for e in tl.snapshot(since=t0)
+               if e["type"] == "flush_end" and e.get("route") == "device"]
+        lanesets = {tuple(e["lanes"]) for e in evs}
+        assert all(len(t) == 1 for t in lanesets), \
+            f"affinity flushes must occupy ONE lane, got {lanesets}"
+        assert len(lanesets) >= 2, "sets must fan out across lanes"
+        # per-lane queued-bytes surface exists once lanes are active
+        assert set(q.lane_queued_bytes()) == {f"dev{i}" for i in range(8)}
+        # an unpinned flush records ALL lanes (SPMD — truthful)
+        t1 = time.monotonic()
+        f = q.encode(codec, rs_jax.pack_shards(data[0]))
+        got = np.stack(rs_jax.unpack_shards(f.result(timeout=30))[:M])
+        assert np.array_equal(got, gf256.gf_matmul_ref(enc[K:], data[0]))
+        evs = [e for e in tl.snapshot(since=t1)
+               if e["type"] == "flush_end" and e.get("route") == "device"]
+        assert evs and len(evs[-1]["lanes"]) == 8
+    finally:
+        q.stop()
+        del os.environ["MINIO_TPU_DISPATCH_MODE"]

@@ -390,3 +390,158 @@ def test_qos_config_registered():
               "interactive_budget_ms", "background_budget_ms",
               "max_wait_ms", "interactive_rps", "control_rps"):
         assert k in keys, k
+
+
+# -- per-device flush lanes (mesh placement, ISSUE 11) ------------------------
+
+
+def test_lane_saturation_spills_to_sibling_before_cpu(monkeypatch):
+    """THE spill-order pin: device-lane → sibling-lane → CPU. A flush
+    whose preferred (affinity) lane is over its per-lane queued-bytes
+    cap lands on the least-loaded SIBLING at full strength; only when
+    every lane is saturated does plan() spill items to the CPU
+    executor (reason lane_cap)."""
+    monkeypatch.setenv("MINIO_TPU_QOS_DEVICE_QUEUE_BYTES",
+                       str(256 << 20))
+    monkeypatch.setenv("MINIO_TPU_QOS_LANE_QUEUE_BYTES", str(4 << 20))
+    s = QosScheduler()
+    s.configure_lanes(4)
+    fast = FakeProfile(rt_s=2e-4, up_gibs=8.0, down_gibs=8.0,
+                       cpu_gibs=0.5)
+    sizes = [(1 << 20, 256 << 10)] * 2
+    aff = 17                       # preferred lane = 17 % 4 = 1
+    assert s.pick_lane(aff) == 1   # empty lanes: affinity wins
+    # saturate the preferred lane past its per-lane cap
+    s.device_dispatched(8 << 20, lane=1, flush_s=5.0)
+    lane = s.pick_lane(aff)
+    assert lane != 1, "saturated lane must divert to a sibling"
+    assert s.lane_diverts >= 1
+    n = s.plan("device", fast, qos.CLASS_INTERACTIVE, sizes,
+               backlog_s=s.lane_backlog_s(lane), cpu_workers=8,
+               lane=lane)
+    assert n == len(sizes), "sibling lane absorbs the flush — no CPU"
+    assert s.spilled_items == 0
+    # saturate EVERY lane: now (and only now) items spill to CPU
+    for i in range(4):
+        s.device_dispatched(8 << 20, lane=i)
+    lane = s.pick_lane(aff)
+    n = s.plan("device", fast, qos.CLASS_INTERACTIVE, sizes,
+               backlog_s=0.0, cpu_workers=8, lane=lane)
+    assert n == 0
+    assert s.spill_reasons.get("lane_cap") == 1
+    # completion drains the lane model symmetrically
+    s.device_completed(8 << 20, lane=1)
+    s.device_completed(8 << 20, lane=1)
+    assert s.lane_queued_bytes()[1] == 0
+    assert s.lane_backlog_s(1) == 0.0
+
+
+def test_lane_accounting_and_stats(monkeypatch):
+    s = QosScheduler()
+    s.configure_lanes(3)
+    # an SPMD (lane=None) flush charges only the global counter but
+    # extends EVERY lane's busy-until — all chips are occupied
+    s.device_dispatched(6 << 20, lane=None, flush_s=2.0)
+    assert s.device_queued_bytes() == 6 << 20
+    assert s.lane_queued_bytes() == [0, 0, 0]
+    assert all(s.lane_backlog_s(i) > 1.0 for i in range(3))
+    s.device_completed(6 << 20, lane=None)
+    st = s.stats()
+    assert st["lanes"] == 3
+    assert st["lane_queued_bytes"] == [0, 0, 0]
+    assert "lane_queue_bytes_cap" in st and "lane_diverts" in st
+    # derived per-lane cap = device cap / lanes when the knob is 0
+    monkeypatch.setenv("MINIO_TPU_QOS_DEVICE_QUEUE_BYTES", str(96 << 20))
+    monkeypatch.delenv("MINIO_TPU_QOS_LANE_QUEUE_BYTES", raising=False)
+    from minio_tpu.qos.scheduler import lane_queue_bytes_cap
+    assert lane_queue_bytes_cap(3) == 32 << 20
+
+
+def test_lane_affinity_context_and_key():
+    assert qos.current_affinity() is None
+    with qos.lane_affinity(qos.set_affinity_key(0, 3)):
+        a = qos.current_affinity()
+        assert isinstance(a, int) and a >= 0
+        with qos.lane_affinity(None):
+            assert qos.current_affinity() is None
+        assert qos.current_affinity() == a
+    assert qos.current_affinity() is None
+    # stable across calls/processes (crc32, not PYTHONHASHSEED)
+    assert qos.set_affinity_key(1, 2) == qos.set_affinity_key(1, 2)
+    assert qos.set_affinity_key(0, 0) != qos.set_affinity_key(0, 1)
+
+
+def test_parallel_pinned_lanes_read_busiest_not_serial_sum():
+    """Pinned flushes on distinct lanes run in PARALLEL: the backlog an
+    SPMD all-lanes flush plans against is the busiest single lane, not
+    the serial sum of every lane's wall (which read ~Nx the real drain
+    time and spilled idle-mesh work to CPU)."""
+    s = QosScheduler()
+    s.configure_lanes(4)
+    for i in range(4):
+        s.device_dispatched(1 << 20, lane=i, flush_s=1.0)
+    assert s.max_lane_backlog_s() <= 1.1  # not ~4s
+
+
+def test_spmd_drain_resyncs_lane_model():
+    """SPMD (lane=None) dispatches extend every lane's busy-until but
+    have no per-lane completion; the full-pipeline drain must clamp the
+    whole lane model or it only ever ratchets up."""
+    s = QosScheduler()
+    s.configure_lanes(4)
+    s.device_dispatched(1 << 20, lane=None, flush_s=5.0)
+    assert s.max_lane_backlog_s() > 4.0
+    s.device_completed(1 << 20, lane=None)  # queued hits 0: full resync
+    assert s.max_lane_backlog_s() == 0.0
+    assert all(b == 0 for b in s.lane_queued_bytes())
+
+
+def test_pinned_flushes_do_not_inflate_global_spmd_backlog():
+    """dispatch._backlog_s(None) joins the global serial model with the
+    busiest lane — pinned flushes live only in the lane model, so
+    concurrent per-lane traffic must not stack up as serial global
+    backlog in an SPMD flush's plan."""
+    from minio_tpu.runtime.dispatch import DispatchQueue
+    q = DispatchQueue()
+    try:
+        q.qos.configure_lanes(8)
+        for i in range(8):
+            q.qos.device_dispatched(1 << 20, lane=i, flush_s=2.0)
+        b = q._backlog_s(None)
+        assert 1.5 < b <= 2.1, b  # busiest lane, not 16s serial
+        with q._profile_lock:
+            assert q._dev_busy_until == 0.0
+    finally:
+        q.stop()
+
+
+def test_affinity_slot_folds_to_lane_or_none(monkeypatch):
+    """Bucket keys carry the flush-lane SLOT, not the raw crc32 key:
+    single-device hosts (and lanes-off config) fold every affinity to
+    None so cross-set coalescing survives, multi-lane hosts fold to
+    key % lanes so sets sharing a lane share a flush; an unknown
+    topology passes the raw key through (submit must never initialize
+    the backend)."""
+    from minio_tpu.runtime import dispatch as dp
+    monkeypatch.delenv("MINIO_TPU_DISPATCH_MODE", raising=False)
+    q = dp.DispatchQueue()
+    try:
+        assert q._affinity_slot(None) is None
+        q.__dict__.pop("_lanes_cache", None)  # topology unknown
+        assert q._affinity_slot(13) == 13
+        # forced-CPU mode: no device flush will ever resolve the
+        # topology, so the conservative split must not become permanent
+        monkeypatch.setenv("MINIO_TPU_DISPATCH_MODE", "cpu")
+        assert q._affinity_slot(13) is None
+        monkeypatch.delenv("MINIO_TPU_DISPATCH_MODE")
+        q._lanes_cache = ("dev0",)            # single-chip host
+        assert q._affinity_slot(13) is None
+        q._lanes_cache = tuple(f"dev{i}" for i in range(8))
+        assert q._affinity_slot(13) == 13 % 8
+        assert q._affinity_slot(13 + 8) == 13 % 8  # shared-lane coalesce
+        monkeypatch.setattr(dp, "DISPATCH_LANES", "1")
+        assert q._affinity_slot(13) is None
+        monkeypatch.setattr(dp, "DISPATCH_LANES", "4")
+        assert q._affinity_slot(13) == 1
+    finally:
+        q.stop()

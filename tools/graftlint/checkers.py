@@ -31,6 +31,11 @@ text — nothing in the checked tree is imported.
 |       | docs/observability.md, and every SLO-evaluated window        |
 |       | comes from ``obs/latency.Window`` — no ad-hoc percentile     |
 |       | math (statistics/numpy quantiles, local Window shadows)      |
+| GL013 | every ``b.op`` branch in ``_flush_device`` calls             |
+|       | ``sharded_batched`` under a ``mesh``-guarded arm or its ops  |
+|       | appear in the ``_MESH_SINGLE_DEVICE_OPS`` exemption          |
+|       | registry — a new dispatch op cannot silently ship            |
+|       | device-only without a mesh route                             |
 """
 from __future__ import annotations
 
@@ -904,6 +909,145 @@ def check_slo_plane(ctx: FileCtx) -> list[Finding]:
     return out
 
 
+# --------------------------------------------------------------------------
+# GL013 — every dispatch op branch in _flush_device carries a mesh route
+
+#: the exemption registry _flush_device's ops may opt out through — an
+#: EXPLICIT set literal in dispatch.py, so shipping a device-only op is
+#: a visible, reviewable line, not an accident (the way select_scan
+#: shipped without a mesh route in PR 8)
+_MESH_EXEMPT_NAME = "_MESH_SINGLE_DEVICE_OPS"
+
+
+def _op_branch_consts(test: ast.AST) -> set[str] | None:
+    """The op constants a ``b.op == 'x'`` / ``b.op in (...)`` test
+    selects, or None when the test is not an op dispatch."""
+    if not (isinstance(test, ast.Compare) and len(test.ops) == 1 and
+            dotted(test.left).endswith(".op")):
+        return None
+    cmp = test.comparators[0]
+    if isinstance(test.ops[0], ast.Eq) and isinstance(cmp, ast.Constant) \
+            and isinstance(cmp.value, str):
+        return {cmp.value}
+    if isinstance(test.ops[0], ast.In) and \
+            isinstance(cmp, (ast.Tuple, ast.List, ast.Set)):
+        vals = {e.value for e in cmp.elts
+                if isinstance(e, ast.Constant) and isinstance(e.value, str)}
+        if vals:
+            return vals
+    return None
+
+
+def check_mesh_routes(ctx: FileCtx) -> list[Finding]:
+    """GL013: the mesh-route contract for the dispatch plane — every
+    ``b.op`` branch inside ``_flush_device`` must either call
+    ``sharded_batched`` under an arm whose condition involves the mesh
+    (``if mesh is not None`` / ``if use_mesh``), or every op the branch
+    handles must appear in the ``_MESH_SINGLE_DEVICE_OPS`` exemption
+    registry. Ops not matched by any explicit test are attributed to
+    the chain's ``else`` branch. Without this gate a new op PR ships
+    device-only silently (select_scan did exactly that in PR 8 — the
+    8-chip mesh carried zero Select traffic for two rounds and nothing
+    failed)."""
+    if ctx.path != "minio_tpu/runtime/dispatch.py":
+        return []
+    op_names: set[str] = set()
+    exempt: set[str] | None = None
+    exempt_line = 1
+    flush_fn: ast.FunctionDef | None = None
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, (ast.Assign, ast.AnnAssign)) and \
+                node.value is not None:
+            names = {dotted(t) for t in node.targets} \
+                if isinstance(node, ast.Assign) else {dotted(node.target)}
+            if "_OP_NAME" in names and isinstance(node.value, ast.Dict):
+                op_names = {k.value for k in node.value.keys
+                            if isinstance(k, ast.Constant)}
+            elif _MESH_EXEMPT_NAME in names:
+                exempt = {sub.value for sub in ast.walk(node.value)
+                          if isinstance(sub, ast.Constant) and
+                          isinstance(sub.value, str)}
+                exempt_line = node.lineno
+        elif isinstance(node, ast.FunctionDef) and \
+                node.name == "_flush_device":
+            flush_fn = node
+    if not op_names or flush_fn is None:
+        return []  # GL006/GL011 report the real problem
+    out = []
+    if exempt is None:
+        out.append(Finding(
+            ctx.path, exempt_line, "GL013",
+            f"dispatch declares no {_MESH_EXEMPT_NAME} registry — "
+            "single-device exemptions must be an explicit, reviewable "
+            "set literal",
+            token=_MESH_EXEMPT_NAME))
+        exempt = set()
+    # collect the op-dispatch branches: each If whose test compares
+    # b.op, chains of elifs walked, the trailing else attributed to
+    # every registry op no explicit test claims
+    branches: list[tuple[set[str] | None, list, int]] = []
+    tested: set[str] = set()
+    consumed: set[int] = set()
+
+    def walk_chain(if_node: ast.If) -> bool:
+        ops = _op_branch_consts(if_node.test)
+        if ops is None:
+            return False
+        consumed.add(id(if_node))
+        branches.append((ops, if_node.body, if_node.body[0].lineno))
+        tested.update(ops)
+        rest = if_node.orelse
+        if len(rest) == 1 and isinstance(rest[0], ast.If) and \
+                walk_chain(rest[0]):
+            return True
+        if rest:
+            branches.append((None, rest, rest[0].lineno))
+        return True
+
+    for node in ast.walk(flush_fn):
+        if isinstance(node, ast.If) and id(node) not in consumed:
+            walk_chain(node)
+
+    def has_mesh_sharded(stmts: list) -> bool:
+        for st in stmts:
+            for sub in ast.walk(st):
+                if isinstance(sub, ast.If) and \
+                        "mesh" in _unparse(sub.test, 200):
+                    for inner in ast.walk(sub):
+                        if isinstance(inner, ast.Call) and \
+                                dotted(inner.func).rsplit(".", 1)[-1] == \
+                                "sharded_batched":
+                            return True
+        return False
+
+    default_ops = op_names - tested
+    saw_default = any(ops is None for ops, _, _ in branches)
+    for ops, body, line in branches:
+        ops = default_ops if ops is None else ops & op_names
+        if not ops or has_mesh_sharded(body):
+            continue
+        for op in sorted(ops - exempt):
+            out.append(Finding(
+                ctx.path, line, "GL013",
+                f"dispatch op {op!r} branch in _flush_device has no "
+                "mesh route — call sharded_batched under a "
+                "mesh-guarded arm or register the op in "
+                f"{_MESH_EXEMPT_NAME}",
+                token=f"mesh-route:{op}",
+                scope=ctx.scope_at(line)))
+    if default_ops and not saw_default:
+        # registry ops no branch handles at all: same contract
+        for op in sorted(default_ops - exempt):
+            out.append(Finding(
+                ctx.path, flush_fn.lineno, "GL013",
+                f"dispatch op {op!r} is registered in _OP_NAME but no "
+                "_flush_device branch (and no else) handles it — it "
+                "cannot have a mesh route",
+                token=f"mesh-route:{op}",
+                scope=ctx.scope_at(flush_fn.lineno + 1)))
+    return out
+
+
 PER_FILE = [
     check_wall_duration,
     check_blocking_under_lock,
@@ -916,5 +1060,6 @@ PER_FILE = [
     check_hot_path_host_copies,
     check_timeline_flush_pairs,
     check_slo_plane,
+    check_mesh_routes,
 ]
 PROJECT = [check_metrics_documented]
