@@ -885,6 +885,75 @@ def scale_slo_extra() -> dict:
     return {"scale_slo": slim}
 
 
+def node_chaos_extra() -> dict:
+    """ISSUE 12: clean vs kill-1-of-4 on a real 4-node topology
+    (dist.harness.LocalCluster — separate listeners, storage REST,
+    dsync locks). Reports S3 PUT/GET p50/p99 with all nodes up, the
+    same with one node killed mid-bench (write-quorum degraded writes +
+    cross-peer reads), and the heal-drain seconds after the node
+    rejoins — the BENCH_r07+ trajectory for the node fault-tolerance
+    plane. MINIO_TPU_NODE_CHAOS_BENCH=0 skips."""
+    if os.environ.get("MINIO_TPU_NODE_CHAOS_BENCH", "1") == "0":
+        return {}
+    import tempfile
+    import time as _t
+
+    from minio_tpu.dist.harness import LocalCluster
+    from tools.loadgen import _SigClient
+
+    ops = int(os.environ.get("MINIO_TPU_NODE_CHAOS_OPS", "12"))
+    body = np.random.default_rng(5).integers(
+        0, 256, 256 << 10, dtype=np.uint8).tobytes()
+
+    def pcts(vals):
+        vs = sorted(vals)
+        return {"p50_ms": round(vs[len(vs) // 2] * 1e3, 1),
+                "p99_ms": round(vs[min(len(vs) - 1,
+                                       int(0.99 * len(vs)))] * 1e3, 1)}
+
+    def measure(cl, tag):
+        puts, gets = [], []
+        for i in range(ops):
+            t0 = _t.perf_counter()
+            r = cl.request("PUT", f"/ncb/{tag}{i:03d}", body=body)
+            assert r.status_code == 200, (tag, i, r.status_code)
+            puts.append(_t.perf_counter() - t0)
+            t0 = _t.perf_counter()
+            r = cl.request("GET", f"/ncb/{tag}{i:03d}")
+            assert r.status_code == 200 and len(r.content) == len(body)
+            gets.append(_t.perf_counter() - t0)
+        return {"put": pcts(puts), "get": pcts(gets)}
+
+    with tempfile.TemporaryDirectory(prefix="bench-nc-") as root:
+        lc = LocalCluster(root, nodes=4, disks_per_node=2, parity=2)
+        try:
+            cl = _SigClient(lc.endpoint(0), lc.access_key,
+                            lc.secret_key)
+            r = cl.request("PUT", "/ncb")
+            assert r.status_code == 200, r.status_code
+            clean = measure(cl, "c")
+            lc.kill(3)
+            degraded = measure(cl, "k")
+            lc.restart(3)
+            t0 = _t.monotonic()
+            drained = False
+            while _t.monotonic() - t0 < 120:
+                mrf = getattr(lc.nodes[0].server, "mrf", None)
+                if mrf is not None and mrf.stats()["queued"] == 0:
+                    drained = True
+                    break
+                _t.sleep(0.25)
+            drain_s = round(_t.monotonic() - t0, 2)
+        finally:
+            lc.shutdown()
+    out = {"clean": clean, "kill_1_of_4": degraded,
+           "heal_drain_s": drain_s, "heal_drained": drained}
+    log(f"node_chaos: clean put p99 {clean['put']['p99_ms']}ms vs "
+        f"kill-1-of-4 {degraded['put']['p99_ms']}ms, heal drain "
+        f"{drain_s}s")
+    return {"node_chaos": out}
+
+
 def finish(payload: dict) -> None:
     """Print the one-line result, quiesce framework threads, and exit 0
     deterministically. The axon JAX client's teardown intermittently aborts
@@ -922,6 +991,8 @@ def main() -> None:
     # mixed-workload SLO scale harness (ISSUE 10) — after the kernel
     # configs, before the timeline snapshot so its traffic shows there
     scale = scale_slo_extra()
+    # node fault tolerance on the 4-node topology (ISSUE 12)
+    node_chaos = node_chaos_extra()
     # flight-recorder artifacts LAST so the truncated timeline +
     # attribution report cover every config above (ISSUE 9)
     tl = timeline_extras()
@@ -953,6 +1024,7 @@ def main() -> None:
             **scan,                  # device workloads A (docs/select.md)
             **sse,                   # device workloads B (docs/sse.md)
             **scale,      # mixed-workload SLO scale harness (ISSUE 10)
+            **node_chaos,      # 4-node kill/heal topology (ISSUE 12)
             **tl,     # flight-recorder timeline + attribution (ISSUE 9)
             **extra_chaos,                        # --chaos degraded run
         },

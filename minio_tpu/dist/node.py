@@ -42,16 +42,20 @@ class Node:
                 self.local_disks[ep.path] = d
                 self.disks.append(d)
             else:
-                self.disks.append(
-                    StorageRESTClient(ep.url, ep.path, secret))
+                rc = StorageRESTClient(ep.url, ep.path, secret,
+                                       src=self.local_url)
+                rc.rpc.on_reconnect = self._on_peer_reconnect
+                self.disks.append(rc)
 
         self.peer_urls = [u for u in nodes_of(self.endpoints)
                           if u != self.local_url]
-        self.peers = [PeerRESTClient(u, secret) for u in self.peer_urls]
+        self.peers = [PeerRESTClient(u, secret, src=self.local_url)
+                      for u in self.peer_urls]
 
         # lockers: this node's local locker + one lock client per peer
         self.local_locker = LocalLocker()
-        self._lock_clients = [LockRESTClient(u, secret)
+        self._lock_clients = [LockRESTClient(u, secret,
+                                             src=self.local_url)
                               for u in self.peer_urls]
         self.ns_lock = NSLockMap(
             lambda: [self.local_locker, *self._lock_clients],
@@ -87,8 +91,17 @@ class Node:
         server = S3Server(self.obj, self._address, self._port,
                           self._region, self._access_key, self._secret_key)
         self.server = server
-        lock_svc = LockRESTService(self.local_locker)
+        # owner-driven lock maintenance (reference lockMaintenance):
+        # entries on THIS node acquired by a peer are lease-checked
+        # against that peer's locker — dead owners free up within
+        # interval x (1 + strikes) instead of the stale-sweep age
+        lock_svc = LockRESTService(
+            self.local_locker,
+            owner_lockers_fn=lambda: dict(zip(self.peer_urls,
+                                              self._lock_clients)),
+            local_owner=self.local_url or "standalone")
         lock_svc.start_maintenance()
+        self.lock_service = lock_svc
         server.internal = {
             "storage": StorageRESTService(self.local_disks),
             "lock": lock_svc,
@@ -121,6 +134,28 @@ class Node:
         # cmd/server-main.go:508-514) once the object layer is live
         server.start_background_services()
         return server
+
+    def _on_peer_reconnect(self, client) -> None:
+        """A storage RPC client flipped back online (the peer node
+        rejoined): kick the auto-heal monitor and nudge the MRF so the
+        heal debt journalled while it was gone drains NOW instead of
+        waiting out the retry backoff (cross-node repair,
+        docs/fault.md)."""
+        srv = self.server
+        if srv is None:
+            return
+        autoheal = getattr(srv, "autoheal", None)
+        if autoheal is not None:
+            try:
+                autoheal.kick()
+            except Exception:  # noqa: BLE001 — monitor mid-shutdown
+                pass
+        mrf = getattr(srv, "mrf", None)
+        if mrf is not None:
+            try:
+                mrf.kick()
+            except Exception:  # noqa: BLE001
+                pass
 
     def _broadcast_iam_update(self):
         for p in self.peers:
@@ -185,5 +220,8 @@ class Node:
                 continue  # peer not up yet — it will verify against us
 
     def shutdown(self):
+        svc = getattr(self, "lock_service", None)
+        if svc is not None:
+            svc.stop()
         if self.server is not None:
             self.server.shutdown()

@@ -11,9 +11,9 @@ from .rpc import RPCClient
 
 
 class PeerRESTClient:
-    def __init__(self, node_url: str, secret: str):
+    def __init__(self, node_url: str, secret: str, src: str = ""):
         self.url = node_url
-        self.rpc = RPCClient(node_url, "peer", secret)
+        self.rpc = RPCClient(node_url, "peer", secret, src=src)
 
     def is_online(self) -> bool:
         return self.rpc.is_online()

@@ -8,6 +8,7 @@ from __future__ import annotations
 
 import hashlib
 import hmac
+import os
 import random
 import threading
 import time
@@ -21,8 +22,11 @@ from ..utils import errors
 RPC_VERSION = "v1"
 HEALTH_INTERVAL_S = 1.0
 #: health ping backoff ceiling: a long-dead peer costs one probe per
-#: ~HEALTH_MAX_INTERVAL_S instead of one per second forever
-HEALTH_MAX_INTERVAL_S = 30.0
+#: ~HEALTH_MAX_INTERVAL_S instead of one per second forever — it also
+#: bounds how long a REJOINED peer waits to be rediscovered, so chaos
+#: tests (and latency-sensitive deployments) can lower it
+HEALTH_MAX_INTERVAL_S = float(os.environ.get(
+    "MINIO_TPU_RPC_PING_MAX_S", "30"))
 #: extra attempts for idempotent (read-only) calls on transport errors
 RETRY_BUDGET = 2
 RETRY_BACKOFF_S = 0.05
@@ -62,26 +66,69 @@ class RPCError(errors.RPCError):
     pass
 
 
+#: peer EWMA above this means "degraded" in the health snapshot
+PEER_DEGRADED_EWMA_S = 0.5
+_EWMA_ALPHA = 0.3
+
+
 class RPCClient:
     """One client per remote service endpoint. Offline marking: any
     transport-level failure flips offline; a daemon ping loop probes
-    ``/minio/health/live`` and flips back online."""
+    ``/minio/health/live`` and flips back online.
+
+    ``src`` names the CALLING node (its local URL) — node-layer fault
+    rules key asymmetric partitions on (src, dst), and several nodes
+    share one process in test topologies, so a process-global "my url"
+    cannot exist. The client also keeps a tiny health score (latency
+    EWMA + consecutive/total failures) that the node health snapshot
+    rolls up per peer — partition and slow-peer injections land here,
+    not only disk-layer errors (docs/fault.md)."""
 
     def __init__(self, base_url: str, service: str, secret: str,
-                 timeout: float = 30.0):
+                 timeout: float = 30.0, src: str = ""):
         self.base = base_url.rstrip("/")
         self.service = service
         self.secret = secret
         self.timeout = timeout
+        self.src = src.rstrip("/")
         self._session = requests.Session()
         self._online = True
         self._closed = False
         self._lock = threading.Lock()
         self._ping_thread: threading.Thread | None = None
         self.on_reconnect = None  # hook: called when back online
+        self._ewma_s = 0.0
+        self.failures_total = 0
+        self.consecutive_failures = 0
+        self.reconnects_total = 0
 
     def is_online(self) -> bool:
         return self._online
+
+    def health_stats(self) -> dict:
+        """Per-peer health row for the node snapshot: a peer is
+        ``degraded`` when it is offline, mid-failure-streak, or its
+        success-latency EWMA (which slow-peer delay injections inflate)
+        crossed the threshold."""
+        ewma = self._ewma_s
+        return {
+            "online": self._online,
+            "ewma_ms": round(ewma * 1e3, 3),
+            "failures_total": self.failures_total,
+            "consecutive_failures": self.consecutive_failures,
+            "reconnects_total": self.reconnects_total,
+            "degraded": (not self._online or self.consecutive_failures > 0
+                         or ewma > PEER_DEGRADED_EWMA_S),
+        }
+
+    def _note_result(self, ok: bool, dur_s: float = 0.0) -> None:
+        if ok:
+            self.consecutive_failures = 0
+            self._ewma_s = dur_s if self._ewma_s == 0.0 else \
+                (1 - _EWMA_ALPHA) * self._ewma_s + _EWMA_ALPHA * dur_s
+        else:
+            self.failures_total += 1
+            self.consecutive_failures += 1
 
     def _mark_offline(self):
         with self._lock:
@@ -104,6 +151,12 @@ class RPCClient:
             time.sleep(interval * (0.5 + random.random()))
             if self._closed:
                 return
+            if _fault.blocked("node", self.base, self.src):
+                # a standing partition rule gates the probe: a
+                # partitioned peer must NOT flip back online just
+                # because the wire underneath still answers
+                interval = min(interval * 2, HEALTH_MAX_INTERVAL_S)
+                continue
             try:
                 r = self._session.get(f"{self.base}/minio/health/live",
                                       timeout=2)
@@ -114,6 +167,11 @@ class RPCClient:
                 interval = min(interval * 2, HEALTH_MAX_INTERVAL_S)
                 continue
             self._online = True
+            self.reconnects_total += 1
+            # the probe IS a successful round trip: clear the failure
+            # streak, or an idle cluster (no RPC traffic to call
+            # _note_result) reports the recovered peer degraded forever
+            self.consecutive_failures = 0
             if self.on_reconnect is not None:
                 try:
                     self.on_reconnect(self)
@@ -169,7 +227,14 @@ class RPCClient:
                     # jittered exponential backoff between retries
                     time.sleep(RETRY_BACKOFF_S * (1 << (attempt - 1))
                                * (0.5 + random.random()))
+                t_call = time.monotonic()
                 try:
+                    if _fault.armed("node"):
+                        # whole-peer injection point (node chaos):
+                        # partition blackholes the call before the
+                        # wire, delay slows EVERY service/method
+                        # toward this peer (docs/fault.md node layer)
+                        _fault.inject("node", self.base, self.src)
                     if _fault.armed("rpc"):
                         # per-call injection point (chaos harness);
                         # typed errors raise like a peer-sent error,
@@ -182,11 +247,15 @@ class RPCClient:
                         errors.RPCError) as e:
                     mx.inc("minio_tpu_inter_node_errors_total",
                            service=self.service)
+                    mx.inc("minio_tpu_node_peer_errors_total",
+                           service=self.service)
+                    self._note_result(False)
                     if attempt + 1 < attempts:
                         continue
                     self._mark_offline()
                     raise errors.DiskNotFound(f"{self.base}: {e}") from e
                 if r.status_code == 200:
+                    self._note_result(True, time.monotonic() - t_call)
                     if not stream:
                         mx.inc("minio_tpu_inter_node_received_bytes_total",
                                len(r.content), service=self.service)
@@ -194,8 +263,11 @@ class RPCClient:
                 err_name = r.headers.get("x-minio-tpu-error", "")
                 msg = r.content.decode("utf-8", "replace")[:200]
                 if err_name in _ERR_BY_NAME:
+                    # typed error = the peer answered: the WIRE is fine
+                    self._note_result(True, time.monotonic() - t_call)
                     raise _ERR_BY_NAME[err_name](msg)
                 if r.status_code in (502, 503, 504):
+                    self._note_result(False)
                     if attempt + 1 < attempts:
                         continue
                     self._mark_offline()

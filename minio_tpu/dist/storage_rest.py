@@ -17,8 +17,9 @@ from .rpc import RPCClient
 class StorageRESTClient(StorageAPI):
     """Remote disk: one RPC client bound to (node URL, disk path)."""
 
-    def __init__(self, node_url: str, disk_path: str, secret: str):
-        self.rpc = RPCClient(node_url, "storage", secret)
+    def __init__(self, node_url: str, disk_path: str, secret: str,
+                 src: str = ""):
+        self.rpc = RPCClient(node_url, "storage", secret, src=src)
         self.disk_path = disk_path
         self._endpoint = f"{node_url}{disk_path}"
 

@@ -9,13 +9,23 @@ A rule targets ``layer × target × op``:
 
 * ``layer``  — ``disk`` (xlstorage per-op + per-shard-read),
   ``rpc`` (dist/rpc.py per-call), ``kernel`` (runtime/dispatch.py
-  per-flush).
+  per-flush), ``node`` (dist/rpc.py whole-peer: EVERY call any client
+  in this process makes toward the target node, regardless of
+  service/method).
 * ``target`` — substring of the disk endpoint / peer base URL, or ``*``.
 * ``op``     — storage op (``read_all``, ``read_at``, ``rename_data``,
   ...), RPC method, or dispatch op (``encode``/``masked``/``fused``),
-  or ``*``.
+  or ``*``. For the ``node`` layer the op slot carries a SOURCE-node
+  URL substring; since URLs hold colons, the compact grammar passes it
+  as the action argument instead: ``node:http://B:*:partition`` blocks
+  everyone toward B, ``node:http://B:*:partition(http://A)`` is the
+  asymmetric A↛B blackhole (A's calls to B vanish; B→A works).
 
 Actions: ``error(<TypedStorageError>)``, ``delay(ms[,jitter_ms])``,
+``partition`` (node layer: the call never reaches the wire — a
+transport-class ``RPCError`` fires immediately, the caller's retry
+budget burns, and the peer is marked offline; the reconnect probe is
+gated by the same rule so the peer STAYS offline until disarm),
 ``bitrot`` (corrupt returned shard bytes — bitrot readers detect it),
 ``hang[(s)]`` (a long, clear()-interruptible stall), ``flaky(p[,seed])``
 (probabilistic typed error from a per-rule seeded RNG, so chaos tests
@@ -46,8 +56,9 @@ from dataclasses import dataclass, field
 
 from ..utils import errors
 
-LAYERS = ("disk", "rpc", "kernel")
-ACTIONS = ("error", "delay", "bitrot", "hang", "flaky", "crash", "torn")
+LAYERS = ("disk", "rpc", "kernel", "node")
+ACTIONS = ("error", "delay", "bitrot", "hang", "flaky", "crash", "torn",
+           "partition")
 
 
 class SimulatedCrash(BaseException):
@@ -108,6 +119,10 @@ class FaultRule:
     def matches(self, target: str, op: str) -> bool:
         if self.target != "*" and self.target not in target:
             return False
+        if self.layer == "node":
+            # the op slot carries a SOURCE-node URL: substring match,
+            # like targets (asymmetric partitions name both ends)
+            return self.op == "*" or (bool(op) and self.op in op)
         return self.op in ("*", op)
 
     def to_dict(self) -> dict:
@@ -132,15 +147,25 @@ def parse_rule(spec: str) -> FaultRule:
     e.g. ``disk:*:read_at:delay(200,50)@ttl=30``,
     ``disk:/data/d3:*:error(FaultyDisk)@count=8``,
     ``rpc:http://peer:9000:readversion:flaky(0.3,42)``,
-    ``kernel:*:encode:error(FaultyDisk)@count=1``.
-    Empty target/op mean ``*``; the target may itself contain colons
-    (peer URLs) — the op and action are split from the right.
+    ``kernel:*:encode:error(FaultyDisk)@count=1``,
+    ``node:http://b:9000:*:partition(http://a:9000)``.
+    Empty target/op mean ``*``; the target AND action arguments may
+    themselves contain colons (peer URLs) — the action is matched
+    anchored at the end, the op is the colon-free segment before it.
     """
     try:
         layer, rest = spec.strip().split(":", 1)
-        target, op, act_part = rest.rsplit(":", 2)
     except ValueError:
         raise ValueError(f"unparseable fault rule {spec!r}") from None
+    m_act = re.search(
+        r":(?P<act>[a-z]+(?:\([^)]*\))?(?:@[a-z]+=[^@]+)*)$", rest)
+    if m_act is None:
+        raise ValueError(f"unparseable fault rule {spec!r}")
+    act_part = m_act["act"]
+    head = rest[:m_act.start()]
+    target, sep, op = head.rpartition(":")
+    if not sep:
+        raise ValueError(f"unparseable fault rule {spec!r}")
     target, op = target or "*", op or "*"
     m = _ACTION_RE.match(act_part)
     if m is None:
@@ -166,6 +191,12 @@ def parse_rule(spec: str) -> FaultRule:
             kw["seed"] = int(args[1])
         if len(args) > 2:
             kw["error"] = args[2]
+    elif action == "partition" and args:
+        # the source-node selector rides as the action argument (URLs
+        # hold colons, so it cannot survive the op-slot split); it
+        # lands in the op field, which node-layer matching reads as a
+        # src substring
+        op = args[0]
     for mod in (m["mods"] or "").split("@"):
         if not mod:
             continue
@@ -314,12 +345,32 @@ class FaultRegistry:
             return BITROT
         if r.action == "torn":
             return _Torn(r._rng)
+        if r.action == "partition":
+            # transport-class: the RPC client treats it exactly like a
+            # dropped connection (retry budget, then offline marking)
+            raise errors.RPCError(
+                f"fault-injected partition [{r.id} "
+                f"{layer}:{r.target}:{r.op}] {op or '?'} -> {target}")
         if r.action == "crash":
             raise SimulatedCrash(
                 f"fault-injected crash [{r.id} {layer}:{r.target}:{r.op}] "
                 f"{target} at {op}")
         raise ERRORS_BY_NAME[r.error](
             f"fault-injected [{r.id} {layer}:{r.target}:{r.op}] {target}")
+
+    def blocked(self, layer: str, target: str, op: str) -> bool:
+        """Is a live ``partition`` rule standing between op(src) and
+        target(dst)? Unlike :meth:`inject` this takes no hit and fires
+        no metrics — it gates background probes (the RPC reconnect
+        ping) that must not flip a partitioned peer back online."""
+        if not self._armed.get(layer, False):
+            return False
+        now = time.monotonic()
+        with self._lock:
+            self._sweep(now)
+            return any(r.layer == layer and r.action == "partition"
+                       and r.matches(target, op)
+                       for r in self._rules.values())
 
     @staticmethod
     def _annotate_span(layer: str, target: str, op: str, r: FaultRule):
@@ -371,6 +422,10 @@ def armed(layer: str | None = None) -> bool:
 
 def inject(layer: str, target: str, op: str):
     return _registry.inject(layer, target, op)
+
+
+def blocked(layer: str, target: str, op: str) -> bool:
+    return _registry.blocked(layer, target, op)
 
 
 def torn_truncate(path: str, rng: random.Random | None = None) -> int:

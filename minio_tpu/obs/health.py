@@ -6,6 +6,10 @@ healthy, are the device lanes busy, is anything burning error budget".
 beyond in-memory state):
 
 * disk health tracker states + trip counts (PR 4 ``storage/health.py``),
+* per-peer RPC health (``dist/rpc.py`` client scores: online flag,
+  success-latency EWMA, failure streaks) — partition and slow-peer
+  injections land HERE, so a sick peer degrades the snapshot even when
+  every local disk is fine,
 * dispatch lane utilization + queue depth from the flight recorder
   (PR 9 ``obs/timeline.py``),
 * QoS saturation — admission inflight vs capacity, per-class rejects,
@@ -41,6 +45,48 @@ def _disk_rows(server) -> list[dict]:
     return rows
 
 
+def _peer_rows(server) -> dict:
+    """This node's live view of every dist peer, from the RPC client
+    health scores (no probe I/O): a peer whose control-plane OR
+    storage-plane client is offline/degraded shows up within one
+    probe interval of the wire noticing. Rows merge the peer client
+    and any storage clients pointing at the same node URL."""
+    rows: dict[str, dict] = {}
+    for peer in getattr(server, "peers", lambda: [])():
+        rpc = getattr(peer, "rpc", None)
+        if rpc is None:
+            continue
+        rows[getattr(peer, "url", rpc.base)] = dict(rpc.health_stats())
+    # storage REST clients carry the data-plane view of the same peers
+    # (disks ride health wrappers — unwrap to reach the RPC client)
+    from .metrics import _all_disks
+    for d in _all_disks(server.obj):
+        inner = getattr(d, "inner", d)
+        rpc = getattr(inner, "rpc", None)
+        if rpc is None or getattr(inner, "is_local", lambda: True)():
+            continue
+        row = rows.get(rpc.base)
+        st = rpc.health_stats()
+        if row is None:
+            rows[rpc.base] = dict(st)
+            continue
+        # the worse verdict wins per field
+        row["online"] = row["online"] and st["online"]
+        row["degraded"] = row["degraded"] or st["degraded"]
+        row["ewma_ms"] = max(row["ewma_ms"], st["ewma_ms"])
+        row["failures_total"] += st["failures_total"]
+        row["consecutive_failures"] = max(row["consecutive_failures"],
+                                          st["consecutive_failures"])
+        row["reconnects_total"] += st["reconnects_total"]
+    out_rows = [{"url": u, **r} for u, r in sorted(rows.items())]
+    return {
+        "rows": out_rows,
+        "total": len(out_rows),
+        "unreachable": sum(1 for r in out_rows if not r["online"]),
+        "degraded": sum(1 for r in out_rows if r["degraded"]),
+    }
+
+
 def node_snapshot(server) -> dict:
     """One node's live health planes as a JSON-able dict."""
     from . import slo, timeline
@@ -57,6 +103,7 @@ def node_snapshot(server) -> dict:
         "faulty": sum(1 for d in disks if d.get("state") == "faulty"),
         "trips_total": sum(int(d.get("trips", 0)) for d in disks),
     }
+    out["peers"] = _peer_rows(server)
     util = timeline.utilization()
     out["lanes"] = util["lanes"]
     out["queue_depth"] = util["queue_depth"]
@@ -95,6 +142,7 @@ def _rollup(nodes: list[dict]) -> dict:
     disks: dict[str, dict] = {}   # endpoint -> merged row
     heal_backlog = 0
     breaches: list[dict] = []
+    peers_unreachable = peers_degraded = 0
     for n in nodes:
         if "error" in n:
             continue
@@ -104,6 +152,9 @@ def _rollup(nodes: list[dict]) -> dict:
             if row.get("state") == "faulty":
                 cur["faulty"] = True
             cur["trips"] = max(cur["trips"], int(row.get("trips", 0)))
+        peers = n.get("peers", {})
+        peers_unreachable += int(peers.get("unreachable", 0))
+        peers_degraded += int(peers.get("degraded", 0))
         mrf = n.get("heal", {}).get("mrf", {})
         heal_backlog += int(mrf.get("queued", 0))
         for cls, ent in n.get("slo", {}).get("classes", {}).items():
@@ -118,9 +169,12 @@ def _rollup(nodes: list[dict]) -> dict:
         "disks_total": len(disks),
         "disks_faulty": disks_faulty,
         "disk_trips_total": sum(d["trips"] for d in disks.values()),
+        "peers_unreachable": peers_unreachable,
+        "peers_degraded": peers_degraded,
         "heal_backlog": heal_backlog,
         "slo_breaches": breaches,
         "healthy": disks_faulty == 0 and not breaches and
+        peers_unreachable == 0 and peers_degraded == 0 and
         not any("error" in n for n in nodes),
     }
 

@@ -25,6 +25,27 @@ import time
 #: into a fsync storm); the drain loop flushes pending dirt on idle
 FLUSH_INTERVAL_S = 0.25
 
+#: failed heals re-enqueue with exponential backoff instead of being
+#: forgotten: a whole NODE being down fails every heal touching its
+#: shards, and debt dropped after one attempt would sit invisible
+#: until the next deep scanner cycle instead of draining on rejoin
+RETRY_MAX = 8
+RETRY_BASE_S = float(os.environ.get("MINIO_TPU_MRF_RETRY_BASE_S", "1.0"))
+RETRY_CAP_S = 30.0
+
+
+class _IncompleteHeal(Exception):
+    """A heal pass finished but drives stayed offline/missing — the
+    debt is unpaid (routes the result into the retry park)."""
+
+
+def _debt_moot(e: BaseException) -> bool:
+    """The object/bucket no longer exists: nothing to heal, retrying
+    would only ladder through the full backoff for a churn-deleted
+    key. (Typed object errors from objectlayer.datatypes.)"""
+    return type(e).__name__ in ("ObjectNotFound", "VersionNotFound",
+                                "BucketNotFound")
+
 
 class MRFHealer:
     def __init__(self, objlayer, max_queue: int = 10_000):
@@ -48,6 +69,9 @@ class MRFHealer:
         #: race their durable_replace and a stale journal could land
         #: LAST with the dirty flag already cleared
         self._flushing = False
+        #: failed heals awaiting retry: [(due_monotonic, item, attempt)]
+        self._retry: list[tuple[float, tuple, int]] = []
+        self._retry_lock = threading.Lock()
 
     def add_partial(self, bucket: str, object: str, version_id: str = "",
                     scan_mode: str = "normal"):
@@ -96,8 +120,10 @@ class MRFHealer:
             # debt the queue already shed — unless an identical-key
             # duplicate is still queued (the queue does not dedupe):
             # the journal mirrors the queue's KEY SET, and debt the
-            # queue still holds must survive a crash
-            for b, o, v, _m in evicted:
+            # queue still holds must survive a crash. Slice, don't
+            # unpack: retry promotions are 5-tuples (attempt count)
+            for ev in evicted:
+                b, o, v = ev[:3]
                 if (b, o, v) != key and not self._queued((b, o, v)):
                     with self._plock:
                         self._persist_entries.pop((b, o, v), None)
@@ -143,11 +169,16 @@ class MRFHealer:
         return len(loaded)
 
     def _queued(self, key: tuple) -> bool:
-        """Best-effort 'is this key still in the queue' (snapshot under
-        the GIL; evictions and post-heal forgets are rare, the queue is
-        bounded, so the O(n) scan is fine)."""
-        return any((b, o, v) == key
-                   for (b, o, v, _m) in list(self.q.queue))
+        """Best-effort 'is this key still in the queue (or parked for
+        retry)' (snapshot under the GIL; evictions and post-heal
+        forgets are rare, the queue is bounded, so the O(n) scan is
+        fine). Retry entries carry an attempt count as a 5th element —
+        slice, don't unpack."""
+        if any(tuple(e[:3]) == key for e in list(self.q.queue)):
+            return True
+        with self._retry_lock:
+            return any(tuple(item[:3]) == key
+                       for _due, item, _a in self._retry)
 
     def _forget(self, key: tuple) -> None:
         if self._persist_path is None or self._queued(key):
@@ -200,29 +231,86 @@ class MRFHealer:
         return self
 
     def stats(self) -> dict:
+        with self._retry_lock:
+            retry_pending = len(self._retry)
         return {"healed": self.healed, "failed": self.failed,
-                "queued": self.q.qsize(), "dropped": self.dropped}
+                "queued": self.q.qsize() + retry_pending,
+                "retry_pending": retry_pending, "dropped": self.dropped}
+
+    def kick(self) -> None:
+        """Promote every backoff-parked retry to runnable NOW — called
+        when a peer node rejoins (rpc on_reconnect): the heal debt its
+        absence created should drain immediately, not wait out the
+        exponential backoff."""
+        with self._retry_lock:
+            self._retry = [(0.0, item, attempt)
+                           for _due, item, attempt in self._retry]
+
+    def _promote_due_retries(self) -> None:
+        now = time.monotonic()
+        with self._retry_lock:
+            due = [e for e in self._retry if e[0] <= now]
+            if not due:
+                return
+            self._retry = [e for e in self._retry if e[0] > now]
+        for _due, item, attempt in due:
+            try:
+                self.q.put_nowait((*item, attempt))
+            except queue.Full:
+                # queue refilled under load: park it again shortly
+                with self._retry_lock:
+                    self._retry.append((now + RETRY_BASE_S, item, attempt))
+
+    def _park_retry(self, item: tuple, attempt: int) -> None:
+        delay = min(RETRY_CAP_S, RETRY_BASE_S * (1 << min(attempt, 5)))
+        with self._retry_lock:
+            self._retry.append((time.monotonic() + delay, item, attempt))
 
     def _loop(self):
         while not self._stop.is_set():
+            self._promote_due_retries()
             try:
-                bucket, object, version_id, scan_mode = self.q.get(
-                    timeout=0.5)
+                entry = self.q.get(timeout=0.5)
             except queue.Empty:
                 self._flush(force=True)  # idle: settle throttled dirt
                 continue
+            # queue entries are 4-tuples; retry promotions carry a 5th
+            # element with the attempt count
+            bucket, object, version_id, scan_mode = entry[:4]
+            attempt = entry[4] if len(entry) > 4 else 0
             try:
                 from .. import qos
-                # MRF heals are background-class dispatch work
+                # MRF heals are background-class dispatch work;
+                # remove_dangling: an object deleted while a node was
+                # down leaves quorum-lost junk that can never heal —
+                # purging it IS paying the debt (reference healObject
+                # dangling handling)
                 with qos.background():
-                    self.obj.heal_object(bucket, object, version_id,
-                                         scan_mode=scan_mode)
+                    res = self.obj.heal_object(bucket, object, version_id,
+                                               scan_mode=scan_mode,
+                                               remove_dangling=True)
+                # a heal that left any drive offline/missing/corrupt
+                # did NOT pay the debt — a dead node's shards cannot be
+                # rebuilt until it rejoins, so the entry must survive
+                after = getattr(res, "after_state", None) or []
+                if any(s != "ok" for s in after):
+                    raise _IncompleteHeal(
+                        [s for s in after if s != "ok"])
                 self.healed += 1
-            except Exception:  # noqa: BLE001
+            except Exception as e:  # noqa: BLE001
                 self.failed += 1
-            # attempted either way: a persistently failing entry must
-            # not resurrect forever across restarts (the deep scanner
-            # cycle re-finds anything still genuinely degraded)
+                if attempt + 1 <= RETRY_MAX and not _debt_moot(e):
+                    # park with backoff, KEEP the journal entry: the
+                    # failure is usually an offline target (a dead
+                    # node), and the debt must survive until rejoin
+                    self._park_retry(
+                        (bucket, object, version_id, scan_mode),
+                        attempt + 1)
+                    self._flush()
+                    continue
+                # retries exhausted (or the object is gone): the deep
+                # scanner cycle re-finds anything still genuinely
+                # degraded
             self._forget((bucket, object, version_id))
             self._flush()  # on OUR thread, throttled by FLUSH_INTERVAL_S
 
@@ -231,10 +319,15 @@ class MRFHealer:
         self._flush(force=True)
 
     def drain(self, timeout: float = 30.0):
-        """Block until the queue is empty (tests / shutdown)."""
+        """Block until the queue AND the retry park are empty
+        (tests / shutdown)."""
         import time
         deadline = time.monotonic() + timeout
-        while not self.q.empty() and time.monotonic() < deadline:
+        while time.monotonic() < deadline:
+            with self._retry_lock:
+                parked = len(self._retry)
+            if self.q.empty() and parked == 0:
+                return
             time.sleep(0.05)
 
     def stop(self):

@@ -124,6 +124,36 @@ class S3Server:
         #: internal RPC services mounted under /minio/<name>/v1/<method>
         #: (storage/lock/peer — populated by dist.node.Node)
         self.internal: dict[str, object] = {}
+        #: live accepted connections — node-kill chaos severs these the
+        #: way a dead process would (keep-alive peers must not keep
+        #: talking to a "killed" node through zombie sockets)
+        self._conns: set = set()
+        self._conns_lock = threading.Lock()
+
+    def _track_conn(self, sock) -> None:
+        with self._conns_lock:
+            self._conns.add(sock)
+
+    def _untrack_conn(self, sock) -> None:
+        with self._conns_lock:
+            self._conns.discard(sock)
+
+    def hard_close_connections(self) -> None:
+        """Sever every accepted connection (fault.node.node_kill): a
+        SIGKILL'd process takes its established sockets with it, so
+        the in-process kill must too — otherwise peers keep completing
+        RPCs against the 'dead' node over keep-alive connections."""
+        with self._conns_lock:
+            conns, self._conns = list(self._conns), set()
+        for s in conns:
+            try:
+                s.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                s.close()
+            except OSError:
+                pass
 
     def enable_iam(self):
         """Attach the IAM subsystem: per-user credentials, policy
@@ -342,7 +372,24 @@ class S3Server:
                         request.settimeout(self.idle_timeout_s)
                 except OSError:
                     pass
+                server._track_conn(request)
                 super().process_request(request, client_address)
+
+            def shutdown_request(self, request):
+                server._untrack_conn(request)
+                super().shutdown_request(request)
+
+            def handle_error(self, request, client_address):
+                # a client (or node-kill chaos) severing the socket
+                # mid-response is normal churn, not a server error —
+                # everything else keeps the stderr traceback
+                import sys as _sys
+                et = _sys.exc_info()[0]
+                if et is not None and issubclass(
+                        et, (BrokenPipeError, ConnectionResetError,
+                             TimeoutError, socket.timeout)):
+                    return
+                super().handle_error(request, client_address)
 
         httpd = TunedServer((self.address, self.port), Handler)
         self._httpd = httpd

@@ -114,6 +114,96 @@ def test_drwmutex_quorum_with_dead_lockers():
     assert not m.get_lock(timeout=0.3)  # 2/5 < quorum
 
 
+def test_drwmutex_failed_quorum_releases_async():
+    """ISSUE 12 satellite: a failed quorum releases every acquired
+    lock ASYNCHRONOUSLY (drwmutex.go:297) — a locker whose unlock
+    stalls must not stretch the acquire loop, and the partial grants
+    must still drain once the stall clears."""
+    gate = threading.Event()
+
+    class SlowUnlock(LocalLocker):
+        def unlock(self, resource, uid):
+            gate.wait(5.0)  # a stalled peer answering the release
+            return super().unlock(resource, uid)
+
+    slow = SlowUnlock()
+    dead_count = 3
+
+    class Dead:
+        def lock(self, *a):
+            raise ConnectionError
+
+        rlock = unlock = runlock = lock
+
+    lockers = [slow, LocalLocker(),
+               *[Dead() for _ in range(dead_count)]]
+    m = DRWMutex(lockers, "r", owner="n1")
+    t0 = time.monotonic()
+    assert not m.get_lock(timeout=0.4)  # 2/5 grants < 3 quorum
+    elapsed = time.monotonic() - t0
+    # the stalled unlock never ran on the acquire path
+    assert elapsed < 2.0, elapsed
+    gate.set()
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline:
+        if not slow.snapshot() and not lockers[1].snapshot():
+            break
+        time.sleep(0.02)
+    assert not slow.snapshot(), "granted locks must drain after the stall"
+    assert not lockers[1].snapshot()
+
+
+def test_dynamic_timeout_decays_only_on_success(monkeypatch):
+    """ISSUE 12 satellite: under injected locker failures the shared
+    operation timeout must RISE (»33% failures) and only decay toward
+    the slowest recent success when acquisitions actually succeed."""
+    from minio_tpu.dist import dsync as ds
+    from minio_tpu.utils.dyntimeout import LOG_SIZE, DynamicTimeout
+    dyn = DynamicTimeout(0.12, 0.05)
+    monkeypatch.setattr(ds, "OPERATION_TIMEOUT", dyn)
+
+    class Dead:
+        def lock(self, *a):
+            raise ConnectionError
+
+        rlock = unlock = runlock = lock
+
+    dead = [Dead(), Dead(), Dead()]
+    start = dyn.timeout()
+    for _ in range(LOG_SIZE):  # a full log of failures
+        assert not DRWMutex(dead, "r", owner="nX").get_lock()
+    assert dyn.timeout() > start, "all-failure window must raise it"
+    raised = dyn.timeout()
+    good = [LocalLocker(), LocalLocker(), LocalLocker()]
+    for _ in range(LOG_SIZE):  # a full log of fast successes
+        m = DRWMutex(good, "r", owner="nY")
+        assert m.get_lock()
+        m.unlock()
+    assert dyn.timeout() < raised, "successes must decay it"
+    assert dyn.timeout() >= 0.05, "never below the configured floor"
+
+
+def test_local_locker_monotonic_age():
+    """ISSUE 12 satellite: lease/stale age math runs on the monotonic
+    clock — a wall-clock (NTP) step cannot mass-expire live locks."""
+    lk = LocalLocker()
+    assert lk.lock("res", "u1", "o1")
+    with lk._lock:
+        entry = lk._table["res"][0]
+        entry["ts"] -= 10_000.0  # simulated NTP step: wall jumps back
+    assert lk.stale_sweep(300.0) == 0, "wall step must not expire it"
+    assert not lk.expired("res", "u1")
+    with lk._lock:
+        lk._table["res"][0]["ts_mono"] -= 10_000.0  # genuinely old
+    assert lk.entries_older_than(300.0) == [("res", "u1", "o1")]
+    assert lk.touch("res", "u1")  # lease renewal resets the age
+    assert lk.entries_older_than(300.0) == []
+    with lk._lock:
+        lk._table["res"][0]["ts_mono"] -= 10_000.0
+    assert lk.stale_sweep(300.0) == 1
+    assert lk.expired("res", "u1")
+
+
 # --- format ------------------------------------------------------------------
 
 

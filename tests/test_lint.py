@@ -697,3 +697,79 @@ def test_gl013_real_dispatch_module_clean():
         graftlint.REPO_ROOT, "minio_tpu", "runtime", "dispatch.py"))
     assert real is not None
     assert not checkers.check_mesh_routes(real)
+
+
+# --------------------------------------------------------------------------
+# GL014 — dist/ RPC plane: chaos-reachable entry points, bounded waits
+
+
+def test_gl014_unbounded_http_and_waits_flagged():
+    ctx = ctx_for("""
+        import requests
+        class SomeClient:
+            def fetch(self):
+                return self._session.post(url, data=b"")   # no timeout
+
+            def probe(self):
+                return self._session.get(url, timeout=2)   # bounded: ok
+
+            def park(self):
+                self._stop.wait()                           # unbounded
+                self._stop.wait(1.0)                        # bounded: ok
+    """, path="minio_tpu/dist/newsvc.py")
+    got = checkers.check_dist_rpc_bounds(ctx)
+    tokens = sorted(f.token for f in got)
+    assert "http:post" in tokens, tokens
+    assert any(t.startswith("wait:") for t in tokens), tokens
+    # the requests import outside rpc.py is itself a finding
+    assert "requests-import" in tokens, tokens
+    assert all(f.checker == "GL014" for f in got)
+    # dict .get / plain calls never match
+    assert not any("http:get" == t for t in tokens
+                   if "session" not in t), tokens
+
+
+def test_gl014_out_of_scope_and_rpc_py_import_clean():
+    src = """
+        import requests
+        def f(session):
+            return session.post(url, data=b"")
+    """
+    # outside dist/: not GL014's business
+    assert not checkers.check_dist_rpc_bounds(
+        ctx_for(src, path="minio_tpu/server/s3api.py"))
+    # rpc.py may import requests (it IS the funnel), but its HTTP
+    # calls still need timeouts
+    got = checkers.check_dist_rpc_bounds(
+        ctx_for(src, path="minio_tpu/dist/rpc.py"))
+    assert [f.token for f in got] == ["http:post"]
+
+
+def test_gl014_rpc_call_needs_both_fault_layers():
+    missing_node = """
+        class RPCClient:
+            def call(self, method):
+                _fault.inject("rpc", self.base, method)
+                return self._session.post(url, timeout=5)
+    """
+    got = checkers.check_dist_rpc_bounds(
+        ctx_for(missing_node, path="minio_tpu/dist/rpc.py"))
+    assert [f.token for f in got] == ["hook:node"], got
+    both = """
+        class RPCClient:
+            def call(self, method):
+                _fault.inject("node", self.base, self.src)
+                _fault.inject("rpc", self.base, method)
+                return self._session.post(url, timeout=5)
+    """
+    assert not checkers.check_dist_rpc_bounds(
+        ctx_for(both, path="minio_tpu/dist/rpc.py"))
+
+
+def test_gl014_real_dist_modules_clean():
+    for name in ("rpc", "storage_rest", "lock_rest", "peer", "dsync",
+                 "harness"):
+        real = graftlint.parse_file(os.path.join(
+            graftlint.REPO_ROOT, "minio_tpu", "dist", f"{name}.py"))
+        assert real is not None
+        assert not checkers.check_dist_rpc_bounds(real), name

@@ -36,6 +36,12 @@ text — nothing in the checked tree is imported.
 |       | appear in the ``_MESH_SINGLE_DEVICE_OPS`` exemption          |
 |       | registry — a new dispatch op cannot silently ship            |
 |       | device-only without a mesh route                             |
+| GL014 | the dist/ RPC plane is chaos-reachable and bounded: every    |
+|       | HTTP call carries a ``timeout=``, no unbounded ``.wait()``/  |
+|       | ``.recv()``, ``requests`` is imported only by ``rpc.py``     |
+|       | (every client funnels through ``RPCClient.call``), and       |
+|       | ``RPCClient.call`` carries BOTH the per-call ``rpc`` and     |
+|       | whole-peer ``node`` fault-injection hooks                    |
 """
 from __future__ import annotations
 
@@ -1048,6 +1054,101 @@ def check_mesh_routes(ctx: FileCtx) -> list[Finding]:
     return out
 
 
+# --------------------------------------------------------------------------
+# GL014 — dist/ RPC plane: chaos-reachable entry points, bounded waits
+
+_GL014_HTTP_VERBS = {"post", "get", "put", "delete", "request", "head"}
+_GL014_HTTP_RECV_RE = re.compile(r"(^|[._])(session|http|requests)($|[._])",
+                                 re.IGNORECASE)
+
+
+def check_dist_rpc_bounds(ctx: FileCtx) -> list[Finding]:
+    """GL014: the node fault layer (docs/fault.md) injects at
+    ``RPCClient.call`` — so every dist/ client entry point must funnel
+    through it (no direct ``requests`` use outside rpc.py), every HTTP
+    call must carry a bounded ``timeout=`` (a partitioned peer must
+    fail the caller, not hang it), ``.wait()``/``.recv()`` must be
+    bounded, and ``RPCClient.call`` itself must consult BOTH the
+    ``rpc`` (per-call) and ``node`` (whole-peer) fault layers."""
+    if not ctx.path.startswith("minio_tpu/dist/"):
+        return []
+    out: list[Finding] = []
+    is_rpc_py = ctx.path == "minio_tpu/dist/rpc.py"
+    if not is_rpc_py:
+        for node in ast.walk(ctx.tree):
+            mods: list[str] = []
+            if isinstance(node, ast.Import):
+                mods = [a.name for a in node.names]
+            elif isinstance(node, ast.ImportFrom):
+                mods = [node.module or ""]
+            if any(m == "requests" or m.startswith("requests.")
+                   for m in mods):
+                if ctx.suppressed(node.lineno, "GL014"):
+                    continue
+                out.append(Finding(
+                    ctx.path, node.lineno, "GL014",
+                    "direct `requests` use outside dist/rpc.py — dist "
+                    "clients must funnel through RPCClient.call so the "
+                    "node-layer fault hooks and offline marking cover "
+                    "them", token="requests-import",
+                    scope=ctx.scope_at(node.lineno)))
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        d = dotted(node.func)
+        tail = d.rsplit(".", 1)[-1]
+        recv = d.rsplit(".", 1)[0] if "." in d else ""
+        if tail in _GL014_HTTP_VERBS and \
+                _GL014_HTTP_RECV_RE.search(recv):
+            if not any(kw.arg == "timeout" for kw in node.keywords):
+                if ctx.suppressed(node.lineno, "GL014"):
+                    continue
+                out.append(Finding(
+                    ctx.path, node.lineno, "GL014",
+                    f"HTTP call `{_unparse(node.func)}(...)` without a "
+                    "timeout= — a hung peer would pin this caller "
+                    "forever (no unbounded waits on the dist plane)",
+                    token=f"http:{tail}",
+                    scope=ctx.scope_at(node.lineno)))
+        if tail in ("wait", "recv") and not node.args and \
+                not node.keywords and recv:
+            if ctx.suppressed(node.lineno, "GL014"):
+                continue
+            out.append(Finding(
+                ctx.path, node.lineno, "GL014",
+                f"unbounded `{_unparse(node.func)}()` on the dist "
+                "plane — pass a timeout so a dead peer cannot park "
+                "this thread forever",
+                token=f"wait:{recv}", scope=ctx.scope_at(node.lineno)))
+    if is_rpc_py:
+        layers: set[str] = set()
+        call_fn = None
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.ClassDef) and node.name == "RPCClient":
+                for fn in node.body:
+                    if isinstance(fn, ast.FunctionDef) and \
+                            fn.name == "call":
+                        call_fn = fn
+        if call_fn is not None:
+            for node in ast.walk(call_fn):
+                if isinstance(node, ast.Call) and \
+                        dotted(node.func).endswith("inject") and \
+                        node.args and isinstance(node.args[0],
+                                                 ast.Constant):
+                    layers.add(node.args[0].value)
+        for layer in ("rpc", "node"):
+            if call_fn is not None and layer not in layers:
+                out.append(Finding(
+                    ctx.path, call_fn.lineno, "GL014",
+                    f"RPCClient.call carries no {layer!r}-layer fault "
+                    "hook — the chaos matrix cannot reach the "
+                    f"{'whole-peer' if layer == 'node' else 'per-call'}"
+                    " injection point",
+                    token=f"hook:{layer}",
+                    scope=ctx.scope_at(call_fn.lineno + 1)))
+    return out
+
+
 PER_FILE = [
     check_wall_duration,
     check_blocking_under_lock,
@@ -1061,5 +1162,6 @@ PER_FILE = [
     check_timeline_flush_pairs,
     check_slo_plane,
     check_mesh_routes,
+    check_dist_rpc_bounds,
 ]
 PROJECT = [check_metrics_documented]

@@ -29,9 +29,19 @@ whose ``passed`` gates CI. ``bench.py`` embeds a run as the
 ``scale_slo`` extra for BENCH_r07+; ``tests/test_loadgen.py`` runs the
 scaled-down tier-1 profile from ISSUE 10's acceptance criteria.
 
+``--topology N`` stands the same load on a real N-node in-process
+cluster (``dist.harness.LocalCluster``: separate listeners, storage
+REST RPC, dsync locks) and ``--chaos-kill <idx>`` runs the node-chaos
+phase (ISSUE 12): a ledger writer records every acknowledged PUT while
+the node is killed mid-run and restarted later; after the heal backlog
+drains, every acked key is re-verified — the ``no_acked_write_loss``,
+``node_unreachable_detected``, ``heal_backlog_drained`` and
+``background_slo_availability_ok`` verdicts gate the run.
+
 Run standalone::
 
     python -m tools.loadgen --objects 1000 --clients 64 --duration 6
+    python -m tools.loadgen --topology 4 --chaos-kill 3 --duration 12
 """
 from __future__ import annotations
 
@@ -69,6 +79,14 @@ class Profile:
     scanner_mid_run: bool = True
     overload_probe: bool = True
     preload_threads: int = 16
+    #: node-chaos phase (needs a LoadGen.cluster topology): kill this
+    #: node index mid-run, restart it later in the run, then hold the
+    #: run open until the heal backlog drains — the ledger writer
+    #: proves zero acknowledged-write loss across the kill
+    chaos_kill_node: int | None = None
+    chaos_kill_at_frac: float = 0.35
+    chaos_restart_at_frac: float = 0.7
+    heal_drain_timeout_s: float = 90.0
 
     @classmethod
     def tier1(cls) -> "Profile":
@@ -215,8 +233,33 @@ class LoadGen:
         lg._owned = True
         return lg
 
+    @classmethod
+    def cluster(cls, root: str, nodes: int = 4, disks_per_node: int = 2,
+                parity: int = 2) -> "LoadGen":
+        """The distributed form (``--topology N``, ROADMAP item 4): an
+        in-process N-node cluster (separate HTTP listeners, storage
+        REST RPC, dsync locks — dist.harness.LocalCluster) with the
+        load driven at node 0 and the cluster handle exposed for the
+        node-chaos phase. Scanner forcing targets node 0's scanner."""
+        from minio_tpu.dist.harness import LocalCluster
+        lc = LocalCluster(root, nodes=nodes,
+                          disks_per_node=disks_per_node, parity=parity)
+        node0 = lc.nodes[0]
+        if getattr(node0.server, "scanner", None) is not None:
+            node0.server.scanner.sleep_per_object = 0.0
+        lg = cls(lc.endpoint(0), lc.access_key, lc.secret_key,
+                 server=node0.server, objlayer=node0.obj)
+        lg.topology = lc
+        lg._owned = True
+        return lg
+
     def close(self) -> None:
-        if self._owned and self.server is not None:
+        if not self._owned:
+            return
+        lc = getattr(self, "topology", None)
+        if lc is not None:
+            lc.shutdown()
+        elif self.server is not None:
             self.server.shutdown()
 
     # -- phases ---------------------------------------------------------------
@@ -366,6 +409,94 @@ class LoadGen:
         out["end_s"] = round(time.monotonic() - rec_t0, 3)
         out["cycle"] = scanner.cycle
 
+    def _chaos_phase(self, profile: Profile, rec_t0: float,
+                     deadline: float, out: dict) -> None:
+        """Node-chaos driver (its own thread): a LEDGER WRITER puts
+        unique keys continuously while the target node is killed and
+        later restarted; every 200-acked key is recorded and verified
+        AFTER the run — the zero-acknowledged-write-loss proof. The
+        health snapshot is sampled right after the kill (unreachable
+        detection) and the heal backlog is watched to zero after
+        rejoin (cross-node repair drains)."""
+        import hashlib
+        lc = self.topology
+        idx = profile.chaos_kill_node
+        kill_at = rec_t0 + profile.duration_s * profile.chaos_kill_at_frac
+        restart_at = rec_t0 + profile.duration_s * \
+            profile.chaos_restart_at_frac
+        cl = _SigClient(self.endpoint, self.ak, self.sk)
+        acked: dict[str, str] = {}
+        seq = 0
+        killed = restarted = False
+        while time.monotonic() < deadline or (killed and not restarted):
+            now = time.monotonic()
+            if not killed and now >= kill_at:
+                lc.kill(idx)
+                out["killed_at_s"] = round(now - rec_t0, 3)
+                killed = True
+                # unreachable detection: ONE aggregation right after
+                # the kill must already report the node gone
+                from minio_tpu.obs.health import cluster_snapshot
+                snap = cluster_snapshot(self.server)["cluster"]
+                out["detected_unreachable"] = (
+                    snap["nodes_offline"] > 0 or
+                    snap["peers_unreachable"] > 0)
+                continue
+            if killed and not restarted and now >= restart_at:
+                lc.restart(idx)
+                out["restarted_at_s"] = round(
+                    time.monotonic() - rec_t0, 3)
+                restarted = True
+                continue
+            body = hashlib.sha256(f"ledger{seq}".encode()).digest() * 64
+            key = f"ledger/k{seq:06d}"
+            try:
+                r = cl.request("PUT", f"/{profile.bucket}/{key}",
+                               body=body)
+                if r.status_code == 200:
+                    acked[key] = hashlib.md5(body).hexdigest()
+            except Exception:  # noqa: BLE001 — unacked: not in ledger
+                pass
+            seq += 1
+        out["acked_writes"] = len(acked)
+        out["_acked"] = acked
+
+    def _chaos_settle(self, profile: Profile, out: dict) -> None:
+        """Post-run: wait for every live node's heal backlog to drain,
+        then re-read every acknowledged ledger key."""
+        import hashlib
+        lc = self.topology
+        t0 = time.monotonic()
+        deadline = t0 + profile.heal_drain_timeout_s
+        drained = False
+        while time.monotonic() < deadline:
+            backlog = 0
+            for node in lc.nodes:
+                srv = node.server
+                mrf = getattr(srv, "mrf", None) if srv else None
+                if mrf is not None:
+                    backlog += mrf.stats()["queued"]
+            if backlog == 0:
+                drained = True
+                break
+            time.sleep(0.25)
+        out["heal_drain_s"] = round(time.monotonic() - t0, 3)
+        out["heal_drained"] = drained
+        acked = out.pop("_acked", {})
+        cl = _SigClient(self.endpoint, self.ak, self.sk)
+        lost: list[str] = []
+        for key, md5 in acked.items():
+            try:
+                r = cl.request("GET", f"/{profile.bucket}/{key}")
+                ok = r.status_code == 200 and \
+                    hashlib.md5(r.content).hexdigest() == md5
+            except Exception:  # noqa: BLE001
+                ok = False
+            if not ok:
+                lost.append(key)
+        out["lost_writes"] = lost[:16]
+        out["lost_count"] = len(lost)
+
     def _overload_probe(self, profile: Profile) -> dict:
         """Deliberately pinch the admission gate to capacity 1 and fire
         a concurrent burst so the 503 SlowDown + Retry-After contract is
@@ -419,6 +550,17 @@ class LoadGen:
 
     def run(self, profile: Profile) -> dict:
         from minio_tpu.obs import slo
+        if profile.chaos_kill_node is not None and \
+                getattr(self, "topology", None) is not None:
+            n_nodes = len(self.topology.nodes)
+            if not 0 < profile.chaos_kill_node < n_nodes:
+                # node 0 serves ALL the load (ledger writer, health
+                # sampling, settle-phase verification) — killing it, or
+                # a node that doesn't exist, would produce misleading
+                # red verdicts instead of an operator error
+                raise ValueError(
+                    f"chaos_kill_node must be 1..{n_nodes - 1} "
+                    "(node 0 is the load endpoint)")
         body = random.Random(profile.seed + 1).randbytes(
             profile.value_bytes)
         preload_s = self.preload(profile)
@@ -436,6 +578,15 @@ class LoadGen:
         deadline = rec.t0 + profile.duration_s
         ths = self._closed_loop(profile, rec, deadline, body)
         open_t = self._open_loop(profile, rec, deadline, body)
+        chaos: dict = {}
+        chaos_t: threading.Thread | None = None
+        if profile.chaos_kill_node is not None and \
+                getattr(self, "topology", None) is not None:
+            chaos_t = threading.Thread(
+                target=self._chaos_phase,
+                args=(profile, rec.t0, deadline, chaos),
+                daemon=True, name="loadgen-chaos")
+            chaos_t.start()
         scanner_win: dict = {}
         scan_t: threading.Thread | None = None
         if profile.scanner_mid_run and self.server is not None:
@@ -451,8 +602,11 @@ class LoadGen:
         wall_s = time.monotonic() - rec.t0
         if scan_t is not None:
             scan_t.join(timeout=180)
+        if chaos_t is not None:
+            chaos_t.join(timeout=profile.duration_s + 120)
+            self._chaos_settle(profile, chaos)
         return self._report(profile, rec, wall_s, preload_s,
-                            scanner_win, probe, lockrank_before)
+                            scanner_win, probe, lockrank_before, chaos)
 
     @staticmethod
     def _lockrank_count() -> int | None:
@@ -472,7 +626,8 @@ class LoadGen:
 
     def _report(self, profile: Profile, rec: _Recorder, wall_s: float,
                 preload_s: float, scanner_win: dict, probe: dict,
-                lockrank_before: int | None) -> dict:
+                lockrank_before: int | None,
+                chaos: dict | None = None) -> dict:
         from minio_tpu.obs import slo
         from minio_tpu.obs.health import cluster_snapshot
         rows = rec.snapshot()
@@ -559,6 +714,22 @@ class LoadGen:
             "burn_rate_metrics_live":
                 "minio_tpu_slo_burn_rate" in metrics_text,
         }
+        if chaos:
+            # the node-chaos acceptance set (ISSUE 12): the kill was
+            # DETECTED, nothing acknowledged was lost, the heal
+            # backlog drained after rejoin, and the background class
+            # kept its availability SLO through the whole run
+            bg_breach = slo_rep.get("classes", {}).get(
+                "background", {}).get("breach", {})
+            verdicts["node_unreachable_detected"] = \
+                chaos.get("detected_unreachable", False)
+            verdicts["no_acked_write_loss"] = (
+                chaos.get("acked_writes", 0) > 0 and
+                chaos.get("lost_count", 1) == 0)
+            verdicts["heal_backlog_drained"] = \
+                chaos.get("heal_drained", False)
+            verdicts["background_slo_availability_ok"] = \
+                not bg_breach.get("availability", False)
         verdicts["passed"] = all(verdicts.values())
         return {
             "profile": {
@@ -580,6 +751,7 @@ class LoadGen:
             "per_class": overall["classes"],
             "scanner": scanner_impact,
             "overload_probe": probe,
+            "node_chaos": chaos or {},
             "qos_evidence": qos_evidence,
             "slo": slo_rep,
             "health": cluster_snapshot(self.server, peers=False)
@@ -600,6 +772,23 @@ def run_tier1_profile(root: str, profile: Profile | None = None) -> dict:
         lg.close()
 
 
+def run_topology_profile(root: str, profile: Profile | None = None,
+                         nodes: int = 4, disks_per_node: int = 2,
+                         parity: int = 2) -> dict:
+    """The ISSUE 12 node-chaos profile (``--topology N``): mixed load
+    against a real N-node in-process cluster; with
+    ``profile.chaos_kill_node`` set, one node is killed mid-run and
+    restarted later, and the verdicts block gates on unreachable
+    detection, zero acknowledged-write loss, heal-backlog drain and
+    the background availability SLO."""
+    lg = LoadGen.cluster(root, nodes=nodes,
+                         disks_per_node=disks_per_node, parity=parity)
+    try:
+        return lg.run(profile or Profile.tier1())
+    finally:
+        lg.close()
+
+
 def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser(
         description="mixed-workload SLO scale harness")
@@ -611,6 +800,12 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--ramp", type=float, default=2.0)
     ap.add_argument("--no-scanner", action="store_true")
     ap.add_argument("--no-probe", action="store_true")
+    ap.add_argument("--topology", type=int, default=1,
+                    help="run against an in-process N-node cluster")
+    ap.add_argument("--disks-per-node", type=int, default=2)
+    ap.add_argument("--chaos-kill", type=int, default=-1, metavar="NODE",
+                    help="kill this node index mid-run and restart it "
+                    "(needs --topology > 1)")
     ap.add_argument("--out", default="", help="write the report JSON")
     args = ap.parse_args(argv)
     import tempfile
@@ -620,9 +815,16 @@ def main(argv: list[str] | None = None) -> int:
         duration_s=args.duration, value_bytes=args.value_bytes,
         open_rps=args.open_rps, ramp_s=args.ramp,
         scanner_mid_run=not args.no_scanner,
-        overload_probe=not args.no_probe)
+        overload_probe=not args.no_probe,
+        chaos_kill_node=args.chaos_kill if args.chaos_kill >= 0
+        else None)
     with tempfile.TemporaryDirectory(prefix="loadgen-") as root:
-        report = run_tier1_profile(root, profile)
+        if args.topology > 1:
+            report = run_topology_profile(
+                root, profile, nodes=args.topology,
+                disks_per_node=args.disks_per_node)
+        else:
+            report = run_tier1_profile(root, profile)
     blob = json.dumps(report, indent=1, sort_keys=True)
     if args.out:
         with open(args.out, "w", encoding="utf-8") as f:
