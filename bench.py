@@ -564,6 +564,79 @@ def heal_latency(rng) -> dict:
     return out
 
 
+def interactive_lane_extra(rng) -> dict:
+    """ISSUE 13: heal-shard wall p50/p99 at conc=8 and conc=128 through
+    BOTH device-lane disciplines — the bulk coalescing lane
+    (``qos.device_stream(STREAM_BULK)``) vs the interactive lane
+    (bounded <=8 batches on a dedicated dispatcher, deadline-aware
+    sizing, async on_ready completion, donated inputs on TPU). Leaves
+    are ``heal_p50_s``/``heal_p99_s`` (down-better headline metrics for
+    tools/bench_compare). On a TPU host the acceptance target is device
+    heal-shard p99 within 5x of CPU at conc=8 while bulk encode stays
+    >=100 GiB/s (ROADMAP item 2); on a CPU-only host both lanes run the
+    CPU route and the number documents the lane overheads instead."""
+    import threading
+
+    from minio_tpu import qos
+    from minio_tpu.ops import rs_jax
+    from minio_tpu.runtime.dispatch import global_queue
+    K, M, BLOCK = 16, 4, 1 << 20
+    shard = BLOCK // K
+    codec = rs_jax.get_codec(K, M)
+    q = global_queue()
+    present = tuple(i for i in range(K + M) if i not in (3, 17))[:K]
+    masks = codec.target_masks_np(present, (3, 17))
+    words = rs_jax.pack_shards(
+        rng.integers(0, 256, (K, shard), dtype=np.uint8))
+
+    def pcts(vals: list[float]) -> dict:
+        vs = sorted(vals)
+        return {"heal_p50_s": round(vs[len(vs) // 2], 6),
+                "heal_p99_s": round(
+                    vs[min(len(vs) - 1, int(0.99 * len(vs)))], 6)}
+
+    def run_leg(stream: str, conc: int) -> dict:
+        # warm the pow2 batch shapes this leg can hit
+        with qos.device_stream(stream):
+            futs = [q.masked(codec, words, masks)
+                    for _ in range(min(conc, 8))]
+            for f in futs:
+                f.result()
+        n_ops = 64 if conc == 8 else 256
+        per_worker = max(1, n_ops // conc)
+        walls: list[float] = []
+        wlock = threading.Lock()
+
+        def worker():
+            with qos.device_stream(stream):
+                for _ in range(per_worker):
+                    t0 = time.perf_counter()
+                    q.masked(codec, words, masks).result()
+                    dt = time.perf_counter() - t0
+                    with wlock:
+                        walls.append(dt)
+
+        threads = [threading.Thread(target=worker)
+                   for _ in range(conc)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        return pcts(walls)
+
+    out: dict = {}
+    for stream in (qos.STREAM_BULK, qos.STREAM_INTERACTIVE):
+        leg: dict = {}
+        for conc in (8, 128):
+            leg[f"conc{conc}"] = run_leg(stream, conc)
+            log(f"interactive_lane [{stream}] conc={conc}: "
+                f"p50={leg[f'conc{conc}']['heal_p50_s'] * 1e3:.1f}ms "
+                f"p99={leg[f'conc{conc}']['heal_p99_s'] * 1e3:.1f}ms")
+        out[stream] = leg
+    out["lane"] = q.stats()["interactive_lane"]
+    return {"interactive_lane": out}
+
+
 def chaos_profile(rng) -> dict:
     """--chaos: the degraded-operation half of the north-star. A 16+4
     set of 1 MiB objects is measured clean, then with a 1-slow-disk
@@ -882,6 +955,36 @@ def scale_slo_extra() -> dict:
         cls: ent["breach"] for cls, ent in rep["slo"]["classes"].items()}
     log(f"scale_slo: {rep['requests_total']} reqs @ {rep['rps']}/s, "
         f"passed={rep['verdicts']['passed']}")
+    # degraded-GET + heal interactive mix (ISSUE 13): a second, smaller
+    # run with one disk's shard reads killed — GETs reconstruct on the
+    # interactive device lane, a heal worker rebuilds concurrently, and
+    # the interactive class's own burn rates judge the latency tier.
+    # MINIO_TPU_SCALE_DEGRADED=0 skips.
+    if os.environ.get("MINIO_TPU_SCALE_DEGRADED", "1") != "0":
+        dprofile = Profile(
+            objects=int(os.environ.get(
+                "MINIO_TPU_SCALE_DEGRADED_OBJECTS", "128")),
+            clients=int(os.environ.get(
+                "MINIO_TPU_SCALE_DEGRADED_CLIENTS", "16")),
+            duration_s=float(os.environ.get(
+                "MINIO_TPU_SCALE_DEGRADED_DURATION", "4")),
+            value_bytes=256 << 10,   # above the 128 KiB inline line
+            open_rps=0.0,
+            degraded=True,
+        )
+        with tempfile.TemporaryDirectory(prefix="bench-slo-deg-") as root:
+            drep = run_tier1_profile(root, dprofile)
+        slim["degraded"] = {
+            "profile": drep["profile"],
+            "degraded": drep["degraded"],
+            "interactive": drep["per_class"].get("interactive", {}),
+            "verdicts": {k: v for k, v in drep["verdicts"].items()
+                         if k.startswith("degraded") or k == "passed"},
+        }
+        log(f"scale_slo degraded: reconstruct items="
+            f"{drep['degraded'].get('interactive_lane_items')} heals="
+            f"{drep['degraded'].get('heals')} passed="
+            f"{drep['verdicts']['passed']}")
     return {"scale_slo": slim}
 
 
@@ -985,6 +1088,9 @@ def main() -> None:
     cha = chaos_profile(rng) if chaos else None
     dev = device_configs(rng)
     lat = heal_latency(rng)
+    # interactive device lane (ISSUE 13): heal-shard p50/p99 on both
+    # lane disciplines — rides the same global queue as heal_latency
+    ia_lane = interactive_lane_extra(rng)
     # device workloads (ISSUE 8): Select scan + SSE package crypto
     scan = select_scan_bench(rng)
     sse = sse_put_bench(rng)
@@ -1019,6 +1125,7 @@ def main() -> None:
             "batched_heal_rebuild_gibs": round(
                 dev["batched_heal_rebuild_b128"], 2),           # config 5
             "heal_shard_latency": lat,                # north-star p99 half
+            **ia_lane,     # both-lanes heal p50/p99 (ISSUE 13)
             "reconstruct_vs_cpu": round(
                 dev["reconstruct_2loss_16p4_b128"] / cpu_gibs, 2),
             **scan,                  # device workloads A (docs/select.md)

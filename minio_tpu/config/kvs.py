@@ -118,6 +118,29 @@ SUB_SYSTEMS: dict[str, dict[str, KV]] = {
                          "mesh device, N caps the count, 0/1 disables "
                          "per-lane placement (every device flush shards "
                          "SPMD across all lanes; read at process start)"),
+        "interactive_lane": KV(
+            "1", env="MINIO_TPU_DISPATCH_INTERACTIVE_LANE",
+            help="latency-tuned interactive device lane for heal-shard "
+                 "rebuilds + degraded-GET reconstruct (docs/qos.md); 0 "
+                 "restores the single bulk coalescing lane"),
+        "interactive_batch": KV(
+            "8", env="MINIO_TPU_DISPATCH_INTERACTIVE_BATCH",
+            help="max items per interactive-lane flush (deadline-aware "
+                 "sizing may cut below, never above)"),
+        "interactive_delay_us": KV(
+            "200", env="MINIO_TPU_DISPATCH_INTERACTIVE_DELAY_US",
+            help="max coalescing wait on the interactive lane "
+                 "(microseconds — the lane trades batch fill for "
+                 "latency)"),
+        "interactive_poll_us": KV(
+            "100", env="MINIO_TPU_DISPATCH_INTERACTIVE_POLL_US",
+            help="on_ready poll interval of the interactive lane's "
+                 "async completer (microseconds)"),
+        "interactive_donate": KV(
+            "auto", env="MINIO_TPU_DISPATCH_INTERACTIVE_DONATE",
+            help="auto|1|0 donated input buffers for interactive-lane "
+                 "rebuild launches (jax donate_argnums); auto = only "
+                 "on a TPU backend"),
     },
     "qos": {
         "spill_factor": KV(

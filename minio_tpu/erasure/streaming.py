@@ -26,6 +26,7 @@ from .. import fault as _fault
 from ..obs import metrics as _mx
 from ..obs import spans as _spans
 from ..obs import stages as _stages
+from ..runtime import completion as _compl
 from ..utils import errors
 from .codec import Erasure, ceil_div
 
@@ -840,7 +841,9 @@ class _ParallelReader:
             for f in ready:
                 i = pending.pop(f)
                 try:
-                    data = f.result()
+                    # already done (came back from wait()): the helper
+                    # keeps the GL015 funnel uniform at ~zero wall
+                    data = _compl.await_result(f, op="shard_read")
                     _observe_shard_read(
                         time.monotonic() - t_launch.pop(f, 0.0), shard_len)
                     if raw:
@@ -951,7 +954,9 @@ def erasure_decode(erasure: Erasure, writer, readers: list, offset: int,
         failed = False
         for f, i in futs.items():
             try:
-                out[i] = f.result()
+                # sanctioned async-completion helper (GL015): the ONLY
+                # blocking-wait form on the interactive-class GET path
+                out[i] = _compl.await_result(f, op="shard_read")
             except Exception as e:  # noqa: BLE001 — disk errors become votes
                 preader.errs[i] = e if isinstance(e, errors.StorageError) \
                     else errors.FaultyDisk(str(e))
@@ -1082,7 +1087,7 @@ def erasure_decode(erasure: Erasure, writer, readers: list, offset: int,
             # the garbage-assembling call finishes last). Its pooled
             # buffer (non-zero-copy case) is recycled here too.
             try:
-                res = e[1].result()
+                res = _compl.await_result(e[1], op="decode")
                 if e[0] == "native":
                     out_arr = res[0]
                     if out_arr is not e[6]:
@@ -1097,7 +1102,7 @@ def erasure_decode(erasure: Erasure, writer, readers: list, offset: int,
     def emit(entry):
         kind, fut, b, block_data_len, boff, blen, dest = entry
         with _stages.timed(stc, "decode"):
-            res = fut.result()
+            res = _compl.await_result(fut, op="decode")
         if kind == "native":
             out_arr, bad = res
             if bad == -1:
@@ -1212,7 +1217,7 @@ def erasure_heal(erasure: Erasure, writers: list, readers: list,
     def emit(entry):
         kind, fut, b = entry
         with _stages.timed(stc, "rebuild"):
-            res = fut.result()
+            res = _compl.await_result(fut, op="rebuild")
         if kind == "fused":
             rebuilt, corrupt = res
             if corrupt:
@@ -1221,10 +1226,11 @@ def erasure_heal(erasure: Erasure, writers: list, readers: list,
                 # (its raw reads also carried the corrupt shard)
                 preader.drop_corrupt(corrupt)
                 block_data_len = min(bs, total_length - b * bs)
-                rebuilt = erasure.rebuild_targets_async(
-                    preader.read_block(b * erasure.shard_size(),
-                                       ceil_div(block_data_len, k)),
-                    targets).result()
+                rebuilt = _compl.await_result(
+                    erasure.rebuild_targets_async(
+                        preader.read_block(b * erasure.shard_size(),
+                                           ceil_div(block_data_len, k)),
+                        targets), op="rebuild")
                 pending = list(window)
                 window.clear()
                 for e in pending:

@@ -1173,10 +1173,18 @@ class ErasureObjects(MultipartMixin, ObjectLayer):
             # background planes (MRF/scanner/heal sequences) get a root
             # of their own, so the heal-p99 worst sample always links to
             # a span tree and slow background heals tail-sample too
+            # heal-shard rebuilds ride the INTERACTIVE device lane
+            # (ISSUE 13): bounded small batches + deadline-aware sizing
+            # + async completion instead of 20-second coalesced flushes
+            # (BENCH_r05's device heal p99). The op-based default in
+            # runtime/dispatch covers the rebuild ops already; pinning
+            # the stream here makes the routing explicit and keeps any
+            # future heal-path dispatch op on the latency lane too.
             with _spans.maybe_root("heal.object", cls="background",
                                    bucket=bucket, object=object,
                                    mode=scan_mode), _attr.observed("heal"), \
-                    _qos.lane_affinity(self._lane_key):
+                    _qos.lane_affinity(self._lane_key), \
+                    _qos.device_stream(_qos.STREAM_INTERACTIVE):
                 return self._heal_object_inner(bucket, object, version_id,
                                                dry_run, remove_dangling,
                                                scan_mode)

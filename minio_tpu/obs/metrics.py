@@ -331,6 +331,68 @@ def _g_device(server) -> list[str]:
     return lines
 
 
+def _g_lane(server) -> list[str]:
+    """Interactive device lane (ISSUE 13; docs/qos.md "Interactive
+    device lane"): per-stream flush/item totals and wall percentiles,
+    the deadline-cut and async (on_ready) completion counters, and the
+    interactive lane's own queued-bytes/backlog model. The CONSUMER-side
+    wait counters (minio_tpu_lane_await_total{op},
+    minio_tpu_lane_await_seconds_total{op}) ride the counter store,
+    incremented by runtime/completion.await_result — the sanctioned
+    GL015 blocking funnel."""
+    from . import latency as lat
+    from ..runtime.dispatch import _global
+    lines: list[str] = []
+    if _global is not None:
+        st = _global.stats()
+        ia = st["interactive_lane"]
+        # direct per-stream counters (counted at _flush entry), never
+        # derived by subtraction from the route counters — those move
+        # later and twice for split flushes, so a derived value could
+        # scrape negative or drift
+        bulk_flushes = st["bulk_flushes"]
+        bulk_items = st["bulk_items"]
+        lines += [
+            "# TYPE minio_tpu_lane_enabled gauge",
+            f"minio_tpu_lane_enabled {1 if ia['enabled'] else 0}",
+            "# TYPE minio_tpu_lane_flushes_total counter",
+            'minio_tpu_lane_flushes_total{stream="interactive"} '
+            f"{ia['flushes']}",
+            f'minio_tpu_lane_flushes_total{{stream="bulk"}} '
+            f"{bulk_flushes}",
+            "# TYPE minio_tpu_lane_items_total counter",
+            'minio_tpu_lane_items_total{stream="interactive"} '
+            f"{ia['items']}",
+            f'minio_tpu_lane_items_total{{stream="bulk"}} {bulk_items}',
+            "# TYPE minio_tpu_lane_deadline_cuts_total counter",
+            f"minio_tpu_lane_deadline_cuts_total {ia['deadline_cuts']}",
+            "# TYPE minio_tpu_lane_async_completions_total counter",
+            "minio_tpu_lane_async_completions_total "
+            f"{ia['async_completions']}",
+            "# TYPE minio_tpu_lane_batch_max gauge",
+            'minio_tpu_lane_batch_max{stream="interactive"} '
+            f"{ia['max_batch']}",
+            "# TYPE minio_tpu_lane_queued_bytes gauge",
+            'minio_tpu_lane_queued_bytes{stream="interactive"} '
+            f"{ia['queued_bytes']}",
+            "# TYPE minio_tpu_lane_backlog_seconds gauge",
+            'minio_tpu_lane_backlog_seconds{stream="interactive"} '
+            f"{ia['backlog_s']}",
+        ]
+    rows = lat.snapshot("lane")
+    if rows:
+        lines.append("# TYPE minio_tpu_lane_wall_seconds gauge")
+        for labels, w in rows:
+            stream = _esc(labels.get("stream", ""))
+            st = w.stats(tuple(q for q, _ in _QUANTILES))
+            for q, qs in _QUANTILES:
+                lines.append(
+                    "minio_tpu_lane_wall_seconds"
+                    f'{{stream="{stream}",quantile="{qs}"}} '
+                    f'{st["percentiles"][q]:.6f}')
+    return lines
+
+
 def _g_qos(server) -> list[str]:
     """QoS plane (minio_tpu.qos): dispatch spill/deadline counters +
     device queue state from the scheduler, admission inflight/rejects,
@@ -867,6 +929,9 @@ _GROUPS = [
     # qos reads in-memory scheduler/admission state — interval 0 keeps
     # overload tests (and scrapes mid-incident) fresh
     MetricsGroup("qos", "node", _g_qos, interval=0),
+    # interactive device lane reads in-memory queue counters/windows —
+    # interval 0 so the latency tier's behavior is live per scrape
+    MetricsGroup("lane", "node", _g_lane, interval=0),
     # pipeline reads in-memory bufpool counters — interval 0, trivial
     MetricsGroup("pipeline", "node", _g_pipeline, interval=0),
     # disk health reads in-memory tracker state — interval 0 so a trip

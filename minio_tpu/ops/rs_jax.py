@@ -128,6 +128,9 @@ class ReedSolomon:
         self._mask_cache: dict[tuple, jnp.ndarray] = {}
         self._np_mask_cache: dict[tuple, np.ndarray] = {}
         self._mm, self._mm_batch, self._mm_batch_per = _resolve_backend(backend)
+        #: donated-input twin of _mm_batch_per, built lazily for the
+        #: interactive device lane (batch_per_donated)
+        self._batch_per_donated = None
         #: pallas backend: encode runs the static-specialized kernel (the
         #: matrix is fixed per (k, m) — coefficients become compile-time
         #: constants, ~1.4x the dynamic-mask kernel; see rs_pallas.py)
@@ -161,6 +164,23 @@ class ReedSolomon:
         return unpack_shards(np.asarray(self.encode_words_batch(w)))
 
     # -- reconstruct ---------------------------------------------------------
+
+    def batch_per_donated(self):
+        """The per-element-mask batched rebuild kernel with the SHARD
+        WORDS argument donated (``jax.jit(..., donate_argnums=(1,))``):
+        the interactive device lane's heal/reconstruct launches hand
+        their input HBM buffer to the output, so small latency-tuned
+        flushes don't double-allocate device memory per round trip
+        (ISSUE 13). Kept as a separate cached wrapper — donation makes
+        the input buffer unusable after the call, so the bulk path
+        (which may batch the same arrays into a later retry) keeps the
+        plain kernel. Wrapping the already-jitted backend fn in an
+        outer jit is fine: nested jits inline."""
+        fn = self._batch_per_donated
+        if fn is None:
+            fn = self._batch_per_donated = jax.jit(
+                self._mm_batch_per, donate_argnums=(1,))
+        return fn
 
     def _decode_mat(self, present: tuple[int, ...]) -> np.ndarray:
         mat = self._decode_cache.get(present)

@@ -92,6 +92,39 @@ def set_affinity_key(pool_index: int, set_index: int) -> int:
     return zlib.crc32(f"{pool_index}:{set_index}".encode()) & 0x7FFFFFFF
 
 
+#: device-lane DISCIPLINES (ISSUE 13): the bulk lane coalesces toward
+#: max-batch flushes (throughput-tuned — PUT encode, Select scans, SSE);
+#: the interactive lane runs small bounded batches on a dedicated
+#: dispatcher with deadline-aware sizing and async on_ready completion
+#: (latency-tuned — heal-shard rebuilds, degraded-GET reconstruct).
+#: Which stream an op rides defaults by op in runtime/dispatch
+#: (_INTERACTIVE_LANE_OPS); this context variable overrides it — the
+#: bench forces heal work through the bulk lane to measure both.
+STREAM_INTERACTIVE = "interactive"
+STREAM_BULK = "bulk"
+
+_stream: contextvars.ContextVar[str | None] = contextvars.ContextVar(
+    "minio_tpu_qos_stream", default=None)
+
+
+def current_stream() -> str | None:
+    """The explicit device-stream override of the calling context, or
+    None (= the dispatch queue picks by op)."""
+    return _stream.get()
+
+
+@contextlib.contextmanager
+def device_stream(stream: str | None):
+    """Run a block with dispatch submissions pinned to one device-lane
+    discipline (STREAM_INTERACTIVE / STREAM_BULK); None restores the
+    per-op default."""
+    tok = _stream.set(stream)
+    try:
+        yield
+    finally:
+        _stream.reset(tok)
+
+
 from .admission import AdmissionController, classify_request  # noqa: E402
 from .budget import CostModel  # noqa: E402
 from .scheduler import QosScheduler  # noqa: E402
@@ -100,6 +133,8 @@ __all__ = [
     "CLASS_INTERACTIVE", "CLASS_BACKGROUND", "CLASS_PRIORITY",
     "current_class", "work_class", "background",
     "current_affinity", "lane_affinity", "set_affinity_key",
+    "STREAM_INTERACTIVE", "STREAM_BULK", "current_stream",
+    "device_stream",
     "CostModel", "QosScheduler", "AdmissionController",
     "classify_request", "qos_status",
 ]

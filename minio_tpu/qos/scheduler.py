@@ -74,6 +74,12 @@ class QosScheduler:
         self._lane_count = max(1, lanes)
         self._lane_queued = [0] * self._lane_count
         self._lane_busy_until = [0.0] * self._lane_count
+        # interactive device lane (ISSUE 13): its OWN queued-bytes +
+        # busy-until model, separate from the bulk lanes — the
+        # dedicated submission stream means a coalescing bulk backlog
+        # must not inflate the deadline math for a 2-item heal flush
+        self._ia_queued = 0
+        self._ia_busy_until = 0.0
         # telemetry — the minio_tpu_qos_* metric group and the admin qos
         # op read these
         self.spilled_items = 0
@@ -161,6 +167,82 @@ class QosScheduler:
         with self._lock:
             i = lane % self._lane_count
             return max(0.0, self._lane_busy_until[i] - time.monotonic())
+
+    # -- interactive device lane (ISSUE 13) ----------------------------------
+
+    def ia_dispatched(self, nbytes: int, flush_s: float = 0.0) -> None:
+        """Charge one launched interactive-lane flush to its model."""
+        now = time.monotonic()
+        with self._lock:
+            self._ia_queued += nbytes
+            if flush_s > 0.0:
+                self._ia_busy_until = \
+                    max(self._ia_busy_until, now) + flush_s
+
+    def ia_completed(self, nbytes: int) -> None:
+        with self._lock:
+            self._ia_queued = max(0, self._ia_queued - nbytes)
+            if self._ia_queued == 0:
+                # drained ahead of (or behind) the model: resync, same
+                # rule as the bulk lanes
+                self._ia_busy_until = min(self._ia_busy_until,
+                                          time.monotonic())
+
+    def ia_backlog_s(self) -> float:
+        """Predicted drain seconds of the interactive lane's own
+        in-flight flushes."""
+        with self._lock:
+            return max(0.0, self._ia_busy_until - time.monotonic())
+
+    def ia_queued_bytes(self) -> int:
+        with self._lock:
+            return self._ia_queued
+
+    def deadline_batch(self, profile, cls: str,
+                       sizes: list[tuple[int, int]], backlog_s: float,
+                       oldest_age_s: float) -> tuple[int, bool]:
+        """Deadline-aware batch sizing for the interactive device lane
+        (ISSUE 13): how many leading items of a candidate flush fit
+        under the OLDEST item's remaining class budget given the link
+        profile — ``budget(cls) - oldest_age - backlog`` seconds buy
+        ``device_s(cumulative bytes)`` of flush. The lane cuts its
+        batch here instead of waiting for coalescing.
+
+        Returns ``(take, cut)``; ``cut`` is True when the deadline
+        (not the candidate count) limited the batch. Two regimes:
+
+        * **Deadline binding** (some but not all items fit): cut at the
+          last item that fits — the oldest item's budget is protected.
+        * **Overload** (not even ONE item fits the remaining budget):
+          the deadline is already lost, and collapsing to 1-item
+          flushes would only shrink throughput and grow every later
+          item's wait (measured: 2.3 s p99 vs the bulk lane's 1.25 s
+          on a saturated host when the cutter clamped to 1). Take the
+          FULL candidate instead — still bounded by the caller's
+          ``interactive_batch`` cap, and ``plan()`` may still spill
+          the flush to the CPU route.
+
+        Starvation-free by construction either way: at least one item
+        always flushes.
+        """
+        n = len(sizes)
+        if n == 0:
+            return 0, False
+        if profile is None:
+            return n, False
+        remaining = self.cost.budget_s(cls) - oldest_age_s - backlog_s
+        cum_in = cum_out = 0
+        fit = 0
+        for b_in, b_out in sizes:
+            if self.cost.device_s(profile, cum_in + b_in,
+                                  cum_out + b_out) > remaining:
+                break
+            cum_in += b_in
+            cum_out += b_out
+            fit += 1
+        if fit == 0:
+            return n, False
+        return fit, fit < n
 
     def pick_lane(self, affinity: int, record: bool = True) -> int:
         """The flush lane for an affinity key: the preferred lane
@@ -304,6 +386,7 @@ class QosScheduler:
                 "class_items": dict(self.class_items),
                 "deadline_misses": dict(self.deadline_misses),
                 "device_queued_bytes": self._dev_queued_bytes,
+                "ia_queued_bytes": self._ia_queued,
                 "lanes": self._lane_count,
                 "lane_queued_bytes": list(self._lane_queued),
                 "lane_diverts": self.lane_diverts,

@@ -1,0 +1,49 @@
+"""The sanctioned async-completion helper for interactive-class code
+paths (graftlint GL015; docs/static-analysis.md).
+
+The interactive device lane (runtime/dispatch.py, ISSUE 13) never blocks
+on the DISPATCH side: device flushes complete via the on_ready poller
+instead of parking a thread inside a readback. The CONSUMER side —
+heal-shard rebuild and degraded-GET reconstruct in erasure/streaming.py
+— does eventually need the value on its own thread (the rebuilt shards
+feed the very next write), and that wait must be one visible, measured
+funnel rather than bare ``Future.result()`` calls scattered through the
+hot path:
+
+* every wait is counted and timed per op
+  (``minio_tpu_lane_await_total{op}`` /
+  ``minio_tpu_lane_await_seconds_total{op}``), so "where does the 20 s
+  heal-p99 go" has a standing answer next to the PR 9 attribution;
+* GL015 statically bans ``.result()`` inside the registered interactive
+  paths, so a refactor cannot silently reintroduce an unobserved
+  blocking wait on the latency-tuned lane.
+
+This module is the ONE place those paths may block; it is exempt from
+GL015 by construction.
+"""
+from __future__ import annotations
+
+import time
+
+from ..obs import metrics as _mx
+
+
+def await_result(fut, op: str = "", timeout: float | None = None):
+    """Wait for ``fut`` and return its result (or raise its exception) —
+    the sanctioned blocking point for interactive-class code paths.
+
+    ``op`` labels the wait for the ``minio_tpu_lane_await_*`` counters
+    ("rebuild", "decode", "shard_read", …). ``timeout`` passes through
+    to ``Future.result``.
+    """
+    t0 = time.monotonic()
+    try:
+        return fut.result(timeout)
+    finally:
+        try:
+            wall = time.monotonic() - t0
+            label = op or "other"
+            _mx.inc("minio_tpu_lane_await_total", op=label)
+            _mx.inc("minio_tpu_lane_await_seconds_total", wall, op=label)
+        except Exception:  # noqa: BLE001 — obs never breaks the path
+            pass

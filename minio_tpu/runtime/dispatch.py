@@ -36,6 +36,32 @@ mode, so a saturated link yields bounded latency instead of a backlog.
 Work class (interactive vs background) rides a context variable set by
 the scanners/healers; interactive buckets flush first.
 
+Interactive device lane (ISSUE 13, ROADMAP item 2): the coalescing
+discipline above is throughput-tuned — at conc 128 it put device
+heal-shard p99 at 20.3 s vs 14 ms on CPU (BENCH_r05), because every
+flush blocks toward max-batch buckets and the readback parks a
+completer thread. Heal-shard rebuilds and degraded-GET reconstruct
+('masked'/'fused' ops, overridable via ``qos.device_stream``) therefore
+ride a SECOND, latency-tuned lane:
+
+* small bounded batches (``dispatch.interactive_batch``, default <=8)
+  collected by a DEDICATED dispatcher thread, so an interactive flush
+  never queues behind a bulk flush's stack/launch work;
+* deadline-aware batch sizing — ``QosScheduler.deadline_batch`` computes
+  how many items fit under the oldest item's remaining ``qos.budget``
+  given the LinkProfile and cuts the batch there instead of waiting for
+  coalescing;
+* async dispatch with completion callbacks instead of blocking flushes:
+  the on_ready poller (``_AsyncCompleter``) polls ``jax.Array.is_ready``
+  and runs the host readback only once the transfer landed, completing
+  futures in submission order per bucket — no thread ever parks inside
+  a device wait;
+* donated input buffers (``ReedSolomon.batch_per_donated``) on a TPU
+  backend, so the small HBM round trips don't double-allocate.
+
+Bulk PUT/encode and the device workloads keep the coalescing lane
+untouched; healthy GETs never reach the queue at all (CPU-native path).
+
 Enable/disable batching entirely with MINIO_TPU_DISPATCH=1/0 (default: on).
 """
 from __future__ import annotations
@@ -44,6 +70,7 @@ import logging
 import os
 import threading
 import time
+from collections import deque
 from concurrent.futures import Future, ThreadPoolExecutor
 from dataclasses import dataclass, field
 
@@ -112,9 +139,76 @@ MAX_HOLD_S = float(os.environ.get("MINIO_TPU_DISPATCH_HOLD_MS",
 COMPLETERS = int(os.environ.get(
     "MINIO_TPU_COMPLETERS", str(max(4, os.cpu_count() or 4))))
 
+#: ops that ride the INTERACTIVE device lane by default: heal-shard
+#: rebuilds and degraded-GET reconstruct ('masked') plus their fused
+#: verify+rebuild twin. Bulk PUT/encode and the device workloads keep
+#: the coalescing lane. ``qos.device_stream(...)`` overrides per
+#: context (the bench forces heal work through the bulk lane to
+#: measure both disciplines).
+_INTERACTIVE_LANE_OPS = frozenset({"masked", "fused"})
+
 
 def dispatch_enabled() -> bool:
     return os.environ.get("MINIO_TPU_DISPATCH", "1") != "0"
+
+
+def interactive_lane_enabled() -> bool:
+    """dispatch.interactive_lane / MINIO_TPU_DISPATCH_INTERACTIVE_LANE:
+    0 sends every op down the bulk coalescing lane (the pre-ISSUE-13
+    behavior)."""
+    from ..qos.budget import _config_float
+    return _config_float("dispatch", "interactive_lane",
+                         "MINIO_TPU_DISPATCH_INTERACTIVE_LANE", 1.0) != 0.0
+
+
+def interactive_batch() -> int:
+    """Bound on items per interactive-lane flush (deadline sizing may
+    cut below it, never above)."""
+    from ..qos.budget import _config_float
+    return max(1, int(_config_float(
+        "dispatch", "interactive_batch",
+        "MINIO_TPU_DISPATCH_INTERACTIVE_BATCH", 8.0)))
+
+
+def interactive_delay_s() -> float:
+    """Max coalescing wait on the interactive lane (microseconds knob —
+    the lane trades batch fill for latency, so this is ~200us, not the
+    bulk lane's milliseconds)."""
+    from ..qos.budget import _config_float
+    return max(0.0, _config_float(
+        "dispatch", "interactive_delay_us",
+        "MINIO_TPU_DISPATCH_INTERACTIVE_DELAY_US", 200.0)) / 1e6
+
+
+def interactive_poll_s() -> float:
+    """on_ready poll interval of the async completer."""
+    from ..qos.budget import _config_float
+    return max(1e-6, _config_float(
+        "dispatch", "interactive_poll_us",
+        "MINIO_TPU_DISPATCH_INTERACTIVE_POLL_US", 100.0)) / 1e6
+
+
+def _donate_active() -> bool:
+    """Whether interactive-lane rebuild launches use the donated-input
+    kernel: ``auto`` only on a TPU backend (CPU/GPU jax warns and
+    ignores donation), ``1`` forces it (tests), ``0`` disables."""
+    v = os.environ.get("MINIO_TPU_DISPATCH_INTERACTIVE_DONATE")
+    if v is None:
+        try:
+            from ..config import get_config_sys
+            v = get_config_sys().get("dispatch", "interactive_donate")
+        except Exception:  # noqa: BLE001 — registry not wired
+            v = None
+    v = v if v not in (None, "") else "auto"
+    if v == "0":
+        return False
+    if v == "1":
+        return True
+    try:
+        import jax
+        return jax.default_backend() == "tpu"
+    except Exception:  # noqa: BLE001 — no jax: no device flushes either
+        return False
 
 
 #: how many times SLOWER than the profiled native GF(256) rate each
@@ -221,13 +315,19 @@ class _Pending:
     #: select_scan: (program, cols, delim, max_rows), equal for every
     #: item of a bucket because they ride the bucket key)
     params: tuple | None = None
+    #: the submitting request's armed stage collector (obs/stages), or
+    #: None — lets the flush charge queue_wait / dev_flush / readback
+    #: into the standing PR 9 attribution, so "where the 20 s heal-p99
+    #: goes" is a per-stage answer, not a guess
+    stc: object | None = None
 
 
 class _Bucket:
     def __init__(self, codec, op: str, hash_key: bytes | None = None,
                  chunk_size: int = 0, hash_algo: int = 0,
                  cls: str = _qos.CLASS_INTERACTIVE,
-                 affinity: int | None = None):
+                 affinity: int | None = None,
+                 stream: str = _qos.STREAM_BULK):
         self.codec = codec
         self.op = op  # 'encode' | 'masked' | 'fused'
         self.hash_key = hash_key
@@ -239,6 +339,11 @@ class _Bucket:
         #: time; rides the bucket key, so one flush never mixes sets):
         #: None = unpinned — such flushes shard SPMD across ALL lanes
         self.affinity = affinity
+        #: device-lane discipline (ISSUE 13): STREAM_INTERACTIVE buckets
+        #: belong to the dedicated latency dispatcher (bounded batches,
+        #: deadline sizing, on_ready completion); STREAM_BULK buckets
+        #: keep the coalescing loop. Rides the bucket key.
+        self.stream = stream
         self.items: list[_Pending] = []
         #: set while the loop holds this bucket for coalescing (device
         #: pipeline saturated); cleared at flush — feeds hold telemetry
@@ -252,6 +357,122 @@ def _pad_batch(n: int) -> int:
     return min(b, MAX_BATCH)
 
 
+def _outputs_ready(out_dev) -> bool:
+    """True when every device array of a flush's output has landed
+    (``jax.Array.is_ready`` — the poll/on_ready form of awaiting a
+    device future without ``__await__`` or a blocking readback).
+    Objects without ``is_ready`` (plain numpy from a CPU route, older
+    array types) count as ready — the subsequent ``np.asarray`` is then
+    the blocking fallback, paid on the poller thread, never on a
+    dispatcher."""
+    outs = out_dev if isinstance(out_dev, tuple) else (out_dev,)
+    for a in outs:
+        ir = getattr(a, "is_ready", None)
+        if ir is None:
+            continue
+        try:
+            if not ir():
+                return False
+        except Exception:  # noqa: BLE001 — unknown state: fall through
+            return True    # to the blocking readback, which will raise
+    return True            # (and salvage) truthfully
+
+
+class _IAHandle:
+    """One in-flight interactive-lane device flush awaiting readiness,
+    carrying everything ``DispatchQueue._complete`` needs."""
+
+    __slots__ = ("b", "out_dev", "items", "accounted", "qbytes",
+                 "predicted_s", "t0", "span_done", "tl_done", "lane")
+
+    def __init__(self, b, out_dev, items, accounted, qbytes,
+                 predicted_s, t0, span_done, tl_done, lane):
+        self.b = b
+        self.out_dev = out_dev
+        self.items = items
+        self.accounted = accounted
+        self.qbytes = qbytes
+        self.predicted_s = predicted_s
+        self.t0 = t0
+        self.span_done = span_done
+        self.tl_done = tl_done
+        self.lane = lane
+
+
+class _AsyncCompleter(threading.Thread):
+    """The interactive lane's on_ready completer (ISSUE 13): device
+    flushes register here after launch, and ONE poller thread checks
+    ``is_ready`` across all of them, running the host readback only for
+    flushes whose transfer already landed. Two contracts:
+
+    * **No parked threads.** The bulk lane's blocking completer model
+      occupies one thread per in-flight readback; here a single thread
+      serves any number of outstanding interactive flushes, so a burst
+      of small heal flushes cannot exhaust the completer pool that the
+      CPU route (and the spill path) depends on.
+    * **Submission order per bucket.** Handles are kept in per-bucket
+      FIFO queues and completed HEAD-FIRST: flush k+1's futures never
+      resolve before flush k's, even if its (smaller) transfer lands
+      earlier — consumers like the heal writer window rely on block
+      order (tests/test_interactive_lane.py pins this).
+    """
+
+    def __init__(self, q: "DispatchQueue"):
+        super().__init__(name="minio-tpu-ia-complete", daemon=True)
+        self.q = q
+        self._cv = threading.Condition()
+        self._pending: dict[int, "deque[_IAHandle]"] = {}
+        self._stopping = False
+
+    def submit(self, h: _IAHandle) -> None:
+        with self._cv:
+            self._pending.setdefault(id(h.b), deque()).append(h)
+            self._cv.notify()
+
+    def stop(self) -> None:
+        """Drain everything still pending (blocking readbacks are fine
+        at shutdown) and join the poller."""
+        with self._cv:
+            self._stopping = True
+            self._cv.notify()
+        self.join(timeout=10)
+
+    def run(self):
+        while True:
+            ready: list[_IAHandle] = []
+            with self._cv:
+                while not self._stopping and not self._pending:
+                    self._cv.wait()
+                if self._stopping and not self._pending:
+                    return
+                for key in list(self._pending):
+                    dq = self._pending[key]
+                    # head-first: completion order == submission order
+                    # per bucket. At shutdown everything counts as
+                    # ready (blocking readback on this thread).
+                    while dq and (self._stopping or
+                                  _outputs_ready(dq[0].out_dev)):
+                        ready.append(dq.popleft())
+                    if not dq:
+                        del self._pending[key]
+                poll = bool(self._pending) and not ready
+            for h in ready:
+                try:
+                    self.q.ia_async_completions += 1
+                    self.q._complete(h.b, h.out_dev, h.items,
+                                     h.accounted, h.qbytes,
+                                     h.predicted_s, h.t0, h.span_done,
+                                     h.tl_done, h.lane)
+                except Exception as e:  # noqa: BLE001 — completion must
+                    for p in h.items:   # never kill the poller; waiters
+                        if not p.future.done():  # get the error
+                            p.future.set_exception(e)
+            if poll:
+                # nothing landed yet: sleep one poll interval OUTSIDE
+                # the lock, then re-check readiness
+                time.sleep(interactive_poll_s())
+
+
 class DispatchQueue:
     def __init__(self, max_batch: int = MAX_BATCH,
                  max_delay: float = MAX_DELAY_S,
@@ -261,9 +482,22 @@ class DispatchQueue:
         self.completer_count = completers
         self._lock = threading.Lock()
         self._cv = threading.Condition(self._lock)
+        #: the interactive dispatcher's OWN wait channel, sharing the
+        #: same lock (bucket state stays single-lock); a bulk submit
+        #: wakes only the bulk loop and vice versa — with one shared cv
+        #: every submit would wake both dispatcher threads
+        self._ia_cv = threading.Condition(self._lock)
         self._buckets: dict[tuple, _Bucket] = {}
         self._completers = ThreadPoolExecutor(
             max_workers=completers, thread_name_prefix="minio-tpu-complete")
+        # the interactive lane's OWN CPU executor: a spilled (or
+        # CPU-routed) heal rebuild must not queue behind thousands of
+        # bulk items in the shared pool's FIFO — measured 22 s heal
+        # wall under bulk saturation with one shared pool, ~flush-time
+        # with this split (tests/test_interactive_lane.py's gate)
+        self._ia_completers = ThreadPoolExecutor(
+            max_workers=max(2, min(4, completers)),
+            thread_name_prefix="minio-tpu-ia-cpu")
         self._stop = False
         self._profile: LinkProfile | None = None
         self._profile_failed = False
@@ -281,6 +515,20 @@ class DispatchQueue:
         self.device_items = 0
         self.hold_events = 0
         self.hold_seconds = 0.0
+        # interactive device lane telemetry (ISSUE 13; GIL-atomic
+        # counters, same rule as the route counters above) — the
+        # minio_tpu_lane_* metric group and the bench extras read these
+        self.ia_flushes = 0
+        self.ia_items = 0
+        self.ia_deadline_cuts = 0
+        self.ia_async_completions = 0
+        self.ia_max_batch = 0
+        # bulk counted DIRECTLY at the same boundary (_flush entry),
+        # not derived as batches - ia_flushes: the route counters move
+        # later (and twice for a split flush), so subtraction could go
+        # transiently negative or permanently drift on a scrape
+        self.bulk_flushes = 0
+        self.bulk_items = 0
         #: monotone flush sequence — the batch id every coalesced item's
         #: span records, so concurrent requests can prove they shared
         #: (or didn't share) a device launch
@@ -294,10 +542,20 @@ class DispatchQueue:
         # the deadline resets to now
         self._dev_busy_until = 0.0
         self._dev_inflight = 0
+        #: on_ready async completer for the interactive lane (started
+        #: lazily on its first device flush; None until then)
+        self._ia_completer: _AsyncCompleter | None = None
         # every attribute the loop reads must exist before it starts
         self._thread = threading.Thread(
             target=self._loop, name="minio-tpu-dispatch", daemon=True)
         self._thread.start()
+        # the interactive lane's DEDICATED submission stream: its own
+        # dispatcher thread, so a small heal flush never queues behind
+        # a bulk flush's stack/launch work on the loop above
+        self._ia_thread = threading.Thread(
+            target=self._ia_loop, name="minio-tpu-dispatch-ia",
+            daemon=True)
+        self._ia_thread.start()
         # warm the profile off the request path: in auto mode the first
         # flush would otherwise absorb the full probe cost (device
         # transfers + 8 CPU encodes) inside its latency. Forced-device
@@ -412,17 +670,30 @@ class DispatchQueue:
         ctx = _sp.current()
         if ctx is not None and not ctx.sampled:
             ctx = None
+        from ..obs import stages as _stages
         p = _Pending(words=words, masks=masks, digests=digests, ctx=ctx,
-                     params=params)
+                     params=params, stc=_stages.active())
         # QoS class rides the bucket key: interactive PUT/GET work and
         # background heal/scanner work never share a flush, so the loop
         # can order and spill them independently. The erasure-set lane
         # affinity rides it too — folded to its flush-lane SLOT, so a
         # flush is one lane's traffic (sets sharing a lane coalesce)
-        # and single-chip hosts keep coalescing across sets entirely
+        # and single-chip hosts keep coalescing across sets entirely.
+        # The device-lane DISCIPLINE (ISSUE 13) rides it last: explicit
+        # qos.device_stream overrides, else heal/reconstruct ops default
+        # to the interactive lane, everything else to bulk.
         cls = _qos.current_class()
         affinity = self._affinity_slot(_qos.current_affinity())
-        key = key + (cls, affinity)
+        stream = _qos.current_stream()
+        if stream is None:
+            stream = _qos.STREAM_INTERACTIVE \
+                if op in _INTERACTIVE_LANE_OPS else _qos.STREAM_BULK
+        if stream == _qos.STREAM_INTERACTIVE and \
+                not interactive_lane_enabled():
+            # master switch: dispatch.interactive_lane=0 restores the
+            # single coalescing lane even for explicit stream pins
+            stream = _qos.STREAM_BULK
+        key = key + (cls, affinity, stream)
         # per-item wall latency through the queue (what a caller sees:
         # queue wait + flush + readback) into the last-minute window
         # behind minio_tpu_kernel_op_latency_seconds — and the per-class
@@ -432,7 +703,7 @@ class DispatchQueue:
         tid = ctx.trace_id if ctx is not None else ""
 
         def _record(_f, t=p.t, op_name=op_name, nbytes=nbytes, cls=cls,
-                    tid=tid):
+                    tid=tid, stream=stream):
             try:
                 wall = time.monotonic() - t
                 if _f.exception() is not None:
@@ -450,11 +721,16 @@ class DispatchQueue:
                              trace_id=tid)
                 _lat.observe("qos", wall, nbytes, trace_id=tid,
                              **{"class": cls})
+                # per-STREAM wall window: the minio_tpu_lane_* family's
+                # latency half (interactive vs bulk percentiles)
+                _lat.observe("lane", wall, nbytes, trace_id=tid,
+                             stream=stream)
                 self.qos.note_deadline(cls, wall)
                 # flight recorder: the completion callback closes the
                 # item's enqueue→...→complete chain (sampled event type)
                 _tl.record("complete", op=op_name, trace_id=tid,
-                           wall=round(wall, 6), **{"class": cls})
+                           wall=round(wall, 6), stream=stream,
+                           **{"class": cls})
             except Exception:  # noqa: BLE001 — obs never breaks the path
                 pass
 
@@ -465,14 +741,20 @@ class DispatchQueue:
                 b = self._buckets[key] = _Bucket(codec, op, hash_key,
                                                  chunk_size, hash_algo,
                                                  cls=cls,
-                                                 affinity=affinity)
+                                                 affinity=affinity,
+                                                 stream=stream)
             b.items.append(p)
             depth = len(b.items)
-            self._cv.notify()
+            # wake the dispatcher that owns this bucket's stream (the
+            # two loops wait on separate conditions over one lock)
+            if stream == _qos.STREAM_INTERACTIVE:
+                self._ia_cv.notify()
+            else:
+                self._cv.notify()
         # flight recorder: item entered its bucket (sampled event type;
         # recorded OUTSIDE the dispatch cv lock)
         _tl.record("enqueue", op=op_name, trace_id=tid, bytes=nbytes,
-                   bucket_depth=depth, **{"class": cls})
+                   bucket_depth=depth, stream=stream, **{"class": cls})
         return p.future
 
     # --- dispatcher ---------------------------------------------------------
@@ -488,6 +770,10 @@ class DispatchQueue:
                     saturated = self._device_saturated()
                     for key in list(self._buckets):
                         b = self._buckets[key]
+                        if b.stream == _qos.STREAM_INTERACTIVE:
+                            # the interactive dispatcher (_ia_loop)
+                            # owns these buckets
+                            continue
                         if not b.items:
                             # evict idle buckets so distinct tail-shard
                             # sizes don't accumulate entries forever
@@ -555,6 +841,97 @@ class DispatchQueue:
                             p.future.set_exception(e)
             if stopping:
                 return
+
+    # --- the interactive lane dispatcher ------------------------------------
+
+    def _deadline_cut(self, b: _Bucket, cap: int) -> tuple[int, bool]:
+        """Deadline-aware batch size for an interactive bucket:
+        ``(take, cut)`` — the number of queued items that fit under the
+        oldest item's remaining class budget (qos.deadline_batch over
+        the link profile + the lane's own backlog), capped at
+        ``dispatch.interactive_batch``; ``cut`` True when the DEADLINE
+        limited the batch (waiting for more arrivals would be pointless
+        — they wouldn't fit either). Called under the cv (reads
+        b.items)."""
+        n = min(cap, len(b.items))
+        prof = self._profile
+        if prof is None:
+            return n, False
+        sizes = [self._item_bytes(b, p) for p in b.items[:n]]
+        oldest = time.monotonic() - b.items[0].t
+        take, cut = self.qos.deadline_batch(
+            prof, b.cls, sizes, self.qos.ia_backlog_s(), oldest)
+        if cut:
+            self.ia_deadline_cuts += 1
+        return max(1, min(n, take)), cut
+
+    def _ia_loop(self):
+        """The interactive lane's dedicated submission stream: small
+        bounded batches, flushed the moment the deadline-aware size is
+        reached (or a ~200us coalescing window expires) — never held
+        for pipeline saturation, never behind a bulk flush."""
+        while True:
+            to_flush: list[tuple[tuple, _Bucket, list[_Pending]]] = []
+            # _ia_cv wraps the SAME lock as _cv — bucket state stays
+            # single-lock; this loop just waits on its own channel
+            with self._ia_cv:
+                while not self._stop:
+                    now = time.monotonic()
+                    deadline = None
+                    delay = interactive_delay_s()
+                    for key in list(self._buckets):
+                        b = self._buckets[key]
+                        if b.stream != _qos.STREAM_INTERACTIVE:
+                            continue
+                        if not b.items:
+                            del self._buckets[key]
+                            continue
+                        age = now - b.items[0].t
+                        cap = interactive_batch()
+                        take, cut = self._deadline_cut(b, cap)
+                        # flush now when the batch cap is reached, the
+                        # DEADLINE limited the batch (later arrivals
+                        # wouldn't fit anyway), or the ~200us
+                        # coalescing window expired; otherwise wait so
+                        # a trickle of items still coalesces
+                        if len(b.items) >= cap or cut or age >= delay:
+                            items, b.items = \
+                                b.items[:take], b.items[take:]
+                            to_flush.append((key, b, items))
+                        else:
+                            d = b.items[0].t + delay
+                            deadline = d if deadline is None \
+                                else min(deadline, d)
+                    if to_flush:
+                        break
+                    timeout = None if deadline is None \
+                        else max(0.0, deadline - time.monotonic())
+                    self._ia_cv.wait(timeout=timeout)
+                if self._stop and not to_flush:
+                    # the bulk loop's stop path drains every bucket,
+                    # interactive ones included
+                    return
+            for key, b, items in to_flush:
+                try:
+                    self._flush(b, items)
+                except Exception as e:  # noqa: BLE001
+                    for p in items:
+                        if not p.future.done():
+                            p.future.set_exception(e)
+            if self._stop:
+                return
+
+    def _async_completer(self) -> "_AsyncCompleter":
+        """The interactive lane's on_ready poller, started on first use
+        (the completer must not exist on CPU-route-only deployments)."""
+        c = self._ia_completer
+        if c is None:
+            with self._profile_lock:
+                c = self._ia_completer
+                if c is None:
+                    c = self._ia_completer = _AsyncCompleter(self)
+                    c.start()
+        return c
 
     # --- device-vs-CPU routing ----------------------------------------------
 
@@ -681,8 +1058,16 @@ class DispatchQueue:
             n_dev = 0
         else:
             prof = self._get_profile()
-            lane = self._lane_for(b)
-            backlog = self._backlog_s(lane)
+            if b.stream == _qos.STREAM_INTERACTIVE:
+                # the interactive lane rides its dedicated submission
+                # stream: no per-lane pinning, and the backlog feeding
+                # the deadline math is the lane's OWN in-flight work —
+                # a coalescing bulk queue must not spill a 2-item heal
+                # flush that will launch immediately
+                backlog = self.qos.ia_backlog_s()
+            else:
+                lane = self._lane_for(b)
+                backlog = self._backlog_s(lane)
             sizes = [self._item_bytes(b, p) for p in items]
             n_dev = self.qos.plan(mode, prof, b.cls, sizes, backlog,
                                   self.completer_count,
@@ -694,7 +1079,7 @@ class DispatchQueue:
         # spill REASONS ride the scheduler's own "spill" events)
         _tl.record("plan", op=_OP_NAME.get(b.op, b.op), n=len(items),
                    device=n_dev, spilled=len(items) - n_dev,
-                   **{"class": b.cls})
+                   stream=b.stream, **{"class": b.cls})
         return n_dev, lane
 
     @staticmethod
@@ -796,6 +1181,12 @@ class DispatchQueue:
                 if not p.future.done():
                     p.future.set_exception(e)
 
+        # interactive-lane CPU work rides its own small executor: the
+        # shared pool's FIFO can hold thousands of queued bulk items,
+        # and a latency-tier rebuild parked behind them defeats the
+        # whole lane (ISSUE 13)
+        pool = self._ia_completers \
+            if b.stream == _qos.STREAM_INTERACTIVE else self._completers
         for p in items:
             if trace_done is not None:
                 p.future.add_done_callback(trace_done)
@@ -805,7 +1196,9 @@ class DispatchQueue:
                 p.future.add_done_callback(cost_done)
             if tl_done is not None:
                 p.future.add_done_callback(tl_done)
-            self._completers.submit(one, p)
+            # pure kernel compute — span context rides the attached
+            # future callbacks, not the executing thread
+            pool.submit(one, p)  # graftlint: disable=GL005
 
     def _flush_trace_cb(self, b: _Bucket, items: list[_Pending],
                         route: str):
@@ -946,10 +1339,12 @@ class DispatchQueue:
         bytes_in, bytes_out = self._flush_bytes(b, items)
         fid = _tl.next_flush_id()
         op_name = _OP_NAME.get(b.op, b.op)
+        cap = interactive_batch() \
+            if b.stream == _qos.STREAM_INTERACTIVE else self.max_batch
         _tl.record("flush_start", op=op_name, lane=lanes, flush_id=fid,
-                   batch=len(items), capacity=self.max_batch,
+                   batch=len(items), capacity=cap,
                    bytes=bytes_in + bytes_out, route=route,
-                   **{"class": b.cls})
+                   stream=b.stream, **{"class": b.cls})
         t0 = time.monotonic()
         remaining = [len(items)]
         rlock = threading.Lock()
@@ -963,8 +1358,9 @@ class DispatchQueue:
             if cancelled[0]:
                 return
             _tl.record("flush_end", op=op_name, lane=lanes, flush_id=fid,
-                       batch=len(items), capacity=self.max_batch,
+                       batch=len(items), capacity=cap,
                        bytes=bytes_in + bytes_out, route=route,
+                       stream=b.stream,
                        dur=round(time.monotonic() - t0, 6))
 
         done.cancel = lambda: cancelled.__setitem__(0, True)
@@ -997,6 +1393,21 @@ class DispatchQueue:
     def _flush(self, b: _Bucket, items: list[_Pending]):
         from .. import fault as _fault
         self.qos.note_items(b.cls, len(items))
+        if b.stream == _qos.STREAM_INTERACTIVE:
+            self.ia_flushes += 1
+            self.ia_items += len(items)
+            if len(items) > self.ia_max_batch:
+                self.ia_max_batch = len(items)
+        else:
+            self.bulk_flushes += 1
+            self.bulk_items += len(items)
+        # standing attribution (satellite of ISSUE 13): each item's
+        # time from submit to flush extraction is its queue_wait —
+        # the stage the 20 s heal-p99 lived in at conc 128
+        now = time.monotonic()
+        for p in items:
+            if p.stc is not None:
+                p.stc.add("queue_wait", now - p.t)
         if _fault.armed("kernel"):
             # per-flush injection point (chaos harness): an injected
             # device error exercises the CPU-salvage path — the whole
@@ -1040,6 +1451,7 @@ class DispatchQueue:
         # a lock held across an XLA launch is a convoy generator even
         # when it never deadlocks — lockrank reports the holder's stack
         _lr.note_blocking(f"device_flush:{b.op}")
+        t_flush0 = time.monotonic()
         import jax
         import jax.numpy as jnp
         from .mesh import (mesh_device, object_mesh, replicated_for,
@@ -1114,7 +1526,8 @@ class DispatchQueue:
             if bsz != n:  # drop pad lanes ON DEVICE, not over the link
                 out_dev = (out_dev[0][:n], out_dev[1][:n])
             self._account_and_complete(b, out_dev, items, span_done,
-                                       trace_done, tl_done, lane=lane)
+                                       trace_done, tl_done, lane=lane,
+                                       t_flush0=t_flush0)
             return
         stack = np.stack([p.words for p in items] +
                          [items[0].words] * (bsz - n))
@@ -1160,6 +1573,15 @@ class DispatchQueue:
                 fn = sharded_batched(b.codec._mm_batch_per, mesh,
                                      (True, True))
                 out_dev = fn(masks, stack)
+            elif b.stream == _qos.STREAM_INTERACTIVE and \
+                    _donate_active():
+                # interactive lane on a TPU backend: the rebuild's
+                # shard-words input buffer is DONATED to the launch
+                # (jax donate_argnums), so the small latency-tuned HBM
+                # round trips don't double-allocate; the fresh
+                # per-flush stack is never touched again host-side
+                out_dev = b.codec.batch_per_donated()(
+                    dev(masks), dev(stack))
             else:
                 out_dev = b.codec._mm_batch_per(dev(masks), dev(stack))
         else:  # 'fused': verify source digests + rebuild in one launch
@@ -1189,17 +1611,23 @@ class DispatchQueue:
             out_dev = tuple(o[:n] for o in out_dev) \
                 if isinstance(out_dev, tuple) else out_dev[:n]
         self._account_and_complete(b, out_dev, items, span_done,
-                                   trace_done, tl_done, lane=lane)
+                                   trace_done, tl_done, lane=lane,
+                                   t_flush0=t_flush0)
 
     def _account_and_complete(self, b: _Bucket, out_dev,
                               items: list[_Pending], span_done,
                               trace_done, tl_done=None,
-                              lane: int | None = None):
+                              lane: int | None = None,
+                              t_flush0: float = 0.0):
         """Post-launch tail shared by every device flush: extend the
         queue model (the chosen LANE's busy-until for pinned flushes,
-        every lane's for SPMD), account queued bytes, attach trace/span
-        callbacks and hand host readback to a completer so the next
-        batch launches while this one's transfer is still in flight."""
+        every lane's for SPMD; the interactive lane's OWN model for its
+        stream), account queued bytes, attach trace/span callbacks and
+        hand host readback off — to a blocking completer thread on the
+        bulk lane, to the on_ready POLLER on the interactive lane (the
+        async-completion half of ISSUE 13: the flush loop never stalls
+        on readback, and no thread parks inside a device wait)."""
+        interactive = b.stream == _qos.STREAM_INTERACTIVE
         # queue model: extend the predicted drain deadline by this
         # flush's link+kernel estimate so the scheduler sees the backlog
         prof = self._profile
@@ -1213,19 +1641,30 @@ class DispatchQueue:
             now = time.monotonic()
             with self._profile_lock:
                 self._dev_inflight += 1
-                if lane is None:
-                    # only SPMD flushes extend the global serial model:
-                    # a pinned flush occupies ONE lane (its wall lives
-                    # in the scheduler's per-lane busy-until) — summing
-                    # 8 parallel lanes' walls into one serial deadline
-                    # read as ~8x backlog and spilled idle-mesh work
+                if lane is None and not interactive:
+                    # only bulk SPMD flushes extend the global serial
+                    # model: a pinned flush occupies ONE lane (its wall
+                    # lives in the scheduler's per-lane busy-until) and
+                    # an interactive flush lives in the ia model —
+                    # summing parallel walls into one serial deadline
+                    # read as a phantom backlog and spilled idle work
                     self._dev_busy_until = \
                         max(self._dev_busy_until, now) + flush_s
         # per-route queued-bytes accounting feeds the scheduler's caps
-        # (global + this flush's lane)
+        # (global + this flush's lane + the interactive lane's model)
         self.qos.device_dispatched(bytes_in + bytes_out, lane=lane,
-                                   flush_s=flush_s)
-        # hand host readback to a completer so the next batch launches now
+                                   flush_s=0.0 if interactive
+                                   else flush_s)
+        if interactive:
+            self.qos.ia_dispatched(bytes_in + bytes_out, flush_s=flush_s)
+        # standing attribution: host-side launch cost of this flush
+        # (stack/upload/dispatch) — the "flush" stage between
+        # queue_wait and readback
+        if t_flush0 > 0.0:
+            dt = time.monotonic() - t_flush0
+            for p in items:
+                if p.stc is not None:
+                    p.stc.add("dev_flush", dt)
         for p in items:
             if trace_done is not None:
                 p.future.add_done_callback(trace_done)
@@ -1234,12 +1673,26 @@ class DispatchQueue:
             if tl_done is not None:
                 p.future.add_done_callback(tl_done)
         try:
-            self._completers.submit(self._complete, b, out_dev, items,
-                                    accounted, bytes_in + bytes_out,
-                                    predicted_s, time.monotonic(),
-                                    span_done, tl_done, lane)
+            if interactive:
+                # async completion: the poller polls device readiness
+                # (is_ready — the __await__-free on_ready form) and
+                # completes in submission order per bucket
+                self._async_completer().submit(_IAHandle(
+                    b, out_dev, items, accounted,
+                    bytes_in + bytes_out, predicted_s,
+                    time.monotonic(), span_done, tl_done, lane))
+            else:
+                # hand host readback to a completer so the next batch
+                # launches while this one's transfer is in flight
+                self._completers.submit(self._complete, b, out_dev,
+                                        items, accounted,
+                                        bytes_in + bytes_out,
+                                        predicted_s, time.monotonic(),
+                                        span_done, tl_done, lane)
         except BaseException:  # submit refused (shutdown): the paired
             self.qos.device_completed(bytes_in + bytes_out, lane=lane)
+            if interactive:
+                self.qos.ia_completed(bytes_in + bytes_out)
             if accounted:  # the pipeline slot must not stay occupied
                 with self._profile_lock:
                     self._dev_inflight = max(0, self._dev_inflight - 1)
@@ -1253,6 +1706,8 @@ class DispatchQueue:
             self._finish_readback(b, out_dev, items, span_done, tl_done)
         finally:
             self.qos.device_completed(qbytes, lane=lane)
+            if b.stream == _qos.STREAM_INTERACTIVE:
+                self.qos.ia_completed(qbytes)
             if predicted_s > 0.0 and t0 > 0.0:
                 # observed flush wall corrects the route cost EWMA
                 self.qos.cost.observe("device", predicted_s,
@@ -1263,14 +1718,26 @@ class DispatchQueue:
                     if self._dev_inflight == 0:
                         # drained ahead of (or behind) the model: resync
                         self._dev_busy_until = time.monotonic()
-                # a pipeline slot freed: wake the loop so held buckets
-                # flush their coalesced batch now
+                # a pipeline slot freed: wake the bulk loop so held
+                # buckets flush their coalesced batch now (the
+                # interactive loop never holds, so it has no interest
+                # in pipeline slots)
                 with self._cv:
                     self._cv.notify()
 
     def _finish_readback(self, b: _Bucket, out_dev,
                          items: list[_Pending], span_done=None,
                          tl_done=None):
+        t_rb = time.monotonic()
+
+        def _charge_readback():
+            # standing attribution: device wait + host copy for this
+            # flush's results (the stage after queue_wait/dev_flush)
+            dt = time.monotonic() - t_rb
+            for p in items:
+                if p.stc is not None:
+                    p.stc.add("readback", dt)
+
         try:
             if b.op == "sse_xor":
                 # one batched (ct, poly_keys) pair for the whole flush.
@@ -1280,15 +1747,18 @@ class DispatchQueue:
                 # (e.g. one slow streaming writer) holds its slice
                 ct = np.asarray(out_dev[0])
                 pk = np.asarray(out_dev[1])
+                _charge_readback()
                 for i, p in enumerate(items):
                     p.future.set_result((ct[i].copy(), pk[i].copy()))
             elif b.op in ("fused", "encode_hashed"):
                 out = np.asarray(out_dev[0])
                 extra = np.asarray(out_dev[1])  # valid mask / digests
+                _charge_readback()
                 for i, p in enumerate(items):
                     p.future.set_result((out[i], extra[i]))
             else:
                 out = np.asarray(out_dev)
+                _charge_readback()
                 for i, p in enumerate(items):
                     p.future.set_result(out[i])
         except Exception:  # noqa: BLE001 — readback died: CPU salvages
@@ -1319,7 +1789,14 @@ class DispatchQueue:
         with self._cv:
             self._stop = True
             self._cv.notify_all()
+            self._ia_cv.notify_all()
+        # the interactive dispatcher first (it defers its leftovers to
+        # the bulk loop's drain), then the bulk loop's drain, then the
+        # async completer (which must still accept the drain's flushes)
+        self._ia_thread.join(timeout=5)
         self._thread.join(timeout=5)
+        if self._ia_completer is not None:
+            self._ia_completer.stop()
         # a probe mid-device-transfer at interpreter exit is one of the two
         # known teardown-abort sources (the other is axon client teardown
         # itself); wait it out before the caller tears the process down
@@ -1327,6 +1804,7 @@ class DispatchQueue:
         if t is not None and t.is_alive():
             t.join(timeout=10)
         self._completers.shutdown(wait=True)
+        self._ia_completers.shutdown(wait=True)
 
     def lane_queued_bytes(self) -> dict:
         """Per-lane queued bytes {lane_name: bytes} for the metrics
@@ -1358,6 +1836,19 @@ class DispatchQueue:
                 "device_queued_bytes": self.qos.device_queued_bytes(),
                 "lane_diverts": self.qos.lane_diverts,
                 "lane_queued_bytes": self.lane_queued_bytes(),
+                "bulk_flushes": self.bulk_flushes,
+                "bulk_items": self.bulk_items,
+                "interactive_lane": {
+                    "enabled": interactive_lane_enabled(),
+                    "flushes": self.ia_flushes,
+                    "items": self.ia_items,
+                    "deadline_cuts": self.ia_deadline_cuts,
+                    "async_completions": self.ia_async_completions,
+                    "max_batch": self.ia_max_batch,
+                    "batch_cap": interactive_batch(),
+                    "queued_bytes": self.qos.ia_queued_bytes(),
+                    "backlog_s": round(self.qos.ia_backlog_s(), 6),
+                },
                 "avg_batch": self.items / self.batches if self.batches else 0}
 
 

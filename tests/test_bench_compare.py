@@ -42,6 +42,16 @@ def test_direction_classification():
     assert direction("scale_slo.profile.duration_s") == ""
     assert direction("scale_slo.preload_s") == ""
     assert direction("scale_slo.wall_s") == ""
+    # the interactive_lane extra (ISSUE 13): its *_p50_s/*_p99_s heal
+    # latencies are down-better HEADLINES (a p99 regression on the
+    # latency tier gates), its lane telemetry is informational
+    assert direction(
+        "extra.interactive_lane.interactive.conc8.heal_p99_s") == "down"
+    assert direction(
+        "extra.interactive_lane.bulk.conc128.heal_p50_s") == "down"
+    assert direction("extra.interactive_lane.lane.backlog_s") == ""
+    assert direction("extra.interactive_lane.lane.batch_cap") == ""
+    assert direction("extra.interactive_lane.lane.deadline_cuts") == ""
 
 
 def test_regression_flags_both_directions():

@@ -773,3 +773,75 @@ def test_gl014_real_dist_modules_clean():
             graftlint.REPO_ROOT, "minio_tpu", "dist", f"{name}.py"))
         assert real is not None
         assert not checkers.check_dist_rpc_bounds(real), name
+
+
+# --------------------------------------------------------------------------
+# GL015 — interactive-class paths block only via the sanctioned helper
+
+
+_GL015_BAD = """
+    def erasure_heal(erasure, writers, readers, total_length):
+        def emit(entry):
+            kind, fut, b = entry
+            res = fut.result()                 # bare blocking wait
+            return res
+        emit(None)
+
+    def erasure_decode(erasure, writer, readers, offset, length, total):
+        fut = erasure.decode_data_blocks_async([])
+        return fut.result(30)                  # bare, with timeout
+
+    def erasure_encode(erasure, stream, writers, quorum):
+        return some_future.result()            # NOT a registered path
+"""
+
+
+def test_gl015_bare_result_in_interactive_paths_flagged():
+    ctx = ctx_for(_GL015_BAD, path="minio_tpu/erasure/streaming.py")
+    found = checkers.check_interactive_blocking(ctx)
+    assert len(found) == 2, found
+    assert all(f.checker == "GL015" for f in found)
+    scopes = {f.scope for f in found}
+    assert scopes == {"erasure_heal.emit", "erasure_decode"}, scopes
+    # out of scope anywhere else — the registry is per-file
+    assert not checkers.check_interactive_blocking(
+        ctx_for(_GL015_BAD, path="minio_tpu/erasure/other.py"))
+
+
+def test_gl015_helper_form_and_helper_module_clean():
+    ok = """
+        from ..runtime import completion as _compl
+
+        def erasure_heal(erasure, writers, readers, total_length):
+            def emit(entry):
+                kind, fut, b = entry
+                return _compl.await_result(fut, op="rebuild")
+            emit(None)
+
+        def erasure_decode(erasure, writer, readers, o, l, t):
+            return _compl.await_result(make_future(), op="decode")
+    """
+    assert not checkers.check_interactive_blocking(
+        ctx_for(ok, path="minio_tpu/erasure/streaming.py"))
+    # the helper module itself is exempt by construction (it IS the
+    # one sanctioned place that may call .result())
+    helper = """
+        def await_result(fut, op="", timeout=None):
+            return fut.result(timeout)
+    """
+    assert not checkers.check_interactive_blocking(
+        ctx_for(helper, path="minio_tpu/runtime/completion.py"))
+
+
+def test_gl015_real_streaming_module_clean():
+    real = graftlint.parse_file(os.path.join(
+        graftlint.REPO_ROOT, "minio_tpu", "erasure", "streaming.py"))
+    assert real is not None
+    assert not checkers.check_interactive_blocking(real)
+    # and the helper really exists where the checker points
+    helper = graftlint.parse_file(os.path.join(
+        graftlint.REPO_ROOT, "minio_tpu", "runtime", "completion.py"))
+    assert helper is not None
+    assert any(isinstance(n, ast.FunctionDef) and
+               n.name == "await_result"
+               for n in ast.walk(helper.tree))

@@ -38,9 +38,13 @@ import re
 #: configuration/setup leaves that merely DESCRIBE the run — never
 #: headline metrics, whatever their suffix looks like (duration_s is a
 #: knob, preload/wall scale with the configured object count)
+#: the interactive_lane extra's TELEMETRY leaves (backlog_s is a live
+#: gauge snapshot, batch_cap a config echo) — its ``*_p50_s``/
+#: ``*_p99_s`` latency leaves DO gate, as down-better headlines
 NON_HEADLINE = {"duration_s", "ramp_s", "preload_s", "wall_s",
                 "interval_s", "timeout_s", "ttl_s", "expiry_s",
-                "value_bytes", "objects", "clients", "open_rps"}
+                "value_bytes", "objects", "clients", "open_rps",
+                "backlog_s", "batch_cap"}
 BURN = re.compile(r"burn", re.IGNORECASE)
 HIGHER_BETTER = re.compile(
     r"(gibs|rps|availability|_ratio|^value$|requests_total)",

@@ -42,6 +42,12 @@ text — nothing in the checked tree is imported.
 |       | (every client funnels through ``RPCClient.call``), and       |
 |       | ``RPCClient.call`` carries BOTH the per-call ``rpc`` and     |
 |       | whole-peer ``node`` fault-injection hooks                    |
+| GL015 | interactive-class code paths (heal-shard rebuild,            |
+|       | degraded-GET reconstruct) never call blocking                |
+|       | ``.result()`` on a future — every wait goes through the      |
+|       | sanctioned async-completion helper                           |
+|       | ``runtime/completion.await_result`` so lane waits are        |
+|       | counted/timed and the latency tier stays enforceable         |
 """
 from __future__ import annotations
 
@@ -1149,6 +1155,62 @@ def check_dist_rpc_bounds(ctx: FileCtx) -> list[Finding]:
     return out
 
 
+# --------------------------------------------------------------------------
+# GL015 — interactive-class code paths block only through the sanctioned
+# async-completion helper
+
+#: registered interactive-class code paths (nested defs inherit via
+#: qualname prefix): the heal-shard rebuild and degraded-GET reconstruct
+#: consumers that the interactive device lane (ISSUE 13) keeps
+#: latency-bounded. A bare ``.result()`` here is an UNOBSERVED blocking
+#: wait on the latency tier — the exact failure shape that hid the 20 s
+#: device heal-p99 behind "rebuild" wall time until PR 9's attribution
+#: split it. Every wait goes through
+#: ``runtime/completion.await_result`` (counted + timed per op).
+_GL015_INTERACTIVE_PATHS: dict[str, tuple[str, ...]] = {
+    "minio_tpu/erasure/streaming.py": (
+        "erasure_heal", "erasure_decode", "_ParallelReader.read_block",
+    ),
+}
+#: the sanctioned helper's module — exempt by construction (it IS the
+#: one place those paths may block)
+_GL015_HELPER_MODULE = "minio_tpu/runtime/completion.py"
+_GL015_HELPER = "await_result"
+
+
+def check_interactive_blocking(ctx: FileCtx) -> list[Finding]:
+    """GL015: inside the registered interactive-class functions
+    (including their nested defs), any ``X.result(...)`` attribute call
+    is a finding — the code must wait via
+    ``runtime.completion.await_result`` instead. Calls to the helper
+    itself obviously don't match (it isn't spelled ``.result``), and
+    the helper module is out of scope."""
+    if ctx.path == _GL015_HELPER_MODULE:
+        return []
+    hot = _GL015_INTERACTIVE_PATHS.get(ctx.path)
+    if not hot:
+        return []
+    out = []
+    for node in ast.walk(ctx.tree):
+        if not (isinstance(node, ast.Call) and
+                isinstance(node.func, ast.Attribute) and
+                node.func.attr == "result"):
+            continue
+        scope = ctx.scope_at(node.lineno)
+        if not scope or not any(
+                scope == h or scope.startswith(h + ".") for h in hot):
+            continue
+        out.append(Finding(
+            ctx.path, node.lineno, "GL015",
+            f"blocking {_unparse(node.func, 40)}() on an "
+            "interactive-class code path — wait via "
+            f"runtime.completion.{_GL015_HELPER}(...) (the sanctioned "
+            "async-completion helper) so the wait is counted and timed "
+            "on the latency tier",
+            token=_unparse(node.func, 40), scope=scope))
+    return out
+
+
 PER_FILE = [
     check_wall_duration,
     check_blocking_under_lock,
@@ -1163,5 +1225,6 @@ PER_FILE = [
     check_slo_plane,
     check_mesh_routes,
     check_dist_rpc_bounds,
+    check_interactive_blocking,
 ]
 PROJECT = [check_metrics_documented]

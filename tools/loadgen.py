@@ -29,6 +29,15 @@ whose ``passed`` gates CI. ``bench.py`` embeds a run as the
 ``scale_slo`` extra for BENCH_r07+; ``tests/test_loadgen.py`` runs the
 scaled-down tier-1 profile from ISSUE 10's acceptance criteria.
 
+``--degraded`` (ISSUE 13) kills one disk's shard READS through the
+fault registry for the whole measured phase: GETs whose data shards
+touched it serve through reconstruct on the dispatch plane's
+interactive device lane while a heal worker thread continuously
+rebuilds toward the dead disk — the interactive class's availability
+and burn-rate verdicts then judge the latency tier under real
+degraded traffic (``degraded_reconstructs_served``,
+``degraded_heal_mix_ran``, ``degraded_interactive_availability_ok``).
+
 ``--topology N`` stands the same load on a real N-node in-process
 cluster (``dist.harness.LocalCluster``: separate listeners, storage
 REST RPC, dsync locks) and ``--chaos-kill <idx>`` runs the node-chaos
@@ -87,6 +96,15 @@ class Profile:
     chaos_kill_at_frac: float = 0.35
     chaos_restart_at_frac: float = 0.7
     heal_drain_timeout_s: float = 90.0
+    #: degraded-GET + heal interactive mix (ISSUE 13): kill one disk's
+    #: shard reads via the fault registry for the whole measured phase
+    #: — GETs whose data shards touched it serve through reconstruct
+    #: (the interactive device lane), while a heal worker thread
+    #: continuously rebuilds toward the dead disk. The interactive
+    #: class's burn rates then judge the latency tier under real
+    #: degraded traffic. Requires value_bytes above the 128 KiB inline
+    #: threshold (inlined objects never read shards).
+    degraded: bool = False
 
     @classmethod
     def tier1(cls) -> "Profile":
@@ -497,6 +515,40 @@ class LoadGen:
         out["lost_writes"] = lost[:16]
         out["lost_count"] = len(lost)
 
+    def _arm_degraded(self) -> tuple[str, str]:
+        """Kill one disk's shard READS through the production fault
+        registry (writes stay healthy, so heals make progress and new
+        PUTs land): every GET whose data shards touch it reconstructs
+        through the dispatch plane's interactive lane. Returns
+        (rule_id, disk endpoint)."""
+        from minio_tpu import fault
+        disks = [d for d in getattr(self.obj, "disks", []) if d is not None]
+        if not disks:
+            raise RuntimeError("degraded mix needs an in-process "
+                               "single-set object layer")
+        target = disks[-1].endpoint()
+        rid = fault.arm(f"disk:{target}:read_at:error(FaultyDisk)")
+        return rid, target
+
+    def _degraded_heal_worker(self, profile: Profile, deadline: float,
+                              out: dict) -> None:
+        """The heal half of the interactive mix: continuously heal
+        sampled preloaded keys toward the dead disk while the GET load
+        reconstructs around it — both ride the interactive device
+        lane."""
+        rng = random.Random(profile.seed ^ 0x4EA1)
+        heals = errors = 0
+        while time.monotonic() < deadline:
+            key = f"o{rng.randrange(profile.objects):07d}"
+            try:
+                self.obj.heal_object(profile.bucket, key)
+                heals += 1
+            except Exception:  # noqa: BLE001 — a failed heal under an
+                errors += 1    # armed fault is data, not a crash
+            time.sleep(0.02)
+        out["heals"] = heals
+        out["heal_errors"] = errors
+
     def _overload_probe(self, profile: Profile) -> dict:
         """Deliberately pinch the admission gate to capacity 1 and fire
         a concurrent burst so the 503 SlowDown + Retry-After contract is
@@ -572,41 +624,87 @@ class LoadGen:
         probe: dict = {}
         if profile.overload_probe and self.server is not None:
             probe = self._overload_probe(profile)
-        slo.reset()                      # measure THIS run, not setup
-        lockrank_before = self._lockrank_count()
-        rec = _Recorder(time.monotonic())
-        deadline = rec.t0 + profile.duration_s
-        ths = self._closed_loop(profile, rec, deadline, body)
-        open_t = self._open_loop(profile, rec, deadline, body)
-        chaos: dict = {}
-        chaos_t: threading.Thread | None = None
-        if profile.chaos_kill_node is not None and \
-                getattr(self, "topology", None) is not None:
-            chaos_t = threading.Thread(
-                target=self._chaos_phase,
-                args=(profile, rec.t0, deadline, chaos),
-                daemon=True, name="loadgen-chaos")
-            chaos_t.start()
-        scanner_win: dict = {}
-        scan_t: threading.Thread | None = None
-        if profile.scanner_mid_run and self.server is not None:
-            time.sleep(profile.duration_s / 2)
-            scan_t = threading.Thread(
-                target=self._force_scanner, args=(rec.t0, scanner_win),
-                daemon=True, name="loadgen-scanner")
-            scan_t.start()
-        for t in ths:
-            t.join(timeout=profile.duration_s + 60)
-        if open_t is not None:
-            open_t.join(timeout=profile.duration_s + 60)
-        wall_s = time.monotonic() - rec.t0
-        if scan_t is not None:
-            scan_t.join(timeout=180)
-        if chaos_t is not None:
-            chaos_t.join(timeout=profile.duration_s + 120)
-            self._chaos_settle(profile, chaos)
-        return self._report(profile, rec, wall_s, preload_s,
-                            scanner_win, probe, lockrank_before, chaos)
+        # degraded-GET + heal interactive mix (ISSUE 13): armed AFTER
+        # the probe, measured by the run — the SLO reset below means
+        # the interactive class's burn rates judge the latency tier
+        # under reconstruct traffic, not setup noise
+        degraded: dict = {}
+        degraded_rule = None
+        if profile.degraded:
+            if getattr(self, "topology", None) is not None:
+                raise ValueError(
+                    "the degraded mix runs on the single-node form "
+                    "(node-level faults are --chaos-kill's job)")
+            from minio_tpu.storage.xlmeta import SMALL_FILE_THRESHOLD
+            if profile.value_bytes <= SMALL_FILE_THRESHOLD:
+                raise ValueError(
+                    "degraded mix needs value_bytes > "
+                    f"{SMALL_FILE_THRESHOLD} (inlined objects never "
+                    "read shards, so nothing would reconstruct)")
+            degraded_rule, degraded["disk"] = self._arm_degraded()
+            from minio_tpu.runtime import dispatch as dp
+            degraded["_ia0"] = dp._global.stats()[
+                "interactive_lane"]["items"] if dp._global else 0
+        try:
+            slo.reset()                  # measure THIS run, not setup
+            lockrank_before = self._lockrank_count()
+            rec = _Recorder(time.monotonic())
+            deadline = rec.t0 + profile.duration_s
+            ths = self._closed_loop(profile, rec, deadline, body)
+            open_t = self._open_loop(profile, rec, deadline, body)
+            heal_t: threading.Thread | None = None
+            if profile.degraded:
+                heal_t = threading.Thread(
+                    target=self._degraded_heal_worker,
+                    args=(profile, deadline, degraded),
+                    daemon=True, name="loadgen-degraded-heal")
+                heal_t.start()
+            chaos: dict = {}
+            chaos_t: threading.Thread | None = None
+            if profile.chaos_kill_node is not None and \
+                    getattr(self, "topology", None) is not None:
+                chaos_t = threading.Thread(
+                    target=self._chaos_phase,
+                    args=(profile, rec.t0, deadline, chaos),
+                    daemon=True, name="loadgen-chaos")
+                chaos_t.start()
+            scanner_win: dict = {}
+            scan_t: threading.Thread | None = None
+            if profile.scanner_mid_run and self.server is not None:
+                time.sleep(profile.duration_s / 2)
+                scan_t = threading.Thread(
+                    target=self._force_scanner,
+                    args=(rec.t0, scanner_win),
+                    daemon=True, name="loadgen-scanner")
+                scan_t.start()
+            for t in ths:
+                t.join(timeout=profile.duration_s + 60)
+            if open_t is not None:
+                open_t.join(timeout=profile.duration_s + 60)
+            wall_s = time.monotonic() - rec.t0
+            if scan_t is not None:
+                scan_t.join(timeout=180)
+            if chaos_t is not None:
+                chaos_t.join(timeout=profile.duration_s + 120)
+                self._chaos_settle(profile, chaos)
+            if heal_t is not None:
+                heal_t.join(timeout=profile.duration_s + 60)
+            if degraded_rule is not None:
+                from minio_tpu.runtime import dispatch as dp
+                ia_now = dp._global.stats()[
+                    "interactive_lane"]["items"] if dp._global else 0
+                degraded["interactive_lane_items"] = \
+                    ia_now - degraded.pop("_ia0", 0)
+            return self._report(profile, rec, wall_s, preload_s,
+                                scanner_win, probe, lockrank_before,
+                                chaos, degraded)
+        finally:
+            # the armed disk-kill rule is PROCESS-WIDE state: a failure
+            # anywhere in the measured phase must not leave every later
+            # GET in this process hitting FaultyDisk
+            if degraded_rule is not None:
+                from minio_tpu import fault
+                fault.disarm(degraded_rule)
 
     @staticmethod
     def _lockrank_count() -> int | None:
@@ -627,7 +725,8 @@ class LoadGen:
     def _report(self, profile: Profile, rec: _Recorder, wall_s: float,
                 preload_s: float, scanner_win: dict, probe: dict,
                 lockrank_before: int | None,
-                chaos: dict | None = None) -> dict:
+                chaos: dict | None = None,
+                degraded: dict | None = None) -> dict:
         from minio_tpu.obs import slo
         from minio_tpu.obs.health import cluster_snapshot
         rows = rec.snapshot()
@@ -714,6 +813,18 @@ class LoadGen:
             "burn_rate_metrics_live":
                 "minio_tpu_slo_burn_rate" in metrics_text,
         }
+        if degraded:
+            # the degraded-mix acceptance set (ISSUE 13): GETs really
+            # served through reconstruct on the interactive device
+            # lane, the heal mix really ran concurrently, and the
+            # interactive class held its availability through it —
+            # the latency tier judged by its own burn rates
+            verdicts["degraded_reconstructs_served"] = \
+                degraded.get("interactive_lane_items", 0) > 0
+            verdicts["degraded_heal_mix_ran"] = \
+                degraded.get("heals", 0) > 0
+            verdicts["degraded_interactive_availability_ok"] = \
+                inter.get("availability", 1.0) >= 0.99
         if chaos:
             # the node-chaos acceptance set (ISSUE 12): the kill was
             # DETECTED, nothing acknowledged was lost, the heal
@@ -752,6 +863,7 @@ class LoadGen:
             "scanner": scanner_impact,
             "overload_probe": probe,
             "node_chaos": chaos or {},
+            "degraded": degraded or {},
             "qos_evidence": qos_evidence,
             "slo": slo_rep,
             "health": cluster_snapshot(self.server, peers=False)
@@ -800,6 +912,11 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--ramp", type=float, default=2.0)
     ap.add_argument("--no-scanner", action="store_true")
     ap.add_argument("--no-probe", action="store_true")
+    ap.add_argument("--degraded", action="store_true",
+                    help="kill one disk's shard reads for the measured "
+                    "phase: GETs reconstruct on the interactive device "
+                    "lane while a heal worker rebuilds concurrently "
+                    "(needs --value-bytes > 131072)")
     ap.add_argument("--topology", type=int, default=1,
                     help="run against an in-process N-node cluster")
     ap.add_argument("--disks-per-node", type=int, default=2)
@@ -816,6 +933,7 @@ def main(argv: list[str] | None = None) -> int:
         open_rps=args.open_rps, ramp_s=args.ramp,
         scanner_mid_run=not args.no_scanner,
         overload_probe=not args.no_probe,
+        degraded=args.degraded,
         chaos_kill_node=args.chaos_kill if args.chaos_kill >= 0
         else None)
     with tempfile.TemporaryDirectory(prefix="loadgen-") as root:
