@@ -62,3 +62,5 @@ def shutdown() -> None:
     _streaming.shutdown_pools()
     from .utils import md5simd as _md5simd
     _md5simd.shutdown_server()
+    from .obs import profiler as _profiler
+    _profiler.stop()

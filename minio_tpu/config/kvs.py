@@ -211,6 +211,26 @@ SUB_SYSTEMS: dict[str, dict[str, KV]] = {
         "background_latency_target": KV(
             "95.0", env="MINIO_TPU_SLO_BACKGROUND_LATENCY_TARGET"),
     },
+    "profiler": {
+        "enable": KV("1", env="MINIO_TPU_PROFILER",
+                     help="always-on sampling profiler (obs/profiler.py,"
+                          " docs/observability.md 'Continuous "
+                          "profiling'); 0 halts sampling"),
+        "hz": KV("19", env="MINIO_TPU_PROFILER_HZ",
+                 help="base sampling rate (prime, so it cannot "
+                      "phase-lock onto the tree's poll loops)"),
+        "cap": KV("20000", env="MINIO_TPU_PROFILER_CAP",
+                  help="max distinct folded stacks kept per aggregate; "
+                       "overflow counts minio_tpu_profiler_dropped_"
+                       "total"),
+        "burst_hz": KV("97", env="MINIO_TPU_PROFILER_BURST_HZ",
+                       help="rate for fresh high-rate windows "
+                            "(profile?seconds=, SLO breach captures, "
+                            "legacy profiling sessions)"),
+        "burst_s": KV("3", env="MINIO_TPU_PROFILER_BURST_S",
+                      help="window length of an SLO-breach-triggered "
+                           "capture"),
+    },
     "fault": {
         "enable": KV("1", help="honor KVS-armed fault-injection rules"),
         "rules": KV(
@@ -373,7 +393,8 @@ SUB_SYSTEMS: dict[str, dict[str, KV]] = {
 #: config.go:132) — consumers read the registry at call time or register
 #: an apply callback.
 DYNAMIC = {"api", "scanner", "heal", "dispatch", "bitrot", "qos", "fault",
-           "durability", "pipeline", "workloads", "timeline", "slo"}
+           "durability", "pipeline", "workloads", "timeline", "slo",
+           "profiler"}
 
 
 class ConfigSys:

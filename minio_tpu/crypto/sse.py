@@ -504,8 +504,14 @@ class DecryptWriter:
         unit = PKG_SIZE + TAG
         while len(self._buf) >= FLUSH_PKGS * unit:
             n = (len(self._buf) // unit) * unit
-            self._open(memoryview(self._buf)[:n], n // unit)
-            del self._buf[:n]
+            # REPLACE the buffer, never resize it: _open hands views of
+            # it downstream, and anything briefly pinning a frame (the
+            # continuous profiler's sample pass, a debugger) keeps such
+            # a view alive past function return — resizing an exported
+            # bytearray raises BufferError. The old buffer just lives
+            # until its last view dies.
+            full, self._buf = self._buf, self._buf[n:]
+            self._open(memoryview(full)[:n], n // unit)
 
     def _open(self, ct: memoryview, npkgs: int):
         unit = PKG_SIZE + TAG
@@ -532,8 +538,9 @@ class DecryptWriter:
         if self._buf:
             unit = PKG_SIZE + TAG
             npkgs = -(-len(self._buf) // unit)
-            self._open(memoryview(self._buf), npkgs)
-            self._buf.clear()
+            # replace, don't clear() — same exported-view rule as write
+            full, self._buf = self._buf, bytearray()
+            self._open(memoryview(full), npkgs)
 
     def close(self):
         self._drain()

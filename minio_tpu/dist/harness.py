@@ -71,7 +71,8 @@ class LocalCluster:
                 self.nodes[i].start(wait_format_timeout=start_timeout_s)
             except BaseException as e:  # noqa: BLE001 — surfaced below
                 errs[i] = e
-        ths = [threading.Thread(target=boot, args=(i,), daemon=True)
+        ths = [threading.Thread(target=boot, args=(i,), daemon=True,
+                                name=f"dist-node-boot-{i}")
                for i in range(nodes)]
         for t in ths:
             t.start()

@@ -138,6 +138,14 @@ class PeerRESTClient:
         aggregation fans this out."""
         return json.loads(self.rpc.call("healthsnapshot"))
 
+    def profile(self, seconds: float = 0.0) -> dict:
+        """The peer's continuous-profiler top report (obs/profiler.py);
+        ``seconds > 0`` captures a fresh high-rate window on the peer —
+        the admin ``profile?peers=1`` aggregation fans this out."""
+        return json.loads(self.rpc.call(
+            "profile", {"seconds": str(seconds)},
+            timeout=max(10.0, seconds + 10.0)))
+
 
 def _stream_pubsub(pubsub, timeout_s: float, count: int, to_dict=None):
     """Generator of NDJSON event lines from a live pubsub subscription,
@@ -278,5 +286,16 @@ class PeerRESTService:
             srv = getattr(self.node, "server", None)
             return json.dumps(
                 node_snapshot(srv) if srv is not None else {}).encode()
+        if method == "profile":
+            from ..obs import profiler
+            seconds = float(params.get("seconds", "0") or "0")
+            try:
+                agg = profiler.capture_window(min(seconds, 60.0)) \
+                    if seconds > 0 else profiler.base_agg()
+                rep = profiler.report_top(agg)
+            except ValueError as e:  # profiler disabled on this node
+                rep = {"error": str(e)}
+            rep["endpoint"] = self.node.local_url
+            return json.dumps(rep).encode()
         from ..utils import errors
         raise errors.MethodNotSupported(method)

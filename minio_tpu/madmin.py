@@ -149,6 +149,31 @@ class AdminClient:
     def remove_tier(self, name: str) -> None:
         self._json("DELETE", "tier", {"name": name})
 
+    def profile(self, fmt: str = "top", seconds: float = 0.0,
+                hz: float = 0.0, peers: bool = False,
+                breach: str = "") -> dict | bytes:
+        """Continuous profiling plane (`GET /minio/admin/v3/profile`,
+        docs/observability.md "Continuous profiling"): the always-on
+        sampler's aggregate as a JSON top report (``fmt="top"``), or
+        raw bytes for ``fmt="folded"`` (flamegraph.pl collapsed
+        stacks) / ``fmt="speedscope"``. ``seconds > 0`` captures a
+        fresh high-rate window (``hz`` overrides the burst rate),
+        ``peers=True`` fans the top report across dist nodes,
+        ``breach="interactive"`` fetches the stored SLO-breach
+        capture for that QoS class."""
+        q: dict[str, str] = {"fmt": fmt}
+        if seconds:
+            q["seconds"] = str(seconds)
+        if hz:
+            q["hz"] = str(hz)
+        if peers:
+            q["peers"] = "1"
+        if breach:
+            q["breach"] = breach
+        if fmt == "folded":
+            return self._request("GET", "profile", q)
+        return self._json("GET", "profile", q)
+
     def start_profiling(self, profiler_type: str = "cpu") -> dict:
         return self._json("POST", "profiling/start",
                           {"profilerType": profiler_type})

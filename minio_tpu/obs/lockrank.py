@@ -42,6 +42,7 @@ from __future__ import annotations
 import os
 import sys
 import threading
+import time
 import traceback
 
 _ORIG_LOCK = threading.Lock
@@ -209,10 +210,39 @@ class TrackedLock:
     # -- lock protocol -------------------------------------------------------
 
     def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
-        ok = self._inner.acquire(blocking, timeout)
+        # uncontended fast path: one extra non-blocking try, no timing
+        # machinery (steady-state acquires stay one C call + bookkeeping)
+        ok = self._inner.acquire(False)
+        if not ok and blocking:
+            ok = self._wait_acquire(timeout)
         if ok:
             self._note_acquired()
         return ok
+
+    def _wait_acquire(self, timeout: float) -> bool:
+        """Contended blocking acquire: the wait is timed into the
+        ``minio_tpu_lock_wait_seconds{site}`` histogram and the thread
+        is marked waiting so profiler samples taken meanwhile carry the
+        ``lockwait`` flag (docs/observability.md "Continuous
+        profiling"). The profiler keeps these stats under a RAW lock —
+        a tracked one here would recurse into its own instrumentation."""
+        if not _enabled:
+            return self._inner.acquire(True, timeout)
+        try:
+            from . import profiler as _prof
+            _prof.lock_wait_begin(self.site)
+        except Exception:  # noqa: BLE001 — detector must never break
+            _prof = None   # the locked code
+        t0 = time.monotonic()
+        try:
+            return self._inner.acquire(True, timeout)
+        finally:
+            if _prof is not None:
+                try:
+                    _prof.lock_wait_end(self.site,
+                                        time.monotonic() - t0)
+                except Exception:  # noqa: BLE001
+                    pass
 
     def release(self) -> None:
         self._note_released()

@@ -900,6 +900,72 @@ def _g_slo(server) -> list[str]:
     return lines
 
 
+def _g_profiler(server) -> list[str]:
+    """Continuous profiling plane (obs/profiler.py, docs/observability.md
+    "Continuous profiling"): sampler health + self-measured overhead,
+    per-role sample counts, subsystem CPU shares, and the lock-wait
+    histogram the tracked-lock acquires feed. The breach-capture
+    counters (minio_tpu_profiler_breach_captures_total{class},
+    minio_tpu_profiler_breach_capture_errors_total) ride the counter
+    store, incremented by the capture worker."""
+    from . import profiler
+    st = profiler.status()
+    lines = [
+        "# TYPE minio_tpu_profiler_enabled gauge",
+        f"minio_tpu_profiler_enabled {1 if st['enabled'] else 0}",
+        "# TYPE minio_tpu_profiler_running gauge",
+        f"minio_tpu_profiler_running {1 if st['running'] else 0}",
+        "# TYPE minio_tpu_profiler_hz gauge",
+        f"minio_tpu_profiler_hz {st['hz']:g}",
+        "# TYPE minio_tpu_profiler_samples_total counter",
+        f"minio_tpu_profiler_samples_total {st['samples_total']}",
+        "# TYPE minio_tpu_profiler_dropped_total counter",
+        f"minio_tpu_profiler_dropped_total {st['dropped_total']}",
+        "# TYPE minio_tpu_profiler_stacks gauge",
+        f"minio_tpu_profiler_stacks {st['distinct_stacks']}",
+        "# TYPE minio_tpu_profiler_overhead_ratio gauge",
+        f"minio_tpu_profiler_overhead_ratio {st['overhead_ratio']}",
+        "# TYPE minio_tpu_profiler_lockwait_samples_total counter",
+        "minio_tpu_profiler_lockwait_samples_total "
+        f"{st['lockwait_samples_total']}",
+    ]
+    if st["roles"]:
+        lines.append(
+            "# TYPE minio_tpu_profiler_role_samples_total counter")
+        for role, n in sorted(st["roles"].items()):
+            lines.append(
+                "minio_tpu_profiler_role_samples_total"
+                f'{{role="{_esc(role)}"}} {n}')
+    if st["subsystem_shares"]:
+        lines.append(
+            "# TYPE minio_tpu_profiler_subsystem_share gauge")
+        for sub, share in sorted(st["subsystem_shares"].items()):
+            lines.append(
+                "minio_tpu_profiler_subsystem_share"
+                f'{{subsystem="{_esc(sub)}"}} {share}')
+    waits = profiler.lock_wait_snapshot()
+    if waits:
+        fam = "minio_tpu_lock_wait_seconds"
+        lines.append(f"# TYPE {fam} histogram")
+        lines.append("# TYPE minio_tpu_lock_wait_sites gauge")
+        lines.append(f"minio_tpu_lock_wait_sites {len(waits)}")
+        for site, w in sorted(waits.items()):
+            lab = f'site="{_esc(site)}",'
+            cum = 0
+            for edge, n in zip(profiler.LOCK_WAIT_BUCKETS,
+                               w["buckets"]):
+                cum += n
+                lines.append(
+                    f'{fam}_bucket{{{lab}le="{edge:g}"}} {cum}')
+            lines.append(
+                f'{fam}_bucket{{{lab}le="+Inf"}} {w["count"]}')
+            lines.append(
+                f'{fam}_sum{{site="{_esc(site)}"}} {w["sum"]:.6f}')
+            lines.append(
+                f'{fam}_count{{site="{_esc(site)}"}} {w["count"]}')
+    return lines
+
+
 def _g_locks(server) -> list[str]:
     locker = getattr(server, "local_locker", None)
     if locker is None:
@@ -945,6 +1011,9 @@ _GROUPS = [
     # slo reads in-memory windows — interval 0 so burn rates move on
     # the very next scrape after an incident starts
     MetricsGroup("slo", "node", _g_slo, interval=0),
+    # profiler reads in-memory sampler state — interval 0 so subsystem
+    # shares and lock-wait stats are live per scrape
+    MetricsGroup("profiler", "node", _g_profiler, interval=0),
     MetricsGroup("process", "node", _g_process),
     MetricsGroup("locks", "node", _g_locks),
     MetricsGroup("notification", "cluster", _g_notification),

@@ -5,17 +5,19 @@ disk).
 
 Go gets pprof for free; the Python runtime equivalents here:
 
-* ``cpu``     — a sampling profiler: a daemon thread walks
-                ``sys._current_frames()`` at ~100 Hz and aggregates
-                collapsed stacks across EVERY live thread. (cProfile
-                would hook only the thread that enabled it — useless in
-                a thread-per-request server.) Output is flamegraph-ready
-                collapsed-stack lines plus a leaf-function table.
+* ``cpu``     — DELEGATED to the always-on continuous profiler
+                (``obs/profiler.py``): a start() attaches a high-rate
+                capture window to the shared sampler (one walk of
+                ``sys._current_frames()`` serves the base aggregate
+                and every session), download detaches it and renders
+                the historical flamegraph-ready format. Session
+                lifecycle — the one-at-a-time busy error and the
+                abandoned-session reaper — lives in ``profiler.
+                start_session``/``stop_session``, the single profiling
+                entry point (docs/observability.md "Continuous
+                profiling").
 * ``threads`` — a goroutine-dump analogue: every live thread's stack.
-* ``mem``     — tracemalloc snapshot (top allocating sites).
-
-One profiling session at a time (the reference enforces the same via
-globalProfiler)."""
+* ``mem``     — tracemalloc snapshot (top allocating sites)."""
 from __future__ import annotations
 
 import io
@@ -23,98 +25,44 @@ import sys
 import threading
 import time
 import traceback
-from collections import Counter
 
 _lock = threading.Lock()
 _active: dict | None = None
 
-SAMPLE_INTERVAL_S = 0.01
-#: a session abandoned by its admin client must not sample forever —
-#: auto-halt after this long (results stay downloadable)
-MAX_PROFILE_S = 300.0
-#: cap on distinct stack signatures kept (deep recursion / very varied
-#: workloads would otherwise grow the Counter without bound)
-MAX_STACKS = 50_000
-
-
-class _Sampler(threading.Thread):
-    """~100 Hz collapsed-stack sampler over all threads."""
-
-    def __init__(self):
-        super().__init__(name="minio-tpu-profiler", daemon=True)
-        self.stacks: Counter = Counter()
-        self.leaves: Counter = Counter()
-        self.samples = 0
-        self._halt = threading.Event()
-
-    def run(self):
-        me = threading.get_ident()
-        deadline = time.monotonic() + MAX_PROFILE_S
-        while not self._halt.is_set() and time.monotonic() < deadline:
-            for tid, frame in sys._current_frames().items():
-                if tid == me:
-                    continue
-                parts = []
-                f = frame
-                depth = 0
-                while f is not None and depth < 40:
-                    code = f.f_code
-                    parts.append(f"{code.co_filename.rsplit('/', 1)[-1]}"
-                                 f":{code.co_name}")
-                    f = f.f_back
-                    depth += 1
-                parts.reverse()
-                sig = ";".join(parts)
-                if sig in self.stacks or len(self.stacks) < MAX_STACKS:
-                    self.stacks[sig] += 1
-                self.leaves[parts[-1] if parts else "?"] += 1
-                self.samples += 1
-            self._halt.wait(SAMPLE_INTERVAL_S)
-
-    def stop(self) -> bytes:
-        self._halt.set()
-        self.join(timeout=2)
-        out = io.StringIO()
-        out.write(f"# samples: {self.samples} "
-                  f"(interval {SAMPLE_INTERVAL_S * 1e3:.0f} ms)\n")
-        out.write("# --- top leaf functions ---\n")
-        for name, n in self.leaves.most_common(50):
-            out.write(f"{n:8d} {name}\n")
-        out.write("# --- collapsed stacks (flamegraph.pl format) ---\n")
-        for stack, n in self.stacks.most_common(500):
-            out.write(f"{stack} {n}\n")
-        return out.getvalue().encode()
-
 
 def start(kind: str) -> dict:
     """Begin a profiling session; returns {kind, started_at}. Raises
-    ValueError on unknown kind or if a session is still RUNNING. A cpu
-    session whose sampler auto-halted at MAX_PROFILE_S no longer wedges
-    the profiler until a download: a new start() reaps it (the halted
-    session's samples are discarded — download before restarting to
-    keep them)."""
+    ValueError on unknown kind or if a same-kind session is still
+    RUNNING. cpu sessions ride the continuous profiler's session
+    machinery (busy error + reaper there); mem/threads keep the local
+    one-at-a-time slot."""
     global _active
-    with _lock:
-        if _active is not None:
-            sampler = _active.get("sampler")
-            if sampler is not None and not sampler.is_alive():
-                # auto-halted session abandoned by its client: reap it
-                # so the profiler is usable again without a download
-                _active = None
-            else:
+    from . import profiler
+    if kind == "cpu":
+        # cross-kind exclusivity preserved: a cpu start while a
+        # mem/threads session is open would otherwise let the cpu
+        # client's download consume the OTHER client's session
+        with _lock:
+            if _active is not None:
                 age = time.monotonic() - _active.get(
                     "started_mono", time.monotonic())
-                state = "running"
-                if sampler is not None and sampler._halt.is_set():
-                    state = "halted"
                 raise ValueError(
-                    f"profiling already {state} ({_active['kind']}, "
-                    f"started {age:.0f}s ago — download to collect it)")
-        if kind == "cpu":
-            sampler = _Sampler()
-            sampler.start()
-            _active = {"kind": kind, "sampler": sampler}
-        elif kind == "mem":
+                    f"profiling already running ({_active['kind']}, "
+                    f"started {age:.0f}s ago — download to collect "
+                    "it)")
+        return profiler.start_session()
+    with _lock:
+        if _active is not None:
+            age = time.monotonic() - _active.get(
+                "started_mono", time.monotonic())
+            raise ValueError(
+                f"profiling already running ({_active['kind']}, "
+                f"started {age:.0f}s ago — download to collect it)")
+        if profiler.session_active():
+            raise ValueError(
+                "profiling already running (cpu — download to "
+                "collect it)")
+        if kind == "mem":
             import tracemalloc
             tracemalloc.start(10)
             _active = {"kind": kind}
@@ -128,15 +76,18 @@ def start(kind: str) -> dict:
 
 
 def stop_and_dump() -> tuple[str, bytes]:
-    """End the session and return (kind, report bytes)."""
+    """End the session and return (kind, report bytes). mem/threads
+    sessions take precedence when one is open; otherwise the cpu
+    session (continuous-profiler capture) is collected."""
     global _active
     with _lock:
-        if _active is None:
-            raise ValueError("no profiling session running")
         sess, _active = _active, None
+    if sess is None:
+        from . import profiler
+        if profiler.session_active():
+            return "cpu", profiler.stop_session()
+        raise ValueError("no profiling session running")
     kind = sess["kind"]
-    if kind == "cpu":
-        return kind, sess["sampler"].stop()
     if kind == "mem":
         import tracemalloc
         snap = tracemalloc.take_snapshot()

@@ -264,6 +264,22 @@ def report(now: float | None = None) -> dict:
             wins[FAST][f"{slo_kind}_burn"] > alert and
             wins[SLOW][f"{slo_kind}_burn"] > alert
             for slo_kind in ("availability", "latency")}
+        # breach-triggered profiling (docs/observability.md "Continuous
+        # profiling"): a class entering breach kicks one async
+        # high-rate capture keyed by the class (cooldown-limited in the
+        # profiler), stored beside the slow-trace store and fetched via
+        # admin profile?breach=<class>; the summary link rides this
+        # report so the verdict names its evidence
+        profile_link: dict = {}
+        try:
+            from . import profiler
+            if any(breach.values()):
+                profiler.note_breach(cls)
+            stored_prof = profiler.breach_profiles_summary().get(cls)
+            if stored_prof is not None:
+                profile_link = {"captured": True, **stored_prof}
+        except Exception:  # noqa: BLE001 — profiler absent/disabled
+            pass
         # the (seconds, trace_id) PAIR comes from whichever window
         # holds the larger breach — mixing one window's trace with the
         # other's duration would advertise a link whose span tree
@@ -284,6 +300,7 @@ def report(now: float | None = None) -> dict:
             },
             "windows": wins,
             "breach": breach,
+            "breach_profile": profile_link,
             "worst_breach": {
                 "trace_id": worst_tid,
                 "seconds": worst_win["worst_slow_s"],

@@ -1391,6 +1391,17 @@ class DispatchQueue:
                              lane=lane) > 0
 
     def _flush(self, b: _Bucket, items: list[_Pending]):
+        # per-thread QoS tag (obs/profiler.py): the sampling profiler
+        # joins this dispatcher thread's samples to the batch's class
+        # and op for the duration of the flush
+        from ..obs import profiler as _prof
+        _prof.set_task_tag(b.cls, _OP_NAME.get(b.op, b.op))
+        try:
+            self._flush_tagged(b, items)
+        finally:
+            _prof.clear_task_tag()
+
+    def _flush_tagged(self, b: _Bucket, items: list[_Pending]):
         from .. import fault as _fault
         self.qos.note_items(b.cls, len(items))
         if b.stream == _qos.STREAM_INTERACTIVE:

@@ -172,6 +172,8 @@ def _dispatch_admin(h, op: str) -> None:
         q = {k: v[0] for k, v in h.query.items()}
         cfg.delete(q.get("subsys", ""), q.get("key", ""))
         return h._send(200, b"{}", "application/json")
+    if op == "profile":
+        return _profile(h)
     if op.startswith("profiling/") or op == "healthinfo" or \
             op == "obdinfo":
         return _profiling_obd(h, op)
@@ -320,6 +322,83 @@ def _fault_op(h) -> None:
     except (ValueError, TypeError) as e:
         return h._error("InvalidArgument", f"bad fault rule: {e}", 400)
     h._send(200, json.dumps({"id": rid}).encode(), "application/json")
+
+
+def _profile(h) -> None:
+    """Continuous profiling plane (obs/profiler.py, docs/observability.md
+    "Continuous profiling"): the always-on sampler's aggregate, or a
+    fresh high-rate window. Query params: ``fmt=top`` (default, the
+    JSON attribution report) | ``folded`` (flamegraph.pl collapsed
+    stacks) | ``speedscope``; ``seconds=N`` captures a fresh window at
+    the burst rate (``hz=`` overrides); ``breach=<class>`` serves the
+    stored SLO-breach capture for that QoS class; ``peers=1`` fans the
+    top report across dist nodes like the health snapshot (peer
+    windows run concurrently with the local one)."""
+    from ..obs import profiler
+    q = {k: v[0] for k, v in h.query.items()}
+    breach_cls = q.get("breach", "")
+    if breach_cls:
+        rep = profiler.breach_profile(breach_cls)
+        if rep is None:
+            return h._error(
+                "XMinioProfileNotFound",
+                f"no stored breach profile for class {breach_cls!r} "
+                "(captures are triggered by SLO burn-rate breaches)",
+                404)
+        return h._send(200, json.dumps(rep).encode(),
+                       "application/json")
+    try:
+        seconds = float(q.get("seconds", "0"))
+        hz = float(q["hz"]) if "hz" in q else None
+    except ValueError:
+        return h._error("InvalidArgument",
+                        "bad seconds/hz profile parameter", 400)
+    fmt = q.get("fmt", "top")
+    if fmt not in ("top", "folded", "speedscope"):
+        return h._error("InvalidArgument",
+                        f"unknown profile fmt {fmt!r}", 400)
+    if seconds > 0 and not profiler.ensure_started():
+        # a fresh window against a halted sampler would block the full
+        # duration and return an all-zero report (docs/config.md:
+        # profiler.enable=0 makes these refuse)
+        return h._error("XMinioProfilerDisabled",
+                        "profiler.enable=0 — enable the profiler "
+                        "before requesting a capture window", 409)
+    profiler.ensure_started()
+    peer_rows: list = []
+    threads: list = []
+    if q.get("peers") == "1" and fmt == "top":
+        import threading as _t
+
+        def fetch(p):
+            try:
+                peer_rows.append(p.profile(seconds=seconds))
+            except Exception as e:  # noqa: BLE001 — peer down: report
+                peer_rows.append({"endpoint": getattr(p, "url", ""),
+                                  "error": str(e)})
+
+        for peer in getattr(h.s3, "peers", lambda: [])():
+            t = _t.Thread(target=fetch, args=(peer,), daemon=True,
+                          name="admin-profile-fanout")
+            t.start()
+            threads.append(t)
+    if seconds > 0:
+        agg = profiler.capture_window(min(seconds, 60.0), hz)
+    else:
+        agg = profiler.base_agg()
+    if fmt == "folded":
+        return h._send(200, profiler.render_folded(agg), "text/plain")
+    if fmt == "speedscope":
+        return h._send(200, profiler.render_speedscope(agg),
+                       "application/json")
+    rep = profiler.report_top(agg)
+    rep["endpoint"] = f"{getattr(h.s3, 'address', '')}:" \
+                      f"{getattr(h.s3, 'port', 0)}"
+    if threads or q.get("peers") == "1":
+        for t in threads:
+            t.join(timeout=max(10.0, seconds + 10.0))
+        rep = {"nodes": [rep] + peer_rows}
+    h._send(200, json.dumps(rep).encode(), "application/json")
 
 
 def _profiling_obd(h, op: str) -> None:

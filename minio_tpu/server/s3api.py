@@ -120,6 +120,11 @@ class S3Server:
             from .. import fault as _fault
             cfg.on_apply("fault", _fault.apply_config)
             _fault.apply_config(cfg)
+        # always-on continuous profiler (obs/profiler.py): one
+        # process-global daemon whatever the server count — repeated
+        # server cycles must not accumulate threads (test_leaks)
+        from ..obs import profiler as _profiler
+        _profiler.ensure_started()
         self._httpd: ThreadingHTTPServer | None = None
         #: internal RPC services mounted under /minio/<name>/v1/<method>
         #: (storage/lock/peer — populated by dist.node.Node)
@@ -1518,11 +1523,20 @@ class _S3Handler(BaseHTTPRequestHandler):
             root, tok = sp.begin_request(rid)
         t0 = _time.perf_counter()
         release = None
+        from ..obs import profiler as _prof
         try:
             proceed, release = self._admit()
+            # per-thread QoS tag (obs/profiler.py): contextvars are not
+            # visible cross-thread, so the sampling profiler joins this
+            # worker's samples to its admitted class + op through the
+            # ident-keyed tag registry instead
+            _prof.set_task_tag(
+                getattr(self, "_qos_class", None) or "control",
+                f"s3.{self.command.lower()}")
             if proceed:
                 self._route()
         finally:
+            _prof.clear_task_tag()
             if release is not None:
                 release()
             try:

@@ -52,6 +52,23 @@ def test_direction_classification():
     assert direction("extra.interactive_lane.lane.backlog_s") == ""
     assert direction("extra.interactive_lane.lane.batch_cap") == ""
     assert direction("extra.interactive_lane.lane.deadline_cuts") == ""
+    # the host_profile / loadgen profile-summary leaves (ISSUE 14):
+    # sampler telemetry and lock-wait attributions shift with host
+    # load — evidence channels, never headlines
+    assert direction("extra.host_profile.put_par8_16p4.samples") == ""
+    assert direction("extra.host_profile.heal.sample_hz") == ""
+    assert direction(
+        "extra.host_profile.put_par8_16p4.lockwait_share") == ""
+    assert direction(
+        "host_profile.lock_contention[0].wait_seconds_total") == ""
+    assert direction(
+        "host_profile.lock_contention[0].max_wait_s") == ""
+    assert direction("scale_slo.host_profile.scanner_cpu_share") == ""
+    assert direction("scale_slo.host_profile.scanner_share_max") == ""
+    # the subsystem-share map's leaves are subsystem names — they must
+    # stay informational too
+    assert direction(
+        "extra.host_profile.put_par8_16p4.subsystems.erasure") == ""
 
 
 def test_regression_flags_both_directions():

@@ -517,6 +517,28 @@ def test_admin_peer_aggregation(cluster):
     assert isinstance(heal, dict)
 
 
+def test_cluster_profile_fanout(cluster):
+    """`GET /minio/admin/v3/profile?peers=1` (ISSUE 14): the continuous
+    profiler's top report aggregated across dist nodes — one row per
+    node (the `profile` peer RPC), each carrying samples + subsystem
+    shares; `seconds=` forces a fresh concurrent window on every
+    node."""
+    n0, _ = cluster
+    from minio_tpu.madmin import AdminClient
+    adm = AdminClient(f"http://127.0.0.1:{n0.server.port}", AK, SK)
+    rep = adm.profile(peers=True, seconds=0.5)
+    nodes = rep["nodes"]
+    assert len(nodes) >= 2, nodes
+    ok = [n for n in nodes if "error" not in n]
+    assert len(ok) >= 2, nodes
+    for n in ok:
+        assert n.get("endpoint"), n
+        assert n["samples"] > 0, n
+        assert "subsystems" in n and "lock_contention" in n
+    endpoints = {n["endpoint"] for n in ok}
+    assert len(endpoints) >= 2, endpoints
+
+
 def test_cluster_health_snapshot(cluster):
     """`GET /minio/admin/v3/health` aggregates the node health snapshot
     (disk states, lane utilization, QoS saturation, heal backlog, SLO

@@ -845,3 +845,59 @@ def test_gl015_real_streaming_module_clean():
     assert any(isinstance(n, ast.FunctionDef) and
                n.name == "await_result"
                for n in ast.walk(helper.tree))
+
+
+# --------------------------------------------------------------------------
+# GL016 — every thread construction under minio_tpu/ carries a name
+
+
+def test_gl016_unnamed_thread_flagged():
+    ctx = ctx_for("""
+        import threading
+        def spawn():
+            t = threading.Thread(target=work, daemon=True)
+            t.start()
+            threading.Thread(target=work, args=(1,)).start()
+    """)
+    found = checkers.check_thread_names(ctx)
+    assert [f.checker for f in found] == ["GL016", "GL016"]
+    assert "name=" in found[0].message
+    assert found[0].scope == "spawn"
+
+
+def test_gl016_named_threads_and_subclasses_ok():
+    ctx = ctx_for("""
+        import threading
+
+        class Worker(threading.Thread):
+            def __init__(self):
+                super().__init__(name="minio-tpu-worker", daemon=True)
+
+        def spawn():
+            threading.Thread(target=work, daemon=True,
+                             name="minio-tpu-x").start()
+            Worker().start()
+            threading.Timer(0.2, work).start()   # not a Thread ctor
+    """)
+    assert not checkers.check_thread_names(ctx)
+
+
+def test_gl016_out_of_scope_paths_ignored():
+    src = """
+        import threading
+        threading.Thread(target=work).start()
+    """
+    assert not checkers.check_thread_names(
+        ctx_for(src, path="tools/something.py"))
+    assert not checkers.check_thread_names(
+        ctx_for(src, path="tests/test_something.py"))
+
+
+def test_gl016_registered_and_baseline_empty():
+    """The satellite fix (ISSUE 14): GL016 is an active PER_FILE
+    checker (so test_tree_is_clean already proves the shipped tree has
+    every Thread construction named) and the baseline is EMPTY — no
+    grandfathered unnamed threads."""
+    assert checkers.check_thread_names in checkers.PER_FILE
+    assert graftlint.load_baseline() == {}, \
+        "GL016 must hold with an EMPTY baseline"

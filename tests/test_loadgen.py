@@ -42,6 +42,16 @@ def test_scale_slo_tier1_profile(tmp_path):
         report["qos_evidence"]
     # burn-rate metrics live on /minio/v2/metrics
     assert v["burn_rate_metrics_live"]
+    # profile summary attached (ISSUE 14): whole-run subsystem shares +
+    # top contended locks, and the scanner-cycle window's scanner-
+    # subsystem CPU share machine-checks the item-3 claim
+    hp = report["host_profile"]
+    assert hp["samples"] > 0, hp
+    assert hp["subsystems"], hp
+    assert isinstance(hp["lock_contention"], list)
+    assert 0.0 <= hp["scanner_cpu_share"] <= 1.0
+    assert "profile" in report["scanner"]["window"], report["scanner"]
+    assert v["scanner_cpu_share_ok"], hp
     # the embedded SLO report measured this run
     w = report["slo"]["classes"]["interactive"]["windows"]["5m"]
     assert w["requests"] > 0

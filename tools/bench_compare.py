@@ -41,10 +41,17 @@ import re
 #: the interactive_lane extra's TELEMETRY leaves (backlog_s is a live
 #: gauge snapshot, batch_cap a config echo) — its ``*_p50_s``/
 #: ``*_p99_s`` latency leaves DO gate, as down-better headlines
+#: ... and the `host_profile` / loadgen profile-summary leaves
+#: (ISSUE 14): sampler telemetry (samples, sample_hz) and lock-wait /
+#: share attributions shift with host load — evidence, not headlines
+#: (pinned by tests/test_bench_compare.py)
 NON_HEADLINE = {"duration_s", "ramp_s", "preload_s", "wall_s",
                 "interval_s", "timeout_s", "ttl_s", "expiry_s",
                 "value_bytes", "objects", "clients", "open_rps",
-                "backlog_s", "batch_cap"}
+                "backlog_s", "batch_cap",
+                "samples", "sample_hz", "lockwait_share",
+                "wait_seconds_total", "max_wait_s",
+                "scanner_cpu_share", "scanner_share_max"}
 BURN = re.compile(r"burn", re.IGNORECASE)
 HIGHER_BETTER = re.compile(
     r"(gibs|rps|availability|_ratio|^value$|requests_total)",

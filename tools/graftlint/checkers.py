@@ -48,6 +48,10 @@ text — nothing in the checked tree is imported.
 |       | sanctioned async-completion helper                           |
 |       | ``runtime/completion.await_result`` so lane waits are        |
 |       | counted/timed and the latency tier stays enforceable         |
+| GL016 | every ``threading.Thread(...)`` created under minio_tpu/     |
+|       | passes a ``name=`` — the continuous profiler's thread-role   |
+|       | classification (``obs/profiler.py``) keys on thread names,   |
+|       | and an unnamed thread can only ever classify as "other"      |
 """
 from __future__ import annotations
 
@@ -1211,6 +1215,40 @@ def check_interactive_blocking(ctx: FileCtx) -> list[Finding]:
     return out
 
 
+# --------------------------------------------------------------------------
+# GL016 — every thread construction carries a name
+
+
+def check_thread_names(ctx: FileCtx) -> list[Finding]:
+    """GL016: the continuous profiler (``obs/profiler.py``) classifies
+    every sample by thread ROLE, resolved through a name registry — an
+    unnamed ``threading.Thread`` can only ever classify as ``other``,
+    silently degrading every profile and the loadgen/bench subsystem
+    shares built on it. Any ``Thread(...)`` construction under
+    ``minio_tpu/`` without a ``name=`` keyword is a finding (Thread
+    SUBCLASS constructions pass their name to ``super().__init__`` and
+    are matched by their own class name, so they stay out of scope)."""
+    if not ctx.path.startswith("minio_tpu/"):
+        return []
+    out = []
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        d = dotted(node.func)
+        if d.rsplit(".", 1)[-1] != "Thread":
+            continue
+        if any(kw.arg == "name" for kw in node.keywords):
+            continue
+        out.append(Finding(
+            ctx.path, node.lineno, "GL016",
+            f"unnamed thread {_unparse(node, 40)} — pass name= so the "
+            "profiler's thread-role classification (obs/profiler.py) "
+            "can attribute its samples",
+            token=_unparse(node.func, 40),
+            scope=ctx.scope_at(node.lineno)))
+    return out
+
+
 PER_FILE = [
     check_wall_duration,
     check_blocking_under_lock,
@@ -1226,5 +1264,6 @@ PER_FILE = [
     check_mesh_routes,
     check_dist_rpc_bounds,
     check_interactive_blocking,
+    check_thread_names,
 ]
 PROJECT = [check_metrics_documented]
