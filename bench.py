@@ -1111,6 +1111,36 @@ def finish(payload: dict) -> None:
     os._exit(0)
 
 
+def device_obs_extra() -> dict:
+    """Device-plane observability snapshot (ISSUE 16): HBM ledger
+    high-water marks, the compile table totals, and per-op roofline
+    ratios accumulated across EVERY config above — the bench's own
+    device traffic doubles as the evidence run. Slimmed to the leaves
+    bench_compare knows how to judge (roofline up-better, compile
+    seconds down-better, ledger counts non-headline)."""
+    from minio_tpu.obs import device
+    st = device.status(touch_backend=True)
+    ledger = {lane: {"peak_bytes": row["peak_bytes"],
+                     "peak_buffers": row["peak_buffers"],
+                     "acquired_total": row["acquired_total"],
+                     "donated_total": row["donated_total"]}
+              for lane, row in st["ledger"].items()}
+    comp = st["compile"]
+    roofline = {op: {"roofline_ratio": row["roofline_ratio"],
+                     "achieved_gibs": row["achieved_gibs"],
+                     "device_seconds": round(row["device_seconds"], 4),
+                     "flushes": row["flushes"]}
+                for op, row in st["roofline"].items()}
+    return {"device_obs": {
+        "ledger": ledger,
+        "ledger_balanced": st["ledger_balanced"],
+        "compiles_total": comp["compiles_total"],
+        "compile_seconds_total": round(comp["compile_seconds_total"], 3),
+        "compile_storms_total": comp["storms_total"],
+        "roofline": roofline,
+    }}
+
+
 def main() -> None:
     chaos = "--chaos" in sys.argv[1:]
     rng = np.random.default_rng(0)
@@ -1141,6 +1171,9 @@ def main() -> None:
     # flight-recorder artifacts LAST so the truncated timeline +
     # attribution report cover every config above (ISSUE 9)
     tl = timeline_extras()
+    # device-plane ledger/compile/roofline accumulated over the whole
+    # run — snapshot after every config has dispatched (ISSUE 16)
+    dev_obs = device_obs_extra()
 
     enc = dev["encode_16p4_1MiB_b128"]
     extra_chaos = {"chaos": cha} if cha is not None else {}
@@ -1177,6 +1210,7 @@ def main() -> None:
             **scale,      # mixed-workload SLO scale harness (ISSUE 10)
             **node_chaos,      # 4-node kill/heal topology (ISSUE 12)
             **tl,     # flight-recorder timeline + attribution (ISSUE 9)
+            **dev_obs,   # HBM ledger + compile + roofline (ISSUE 16)
             **extra_chaos,                        # --chaos degraded run
         },
     })

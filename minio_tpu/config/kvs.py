@@ -231,6 +231,29 @@ SUB_SYSTEMS: dict[str, dict[str, KV]] = {
                       help="window length of an SLO-breach-triggered "
                            "capture"),
     },
+    "device_obs": {
+        "enable": KV("1", env="MINIO_TPU_DEVICE_OBS",
+                     help="device-plane observability (obs/device.py, "
+                          "docs/observability.md 'Device plane'): HBM "
+                          "ledger, compile tracking, roofline "
+                          "attribution; 0 disables all of it"),
+        "storm_threshold": KV(
+            "8", env="MINIO_TPU_DEVICE_OBS_STORM_THRESHOLD",
+            help="compiles inside storm_window_s that count as a "
+                 "compile storm (breach-style capture via the "
+                 "profiler's cooldown machinery)"),
+        "storm_window_s": KV(
+            "30", env="MINIO_TPU_DEVICE_OBS_STORM_WINDOW_S",
+            help="sliding window of the compile-storm detector"),
+        "roofline_encode_gibs": KV(
+            "179", env="MINIO_TPU_DEVICE_OBS_ROOFLINE_ENCODE",
+            help="calibrated encode-kernel ceiling GiB/s "
+                 "(BENCH_r05; re-pin after benching your own part)"),
+        "roofline_reconstruct_gibs": KV(
+            "183", env="MINIO_TPU_DEVICE_OBS_ROOFLINE_RECONSTRUCT",
+            help="calibrated reconstruct-kernel ceiling GiB/s "
+                 "(BENCH_r05)"),
+    },
     "fault": {
         "enable": KV("1", help="honor KVS-armed fault-injection rules"),
         "rules": KV(
@@ -394,7 +417,7 @@ SUB_SYSTEMS: dict[str, dict[str, KV]] = {
 #: an apply callback.
 DYNAMIC = {"api", "scanner", "heal", "dispatch", "bitrot", "qos", "fault",
            "durability", "pipeline", "workloads", "timeline", "slo",
-           "profiler"}
+           "profiler", "device_obs"}
 
 
 class ConfigSys:

@@ -146,6 +146,12 @@ class PeerRESTClient:
             "profile", {"seconds": str(seconds)},
             timeout=max(10.0, seconds + 10.0)))
 
+    def device_status(self) -> dict:
+        """The peer's device-plane snapshot (obs/device.status): HBM
+        ledger, compile table, roofline ratios — the admin
+        ``device?peers=1`` aggregation fans this out."""
+        return json.loads(self.rpc.call("devicestatus"))
+
 
 def _stream_pubsub(pubsub, timeout_s: float, count: int, to_dict=None):
     """Generator of NDJSON event lines from a live pubsub subscription,
@@ -295,6 +301,11 @@ class PeerRESTService:
                 rep = profiler.report_top(agg)
             except ValueError as e:  # profiler disabled on this node
                 rep = {"error": str(e)}
+            rep["endpoint"] = self.node.local_url
+            return json.dumps(rep).encode()
+        if method == "devicestatus":
+            from ..obs import device
+            rep = device.status(touch_backend=True)
             rep["endpoint"] = self.node.local_url
             return json.dumps(rep).encode()
         from ..utils import errors

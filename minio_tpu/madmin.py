@@ -174,6 +174,22 @@ class AdminClient:
             return self._request("GET", "profile", q)
         return self._json("GET", "profile", q)
 
+    def device_status(self, peers: bool = False,
+                      trace_seconds: float = 0.0) -> dict:
+        """Device-plane snapshot (`GET /minio/admin/v3/device`,
+        docs/observability.md "Device plane"): per-lane HBM ledger +
+        leak gate, the per-(op, shape) compile table with seconds,
+        per-op device-seconds and roofline ratios, backend
+        memory_stats. ``peers=True`` fans out across dist nodes;
+        ``trace_seconds > 0`` additionally runs one on-demand
+        ``jax.profiler`` trace session on the target node."""
+        q: dict[str, str] = {}
+        if peers:
+            q["peers"] = "1"
+        if trace_seconds:
+            q["trace"] = str(trace_seconds)
+        return self._json("GET", "device", q or None)
+
     def start_profiling(self, profiler_type: str = "cpu") -> dict:
         return self._json("POST", "profiling/start",
                           {"profilerType": profiler_type})

@@ -966,6 +966,89 @@ def _g_profiler(server) -> list[str]:
     return lines
 
 
+def _g_device_obs(server) -> list[str]:
+    """Device plane (obs/device.py, docs/observability.md "Device
+    plane"): per-lane HBM ledger gauges, compile counters, per-op
+    device-seconds and roofline ratios, host staging-buffer high-water,
+    and raw backend memory_stats when a backend is live. The storm
+    counter (minio_tpu_device_obs_compile_storms_total) rides the
+    counter store, incremented by the storm detector."""
+    from . import device
+    st = device.status(touch_backend=False)
+    lines = [
+        "# TYPE minio_tpu_device_obs_enabled gauge",
+        f"minio_tpu_device_obs_enabled {1 if st['enabled'] else 0}",
+    ]
+    lines.append("# TYPE minio_tpu_device_hbm_used gauge")
+    lines.append("# TYPE minio_tpu_device_hbm_peak gauge")
+    lines.append("# TYPE minio_tpu_device_hbm_live_buffers gauge")
+    lines.append("# TYPE minio_tpu_device_obs_ledger_acquired_total "
+                 "counter")
+    lines.append("# TYPE minio_tpu_device_obs_ledger_released_total "
+                 "counter")
+    lines.append("# TYPE minio_tpu_device_obs_ledger_donated_total "
+                 "counter")
+    for lane, led in sorted(st["ledger"].items()):
+        lab = f'lane="{_esc(lane)}"'
+        lines.append(
+            f"minio_tpu_device_hbm_used{{{lab}}} {led['live_bytes']}")
+        lines.append(
+            f"minio_tpu_device_hbm_peak{{{lab}}} {led['peak_bytes']}")
+        lines.append(
+            f"minio_tpu_device_hbm_live_buffers{{{lab}}} "
+            f"{led['live_buffers']}")
+        lines.append(
+            f"minio_tpu_device_obs_ledger_acquired_total{{{lab}}} "
+            f"{led['acquired_total']}")
+        lines.append(
+            f"minio_tpu_device_obs_ledger_released_total{{{lab}}} "
+            f"{led['released_total']}")
+        lines.append(
+            f"minio_tpu_device_obs_ledger_donated_total{{{lab}}} "
+            f"{led['donated_total']}")
+    comp = st["compile"]
+    lines += [
+        "# TYPE minio_tpu_device_obs_compiles_total counter",
+        f"minio_tpu_device_obs_compiles_total {comp['compiles_total']}",
+        "# TYPE minio_tpu_device_obs_compile_seconds_total counter",
+        "minio_tpu_device_obs_compile_seconds_total "
+        f"{comp['compile_seconds_total']}",
+        "# TYPE minio_tpu_device_obs_host_buf_bytes gauge",
+        "minio_tpu_device_obs_host_buf_bytes "
+        f"{st['host_bufpool']['live_bytes']}",
+        "# TYPE minio_tpu_device_obs_host_buf_peak_bytes gauge",
+        "minio_tpu_device_obs_host_buf_peak_bytes "
+        f"{st['host_bufpool']['peak_bytes']}",
+    ]
+    if st["roofline"]:
+        lines.append("# TYPE minio_tpu_kernel_roofline_ratio gauge")
+        lines.append("# TYPE minio_tpu_kernel_achieved_gibs gauge")
+        lines.append("# TYPE minio_tpu_device_seconds_total counter")
+        for op, r in sorted(st["roofline"].items()):
+            lab = f'op="{_esc(op)}"'
+            lines.append(f"minio_tpu_kernel_roofline_ratio{{{lab}}} "
+                         f"{r['roofline_ratio']}")
+            lines.append(f"minio_tpu_kernel_achieved_gibs{{{lab}}} "
+                         f"{r['achieved_gibs']}")
+            lines.append(f"minio_tpu_device_seconds_total{{{lab}}} "
+                         f"{r['device_seconds']}")
+    mem = st["device_memory"]
+    if any("bytes_in_use" in d for d in mem):
+        lines.append("# TYPE minio_tpu_device_hbm_bytes_in_use gauge")
+        lines.append("# TYPE minio_tpu_device_hbm_bytes_limit gauge")
+        for d in mem:
+            if "bytes_in_use" not in d:
+                continue
+            lab = f'device="{d["id"]}",platform="{_esc(d["platform"])}"'
+            lines.append(f"minio_tpu_device_hbm_bytes_in_use{{{lab}}} "
+                         f"{d['bytes_in_use']}")
+            if "bytes_limit" in d:
+                lines.append(
+                    f"minio_tpu_device_hbm_bytes_limit{{{lab}}} "
+                    f"{d['bytes_limit']}")
+    return lines
+
+
 def _g_locks(server) -> list[str]:
     locker = getattr(server, "local_locker", None)
     if locker is None:
@@ -1014,6 +1097,9 @@ _GROUPS = [
     # profiler reads in-memory sampler state — interval 0 so subsystem
     # shares and lock-wait stats are live per scrape
     MetricsGroup("profiler", "node", _g_profiler, interval=0),
+    # device plane reads in-memory ledger/compile state — interval 0 so
+    # the leak gate and compile counters are live per scrape
+    MetricsGroup("device_obs", "node", _g_device_obs, interval=0),
     MetricsGroup("process", "node", _g_process),
     MetricsGroup("locks", "node", _g_locks),
     MetricsGroup("notification", "cluster", _g_notification),

@@ -46,7 +46,7 @@ import time
 #: event taxonomy (docs/observability.md "Flight recorder" section) —
 #: structural events bypass sampling, high-frequency ones honor it
 STRUCTURAL = frozenset({"plan", "spill", "flush_start", "flush_end",
-                        "salvage"})
+                        "salvage", "compile"})
 SAMPLED = frozenset({"enqueue", "complete", "buf_acquire", "buf_release"})
 EVENT_TYPES = tuple(sorted(STRUCTURAL | SAMPLED))
 

@@ -85,8 +85,9 @@ def _make_kernel(lanes_per_pkg: int, unroll: bool = True):
 def _jitted(lanes_per_pkg: int, n_tiles: int, interpret: bool):
     kernel = _make_kernel(lanes_per_pkg, unroll=not interpret)
     r = n_tiles * RT
+    from ..obs.device import tracked_jit
 
-    @jax.jit
+    @functools.partial(tracked_jit, op="chacha.keystream_xor")
     def run(scalars: jnp.ndarray, n2: jnp.ndarray, x: jnp.ndarray):
         return pl.pallas_call(
             kernel,
@@ -233,7 +234,9 @@ def multi_fn_for(pkgs: int, words: int, interpret: bool | None = None):
 def multi_jitted(pkgs: int, words: int, interpret: bool | None = None):
     """jit of :func:`multi_fn_for` for single-device (or per-lane
     pinned) launches; the mesh route wraps the raw fn in shard_map."""
-    return jax.jit(multi_fn_for(pkgs, words, interpret))
+    from ..obs.device import tracked_jit
+    return tracked_jit(multi_fn_for(pkgs, words, interpret),
+                       op="sse_xor")
 
 
 def xor_packages_device(key: bytes, nonces: np.ndarray, data: np.ndarray):

@@ -82,7 +82,8 @@ def _jitted(key_words: tuple[int, ...], chunk_nbytes: int, backend_mm,
         out = backend_mm(masks, words)             # [B, m, W]
         return out, valid
 
-    return jax.jit(fused)
+    from ..obs.device import tracked_jit
+    return tracked_jit(fused, op="fused.rebuild_verify")
 
 
 def fused_fn_for(key: bytes, shard_nbytes: int, backend_mm,
@@ -131,7 +132,8 @@ def _jitted_encode_hashed(key_words: tuple[int, ...], chunk_nbytes: int,
                        both.reshape(B, k + parity.shape[1], nc, W // nc))
         return parity, digs.reshape(B, k + parity.shape[1], nc * 8)
 
-    return jax.jit(fused)
+    from ..obs.device import tracked_jit
+    return tracked_jit(fused, op="fused.encode_hashed")
 
 
 def encode_hashed_fn_for(key: bytes, shard_nbytes: int, encode_mm,

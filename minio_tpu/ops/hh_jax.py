@@ -280,7 +280,9 @@ def _key_words(key: bytes) -> tuple[int, ...]:
 
 @functools.lru_cache(maxsize=32)
 def _jitted(key_words: tuple[int, ...], nbytes: int):
-    return jax.jit(functools.partial(_hash256_impl, key_words, nbytes))
+    from ..obs.device import tracked_jit
+    return tracked_jit(functools.partial(_hash256_impl, key_words, nbytes),
+                       op="hash.highway")
 
 
 def hash256_chunks(key: bytes, chunks: np.ndarray) -> np.ndarray:

@@ -98,7 +98,8 @@ def _jitted_impl(seeds: tuple[int, int], nbytes: int):
         ks = tuple(flat[:, i::4].T for i in range(4))
         return _hash_core(seeds, nbytes, ks, n)
 
-    return jax.jit(impl)
+    from ..obs.device import tracked_jit
+    return tracked_jit(impl, op="hash.mur3")
 
 
 def _key_words(key: bytes) -> tuple[int, int]:

@@ -157,8 +157,9 @@ def _jitted(seeds: tuple[int, int], nbytes: int, n_lanes_padded: int,
     n_psteps = nblocks // pb
     r = n_lanes_padded // 128
     kernel = _make_kernel(seeds, nbytes, pb, n_psteps)
+    from ..obs.device import tracked_jit
 
-    @jax.jit
+    @functools.partial(tracked_jit, op="hash.mur3_pallas")
     def run(ks: jnp.ndarray) -> jnp.ndarray:
         return pl.pallas_call(
             kernel,

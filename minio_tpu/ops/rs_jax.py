@@ -23,6 +23,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from . import gf256
+from ..obs.device import tracked_jit
 
 _HI = np.uint32(0x80808080)
 _LO7 = np.uint32(0xFEFEFEFE)
@@ -70,12 +71,16 @@ def gf_matmul_packed(masks: jnp.ndarray, x: jnp.ndarray) -> jnp.ndarray:
     return acc
 
 
-# vmapped variants; jit applied at call sites with stable shapes.
-_matmul_j = jax.jit(gf_matmul_packed)
+# vmapped variants; jit applied at call sites with stable shapes. All
+# compile sites route through the device plane's tracked wrapper
+# (obs/device.tracked_jit, GL017) so recompiles are counted and timed.
+_matmul_j = tracked_jit(gf_matmul_packed, op="xla.gf_matmul")
 # batch of shard groups, one shared matrix (encode path)
-_matmul_batch_shared = jax.jit(jax.vmap(gf_matmul_packed, in_axes=(None, 0)))
+_matmul_batch_shared = tracked_jit(
+    jax.vmap(gf_matmul_packed, in_axes=(None, 0)), op="xla.encode_batch")
 # batch with per-element matrices (heal path: different loss patterns)
-_matmul_batch_per = jax.jit(jax.vmap(gf_matmul_packed, in_axes=(0, 0)))
+_matmul_batch_per = tracked_jit(
+    jax.vmap(gf_matmul_packed, in_axes=(0, 0)), op="xla.rebuild_batch")
 
 
 def _backend_name(backend: str) -> str:
@@ -178,8 +183,9 @@ class ReedSolomon:
         outer jit is fine: nested jits inline."""
         fn = self._batch_per_donated
         if fn is None:
-            fn = self._batch_per_donated = jax.jit(
-                self._mm_batch_per, donate_argnums=(1,))
+            fn = self._batch_per_donated = tracked_jit(
+                self._mm_batch_per, op="rebuild_batch_donated",
+                donate_argnums=(1,))
         return fn
 
     def _decode_mat(self, present: tuple[int, ...]) -> np.ndarray:

@@ -36,6 +36,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from .rs_jax import gf2x_packed
+from ..obs.device import tracked_jit
 
 # Flat fallback tile (words per grid step) for shard sizes not divisible by
 # the sublane layouts' 2048-word quantum.
@@ -70,7 +71,8 @@ def _dyn_kernel(masks_ref, x_ref, out_ref):
     out_ref[:] = acc
 
 
-@functools.partial(jax.jit, static_argnames=("interpret",))
+@functools.partial(tracked_jit, op="pallas.gf_matmul",
+                   static_argnames=("interpret",))
 def gf_matmul_pallas(masks: jnp.ndarray, x: jnp.ndarray,
                      interpret: bool = False) -> jnp.ndarray:
     """masks uint32 [8, o, i], x uint32 [i, W] -> [o, W].
@@ -129,7 +131,8 @@ def _dyn_batch_kernel(masks_ref, x_ref, out_ref):
     out_ref[:] = acc
 
 
-@functools.partial(jax.jit, static_argnames=("interpret",))
+@functools.partial(tracked_jit, op="pallas.matmul_batched",
+                   static_argnames=("interpret",))
 def _gf_matmul_batched(masks: jnp.ndarray, x: jnp.ndarray,
                        interpret: bool = False) -> jnp.ndarray:
     """masks uint32 [B, 8, o, i], x uint32 [B, i, W] -> [B, o, W]."""
@@ -160,7 +163,7 @@ def _gf_matmul_batched(masks: jnp.ndarray, x: jnp.ndarray,
     return out[..., :w] if wpad != w else out
 
 
-@jax.jit
+@functools.partial(tracked_jit, op="pallas.encode_batch")
 def gf_matmul_batch(masks: jnp.ndarray, x: jnp.ndarray) -> jnp.ndarray:
     """One shared matrix across the batch (encode-shape path): masks
     [8, o, i], x [B, i, W] -> [B, o, W]."""
@@ -169,7 +172,7 @@ def gf_matmul_batch(masks: jnp.ndarray, x: jnp.ndarray) -> jnp.ndarray:
     return _gf_matmul_batched(mb, x, interpret=not on_tpu())
 
 
-@jax.jit
+@functools.partial(tracked_jit, op="pallas.rebuild_batch")
 def gf_matmul_batch_per(masks: jnp.ndarray, x: jnp.ndarray) -> jnp.ndarray:
     """Per-element matrices (heal path): masks [B, 8, o, i],
     x [B, i, W] -> [B, o, W]."""
@@ -213,7 +216,7 @@ def _static_call(mat_bytes: bytes, o: int, i: int, w: int, interpret: bool):
     rows = wpad // lanes
     kernel = _make_static_kernel(bits, o, i, tl, lanes)
 
-    @jax.jit
+    @functools.partial(tracked_jit, op="pallas.static_encode")
     def mm(x: jnp.ndarray, c: jnp.ndarray) -> jnp.ndarray:
         if wpad != w:
             x = jnp.pad(x, ((0, 0), (0, wpad - w)))
@@ -289,7 +292,7 @@ def _static_batch_call(mat_bytes: bytes, o: int, i: int, bsz: int, w: int,
     nb = _batch_block(bsz, wpad)
     kernel = _make_static_batch_kernel(bits, nb, o, i, tl, lanes)
 
-    @jax.jit
+    @functools.partial(tracked_jit, op="pallas.static_encode_batch")
     def mm(x: jnp.ndarray, c: jnp.ndarray) -> jnp.ndarray:
         if wpad != w:
             x = jnp.pad(x, ((0, 0), (0, 0), (0, wpad - w)))

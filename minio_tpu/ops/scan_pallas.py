@@ -239,7 +239,9 @@ def scan_fn_for(program: tuple, cols: tuple, delim: int, nbytes: int,
     cells_fn = jax.vmap(
         lambda x: _cells_one_block(x, cols, delim, max_rows))
 
-    @jax.jit
+    from ..obs.device import tracked_jit
+
+    @functools.partial(tracked_jit, op="select_scan")
     def run(blocks_u32: jnp.ndarray) -> jnp.ndarray:
         B = blocks_u32.shape[0]
         w = blocks_u32.astype(jnp.uint32)

@@ -21,6 +21,7 @@ from collections import defaultdict
 
 import numpy as np
 
+from ..obs import device as _dev
 from ..obs import timeline as _tl
 
 #: Retained-bytes cap across all buckets (not a cap on live buffers).
@@ -56,9 +57,11 @@ class BufferPool:
                     self.misses += 1
                     arr = None
             # flight recorder: pool pressure on the timeline (sampled
-            # event type, recorded outside the pool lock)
+            # event type, recorded outside the pool lock); the device
+            # plane mirrors it as host staging high-water
             _tl.record("buf_acquire", bytes=nbytes,
                        hit=arr is not None)
+            _dev.note_host_buf(nbytes, acquired=True)
             if arr is not None:
                 return arr
         return np.empty(nbytes, dtype=np.uint8)
@@ -71,6 +74,7 @@ class BufferPool:
                 or not arr.flags.owndata:
             return
         _tl.record("buf_release", bytes=arr.nbytes)
+        _dev.note_host_buf(arr.nbytes, acquired=False)
         with self._lock:
             if self._retained + arr.nbytes > self.max_retained:
                 return

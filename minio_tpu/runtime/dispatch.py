@@ -76,6 +76,7 @@ from dataclasses import dataclass, field
 
 import numpy as np
 
+from ..obs import device as _dev
 from ..obs import latency as _lat
 from ..obs import lockrank as _lr
 from ..obs import slo as _slo
@@ -383,10 +384,11 @@ class _IAHandle:
     carrying everything ``DispatchQueue._complete`` needs."""
 
     __slots__ = ("b", "out_dev", "items", "accounted", "qbytes",
-                 "predicted_s", "t0", "span_done", "tl_done", "lane")
+                 "predicted_s", "t0", "span_done", "tl_done", "lane",
+                 "tok")
 
     def __init__(self, b, out_dev, items, accounted, qbytes,
-                 predicted_s, t0, span_done, tl_done, lane):
+                 predicted_s, t0, span_done, tl_done, lane, tok=None):
         self.b = b
         self.out_dev = out_dev
         self.items = items
@@ -397,6 +399,7 @@ class _IAHandle:
         self.span_done = span_done
         self.tl_done = tl_done
         self.lane = lane
+        self.tok = tok
 
 
 class _AsyncCompleter(threading.Thread):
@@ -462,7 +465,7 @@ class _AsyncCompleter(threading.Thread):
                     self.q._complete(h.b, h.out_dev, h.items,
                                      h.accounted, h.qbytes,
                                      h.predicted_s, h.t0, h.span_done,
-                                     h.tl_done, h.lane)
+                                     h.tl_done, h.lane, h.tok)
                 except Exception as e:  # noqa: BLE001 — completion must
                     for p in h.items:   # never kill the poller; waiters
                         if not p.future.done():  # get the error
@@ -1683,6 +1686,18 @@ class DispatchQueue:
                 p.future.add_done_callback(span_done)
             if tl_done is not None:
                 p.future.add_done_callback(tl_done)
+        # device-plane HBM ledger (obs/device.py): this flush's live
+        # device buffers, charged to its lane until the readback lands
+        # (donated rebuilds alias input into output — flagged, and the
+        # release in _complete's finally covers the salvage path too)
+        names = getattr(self, "_lanes_cache", None)
+        ledger_lane = "interactive" if interactive else \
+            ("mesh" if lane is None and names and len(names) > 1
+             else "bulk")
+        tok = _dev.ledger_acquire(
+            ledger_lane, bytes_in + bytes_out,
+            donated=interactive and b.op == "masked"
+            and _donate_active())
         try:
             if interactive:
                 # async completion: the poller polls device readiness
@@ -1691,7 +1706,7 @@ class DispatchQueue:
                 self._async_completer().submit(_IAHandle(
                     b, out_dev, items, accounted,
                     bytes_in + bytes_out, predicted_s,
-                    time.monotonic(), span_done, tl_done, lane))
+                    time.monotonic(), span_done, tl_done, lane, tok))
             else:
                 # hand host readback to a completer so the next batch
                 # launches while this one's transfer is in flight
@@ -1699,7 +1714,7 @@ class DispatchQueue:
                                         items, accounted,
                                         bytes_in + bytes_out,
                                         predicted_s, time.monotonic(),
-                                        span_done, tl_done, lane)
+                                        span_done, tl_done, lane, tok)
         except BaseException:  # submit refused (shutdown): the paired
             self.qos.device_completed(bytes_in + bytes_out, lane=lane)
             if interactive:
@@ -1707,15 +1722,26 @@ class DispatchQueue:
             if accounted:  # the pipeline slot must not stay occupied
                 with self._profile_lock:
                     self._dev_inflight = max(0, self._dev_inflight - 1)
+            _dev.ledger_release(tok)
             raise  # must not leak into the queued-bytes cap
 
     def _complete(self, b: _Bucket, out_dev, items: list[_Pending],
                   accounted: bool = True, qbytes: int = 0,
                   predicted_s: float = 0.0, t0: float = 0.0,
-                  span_done=None, tl_done=None, lane: int | None = None):
+                  span_done=None, tl_done=None, lane: int | None = None,
+                  tok=None):
         try:
             self._finish_readback(b, out_dev, items, span_done, tl_done)
         finally:
+            # device-plane estimator + ledger release (obs/device.py):
+            # submit -> readback-ready is the cheap per-op device-time
+            # estimate feeding the roofline ratios; the ledger release
+            # runs in the SAME finally, so the CPU-salvage path inside
+            # _finish_readback still balances the lane
+            if t0 > 0.0:
+                _dev.note_device_time(_OP_NAME.get(b.op, b.op),
+                                      time.monotonic() - t0, qbytes)
+            _dev.ledger_release(tok)
             self.qos.device_completed(qbytes, lane=lane)
             if b.stream == _qos.STREAM_INTERACTIVE:
                 self.qos.ia_completed(qbytes)

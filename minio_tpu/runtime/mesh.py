@@ -143,7 +143,8 @@ def sharded_batched(fn, mesh, batch_args: tuple[bool, ...],
         from jax.experimental.shard_map import shard_map as _sm
         sm = _sm(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
                  check_rep=False)
-    w = jax.jit(sm)
+    from ..obs.device import tracked_jit
+    w = tracked_jit(sm, op=f"mesh.{getattr(fn, '__name__', 'fn')}")
     try:  # bound methods / exotic callables: build uncached —
         with _shard_cache_lock:  # correctness over reuse
             per_fn = getattr(fn, _CACHE_ATTR, None)
@@ -204,4 +205,5 @@ def build_sharded_step(K: int, M: int, n_devices: int, sp: int | None = None):
         from jax.experimental.shard_map import shard_map as _sm
         smapped = _sm(step, mesh=mesh, in_specs=in_specs,
                       out_specs=out_specs, check_rep=False)
-    return jax.jit(smapped), mesh
+    from ..obs.device import tracked_jit
+    return tracked_jit(smapped, op="mesh.sharded_step"), mesh

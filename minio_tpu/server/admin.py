@@ -174,6 +174,8 @@ def _dispatch_admin(h, op: str) -> None:
         return h._send(200, b"{}", "application/json")
     if op == "profile":
         return _profile(h)
+    if op == "device":
+        return _device(h)
     if op.startswith("profiling/") or op == "healthinfo" or \
             op == "obdinfo":
         return _profiling_obd(h, op)
@@ -397,6 +399,50 @@ def _profile(h) -> None:
     if threads or q.get("peers") == "1":
         for t in threads:
             t.join(timeout=max(10.0, seconds + 10.0))
+        rep = {"nodes": [rep] + peer_rows}
+    h._send(200, json.dumps(rep).encode(), "application/json")
+
+
+def _device(h) -> None:
+    """Device plane (obs/device.py, docs/observability.md "Device
+    plane"): per-lane HBM ledger, the per-(op, shape) compile table,
+    per-op device-seconds + roofline ratios, backend memory_stats.
+    Query params: ``peers=1`` fans the snapshot across dist nodes (new
+    ``devicestatus`` peer RPC, same shape as the health snapshot);
+    ``trace=<seconds>`` additionally runs one on-demand ``jax.profiler``
+    trace session and returns its logdir under ``trace``."""
+    from ..obs import device
+    q = {k: v[0] for k, v in h.query.items()}
+    peer_rows: list = []
+    threads: list = []
+    if q.get("peers") == "1":
+        import threading as _t
+
+        def fetch(p):
+            try:
+                peer_rows.append(p.device_status())
+            except Exception as e:  # noqa: BLE001 — peer down: report
+                peer_rows.append({"endpoint": getattr(p, "url", ""),
+                                  "error": str(e)})
+
+        for peer in getattr(h.s3, "peers", lambda: [])():
+            t = _t.Thread(target=fetch, args=(peer,), daemon=True,
+                          name="admin-device-fanout")
+            t.start()
+            threads.append(t)
+    rep = device.status(touch_backend=True)
+    rep["endpoint"] = f"{getattr(h.s3, 'address', '')}:" \
+                      f"{getattr(h.s3, 'port', 0)}"
+    if "trace" in q:
+        try:
+            seconds = float(q["trace"])
+        except ValueError:
+            return h._error("InvalidArgument",
+                            "bad trace seconds parameter", 400)
+        rep["trace"] = device.capture_trace(seconds)
+    if threads or q.get("peers") == "1":
+        for t in threads:
+            t.join(timeout=10.0)
         rep = {"nodes": [rep] + peer_rows}
     h._send(200, json.dumps(rep).encode(), "application/json")
 

@@ -69,6 +69,28 @@ def test_direction_classification():
     # stay informational too
     assert direction(
         "extra.host_profile.put_par8_16p4.subsystems.erasure") == ""
+    # the device_obs extra (ISSUE 16): roofline ratios/throughput gate
+    # up-better, compile SECONDS gate down-better (a compile-time
+    # regression is a real cost), while the ledger high-water marks,
+    # compile/storm COUNTS, and device-seconds attribution are
+    # workload-shaped evidence — never headlines
+    assert direction(
+        "extra.device_obs.roofline.encode.roofline_ratio") == "up"
+    assert direction(
+        "extra.device_obs.roofline.encode.achieved_gibs") == "up"
+    assert direction(
+        "extra.device_obs.compile_seconds_total") == "down"
+    assert direction("extra.device_obs.compiles_total") == ""
+    assert direction("extra.device_obs.compile_storms_total") == ""
+    assert direction(
+        "extra.device_obs.roofline.encode.device_seconds") == ""
+    assert direction("extra.device_obs.roofline.encode.flushes") == ""
+    assert direction("extra.device_obs.ledger.bulk.peak_bytes") == ""
+    assert direction("extra.device_obs.ledger.bulk.peak_buffers") == ""
+    assert direction(
+        "extra.device_obs.ledger.bulk.acquired_total") == ""
+    assert direction(
+        "extra.device_obs.ledger.interactive.donated_total") == ""
 
 
 def test_regression_flags_both_directions():

@@ -1,0 +1,520 @@
+"""Device-plane observability (ISSUE 16): tracked-jit compile counting
+with timeline/attribution wiring and the storm detector, the per-lane
+HBM live-buffer ledger (balance through the bulk, interactive, donated
+and CPU-salvage paths — the leak gate), the device-seconds/roofline
+estimator, the admin endpoint + madmin SDK, the metric family, and THE
+steady-state oracle: a warmed mixed workload over both lanes and all
+six dispatch ops triggers ZERO compiles."""
+import os
+import sys
+import threading
+import time
+import warnings
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(__file__))
+from s3client import S3Client  # noqa: E402
+
+from minio_tpu import fault, qos  # noqa: E402,F401
+from minio_tpu.obs import device  # noqa: E402
+from minio_tpu.ops.rs_jax import (get_codec, pack_shards,  # noqa: E402
+                                  unpack_shards)
+from minio_tpu.runtime.dispatch import DispatchQueue  # noqa: E402
+
+AK, SK = "devak", "devsecret1"
+
+
+@pytest.fixture(autouse=True)
+def _fresh_plane():
+    """Each test judges ITS OWN deltas: tables/ledgers reset around the
+    test (per-wrapper _seen caches deliberately survive — an already-
+    compiled kernel will not recompile, so it must not recount)."""
+    device.reset()
+    yield
+    device.reset()
+
+
+def _rebuild_case(codec, seed=0, shard=512):
+    rng = np.random.default_rng(seed)
+    data = rng.integers(0, 256, (codec.k, shard), dtype=np.uint8)
+    parity = codec.encode(data)
+    full = np.concatenate([data, parity])
+    present = tuple(i for i in range(codec.k + codec.m)
+                    if i != 1)[:codec.k]
+    masks = codec.target_masks_np(present, (1,))
+    gathered = np.stack([full[j] for j in present])
+    return pack_shards(gathered), masks, full, 1
+
+
+# --------------------------------------------------------------------------
+# pillar 2: tracked_jit compile counting
+
+
+def test_tracked_jit_counts_one_compile_per_signature():
+    w = device.tracked_jit(lambda x: x + 1, op="test.add")
+    a = np.arange(8, dtype=np.uint32).reshape(2, 4)
+    n0 = device.compiles_total()
+    np.testing.assert_array_equal(np.asarray(w(a)), a + 1)
+    w(a)                       # same signature: cached, not a compile
+    w(a.copy())                # same shapes, different buffer: cached
+    assert device.compiles_total() == n0 + 1
+    w(np.arange(16, dtype=np.uint32).reshape(4, 4))  # new shape
+    assert device.compiles_total() == n0 + 2
+    snap = device.compile_snapshot()
+    rows = [r for r in snap["table"] if r["op"] == "test.add"]
+    assert len(rows) == 2
+    assert all(r["count"] == 1 and r["seconds"] > 0 for r in rows)
+    assert any("uint32[2,4]" in r["signature"] for r in rows)
+    assert snap["compile_seconds_total"] > 0
+
+
+def test_tracked_jit_nested_call_does_not_double_count():
+    """A tracked fn called inside another traced fn sees tracers and
+    passes straight through — jax inlines it, so only the OUTER compile
+    counts (the dispatch kernels nest this way: batched vmap wrappers
+    over tracked matmuls)."""
+    inner = device.tracked_jit(lambda x: x * 2, op="test.inner")
+    outer = device.tracked_jit(lambda x: inner(x) + 1, op="test.outer")
+    n0 = device.compiles_total()
+    out = np.asarray(outer(np.arange(4, dtype=np.uint32)))
+    np.testing.assert_array_equal(out, np.arange(4) * 2 + 1)
+    snap = device.compile_snapshot()
+    ops = [r["op"] for r in snap["table"]]
+    assert "test.outer" in ops and "test.inner" not in ops
+    assert device.compiles_total() == n0 + 1
+
+
+def test_tracked_jit_decorator_forms_and_kwargs():
+    import functools
+
+    @functools.partial(device.tracked_jit, op="test.deco",
+                       static_argnames=("flip",))
+    def run(x, flip=False):
+        return x[::-1] if flip else x
+
+    a = np.arange(6, dtype=np.uint32)
+    np.testing.assert_array_equal(np.asarray(run(a, flip=True)), a[::-1])
+    # static kwarg is part of the signature: flipping it recompiles once
+    n0 = device.compiles_total()
+    np.testing.assert_array_equal(np.asarray(run(a, flip=False)), a)
+    run(a, flip=False)
+    assert device.compiles_total() == n0 + 1
+    assert run.__wrapped__ is not None and run.__name__ == "run"
+
+
+def test_compile_event_lands_in_timeline_and_attribution():
+    from minio_tpu.obs import stages, timeline
+    st = stages.StageTimes()
+    w = device.tracked_jit(lambda x: x ^ 7, op="test.tlwire")
+    t0 = time.monotonic()
+    with stages.collect(st):
+        w(np.arange(32, dtype=np.uint32))
+    evs = [e for e in timeline.snapshot(since=t0)
+           if e["type"] == "compile" and e.get("op") == "test.tlwire"]
+    assert evs, "compile event missing from the flight recorder"
+    assert evs[0]["seconds"] > 0 and "uint32[32]" in evs[0]["sig"]
+    # the armed collector got the compile charged as its own stage —
+    # a recompile-induced e2e spike is attributable, not mystery time
+    assert st.seconds.get("compile", 0.0) > 0
+    # "compile" is a STRUCTURAL event type: never sampled away
+    assert "compile" in timeline.STRUCTURAL
+
+
+def test_compile_storm_detector_fires_once_per_window(monkeypatch):
+    monkeypatch.setenv("MINIO_TPU_DEVICE_OBS_STORM_THRESHOLD", "3")
+    from minio_tpu.obs.metrics import counters_snapshot
+    c0 = counters_snapshot().get(
+        "minio_tpu_device_obs_compile_storms_total", 0.0)
+    # a shape-shifting workload: every call a fresh signature
+    for i in range(5):
+        device.note_compile("test.storm", f"uint32[{i + 1}]", 0.01)
+    snap = device.compile_snapshot()
+    assert snap["storm_threshold"] == 3
+    # 5 compiles in one window: ONE storm transition, then cooldown —
+    # the detector flags the onset, not every compile after it
+    assert snap["storms_total"] == 1
+    assert counters_snapshot().get(
+        "minio_tpu_device_obs_compile_storms_total", 0.0) == c0 + 1
+
+
+def test_compile_table_overflow_folds_to_other():
+    for i in range(device.MAX_COMPILE_ROWS + 5):
+        device.note_compile("test.flood", f"uint32[{i}]", 0.0001)
+    snap = device.compile_snapshot()
+    assert len(snap["table"]) <= device.MAX_COMPILE_ROWS + 1
+    other = [r for r in snap["table"] if r["signature"] == "<other>"]
+    assert other and other[0]["count"] >= 5
+
+
+def test_disabled_plane_is_inert(monkeypatch):
+    monkeypatch.setenv("MINIO_TPU_DEVICE_OBS", "0")
+    assert not device.enabled()
+    assert device.ledger_acquire("bulk", 1024) is None
+    device.ledger_release(None)       # None token round-trips
+    w = device.tracked_jit(lambda x: x + 1, op="test.off")
+    n0 = device.compiles_total()
+    w(np.arange(4, dtype=np.uint32))
+    assert device.compiles_total() == n0
+    device.note_device_time("encode", 0.5, 1 << 20)
+    assert device.roofline_snapshot() == {}
+
+
+# --------------------------------------------------------------------------
+# pillar 1: the per-lane live-buffer ledger (leak gate)
+
+
+def test_ledger_token_release_is_idempotent():
+    tok = device.ledger_acquire("bulk", 4096)
+    assert tok is not None
+    led = device.ledger_snapshot()["bulk"]
+    assert led["live_buffers"] == 1 and led["live_bytes"] == 4096
+    assert not device.ledger_balanced()
+    device.ledger_release(tok)
+    device.ledger_release(tok)        # double release: no underflow
+    led = device.ledger_snapshot()["bulk"]
+    assert led["live_buffers"] == 0 and led["live_bytes"] == 0
+    assert led["released_total"] == 1
+    assert device.ledger_balanced()
+
+
+def test_bulk_dispatch_balances_ledger_and_feeds_roofline(monkeypatch):
+    monkeypatch.setenv("MINIO_TPU_DISPATCH_MODE", "device")
+    q = DispatchQueue(max_batch=8, max_delay=0.002)
+    try:
+        codec = get_codec(4, 2)
+        futs, datas = [], []
+        for i in range(6):
+            d = np.random.default_rng(i).integers(
+                0, 256, (4, 512), dtype=np.uint8)
+            datas.append(d)
+            futs.append(q.encode(codec, pack_shards(d)))
+        for d, f in zip(datas, futs):
+            np.testing.assert_array_equal(
+                unpack_shards(f.result(timeout=30)), codec.encode(d))
+    finally:
+        q.stop()
+    lanes = device.ledger_snapshot()
+    # single-device hosts charge the bulk lane; the suite's 8-virtual-
+    # device conftest topology mesh-shards bulk flushes, so the charge
+    # lands on "mesh" — either way it is NOT the interactive lane
+    led_tot = {k: lanes["bulk"][k] + lanes["mesh"][k]
+               for k in lanes["bulk"]}
+    assert lanes["interactive"]["acquired_total"] == 0
+    assert led_tot["acquired_total"] >= 1
+    assert led_tot["released_total"] == led_tot["acquired_total"]
+    assert led_tot["peak_bytes"] > 0 and led_tot["peak_buffers"] >= 1
+    # THE leak gate: a drained pipeline holds zero live device buffers
+    assert device.ledger_balanced()
+    roof = device.roofline_snapshot()
+    assert "encode" in roof
+    row = roof["encode"]
+    assert row["device_seconds"] > 0 and row["flushes"] >= 1
+    assert row["achieved_gibs"] > 0
+    assert row["ceiling_gibs"] == pytest.approx(
+        device.DEFAULT_ROOFLINE_ENCODE_GIBS)
+    assert row["roofline_ratio"] > 0
+    assert row["roofline_ratio"] == pytest.approx(
+        row["achieved_gibs"] / row["ceiling_gibs"], rel=1e-2)
+
+
+def test_interactive_and_donated_paths_charge_their_lane(monkeypatch):
+    monkeypatch.setenv("MINIO_TPU_DISPATCH_MODE", "device")
+    monkeypatch.setenv("MINIO_TPU_DISPATCH_INTERACTIVE_DONATE", "1")
+    q = DispatchQueue(max_batch=64, max_delay=0.005)
+    try:
+        codec = get_codec(4, 2)
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")   # donation no-ops on cpu
+            futs, fulls = [], []
+            for i in range(4):
+                words, masks, full, lost = _rebuild_case(codec,
+                                                         seed=30 + i)
+                futs.append(q.masked(codec, words, masks))
+                fulls.append((full, lost))
+            for f, (full, lost) in zip(futs, fulls):
+                np.testing.assert_array_equal(
+                    unpack_shards(f.result(timeout=30))[0], full[lost])
+        assert q.stats()["interactive_lane"]["items"] == 4
+    finally:
+        q.stop()
+    led = device.ledger_snapshot()
+    ia = led["interactive"]
+    assert ia["acquired_total"] >= 1
+    assert ia["released_total"] == ia["acquired_total"]
+    assert ia["donated_total"] >= 1       # the donated kernel was live
+    assert led["bulk"]["acquired_total"] == 0
+    assert device.ledger_balanced()
+    assert "reconstruct" in device.roofline_snapshot()
+
+
+def test_ledger_balances_through_cpu_salvage(monkeypatch):
+    """An injected device fault reroutes the whole flush to the CPU
+    executor before any launch — the run must leak NO live-buffer
+    charge: whatever was acquired is released and the gate is green."""
+    monkeypatch.setenv("MINIO_TPU_DISPATCH_MODE", "device")
+    rid = fault.arm("kernel:device:masked:error(FaultyDisk)")
+    q = DispatchQueue(max_batch=64, max_delay=0.005)
+    try:
+        codec = get_codec(4, 2)
+        futs, fulls = [], []
+        for i in range(3):
+            words, masks, full, lost = _rebuild_case(codec, seed=50 + i)
+            futs.append(q.masked(codec, words, masks))
+            fulls.append((full, lost))
+        for f, (full, lost) in zip(futs, fulls):
+            np.testing.assert_array_equal(
+                unpack_shards(f.result(timeout=30))[0], full[lost])
+        assert q.stats()["cpu_items"] == 3    # everything salvaged
+    finally:
+        fault.disarm(rid)
+        q.stop()
+    for lane, led in device.ledger_snapshot().items():
+        assert led["released_total"] == led["acquired_total"], lane
+    assert device.ledger_balanced()
+
+
+def test_ledger_released_when_readback_unwinds(monkeypatch):
+    """The finally contract on _complete (the readback-salvage cover):
+    even when _finish_readback dies outright, the flush's ledger token
+    is released and the device-seconds estimate still charges."""
+    q = DispatchQueue(max_batch=8, max_delay=0.002)
+    try:
+        tok = device.ledger_acquire("interactive", 4096)
+
+        class _B:
+            op = "masked"
+            stream = qos.STREAM_INTERACTIVE
+
+        def boom(*_a, **_k):
+            raise RuntimeError("readback died")
+
+        monkeypatch.setattr(q, "_finish_readback", boom)
+        with pytest.raises(RuntimeError):
+            q._complete(_B(), None, [], accounted=False, qbytes=4096,
+                        t0=time.monotonic() - 0.01, tok=tok)
+        assert device.ledger_balanced()
+        assert "reconstruct" in device.roofline_snapshot()
+    finally:
+        q.stop()
+
+
+def test_host_bufpool_mirror_counts():
+    from minio_tpu.runtime.bufpool import global_pool
+    pool = global_pool()
+    st0 = device.status()["host_bufpool"]
+    arr = pool.get(1 << 20)       # above MIN_POOLED: the hook fires
+    st1 = device.status()["host_bufpool"]
+    assert st1["acquired_total"] == st0["acquired_total"] + 1
+    assert st1["live_bytes"] >= 1 << 20
+    pool.put(arr)
+    st2 = device.status()["host_bufpool"]
+    assert st2["released_total"] == st1["released_total"] + 1
+    assert st2["peak_bytes"] >= 1 << 20
+
+
+# --------------------------------------------------------------------------
+# THE steady-state oracle (tier-1): zero compiles after warm-up
+
+
+def test_zero_steady_state_compiles_mixed_workload(monkeypatch):
+    """Warmed steady state over BOTH lanes and all six dispatch ops
+    (encode, reconstruct, encode+hash, fused verify, select_scan,
+    sse_xor): the second pass re-runs identical shapes and the compile
+    counters — the new oracle — must not move. A nonzero delta means a
+    kernel shape leaked past its warm-up onto the hot path."""
+    from minio_tpu.crypto.chacha20poly1305 import keystream_xor
+    from minio_tpu.ops.scan_pallas import scan_blocks_reference
+    monkeypatch.setenv("MINIO_TPU_DISPATCH_MODE", "device")
+    q = DispatchQueue(max_batch=8, max_delay=0.002)
+    rng = np.random.default_rng(11)
+    codec = get_codec(4, 2)
+    hkey = b"k" * 32
+    program, cols, delim, max_rows, L = (("num", 0, "gt", 500),), \
+        (1,), 44, 64, 4096
+    buf = np.full(L, 10, np.uint8)
+    body = b"7,900\n1,100\n"
+    buf[:len(body)] = np.frombuffer(body, np.uint8)
+    ckey = bytes(rng.integers(0, 256, 32, dtype=np.uint8))
+    nonces = np.stack([np.array([1, 2, s], np.uint32) for s in range(2)])
+    sse_data = rng.integers(0, 256, (2, 64), dtype=np.uint8)
+
+    def one_pass():
+        d = rng.integers(0, 256, (4, 512), dtype=np.uint8)
+        words = pack_shards(d)
+        # bulk lane: encode + encode_hashed
+        np.testing.assert_array_equal(
+            unpack_shards(q.encode(codec, words).result(timeout=60)),
+            codec.encode(d))
+        q.encode_hashed(codec, words, hkey, 512).result(timeout=60)
+        # interactive lane: masked rebuild + fused verify-rebuild
+        mwords, masks, full, lost = _rebuild_case(codec, seed=77)
+        np.testing.assert_array_equal(
+            unpack_shards(q.masked(codec, mwords,
+                                   masks).result(timeout=60))[0],
+            full[lost])
+        digests = np.zeros((4, (512 * 4 // 512) * 8), np.uint32)
+        q.fused(codec, mwords, masks, digests, hkey,
+                512).result(timeout=60)
+        # device workloads: Select scan + SSE package crypto
+        got = np.asarray(q.select_scan(
+            buf.view("<u4").reshape(1, -1), program, cols, delim,
+            max_rows).result(timeout=180)).reshape(-1)
+        np.testing.assert_array_equal(
+            got, scan_blocks_reference(buf.reshape(1, -1), program,
+                                       cols, delim, max_rows)[0])
+        ct, _pk = q.sse_xor(np.ascontiguousarray(sse_data).view("<u4"),
+                            ckey, nonces).result(timeout=180)
+        want_ct, _ = keystream_xor(ckey, nonces, sse_data)
+        np.testing.assert_array_equal(
+            np.ascontiguousarray(ct).view(np.uint8), want_ct)
+
+    try:
+        one_pass()                     # warm-up: compiles are expected
+        n0 = device.compiles_total()
+        one_pass()                     # steady state: same shapes
+        one_pass()
+        assert device.compiles_total() == n0, (
+            "steady-state compiles detected:\n"
+            + "\n".join(f"{r['op']} {r['signature']} x{r['count']}"
+                        for r in device.compile_snapshot()["table"]))
+    finally:
+        q.stop()
+    assert device.ledger_balanced()
+
+
+# --------------------------------------------------------------------------
+# device memory snapshots + trace sessions
+
+
+def test_device_memory_rows_on_live_backend():
+    import jax
+    jax.numpy.zeros(8).block_until_ready()    # backend is live
+    rows = device.device_memory(touch=True)
+    assert rows and all("id" in r and "platform" in r for r in rows)
+    # CPU backends expose no memory_stats: rows stay, byte fields are
+    # absent and the LEDGER is the authoritative fallback
+    assert device.device_memory(touch=False) == rows
+
+
+def test_capture_trace_bounds_and_single_session():
+    out = device.capture_trace(0.05)
+    assert out.get("error") or out["files"], out
+    if "logdir" in out:
+        assert out["seconds"] >= 0.05
+        import shutil
+        shutil.rmtree(out["logdir"], ignore_errors=True)
+    # one session at a time
+    with device._lock:
+        device._trace_busy = True
+    try:
+        assert "already running" in device.capture_trace(0.05)["error"]
+    finally:
+        with device._lock:
+            device._trace_busy = False
+
+
+# --------------------------------------------------------------------------
+# status / admin / metrics surfaces
+
+
+def test_status_shape_and_reset():
+    device.note_compile("test.s", "uint32[4]", 0.02)
+    tok = device.ledger_acquire("mesh", 2048)
+    st = device.status()
+    assert set(st) == {"enabled", "ledger", "ledger_balanced",
+                       "host_bufpool", "compile", "roofline",
+                       "device_memory"}
+    assert st["enabled"] is True
+    assert set(st["ledger"]) == {"bulk", "interactive", "mesh"}
+    assert st["ledger"]["mesh"]["live_buffers"] == 1
+    assert st["ledger_balanced"] is False
+    device.ledger_release(tok)
+    device.reset()
+    st = device.status()
+    assert st["compile"]["compiles_total"] == 0
+    assert st["ledger_balanced"] is True
+
+
+@pytest.fixture
+def srv(tmp_path):
+    from minio_tpu.objectlayer import ErasureObjects
+    from minio_tpu.server import S3Server
+    from minio_tpu.storage import XLStorage
+    obj = ErasureObjects([XLStorage(str(tmp_path / f"d{i}"))
+                          for i in range(4)], default_parity=2)
+    server = S3Server(obj, "127.0.0.1", 0, access_key=AK, secret_key=SK)
+    server.start_background()
+    yield server
+    server.shutdown()
+
+
+def test_admin_device_endpoint_and_madmin(srv):
+    from minio_tpu.madmin import AdminClient
+    device.note_compile("test.admin", "uint32[2,4]", 0.05)
+    c = S3Client(srv.endpoint(), AK, SK)
+    r = c.request("GET", "/minio/admin/v3/device")
+    assert r.status_code == 200
+    rep = r.json()
+    assert rep["enabled"] is True
+    assert {"bulk", "interactive", "mesh"} <= set(rep["ledger"])
+    assert any(row["op"] == "test.admin"
+               for row in rep["compile"]["table"])
+    # the explicit admin query MAY initialize a backend: rows appear
+    assert isinstance(rep["device_memory"], list)
+    # madmin SDK round-trip
+    adm = AdminClient(srv.endpoint(), AK, SK)
+    rep2 = adm.device_status()
+    assert rep2["compile"]["compiles_total"] == \
+        rep["compile"]["compiles_total"]
+    # bad trace query is a 400, not a 500
+    r = c.request("GET", "/minio/admin/v3/device",
+                  query={"trace": "notanumber"})
+    assert r.status_code == 400
+
+
+def test_metrics_family_renders(srv, monkeypatch):
+    monkeypatch.setenv("MINIO_TPU_DISPATCH_MODE", "device")
+    q = DispatchQueue(max_batch=8, max_delay=0.002)
+    try:
+        codec = get_codec(4, 2)
+        d = np.random.default_rng(0).integers(
+            0, 256, (4, 512), dtype=np.uint8)
+        q.encode(codec, pack_shards(d)).result(timeout=30)
+    finally:
+        q.stop()
+    c = S3Client(srv.endpoint(), AK, SK)
+    text = c.http.get(srv.endpoint() + "/minio/v2/metrics/node").text
+    assert "minio_tpu_device_obs_enabled 1" in text
+    assert 'minio_tpu_device_hbm_used{lane="bulk"}' in text
+    assert 'minio_tpu_device_hbm_peak{lane="bulk"}' in text
+    assert 'minio_tpu_device_obs_ledger_acquired_total{lane="bulk"}' \
+        in text
+    assert "minio_tpu_device_obs_compiles_total" in text
+    assert "minio_tpu_device_obs_compile_seconds_total" in text
+    assert 'minio_tpu_kernel_roofline_ratio{op="encode"}' in text
+    assert 'minio_tpu_device_seconds_total{op="encode"}' in text
+    assert "minio_tpu_device_obs_host_buf_bytes" in text
+
+
+def test_config_subsystem_dynamic_roofline(monkeypatch):
+    """device_obs rides the dynamic config KVS: a stored roofline
+    re-pin (operators calibrate on their own part) takes effect without
+    restart, via the on_apply cache invalidation."""
+    from minio_tpu.config import get_config_sys
+    from minio_tpu.qos.budget import _cfg_cache
+    assert device.roofline_gibs("encode") == pytest.approx(
+        device.DEFAULT_ROOFLINE_ENCODE_GIBS)
+    cs = get_config_sys()
+    old = cs.get("device_obs", "roofline_encode_gibs")
+    try:
+        cs.set("device_obs", "roofline_encode_gibs", "250")
+        _cfg_cache.clear()            # TTL cache: apply path clears it
+        assert device.roofline_gibs("encode") == 250.0
+        device.note_device_time("encode", 1.0, 250 << 30)
+        assert device.roofline_snapshot()["encode"][
+            "roofline_ratio"] == pytest.approx(1.0, rel=0.01)
+    finally:
+        cs.set("device_obs", "roofline_encode_gibs", old or "179")
+        _cfg_cache.clear()

@@ -539,6 +539,34 @@ def test_cluster_profile_fanout(cluster):
     assert len(endpoints) >= 2, endpoints
 
 
+def test_cluster_device_fanout(cluster):
+    """`GET /minio/admin/v3/device?peers=1` (ISSUE 16): the device
+    plane aggregated across dist nodes via the new `devicestatus` peer
+    RPC — one row per node, each carrying the lane ledger, compile
+    table and roofline maps."""
+    n0, _ = cluster
+    from minio_tpu.madmin import AdminClient
+    from minio_tpu.obs import device
+    device.note_compile("test.fanout", "uint32[8]", 0.01)
+    adm = AdminClient(f"http://127.0.0.1:{n0.server.port}", AK, SK)
+    rep = adm.device_status(peers=True)
+    nodes = rep["nodes"]
+    assert len(nodes) >= 2, nodes
+    ok = [n for n in nodes if "error" not in n]
+    assert len(ok) >= 2, nodes
+    for n in ok:
+        assert n.get("endpoint"), n
+        assert {"bulk", "interactive", "mesh"} <= set(n["ledger"])
+        assert "compile" in n and "roofline" in n
+        assert isinstance(n["ledger_balanced"], bool)
+    endpoints = {n["endpoint"] for n in ok}
+    assert len(endpoints) >= 2, endpoints
+    # both dist nodes run in THIS process, so the local note_compile
+    # shows on the local row (the row whose endpoint answered)
+    assert any(any(r["op"] == "test.fanout"
+                   for r in n["compile"]["table"]) for n in ok)
+
+
 def test_cluster_health_snapshot(cluster):
     """`GET /minio/admin/v3/health` aggregates the node health snapshot
     (disk states, lane utilization, QoS saturation, heal backlog, SLO

@@ -901,3 +901,104 @@ def test_gl016_registered_and_baseline_empty():
     assert checkers.check_thread_names in checkers.PER_FILE
     assert graftlint.load_baseline() == {}, \
         "GL016 must hold with an EMPTY baseline"
+
+# --------------------------------------------------------------------------
+# GL017 — every compile site routes through obs.device.tracked_jit
+
+
+def test_gl017_untracked_jit_flagged():
+    ctx = ctx_for("""
+        import functools
+        import jax
+
+        def build(fn):
+            w = jax.jit(fn, static_argnames=("interpret",))
+            return w
+
+        @jax.jit
+        def bare(x):
+            return x
+
+        deco = functools.partial(jax.jit, donate_argnums=(0,))
+    """)
+    found = checkers.check_tracked_compiles(ctx)
+    kinds = sorted(f.token for f in found)
+    assert [f.checker for f in found] == ["GL017"] * 3
+    assert kinds == ["jax.jit", "jax.jit", "partial(jax.jit)"]
+    assert any(f.scope == "build" for f in found)
+    assert all("tracked_jit" in f.message for f in found)
+
+
+def test_gl017_untracked_pallas_call_flagged():
+    ctx = ctx_for("""
+        from jax.experimental import pallas as pl
+
+        def kernel_builder(spec):
+            return pl.pallas_call(kern, out_shape=spec)
+    """)
+    found = checkers.check_tracked_compiles(ctx)
+    assert [f.checker for f in found] == ["GL017"]
+    assert found[0].scope == "kernel_builder"
+
+
+def test_gl017_wrapper_module_and_registry_exempt():
+    # the wrapper module itself holds the one sanctioned jax.jit
+    src = """
+        import jax
+        def _build(fn):
+            return jax.jit(fn)
+    """
+    assert not checkers.check_tracked_compiles(
+        ctx_for(src, path="minio_tpu/obs/device.py"))
+    # pallas_call inside a registered tracked-jit scope is sanctioned
+    pallas = """
+        from jax.experimental import pallas as pl
+
+        def gf_matmul_pallas(a, b, interpret=False):
+            return pl.pallas_call(kern, out_shape=shp)(a, b)
+    """
+    assert not checkers.check_tracked_compiles(
+        ctx_for(pallas, path="minio_tpu/ops/rs_pallas.py"))
+    # ...but the SAME site in an unregistered scope is a finding
+    moved = pallas.replace("gf_matmul_pallas", "new_unreviewed_kernel")
+    assert checkers.check_tracked_compiles(
+        ctx_for(moved, path="minio_tpu/ops/rs_pallas.py"))
+    # out-of-scope paths (tools/, tests/) are never checked
+    assert not checkers.check_tracked_compiles(
+        ctx_for(src, path="tools/bench_helper.py"))
+
+
+def test_gl017_tracked_sites_ok():
+    ctx = ctx_for("""
+        import functools
+        from ..obs.device import tracked_jit
+
+        def build(fn):
+            return tracked_jit(fn, op="xla.gf_matmul")
+
+        @functools.partial(tracked_jit, op="pallas.encode",
+                           static_argnames=("interpret",))
+        def run(words):
+            return words
+    """)
+    assert not checkers.check_tracked_compiles(ctx)
+
+
+def test_gl017_registered_and_baseline_empty():
+    """GL017 is an active PER_FILE checker (so test_tree_is_clean
+    proves every live compile site in the shipped tree routes through
+    tracked_jit or a reviewed registry entry) with an EMPTY baseline —
+    no grandfathered untracked compiles."""
+    assert checkers.check_tracked_compiles in checkers.PER_FILE
+    assert graftlint.load_baseline() == {}, \
+        "GL017 must hold with an EMPTY baseline"
+    # the registry only names scopes that actually exist in the tree
+    for relpath, scopes in checkers._GL017_PALLAS_SCOPES.items():
+        ctx = graftlint.parse_file(
+            os.path.join(graftlint.REPO_ROOT, relpath))
+        assert ctx is not None, relpath
+        for s in scopes:
+            leaf = s.rsplit(".", 1)[-1]
+            assert any(isinstance(n, ast.FunctionDef) and
+                       n.name == leaf for n in ast.walk(ctx.tree)), \
+                f"{relpath}: registered scope {s} no longer exists"

@@ -52,6 +52,12 @@ text — nothing in the checked tree is imported.
 |       | passes a ``name=`` — the continuous profiler's thread-role   |
 |       | classification (``obs/profiler.py``) keys on thread names,   |
 |       | and an unnamed thread can only ever classify as "other"      |
+| GL017 | every ``jax.jit`` / ``pl.pallas_call`` construction under    |
+|       | minio_tpu/ routes through the device plane's tracked-compile |
+|       | wrapper (``obs/device.tracked_jit``) or carries an explicit  |
+|       | registry/pragma exemption — compile counting (and the        |
+|       | compile-storm detector riding it) must not silently lose     |
+|       | coverage as new ops land                                     |
 """
 from __future__ import annotations
 
@@ -1249,6 +1255,86 @@ def check_thread_names(ctx: FileCtx) -> list[Finding]:
     return out
 
 
+# --------------------------------------------------------------------------
+# GL017 — every compile site routes through the tracked-jit wrapper
+
+
+#: the wrapper's own module: the ONE sanctioned jax.jit construction
+#: site, exempt by construction
+_GL017_WRAPPER_MODULE = "minio_tpu/obs/device.py"
+#: pallas_call registry: the kernels live INSIDE tracked-jit-compiled
+#: functions (the enclosing jit wrapper is the counted compile unit, so
+#: the inner pallas_call can never compile untracked) — path ->
+#: sanctioned enclosing-scope qualnames. A pallas_call anywhere else is
+#: a finding until its scope is registered here (a reviewed decision,
+#: like GL010's _HOT_PATH_FUNCS) or pragma-suppressed.
+_GL017_PALLAS_SCOPES: dict[str, tuple[str, ...]] = {
+    "minio_tpu/ops/rs_pallas.py": (
+        "gf_matmul_pallas", "_gf_matmul_batched", "_static_call.mm",
+        "_static_batch_call.mm"),
+    "minio_tpu/ops/scan_pallas.py": ("scan_fn_for.run",),
+    "minio_tpu/ops/chacha_pallas.py": ("_jitted.run",
+                                       "multi_fn_for.run"),
+    "minio_tpu/ops/mur3_pallas.py": ("_jitted.run",),
+}
+_GL017_JIT_NAMES = {"jax.jit", "jit"}
+
+
+def _gl017_finding(ctx: FileCtx, lineno: int, what: str,
+                   token: str) -> Finding:
+    return Finding(
+        ctx.path, lineno, "GL017",
+        f"untracked compile site {what} — route it through "
+        "obs.device.tracked_jit so the device plane counts and times "
+        "the compilation (or register/suppress the site explicitly)",
+        token=token, scope=ctx.scope_at(lineno))
+
+
+def check_tracked_compiles(ctx: FileCtx) -> list[Finding]:
+    """GL017: any ``jax.jit(...)`` call, ``functools.partial(jax.jit,
+    ...)`` configuration, bare ``@jax.jit`` decorator, or
+    ``pl.pallas_call(...)`` under ``minio_tpu/`` that is not the
+    wrapper module itself is a finding — except pallas_call sites whose
+    enclosing scope is registered in ``_GL017_PALLAS_SCOPES`` (kernels
+    compiled inside a tracked-jit function)."""
+    if not ctx.path.startswith("minio_tpu/") or \
+            ctx.path == _GL017_WRAPPER_MODULE:
+        return []
+    out = []
+    for node in ast.walk(ctx.tree):
+        # bare @jax.jit decorators are Attribute/Name nodes, not Calls
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                if not isinstance(dec, ast.Call) and \
+                        dotted(dec) in _GL017_JIT_NAMES:
+                    out.append(_gl017_finding(
+                        ctx, dec.lineno, f"@{dotted(dec)} decorator",
+                        dotted(dec)))
+            continue
+        if not isinstance(node, ast.Call):
+            continue
+        d = dotted(node.func)
+        if d in _GL017_JIT_NAMES:
+            out.append(_gl017_finding(ctx, node.lineno, f"{d}(...)", d))
+            continue
+        if d.rsplit(".", 1)[-1] == "partial" and node.args and \
+                dotted(node.args[0]) in _GL017_JIT_NAMES:
+            out.append(_gl017_finding(
+                ctx, node.lineno, "functools.partial(jax.jit, ...)",
+                "partial(jax.jit)"))
+            continue
+        if d.rsplit(".", 1)[-1] == "pallas_call":
+            scope = ctx.scope_at(node.lineno)
+            allowed = _GL017_PALLAS_SCOPES.get(ctx.path, ())
+            if scope in allowed or any(
+                    scope.startswith(a + ".") for a in allowed):
+                continue
+            out.append(_gl017_finding(
+                ctx, node.lineno, f"{d}(...) outside the registered "
+                "tracked-jit scopes", d))
+    return out
+
+
 PER_FILE = [
     check_wall_duration,
     check_blocking_under_lock,
@@ -1265,5 +1351,6 @@ PER_FILE = [
     check_dist_rpc_bounds,
     check_interactive_blocking,
     check_thread_names,
+    check_tracked_compiles,
 ]
 PROJECT = [check_metrics_documented]

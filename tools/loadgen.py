@@ -690,6 +690,12 @@ class LoadGen:
             # 1-core host)
             from minio_tpu.obs import profiler as _prof
             run_snap = _prof.agg_snapshot()
+            # steady-state compile oracle (ISSUE 16): every kernel the
+            # measured phase needs must already be compiled — preload
+            # plus the probe are the warm-up, so any compile counted
+            # past this point is a shape leak on the hot path
+            from minio_tpu.obs import device as _dev
+            compiles0 = _dev.compiles_total()
             deadline = rec.t0 + profile.duration_s
             ths = self._closed_loop(profile, rec, deadline, body)
             open_t = self._open_loop(profile, rec, deadline, body)
@@ -739,7 +745,8 @@ class LoadGen:
             return self._report(profile, rec, wall_s, preload_s,
                                 scanner_win, probe, lockrank_before,
                                 chaos, degraded,
-                                _prof.delta_report(run_snap))
+                                _prof.delta_report(run_snap),
+                                compiles0)
         finally:
             # the armed disk-kill rule is PROCESS-WIDE state: a failure
             # anywhere in the measured phase must not leave every later
@@ -769,7 +776,8 @@ class LoadGen:
                 lockrank_before: int | None,
                 chaos: dict | None = None,
                 degraded: dict | None = None,
-                run_prof=None) -> dict:
+                run_prof=None,
+                compiles0: int | None = None) -> dict:
         from minio_tpu.obs import slo
         from minio_tpu.obs.health import cluster_snapshot
         rows = rec.snapshot()
@@ -903,6 +911,16 @@ class LoadGen:
                 chaos.get("heal_drained", False)
             verdicts["background_slo_availability_ok"] = \
                 not bg_breach.get("availability", False)
+        if compiles0 is not None and not degraded and not chaos:
+            # steady-state compile oracle (ISSUE 16): zero compiles in
+            # the measured phase — a positive delta means a kernel
+            # shape the warm-up never saw landed on the hot path.
+            # Skipped for degraded/chaos runs: their mid-run fault
+            # pivots (first reconstruct, rejoin heal) legitimately
+            # compile fresh kernels
+            from minio_tpu.obs import device as _dev
+            steady_compiles = _dev.compiles_total() - compiles0
+            verdicts["no_steady_state_compiles"] = steady_compiles == 0
         verdicts["passed"] = all(verdicts.values())
         return {
             "profile": {
