@@ -983,6 +983,11 @@ def scale_slo_extra() -> dict:
                                         "6")),
         open_rps=float(os.environ.get("MINIO_TPU_SCALE_OPEN_RPS",
                                       "50")),
+        # multi-tenant spread (ISSUE 18): 48 buckets against the
+        # default top_n=32 registry forces real folding, so the
+        # bucket_metrics_bounded_ok verdict gates on a scrape that
+        # actually had to bound itself
+        buckets=int(os.environ.get("MINIO_TPU_SCALE_BUCKETS", "48")),
     )
     with tempfile.TemporaryDirectory(prefix="bench-slo-") as root:
         rep = run_tier1_profile(root, profile)
@@ -1141,6 +1146,52 @@ def device_obs_extra() -> dict:
     }}
 
 
+def bucket_stats_extra() -> dict:
+    """Per-bucket analytics scrape cost (ISSUE 18): the registry folds
+    past ``top_n`` buckets, so a 4096-bucket storm must render in about
+    the same wall time (and the same series count) as 16 buckets — the
+    acceptance bound is scrape_4096 <= 2x scrape_16. Driven directly
+    against the registry (the s3api charge path is one dict update on
+    top of this), then reset so the synthetic storm leaves no trace in
+    later extras."""
+    import time as _t
+
+    from minio_tpu.obs import bucketstats as bstats
+
+    def drive(n: int) -> tuple[float, int, dict]:
+        bstats.reset()
+        for i in range(n):
+            bstats.record_request(
+                f"bench-{i:05d}", "getobject", 200, 0.002,
+                ttfb_s=0.0005, bytes_in=128, bytes_out=4096)
+        best = float("inf")
+        for _ in range(5):
+            t0 = _t.perf_counter()
+            lines = bstats.metric_lines()
+            best = min(best, (_t.perf_counter() - t0) * 1e3)
+        labels = {ln.split('bucket="', 1)[1].split('"', 1)[0]
+                  for ln in lines if 'bucket="' in ln}
+        rep = bstats.report()
+        return best, len(labels), rep
+
+    ms16, labels16, _ = drive(16)
+    ms4096, labels4096, rep = drive(4096)
+    bstats.reset()
+    out = {
+        "scrape_16_ms": round(ms16, 3),
+        "scrape_4096_ms": round(ms4096, 3),
+        "scrape_scaling_overhead": round(ms4096 / max(ms16, 1e-9), 2),
+        "series_labels": labels4096,
+        "tracked": rep["tracked"],
+        "fold_hits": rep["folds"],
+    }
+    log(f"bucket_stats: scrape 16={out['scrape_16_ms']}ms "
+        f"4096={out['scrape_4096_ms']}ms "
+        f"(x{out['scrape_scaling_overhead']}), "
+        f"labels {labels16}->{labels4096}, folds {rep['folds']}")
+    return {"bucket_stats": out}
+
+
 def main() -> None:
     chaos = "--chaos" in sys.argv[1:]
     rng = np.random.default_rng(0)
@@ -1174,6 +1225,9 @@ def main() -> None:
     # device-plane ledger/compile/roofline accumulated over the whole
     # run — snapshot after every config has dispatched (ISSUE 16)
     dev_obs = device_obs_extra()
+    # per-bucket analytics scrape cost, AFTER the loadgen extras so the
+    # synthetic 4096-bucket storm can reset the registry freely (ISSUE 18)
+    bucket_stats = bucket_stats_extra()
 
     enc = dev["encode_16p4_1MiB_b128"]
     extra_chaos = {"chaos": cha} if cha is not None else {}
@@ -1211,6 +1265,7 @@ def main() -> None:
             **node_chaos,      # 4-node kill/heal topology (ISSUE 12)
             **tl,     # flight-recorder timeline + attribution (ISSUE 9)
             **dev_obs,   # HBM ledger + compile + roofline (ISSUE 16)
+            **bucket_stats,  # bounded per-bucket scrape cost (ISSUE 18)
             **extra_chaos,                        # --chaos degraded run
         },
     })

@@ -398,6 +398,24 @@ SUB_SYSTEMS: dict[str, dict[str, KV]] = {
         "format": KV("namespace", env="MINIO_TPU_NOTIFY_MYSQL_FORMAT",
                      help="namespace|access"),
     },
+    "bucketstats": {
+        "enable": KV("1", env="MINIO_TPU_BUCKETSTATS",
+                     help="per-bucket analytics registry "
+                          "(obs/bucketstats.py); 0 stops charging and "
+                          "folds every label to _overflow_"),
+        "top_n": KV(
+            "32", env="MINIO_TPU_BUCKETSTATS_TOP_N",
+            help="max tracked buckets — everything beyond folds into "
+                 "the _overflow_ row, bounding metric cardinality"),
+        "fold_idle_cycles": KV(
+            "4", env="MINIO_TPU_BUCKETSTATS_FOLD_IDLE_CYCLES",
+            help="scanner cycles a tracked bucket may stay idle "
+                 "before its slot is evicted back to the pool"),
+        "history_samples": KV(
+            "288", env="MINIO_TPU_BUCKETSTATS_HISTORY_SAMPLES",
+            help="persisted usage snapshots kept for the 1h/24h "
+                 "capacity projection windows"),
+    },
     "notify_postgres": {
         "enable": KV("off", env="MINIO_TPU_NOTIFY_POSTGRES_ENABLE"),
         "address": KV("", env="MINIO_TPU_NOTIFY_POSTGRES_ADDRESS",
@@ -417,7 +435,7 @@ SUB_SYSTEMS: dict[str, dict[str, KV]] = {
 #: an apply callback.
 DYNAMIC = {"api", "scanner", "heal", "dispatch", "bitrot", "qos", "fault",
            "durability", "pipeline", "workloads", "timeline", "slo",
-           "profiler", "device_obs"}
+           "profiler", "device_obs", "bucketstats"}
 
 
 class ConfigSys:

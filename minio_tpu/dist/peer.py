@@ -152,6 +152,12 @@ class PeerRESTClient:
         ``device?peers=1`` aggregation fans this out."""
         return json.loads(self.rpc.call("devicestatus"))
 
+    def bucket_stats(self) -> dict:
+        """The peer's per-bucket analytics report (obs/bucketstats):
+        bounded per-bucket request/traffic/latency/usage numbers — the
+        admin ``bucketstats?peers=1`` aggregation fans this out."""
+        return json.loads(self.rpc.call("bucketstats"))
+
 
 def _stream_pubsub(pubsub, timeout_s: float, count: int, to_dict=None):
     """Generator of NDJSON event lines from a live pubsub subscription,
@@ -306,6 +312,11 @@ class PeerRESTService:
         if method == "devicestatus":
             from ..obs import device
             rep = device.status(touch_backend=True)
+            rep["endpoint"] = self.node.local_url
+            return json.dumps(rep).encode()
+        if method == "bucketstats":
+            from ..obs import bucketstats
+            rep = bucketstats.report()
             rep["endpoint"] = self.node.local_url
             return json.dumps(rep).encode()
         from ..utils import errors

@@ -155,6 +155,10 @@ class EventNotifier:
         self.stores: dict[str, QueueStore] = {}
         self.targets: dict[str, object] = {}
         self.queue_limit = queue_limit
+        #: targets whose queue-full drop has been logged once — a full
+        #: queue under load would otherwise emit one warning PER EVENT
+        #: on the request path (the drop counters carry the volume)
+        self._drop_logged: set[str] = set()
         for t in targets:
             self.targets[t.arn] = t
             self.stores[t.arn] = QueueStore(
@@ -217,8 +221,20 @@ class EventNotifier:
             for arn in arns:
                 store = self.stores.get(arn)
                 if store is not None and not store.put(record):
-                    log.warning("event queue full for %s; dropping event",
-                                arn)
+                    if arn not in self._drop_logged:
+                        self._drop_logged.add(arn)
+                        log.warning(
+                            "event queue full for %s; dropping (further "
+                            "drops counted, not logged)", arn)
+                    # every drop path exports a counter — the store's
+                    # failed_puts rides the notification group too, but
+                    # this one survives store replacement/restart
+                    try:
+                        from ..obs import metrics as mx
+                        mx.inc("minio_tpu_notify_events_dropped_total",
+                               target=arn)
+                    except Exception:  # noqa: BLE001 — obs shielded
+                        pass
         # live listeners (ListenBucketNotification): independent of any
         # stored config — the filters came with the listening request
         with self._listen_lock:
@@ -232,7 +248,11 @@ class EventNotifier:
             try:
                 sub.q.put_nowait(record)
             except queue.Full:  # slow consumer: drop, never block PUTs
-                pass
+                try:
+                    from ..obs import metrics as mx
+                    mx.inc("minio_tpu_notify_listener_dropped_total")
+                except Exception:  # noqa: BLE001 — obs shielded
+                    pass
 
     # -- live listen channels (reference ListenBucketNotificationHandler,
     # cmd/bucket-notification-handlers.go: an HTTP stream fed straight

@@ -215,9 +215,20 @@ class AdminClient:
     def slo_report(self) -> dict:
         """The standing per-class SLO verdict report: objectives,
         5m/1h window compliance, error-budget burn rates, breach
-        verdicts and worst-breach trace links (docs/observability.md
-        "SLO plane & health snapshot")."""
+        verdicts, per-bucket burn attribution and worst-breach trace
+        links (docs/observability.md "SLO plane & health snapshot")."""
         return self._json("GET", "slo")
+
+    def bucket_stats(self, peers: bool = False) -> dict:
+        """Per-bucket analytics report (`GET /minio/admin/v3/
+        bucketstats`, docs/observability.md "Per-bucket analytics"):
+        the bounded top-N registry's per-bucket request counts, traffic
+        bytes, TTFB/wall latency, live usage + reconcile drift, SLO
+        burn contribution and capacity projection. ``peers=True`` fans
+        out across dist nodes and returns ``{"nodes": [...]}`` with one
+        report per node."""
+        return self._json("GET", "bucketstats",
+                          {"peers": "1"} if peers else None)
 
     def list_config_history(self) -> list:
         return self._json("GET", "list-config-history")

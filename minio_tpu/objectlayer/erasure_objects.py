@@ -493,6 +493,11 @@ class ErasureObjects(MultipartMixin, ObjectLayer):
         global_tracker().mark(bucket, object)
         self.metacache.on_write(bucket)
         oi = ObjectInfo.from_file_info(fi, bucket, object, opts.versioned)
+        try:  # live usage delta, reconciled each scanner cycle
+            from ..obs import bucketstats as _bs
+            _bs.on_put(bucket, fi.size)
+        except Exception:  # noqa: BLE001 — obs must never fail a put
+            pass
         return oi
 
     def _arm_pipeline_etag(self, hr: HashReader, size: int,
@@ -744,6 +749,19 @@ class ErasureObjects(MultipartMixin, ObjectLayer):
 
         vid = "" if opts.version_id in ("", "null") else opts.version_id
         mark_delete = opts.versioned and not opts.version_id
+        # best-effort size of the doomed version BEFORE the quorum
+        # delete (the live usage delta can't read it afterwards); a miss
+        # charges 0 and the scanner reconcile zeroes the drift
+        del_size = 0
+        if not mark_delete:
+            try:
+                from ..obs import bucketstats as _bs
+                if _bs.enabled():
+                    del_size = self.get_object_info(
+                        bucket, object,
+                        ObjectOptions(version_id=vid)).size or 0
+            except Exception:  # noqa: BLE001 — already-gone object
+                del_size = 0
         if mark_delete:
             fi = FileInfo(volume=bucket, name=object,
                           version_id=FileInfo.new_version_id(), deleted=True,
@@ -784,6 +802,14 @@ class ErasureObjects(MultipartMixin, ObjectLayer):
         # started between the pre-bump and the quorum delete would have
         # captured the old namespace under the new sequence
         self.metacache.on_write(bucket)
+        try:  # live usage delta: a delete marker ADDS a version row
+            from ..obs import bucketstats as _bs
+            if mark_delete:
+                _bs.on_put(bucket, 0, versions=1, objects=0)
+            else:
+                _bs.on_delete(bucket, del_size)
+        except Exception:  # noqa: BLE001 — obs must never fail a delete
+            pass
         return ObjectInfo(bucket=bucket, name=object,
                           version_id=fi.version_id if opts.versioned else "",
                           delete_marker=fi.deleted, mod_time=fi.mod_time)

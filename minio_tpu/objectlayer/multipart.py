@@ -385,6 +385,11 @@ class MultipartMixin:
         from ..scanner.tracker import global_tracker
         global_tracker().mark(bucket, object)
         self.metacache.on_write(bucket)
+        try:  # live usage delta, reconciled each scanner cycle
+            from ..obs import bucketstats as _bs
+            _bs.on_put(bucket, fi.size)
+        except Exception:  # noqa: BLE001 — obs must never fail a commit
+            pass
         return ObjectInfo.from_file_info(fi, bucket, object, opts.versioned)
 
     def _commit_one_disk(self, d, upath: str, tmp_id: str, fi: FileInfo,

@@ -160,8 +160,18 @@ def _rollup(nodes: list[dict]) -> dict:
         for cls, ent in n.get("slo", {}).get("classes", {}).items():
             for kind, hit in ent.get("breach", {}).items():
                 if hit:
-                    breaches.append({"node": n.get("endpoint", ""),
-                                     "class": cls, "slo": kind})
+                    row = {"node": n.get("endpoint", ""),
+                           "class": cls, "slo": kind}
+                    # per-bucket burn attribution rides the slo report
+                    # (obs/bucketstats rings): the rollup names the
+                    # top offender so the cluster verdict points at a
+                    # tenant, not just a class
+                    tops = ent.get("top_buckets", {}).get(kind) or []
+                    if tops:
+                        row["top_bucket"] = tops[0].get("bucket", "")
+                        row["top_bucket_share"] = tops[0].get(
+                            "share", 0.0)
+                    breaches.append(row)
     disks_faulty = sum(1 for d in disks.values() if d["faulty"])
     return {
         "nodes": len(nodes),

@@ -1,32 +1,62 @@
 """Structured logging + audit plane (reference cmd/logger/: console and
 HTTP webhook targets, audit-webhook, logOnce dedup). Rides Python's
 logging for the console path; webhook targets get JSON lines through a
-bounded background sender so a dead endpoint never blocks a request."""
+bounded background sender so a dead endpoint never blocks a request.
+
+Zero silent drops: every place an entry can be lost increments an
+exported counter — ``minio_tpu_log_pubsub_dropped_total`` for slow
+console-stream subscribers (PubSub.publish's return value, which used to
+be discarded), ``minio_tpu_log_target_dropped_total`` /
+``minio_tpu_log_target_sent_total`` per webhook target (labelled
+``target="log"|"audit"``). A send failure gets ONE bounded retry with
+jittered backoff before counting as a drop — a single connect blip used
+to lose the entry outright.
+"""
 from __future__ import annotations
 
 import json
 import logging
 import os
 import queue
+import random
 import threading
 import time
 import urllib.request
 
 _console = logging.getLogger("minio_tpu")
 
+#: one retry after a failed POST, backed off by this base ± jitter —
+#: bounded so a dead endpoint still drains the queue at ~2 entries/s
+#: worst case instead of stalling behind unbounded retries
+RETRY_BACKOFF_S = 0.25
+
+
+def _count(name: str, value: float = 1.0, **labels) -> None:
+    """Exported drop/sent counters, shielded: the logging plane must
+    keep working when the metrics store is unavailable (early boot,
+    bare library use)."""
+    try:
+        from . import metrics as mx
+        mx.inc(name, value, **labels)
+    except Exception:  # noqa: BLE001 — counting must never break logging
+        pass
+
 
 class HTTPLogTarget:
     """POST one JSON document per entry to an endpoint (reference
     cmd/logger/target/http): bounded queue, background sender, drops on
-    overflow (the reference drops too — logging must not backpressure)."""
+    overflow (the reference drops too — logging must not backpressure).
+    ``kind`` labels this target's sent/dropped counters (log|audit)."""
 
     def __init__(self, endpoint: str, auth_token: str = "",
-                 maxsize: int = 4096):
+                 maxsize: int = 4096, kind: str = "log"):
         self.endpoint = endpoint
         self.auth_token = auth_token
+        self.kind = kind
         self.q: queue.Queue = queue.Queue(maxsize=maxsize)
         self.dropped = 0
         self.sent = 0
+        self.retries = 0
         self._stop = threading.Event()
         self._t = threading.Thread(target=self._loop, daemon=True,
                                    name="minio-tpu-log-sender")
@@ -37,6 +67,20 @@ class HTTPLogTarget:
             self.q.put_nowait(entry)
         except queue.Full:
             self.dropped += 1
+            _count("minio_tpu_log_target_dropped_total",
+                   target=self.kind, reason="queue_full")
+
+    def _post(self, entry: dict) -> None:
+        req = urllib.request.Request(
+            self.endpoint,
+            data=json.dumps(entry).encode(),
+            headers={"Content-Type": "application/json"},
+            method="POST")
+        if self.auth_token:
+            req.add_header("Authorization",
+                           f"Bearer {self.auth_token}")
+        with urllib.request.urlopen(req, timeout=5):
+            pass
 
     def _loop(self):
         while not self._stop.is_set():
@@ -45,18 +89,31 @@ class HTTPLogTarget:
             except queue.Empty:
                 continue
             try:
-                req = urllib.request.Request(
-                    self.endpoint,
-                    data=json.dumps(entry).encode(),
-                    headers={"Content-Type": "application/json"},
-                    method="POST")
-                if self.auth_token:
-                    req.add_header("Authorization",
-                                   f"Bearer {self.auth_token}")
-                with urllib.request.urlopen(req, timeout=5):
-                    self.sent += 1
-            except Exception:  # noqa: BLE001 — endpoint down: drop
+                self._post(entry)
+                self.sent += 1
+                _count("minio_tpu_log_target_sent_total",
+                       target=self.kind)
+                continue
+            except Exception:  # noqa: BLE001 — retry once, then count
+                self.retries += 1
+            # one bounded retry with jittered backoff: a transient
+            # connect error must not lose the entry, a dead endpoint
+            # must not stall the queue behind endless retries
+            self._stop.wait(RETRY_BACKOFF_S * (0.5 + random.random()))
+            if self._stop.is_set():
                 self.dropped += 1
+                _count("minio_tpu_log_target_dropped_total",
+                       target=self.kind, reason="send_failed")
+                continue
+            try:
+                self._post(entry)
+                self.sent += 1
+                _count("minio_tpu_log_target_sent_total",
+                       target=self.kind)
+            except Exception:  # noqa: BLE001 — endpoint down: drop, count
+                self.dropped += 1
+                _count("minio_tpu_log_target_dropped_total",
+                       target=self.kind, reason="send_failed")
 
     def stop(self):
         self._stop.set()
@@ -89,18 +146,23 @@ class LogSys:
         if ep:
             self.log_target = HTTPLogTarget(
                 ep, os.environ.get(
-                    "MINIO_TPU_LOGGER_WEBHOOK_AUTH_TOKEN", ""))
+                    "MINIO_TPU_LOGGER_WEBHOOK_AUTH_TOKEN", ""),
+                kind="log")
         ep = os.environ.get("MINIO_TPU_AUDIT_WEBHOOK_ENDPOINT", "")
         if ep:
             self.audit_target = HTTPLogTarget(
                 ep, os.environ.get(
-                    "MINIO_TPU_AUDIT_WEBHOOK_AUTH_TOKEN", ""))
+                    "MINIO_TPU_AUDIT_WEBHOOK_AUTH_TOKEN", ""),
+                kind="audit")
 
     def event(self, level: str, subsystem: str, message: str, **fields):
         rec = {"level": level, "subsystem": subsystem, "message": message,
                "time": time.time(), **fields}
         self.ring.append(rec)
-        self.pubsub.publish(rec)
+        dropped = self.pubsub.publish(rec)
+        if dropped:
+            _count("minio_tpu_log_pubsub_dropped_total", dropped,
+                   stream="log")
         getattr(_console, level if level != "fatal" else "critical",
                 _console.info)("%s: %s", subsystem, message)
         if self.log_target is not None:
@@ -127,7 +189,10 @@ class LogSys:
         rec = {"version": "1", "deploymentid": "minio-tpu",
                "type": "audit", "time": time.time(), **entry}
         self.audit_ring.append(rec)
-        self.pubsub.publish(rec)
+        dropped = self.pubsub.publish(rec)
+        if dropped:
+            _count("minio_tpu_log_pubsub_dropped_total", dropped,
+                   stream="audit")
         if self.audit_target is not None:
             self.audit_target.enqueue(rec)
 

@@ -164,8 +164,12 @@ def _g_capacity(server) -> list[str]:
 
 
 def _g_usage(server) -> list[str]:
-    """Scanner-derived usage (reference getBucketUsageMetrics)."""
+    """Scanner-derived usage (reference getBucketUsageMetrics). Bucket
+    rows flow through the bucketstats fold gate (graftlint GL018): a
+    10k-bucket namespace renders at most top_n tracked rows plus one
+    ``_overflow_`` row summing the rest."""
     from ..scanner.usage import load_usage
+    from . import bucketstats as _bs
     usage = load_usage(server.obj)
     lines = [
         "# TYPE minio_tpu_cluster_usage_object_total gauge",
@@ -176,14 +180,30 @@ def _g_usage(server) -> list[str]:
         "# TYPE minio_tpu_bucket_usage_total_bytes gauge",
         "# TYPE minio_tpu_bucket_usage_object_total gauge",
     ]
-    for b, st in sorted(usage.get("buckets", {}).items()):
+    folded: dict[str, list[int]] = {}
+    for b, st in usage.get("buckets", {}).items():
+        lab = _bs.fold_label(b)
+        row = folded.setdefault(lab, [0, 0])
+        row[0] += st.get("size", 0)
+        row[1] += st.get("objects", 0)
+    for lab, (size, objs) in sorted(folded.items()):
         lines.append(
-            f'minio_tpu_bucket_usage_total_bytes{{bucket="{b}"}} '
-            f'{st.get("size", 0)}')
+            f'minio_tpu_bucket_usage_total_bytes{{bucket="{_esc(lab)}"}} '
+            f'{size}')
         lines.append(
-            f'minio_tpu_bucket_usage_object_total{{bucket="{b}"}} '
-            f'{st.get("objects", 0)}')
+            f'minio_tpu_bucket_usage_object_total{{bucket="{_esc(lab)}"}} '
+            f'{objs}')
     return lines
+
+
+def _g_bucket(server) -> list[str]:
+    """Per-bucket analytics (obs/bucketstats): requests/traffic/latency
+    per tracked bucket, live usage, drift, SLO burn contribution and
+    growth projection — cardinality bounded by the registry's top_n +
+    the ``_overflow_`` fold row (docs/observability.md "Per-bucket
+    analytics")."""
+    from . import bucketstats as _bs
+    return _bs.metric_lines()
 
 
 def _g_replication(server) -> list[str]:
@@ -207,11 +227,14 @@ def _g_replication(server) -> list[str]:
         lines.append("# TYPE minio_tpu_bucket_bandwidth_limit_bytes gauge")
         lines.append(
             "# TYPE minio_tpu_bucket_bandwidth_current_bytes gauge")
+        # bandwidth rows are bounded by the OPERATOR's throttle config
+        # (a bucket appears only once an admin sets a limit on it), not
+        # by request traffic — exempt from the fold-gate rule
         for b, st in sorted(stats.items()):
-            lines.append(
+            lines.append(  # graftlint: disable=GL018
                 f'minio_tpu_bucket_bandwidth_limit_bytes{{bucket="{b}"}} '
                 f'{st["limitInBits"]}')
-            lines.append(
+            lines.append(  # graftlint: disable=GL018
                 f'minio_tpu_bucket_bandwidth_current_bytes{{bucket="{b}"}}'
                 f' {st["currentBandwidth"]}')
     return lines
@@ -535,6 +558,7 @@ def _g_notification(server) -> list[str]:
         return []
     lines = [
         "# TYPE minio_tpu_notify_events_queued gauge",
+        "# TYPE minio_tpu_notify_events_queue_limit gauge",
         "# TYPE minio_tpu_notify_events_sent_total counter",
         "# TYPE minio_tpu_notify_events_send_failures_total counter",
         "# TYPE minio_tpu_notify_events_skipped_total counter",
@@ -543,6 +567,7 @@ def _g_notification(server) -> list[str]:
         lab = f'{{target="{arn}"}}'
         lines += [
             f"minio_tpu_notify_events_queued{lab} {st._count}",
+            f"minio_tpu_notify_events_queue_limit{lab} {st.limit}",
             f"minio_tpu_notify_events_sent_total{lab} {st.delivered}",
             f"minio_tpu_notify_events_send_failures_total{lab} "
             f"{st.send_failures}",
@@ -1068,6 +1093,9 @@ _GROUPS = [
     # interval 0 so a lane's busy ratio is live on every scrape
     MetricsGroup("device", "node", _g_device, interval=0),
     MetricsGroup("usage", "cluster", _g_usage),
+    # per-bucket analytics read the in-memory bounded registry —
+    # interval 0 so request counters and drift are live per scrape
+    MetricsGroup("bucket", "node", _g_bucket, interval=0),
     MetricsGroup("replication", "cluster", _g_replication),
     MetricsGroup("cache", "node", _g_cache),
     MetricsGroup("dispatch", "node", _g_dispatch),

@@ -174,12 +174,15 @@ def _cell(cls: str, win: str, span: int) -> dict[str, Window]:
 
 def record(cls: str, duration_s: float, status: int = 200,
            error: bool = False, trace_id: str = "",
-           now: float | None = None) -> None:
+           now: float | None = None, bucket: str = "") -> None:
     """Fold one finished request/work item into the class's SLO windows.
     Server-side failures (5xx, including admission 503 SlowDown, or
     ``error=True``) burn availability budget; good outcomes over the
     class latency threshold burn latency budget. 4xx are the client's
-    fault and count as good."""
+    fault and count as good. A non-empty ``bucket`` also charges the
+    outcome to that bucket's burn-contribution ring (obs/bucketstats) —
+    one err/slow judgement feeding both ledgers, so the class verdict
+    and its per-bucket attribution can never disagree."""
     if cls not in CLASSES or not enabled():
         return
     err = error or status >= 500
@@ -192,6 +195,9 @@ def record(cls: str, duration_s: float, status: int = 200,
             cell["err"].observe(duration_s, 0, now, trace_id)
         elif slow:
             cell["slow"].observe(duration_s, 0, now, trace_id)
+    if bucket:
+        from . import bucketstats
+        bucketstats.record_slo(bucket, cls, err, slow, now)
     from . import metrics as mx
     outcome = "error" if err else ("slow" if slow else "ok")
     mx.inc("minio_tpu_slo_requests_total", outcome=outcome,
@@ -287,6 +293,19 @@ def report(now: float | None = None) -> dict:
         worst_win = max((wins[w] for w, _ in WINDOWS),
                         key=lambda w: w["worst_slow_s"])
         worst_tid = worst_win["worst_slow_trace_id"]
+        # per-bucket burn attribution (obs/bucketstats minute rings):
+        # the fast window's top offenders per slo kind, so a breach
+        # names the tenant causing it right in this report
+        top_buckets: dict = {}
+        try:
+            from . import bucketstats
+            for slo_kind in ("availability", "latency"):
+                rows = bucketstats.top_offenders(
+                    cls, slo_kind, WINDOWS[0][1], now)
+                if rows:
+                    top_buckets[slo_kind] = rows
+        except Exception:  # noqa: BLE001 — attribution is additive
+            pass
         out["classes"][cls] = {
             "objective": {
                 # rounded: 99.9/100 is 0.9990000000000001 in binary
@@ -301,6 +320,7 @@ def report(now: float | None = None) -> dict:
             "windows": wins,
             "breach": breach,
             "breach_profile": profile_link,
+            "top_buckets": top_buckets,
             "worst_breach": {
                 "trace_id": worst_tid,
                 "seconds": worst_win["worst_slow_s"],

@@ -161,6 +161,14 @@ class DataScanner:
             usage_mod.save_usage(self.obj, snapshot)
         except Exception:  # noqa: BLE001
             pass
+        try:
+            # snap the per-bucket live usage deltas back to this
+            # authoritative tree (drift measured + zeroed) and feed the
+            # capacity-projection history (obs/bucketstats)
+            from ..obs import bucketstats
+            bucketstats.reconcile(snapshot, objlayer=self.obj)
+        except Exception:  # noqa: BLE001 — accounting is best-effort
+            pass
         trc.publish_scanner(func="scanner.cycle",
                             path=f"cycle={self.cycle} deep={deep}",
                             duration_s=time.perf_counter() - t_cycle,

@@ -66,6 +66,30 @@ def _dispatch_admin(h, op: str) -> None:
         from ..obs import slo
         return h._send(200, json.dumps(slo.report()).encode(),
                        "application/json")
+    if op == "bucketstats":
+        # per-bucket analytics (obs/bucketstats): bounded registry
+        # report — requests/traffic/latency, live usage + drift, SLO
+        # burn contribution, growth projection. ?peers=1 fans out the
+        # same report over every dist peer (each node charges only the
+        # requests IT served, so the caller gets per-node rows to merge
+        # or inspect — the same shape as the device fan-out)
+        from ..obs import bucketstats
+        q = {k: v[0] for k, v in h.query.items()}
+        mine = bucketstats.report()
+        mine["endpoint"] = f"{getattr(h.s3, 'address', '')}:" \
+                           f"{getattr(h.s3, 'port', '')}"
+        if q.get("peers") != "1":
+            return h._send(200, json.dumps(mine).encode(),
+                           "application/json")
+        nodes = [mine]
+        for peer in getattr(h.s3, "peers", lambda: [])():
+            try:
+                nodes.append(peer.bucket_stats())
+            except Exception as e:  # noqa: BLE001 — peer down: report
+                nodes.append({"endpoint": getattr(peer, "url", ""),
+                              "error": str(e)})
+        return h._send(200, json.dumps({"nodes": nodes}).encode(),
+                       "application/json")
     if op == "heal" or op.startswith("heal/"):
         return _heal(h, op)
     if op == "datausageinfo":

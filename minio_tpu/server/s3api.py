@@ -557,9 +557,34 @@ class _ChunkedWriter:
         self.wfile.write(b"0\r\n\r\n")
 
 
+class _CountingWriter:
+    """Transparent wfile proxy counting bytes written — the per-bucket
+    traffic counters (obs/bucketstats) read ``sent`` deltas per request
+    on a keep-alive connection, so streamed GET bodies are charged
+    without any hook inside the streaming loops."""
+
+    __slots__ = ("_w", "sent")
+
+    def __init__(self, w):
+        self._w = w
+        self.sent = 0
+
+    def write(self, b) -> int:
+        n = self._w.write(b)
+        self.sent += len(b)
+        return n
+
+    def __getattr__(self, name):
+        return getattr(self._w, name)
+
+
 class _S3Handler(BaseHTTPRequestHandler):
     protocol_version = "HTTP/1.1"
     s3: S3Server = None  # set by subclass factory
+
+    def setup(self):
+        super().setup()
+        self.wfile = _CountingWriter(self.wfile)
 
     # silence default request logging (trace subsystem handles this)
     def log_message(self, fmt, *args):  # noqa: A003
@@ -1522,6 +1547,7 @@ class _S3Handler(BaseHTTPRequestHandler):
         if sp.enabled() and not span_exempt:
             root, tok = sp.begin_request(rid)
         t0 = _time.perf_counter()
+        sent_mark = getattr(self.wfile, "sent", 0)
         release = None
         from ..obs import profiler as _prof
         try:
@@ -1578,6 +1604,18 @@ class _S3Handler(BaseHTTPRequestHandler):
                         _lt.observe("api", dur, 0,
                                     trace_id=rid if root.sampled else "",
                                     api=name)
+                    # per-bucket analytics (obs/bucketstats): request
+                    # counts, traffic bytes, TTFB/wall windows keyed by
+                    # the BOUNDED registry — long-polls stay out for
+                    # the same client-chosen-duration reason as spans
+                    bkt = getattr(self, "bucket", "")
+                    if bkt and not span_exempt:
+                        from ..obs import bucketstats as _bs
+                        sent = getattr(self.wfile, "sent", 0)
+                        _bs.record_request(
+                            bkt, name, status, dur, ttfb_s=ttfb,
+                            bytes_in=getattr(self, "_consumed", 0),
+                            bytes_out=max(0, sent - sent_mark))
                 elif api == "admin" and root is not None:
                     _lt.observe("api", dur, 0,
                                 trace_id=rid if root.sampled else "",
@@ -1617,7 +1655,9 @@ class _S3Handler(BaseHTTPRequestHandler):
                     _slo.record(
                         qcls, dur, status=status,
                         trace_id=rid if root is not None and
-                        root.sampled else "")
+                        root.sampled else "",
+                        bucket=getattr(self, "bucket", "")
+                        if api.startswith("s3.") else "")
             except Exception:  # noqa: BLE001 — obs must never break serving
                 pass
             if root is not None:

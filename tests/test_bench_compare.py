@@ -91,6 +91,16 @@ def test_direction_classification():
         "extra.device_obs.ledger.bulk.acquired_total") == ""
     assert direction(
         "extra.device_obs.ledger.interactive.donated_total") == ""
+    # the bucket_stats extra (ISSUE 18): scrape wall times and the
+    # scaling overhead ratio gate down-better (flat-scrape is the
+    # acceptance bound), while the storm-shape leaves stay evidence
+    assert direction("extra.bucket_stats.scrape_16_ms") == "down"
+    assert direction("extra.bucket_stats.scrape_4096_ms") == "down"
+    assert direction(
+        "extra.bucket_stats.scrape_scaling_overhead") == "down"
+    assert direction("extra.bucket_stats.fold_hits") == ""
+    assert direction("extra.bucket_stats.tracked") == ""
+    assert direction("extra.bucket_stats.series_labels") == ""
 
 
 def test_regression_flags_both_directions():

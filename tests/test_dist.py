@@ -567,6 +567,33 @@ def test_cluster_device_fanout(cluster):
                    for r in n["compile"]["table"]) for n in ok)
 
 
+def test_cluster_bucketstats_fanout(cluster):
+    """`GET /minio/admin/v3/bucketstats?peers=1` (ISSUE 18): the
+    per-bucket analytics report aggregated across dist nodes via the
+    new `bucketstats` peer RPC — one row per node, each carrying the
+    tracked-bucket rollups and projection."""
+    n0, _ = cluster
+    from minio_tpu.madmin import AdminClient
+    from minio_tpu.obs import bucketstats
+    bucketstats.record_request("fanoutbkt", "getobject", 200, 0.01,
+                               bytes_out=64)
+    adm = AdminClient(f"http://127.0.0.1:{n0.server.port}", AK, SK)
+    rep = adm.bucket_stats(peers=True)
+    nodes = rep["nodes"]
+    assert len(nodes) >= 2, nodes
+    ok = [n for n in nodes if "error" not in n]
+    assert len(ok) >= 2, nodes
+    for n in ok:
+        assert n.get("endpoint"), n
+        assert "buckets" in n and "projection" in n
+        assert n["top_n"] >= 1
+    endpoints = {n["endpoint"] for n in ok}
+    assert len(endpoints) >= 2, endpoints
+    # both dist nodes run in THIS process, so the charge above shows
+    # on every row (shared in-process registry)
+    assert any("fanoutbkt" in n["buckets"] for n in ok)
+
+
 def test_cluster_health_snapshot(cluster):
     """`GET /minio/admin/v3/health` aggregates the node health snapshot
     (disk states, lane utilization, QoS saturation, heal backlog, SLO

@@ -1002,3 +1002,82 @@ def test_gl017_registered_and_baseline_empty():
             assert any(isinstance(n, ast.FunctionDef) and
                        n.name == leaf for n in ast.walk(ctx.tree)), \
                 f"{relpath}: registered scope {s} no longer exists"
+
+
+def test_gl018_raw_emitter_kwarg_flagged():
+    ctx = ctx_for("""
+        from .obs import metrics as mx
+
+        def handler(bucket, key):
+            mx.inc("minio_tpu_x_total", bucket=bucket)
+            mx.observe("minio_tpu_y_seconds", 0.1, key=key)
+    """)
+    found = checkers.check_bounded_request_labels(ctx)
+    assert [f.checker for f in found] == ["GL018", "GL018"]
+    assert "bucket=bucket" in found[0].token
+    assert "key=key" in found[1].token
+
+
+def test_gl018_raw_fstring_label_flagged():
+    ctx = ctx_for('''
+        def collect(rows):
+            out = []
+            for b, size in rows:
+                out.append(
+                    f'minio_tpu_x_bytes{{bucket="{b}"}} {size}')
+            return out
+    ''')
+    found = checkers.check_bounded_request_labels(ctx)
+    assert [f.checker for f in found] == ["GL018"]
+    assert "bucket" in found[0].token
+
+
+def test_gl018_folded_and_constant_labels_ok():
+    """fold_label calls, names bound from one, and constants all pass —
+    both the kwarg and the f-string surface (the `lab = fold_label(b);
+    f'...{_esc(lab)}...'` bind-then-interpolate idiom included)."""
+    ctx = ctx_for('''
+        from .obs import metrics as mx
+        from .obs.bucketstats import fold_label
+
+        def handler(bucket):
+            mx.inc("minio_tpu_x_total", bucket=fold_label(bucket))
+            mx.inc("minio_tpu_x_total", bucket="_all_")
+            mx.inc("minio_tpu_x_total", target=bucket)  # not sensitive
+
+        def collect(rows):
+            out = []
+            for b, size in rows:
+                lab = fold_label(b)
+                out.append(
+                    f'minio_tpu_x_bytes{{bucket="{_esc(lab)}"}} {size}')
+            return out
+    ''')
+    assert not checkers.check_bounded_request_labels(ctx)
+
+
+def test_gl018_home_module_and_foreign_paths_exempt():
+    src = """
+        from . import metrics as mx
+
+        def charge(bucket):
+            mx.inc("minio_tpu_x_total", bucket=bucket)
+    """
+    # the fold helper's own module IS the bound — exempt
+    assert not checkers.check_bounded_request_labels(
+        ctx_for(src, path="minio_tpu/obs/bucketstats.py"))
+    # outside minio_tpu/ (tools, tests) out of scope
+    assert not checkers.check_bounded_request_labels(
+        ctx_for(src, path="tools/loadgen.py"))
+    # same source elsewhere under minio_tpu/ is a finding
+    assert checkers.check_bounded_request_labels(
+        ctx_for(src, path="minio_tpu/obs/health.py"))
+
+
+def test_gl018_registered_and_baseline_empty():
+    """GL018 is an active PER_FILE checker (so test_tree_is_clean
+    proves every live emission site folds request-derived labels) with
+    an EMPTY baseline — no grandfathered cardinality leaks."""
+    assert checkers.check_bounded_request_labels in checkers.PER_FILE
+    assert graftlint.load_baseline() == {}, \
+        "GL018 must hold with an EMPTY baseline"

@@ -95,3 +95,36 @@ def test_degraded_interactive_mix(tmp_path):
             objects=8, clients=2, duration_s=1.0, value_bytes=4096,
             degraded=True, scanner_mid_run=False,
             overload_probe=False))
+
+
+def test_multi_bucket_spread_bounds_scrape(tmp_path, monkeypatch):
+    """ISSUE 18 satellite: 40 tenants against a top_n=8 registry — the
+    spread forces real folding, the scrape's bucket-label set stays at
+    top_n+1 values, and the dead-webhook probe proves the event queue
+    caps at its limit with every overflow counted."""
+    from minio_tpu.obs import bucketstats
+    monkeypatch.setenv("MINIO_TPU_BUCKETSTATS_TOP_N", "8")
+    bucketstats.reset()
+    profile = Profile(objects=160, clients=8, duration_s=2.5,
+                      open_rps=0.0, buckets=40,
+                      scanner_mid_run=False, overload_probe=False)
+    try:
+        report = run_tier1_profile(str(tmp_path), profile)
+    finally:
+        bucketstats.reset()
+    v = report["verdicts"]
+    bs = report["bucket_stats"]
+    # the registry really had to fold: 40 tenants, 8 tracked rows
+    assert bs["folds_total"] > 0, bs
+    assert bs["tracked"] <= 8, bs
+    assert bs["series_label_values"] <= 9, bs
+    assert v["bucket_metrics_bounded_ok"], bs
+    # breach attribution: vacuously green or named, never breached-blank
+    assert v["slo_breach_names_bucket_ok"], report["slo"]
+    # the dead-target queue capped at its limit and counted overflow
+    np = report["notifier_probe"]
+    assert np, "notifier probe did not arm"
+    assert np["queue_count"] <= np["limit"], np
+    assert np["queue_count"] + np["delivered"] + np["failed_puts"] > 0, np
+    assert v["notifier_bounded_ok"], np
+    assert v["passed"], v

@@ -51,6 +51,10 @@ import re
 #: compile-table COUNTS describe the warm-up — only the roofline
 #: ratios/gibs (up-better) and compile_seconds_total (down-better)
 #: gate (pinned by tests/test_bench_compare.py)
+#: ... and the `bucket_stats` extra's registry leaves (ISSUE 18):
+#: tracked/fold_hits/series_labels describe the synthetic storm's
+#: shape — only the scrape `_ms` wall times and the scaling overhead
+#: ratio (all down-better) gate (pinned by tests/test_bench_compare.py)
 NON_HEADLINE = {"duration_s", "ramp_s", "preload_s", "wall_s",
                 "interval_s", "timeout_s", "ttl_s", "expiry_s",
                 "value_bytes", "objects", "clients", "open_rps",
@@ -61,7 +65,8 @@ NON_HEADLINE = {"duration_s", "ramp_s", "preload_s", "wall_s",
                 "peak_bytes", "peak_buffers", "live_buffers",
                 "acquired_total", "released_total", "donated_total",
                 "flushes", "device_seconds", "compiles_total",
-                "compile_storms_total"}
+                "compile_storms_total",
+                "fold_hits", "tracked", "series_labels"}
 BURN = re.compile(r"burn", re.IGNORECASE)
 HIGHER_BETTER = re.compile(
     r"(gibs|rps|availability|_ratio|^value$|requests_total)",

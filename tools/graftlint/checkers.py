@@ -1,4 +1,4 @@
-"""The graftlint checkers (GL001-GL012).
+"""The graftlint checkers (GL001-GL018).
 
 Each per-file checker takes a ``FileCtx`` and yields ``Finding``s; the
 project-wide checkers take the full list of parsed files (cross-file
@@ -58,6 +58,11 @@ text — nothing in the checked tree is imported.
 |       | registry/pragma exemption — compile counting (and the        |
 |       | compile-storm detector riding it) must not silently lose     |
 |       | coverage as new ops land                                     |
+| GL018 | request-derived Prometheus labels (bucket/key/user/tenant/   |
+|       | object) must flow through the bounded-cardinality fold       |
+|       | helper ``obs/bucketstats.fold_label`` — a raw request string |
+|       | as a label value is an unbounded time-series cardinality     |
+|       | leak (one series per tenant-chosen name)                     |
 """
 from __future__ import annotations
 
@@ -1335,6 +1340,108 @@ def check_tracked_compiles(ctx: FileCtx) -> list[Finding]:
     return out
 
 
+# --------------------------------------------------------------------------
+# GL018 — request-derived metric labels fold through bucketstats.fold_label
+
+#: label keys whose values are tenant-chosen strings: a raw one creates
+#: one Prometheus series per distinct request value (unbounded).
+_GL018_SENSITIVE = {"bucket", "key", "user", "tenant", "object"}
+
+#: metric-emitting call leaves whose keyword args become label pairs
+_GL018_EMITTERS = {"inc", "observe", "_metric"}
+
+#: the fold helper itself (and its home module, which is exempt — it IS
+#: the cardinality bound)
+_GL018_FOLD = "fold_label"
+_GL018_HOME = "minio_tpu/obs/bucketstats.py"
+
+_GL018_FRAG_RE = re.compile(
+    r"(?P<label>" + "|".join(sorted(_GL018_SENSITIVE)) + r')="$')
+
+
+def _gl018_folded_names(tree: ast.AST) -> set[str]:
+    """Names assigned from ``fold_label(...)`` anywhere in the file count
+    as folded (the bind-then-interpolate pattern: ``lab =
+    fold_label(b)``; ``f'...bucket="{_esc(lab)}"...'``) — same
+    assignment-tracking shape GL005 uses for ``wrap_ctx``."""
+    out: set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and \
+                isinstance(node.value, ast.Call) and \
+                dotted(node.value.func).rsplit(".", 1)[-1] == _GL018_FOLD:
+            out.update(d for d in (dotted(t) for t in node.targets) if d)
+    return out
+
+
+def _gl018_is_folded(expr: ast.AST, folded: set[str]) -> bool:
+    """True when ``expr``'s subtree routes through the fold helper: a
+    ``fold_label(...)`` call or a Name previously bound to one."""
+    for n in ast.walk(expr):
+        if isinstance(n, ast.Call) and \
+                dotted(n.func).rsplit(".", 1)[-1] == _GL018_FOLD:
+            return True
+        if isinstance(n, (ast.Name, ast.Attribute)) and \
+                dotted(n) in folded:
+            return True
+    return False
+
+
+def check_bounded_request_labels(ctx: FileCtx) -> list[Finding]:
+    """GL018: two surfaces leak request strings into metric labels —
+    (a) emitter keyword args (``mx.inc(..., bucket=b)``) and (b)
+    hand-rendered exposition f-strings (``f'...bucket="{b}"...'``, the
+    collector-group idiom). Both must pass a constant, a
+    ``fold_label(...)`` call, or a name bound from one."""
+    if not ctx.path.startswith("minio_tpu/") or ctx.path == _GL018_HOME:
+        return []
+    folded = _gl018_folded_names(ctx.tree)
+    out = []
+    for node in ast.walk(ctx.tree):
+        # Rule A — emitter call kwargs
+        if isinstance(node, ast.Call) and \
+                dotted(node.func).rsplit(".", 1)[-1] in _GL018_EMITTERS:
+            for kw in node.keywords:
+                if kw.arg not in _GL018_SENSITIVE:
+                    continue
+                if isinstance(kw.value, ast.Constant):
+                    continue
+                if _gl018_is_folded(kw.value, folded):
+                    continue
+                out.append(Finding(
+                    ctx.path, node.lineno, "GL018",
+                    f'request-derived label {kw.arg}='
+                    f"{_unparse(kw.value, 40)} without "
+                    "bucketstats.fold_label — unbounded series "
+                    "cardinality (one per tenant-chosen name)",
+                    token=f"{kw.arg}={_unparse(kw.value, 40)}",
+                    scope=ctx.scope_at(node.lineno)))
+        # Rule B — exposition f-strings: a text fragment ending in
+        # `bucket="` etc. labels the NEXT interpolated value
+        if isinstance(node, ast.JoinedStr):
+            vals = node.values
+            for i, frag in enumerate(vals[:-1]):
+                if not (isinstance(frag, ast.Constant) and
+                        isinstance(frag.value, str)):
+                    continue
+                m = _GL018_FRAG_RE.search(frag.value)
+                if m is None:
+                    continue
+                nxt = vals[i + 1]
+                if not isinstance(nxt, ast.FormattedValue):
+                    continue
+                if _gl018_is_folded(nxt.value, folded):
+                    continue
+                out.append(Finding(
+                    ctx.path, node.lineno, "GL018",
+                    f'f-string label {m.group("label")}='
+                    f'"{{{_unparse(nxt.value, 40)}}}" without '
+                    "bucketstats.fold_label — unbounded series "
+                    "cardinality (one per tenant-chosen name)",
+                    token=f'{m.group("label")}={_unparse(nxt.value, 40)}',
+                    scope=ctx.scope_at(node.lineno)))
+    return out
+
+
 PER_FILE = [
     check_wall_duration,
     check_blocking_under_lock,
@@ -1352,5 +1459,6 @@ PER_FILE = [
     check_interactive_blocking,
     check_thread_names,
     check_tracked_compiles,
+    check_bounded_request_labels,
 ]
 PROJECT = [check_metrics_documented]

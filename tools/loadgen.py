@@ -47,6 +47,19 @@ yields its scanner-subsystem CPU share — the
 default 0.5) makes the item-3 "scanner never stalls the hot path"
 claim machine-checked instead of inferred.
 
+``--buckets N`` (ISSUE 18) spreads the same key space across N
+buckets: the per-bucket analytics registry (``obs/bucketstats.py``)
+sees real multi-tenant traffic, and two verdicts gate on it —
+``bucket_metrics_bounded_ok`` (the scrape's bucket-label value set
+stays at ``top_n``+1 however many tenants hit the server) and
+``slo_breach_names_bucket_ok`` (any breached class/window carries burn
+attribution naming the offending buckets). A dead-webhook probe rides
+every single-node run unless ``--no-notifier-probe``: a webhook target
+nothing listens on gets a tiny persistent queue and every load
+bucket's object events, and ``notifier_bounded_ok`` proves the queue
+caps at its limit with every overflow counted — never a stalled PUT,
+never a silent drop.
+
 ``--topology N`` stands the same load on a real N-node in-process
 cluster (``dist.harness.LocalCluster``: separate listeners, storage
 REST RPC, dsync locks) and ``--chaos-kill <idx>`` runs the node-chaos
@@ -93,9 +106,20 @@ class Profile:
     open_rps: float = 50.0      # open-loop arrival rate after the ramp
     ramp_s: float = 2.0
     bucket: str = "loadgen"
+    #: per-bucket analytics spread (ISSUE 18): >1 fans the SAME key
+    #: space across ``bucket-0000..bucket-NNNN`` so the bounded-
+    #: cardinality registry sees real multi-tenant traffic — the
+    #: ``bucket_metrics_bounded_ok`` verdict then proves the scrape
+    #: stays at top_n+1 label values however many tenants hit it
+    buckets: int = 1
     seed: int = 7
     scanner_mid_run: bool = True
     overload_probe: bool = True
+    #: arm a dead webhook target with a tiny queue limit and route the
+    #: load buckets' object events at it: the measured phase proves the
+    #: event queue caps at its limit with every overflow counted, and
+    #: PUT availability holds through the full queue (ISSUE 18)
+    notifier_probe: bool = True
     preload_threads: int = 16
     #: "the scanner never stalls the hot path" made machine-checked
     #: (ISSUE 14 / ROADMAP item 3): the scanner-cycle window's
@@ -124,6 +148,15 @@ class Profile:
     @classmethod
     def tier1(cls) -> "Profile":
         return cls()
+
+    def bucket_name(self, i: int) -> str:
+        """Bucket for object index ``i``: the single configured bucket,
+        or a deterministic spread across ``buckets`` names — preload and
+        the op mix map indexes the same way, so every GET finds its
+        key."""
+        if self.buckets <= 1:
+            return self.bucket
+        return f"{self.bucket}-{i % self.buckets:04d}"
 
 
 class _SigClient:
@@ -304,15 +337,16 @@ class LoadGen:
         if self.obj is None:
             raise RuntimeError("preload needs an in-process layer")
         body = random.Random(profile.seed).randbytes(profile.value_bytes)
-        try:
-            self.obj.make_bucket(profile.bucket)
-        except Exception:  # noqa: BLE001 — exists from a prior phase
-            pass
+        for bi in range(max(1, profile.buckets)):
+            try:
+                self.obj.make_bucket(profile.bucket_name(bi))
+            except Exception:  # noqa: BLE001 — exists from a prior phase
+                pass
         t0 = time.monotonic()
 
         def put_range(lo: int, hi: int) -> None:
             for j in range(lo, hi):
-                self.obj.put_object(profile.bucket, f"o{j:07d}",
+                self.obj.put_object(profile.bucket_name(j), f"o{j:07d}",
                                     io.BytesIO(body), len(body))
 
         nthreads = max(1, profile.preload_threads)
@@ -335,21 +369,26 @@ class LoadGen:
             if r <= acc:
                 op = name
                 break
-        b = profile.bucket
         t0 = time.perf_counter()
         try:
             if op == "get":
+                i = rng.randrange(profile.objects)
                 resp = cl.request(
-                    "GET", f"/{b}/o{rng.randrange(profile.objects):07d}")
+                    "GET", f"/{profile.bucket_name(i)}/o{i:07d}")
             elif op == "put":
                 # churn range: PUT/DELETE share keys ABOVE the stable
                 # GET namespace so deletes never starve readers
-                key = f"c{rng.randrange(max(1, profile.objects // 4)):07d}"
-                resp = cl.request("PUT", f"/{b}/{key}", body=body)
+                i = rng.randrange(max(1, profile.objects // 4))
+                resp = cl.request(
+                    "PUT", f"/{profile.bucket_name(i)}/c{i:07d}",
+                    body=body)
             elif op == "delete":
-                key = f"c{rng.randrange(max(1, profile.objects // 4)):07d}"
-                resp = cl.request("DELETE", f"/{b}/{key}")
+                i = rng.randrange(max(1, profile.objects // 4))
+                resp = cl.request(
+                    "DELETE", f"/{profile.bucket_name(i)}/c{i:07d}")
             else:  # list
+                b = profile.bucket_name(
+                    rng.randrange(max(1, profile.buckets)))
                 resp = cl.request(
                     "GET", f"/{b}",
                     query={"max-keys": "64",
@@ -428,15 +467,25 @@ class LoadGen:
         t.start()
         return t
 
-    def _force_scanner(self, rec_t0: float, out: dict) -> None:
+    def _force_scanner(self, rec_t0: float, out: dict,
+                       at: float | None = None) -> None:
         """One scanner cycle mid-run (QoS background class applied by
         the scanner itself); records its relative-time window into
         ``out``. Runs on its own thread — on a saturated host the
         cycle being CPU-starved by interactive traffic is the desired
-        outcome, and the run must not stretch to wait for it."""
+        outcome, and the run must not stretch to wait for it. ``at``
+        (absolute monotonic time) delays the cycle from INSIDE the
+        thread: the caller spawns it before the client storm, because
+        Thread.start plus the profiler snapshot under a full GIL convoy
+        has been observed to lag seconds — enough to push the cycle
+        past the measured window entirely."""
         scanner = getattr(self.server, "scanner", None)
         if scanner is None:
             return
+        if at is not None:
+            delay = at - time.monotonic()
+            if delay > 0:
+                time.sleep(delay)
         # profiler window over EXACTLY the cycle (ISSUE 14): the
         # scanner-subsystem CPU share inside it is the evidence behind
         # the scanner_cpu_share_ok verdict. A base-aggregate DELTA, so
@@ -445,8 +494,8 @@ class LoadGen:
         # "during the cycle" measurably slower than "before" and the
         # attribution blamed the scanner for the profiler's own load
         from minio_tpu.obs import profiler as prof
-        snap = prof.agg_snapshot()
         out["start_s"] = round(time.monotonic() - rec_t0, 3)
+        snap = prof.agg_snapshot()
         try:
             scanner.scan_cycle()
         finally:
@@ -630,6 +679,42 @@ class LoadGen:
             adm.reconfigure(saved)
         return out
 
+    def _arm_notifier_probe(self, profile: Profile) -> dict:
+        """Dead-letter event probe (ISSUE 18 satellite): register a
+        webhook target nothing listens on, give its persistent queue a
+        deliberately tiny limit, and route every load bucket's object
+        events at it. The measured phase then proves the queue-full
+        contract under real traffic: depth caps at the limit, every
+        overflow increments ``failed_puts`` plus the exported drop
+        counter, and the PUT path never blocks on the full queue."""
+        import os
+        import tempfile
+
+        from minio_tpu.event.queuestore import QueueStore
+        from minio_tpu.event.targets import WebhookTarget
+        n = self.server.ensure_notifier()
+        region = getattr(self.server, "region", "us-east-1")
+        t = WebhookTarget("loadgen-dead", "http://127.0.0.1:9/dead",
+                          timeout_s=0.2, region=region)
+        limit = 64
+        qroot = tempfile.mkdtemp(prefix="loadgen-notify-")
+        # built directly (not add_targets) for the non-default limit; a
+        # long retry base keeps the doomed sender quiet during the run
+        store = QueueStore(os.path.join(qroot, t.KIND, t.id), t.send,
+                           limit=limit, retry_base_s=5.0).start()
+        n.targets[t.arn] = t
+        n.stores[t.arn] = store
+        xml = (
+            "<NotificationConfiguration><QueueConfiguration>"
+            f"<Queue>{t.arn}</Queue><Event>s3:ObjectCreated:*</Event>"
+            "<Event>s3:ObjectRemoved:*</Event>"
+            "</QueueConfiguration></NotificationConfiguration>").encode()
+        for bi in range(max(1, profile.buckets)):
+            b = profile.bucket_name(bi)
+            self.server.bucket_meta.update(b, notification_xml=xml)
+            n.invalidate(b)
+        return {"arn": t.arn, "limit": limit, "store": store}
+
     # -- the run --------------------------------------------------------------
 
     def run(self, profile: Profile) -> dict:
@@ -677,6 +762,13 @@ class LoadGen:
             from minio_tpu.runtime import dispatch as dp
             degraded["_ia0"] = dp._global.stats()[
                 "interactive_lane"]["items"] if dp._global else 0
+        # bounded event fan-out under load (ISSUE 18): armed after the
+        # overload probe so only measured-phase traffic hits the dead
+        # target's tiny queue
+        notifier_arm: dict = {}
+        if profile.notifier_probe and self.server is not None and \
+                getattr(self, "topology", None) is None:
+            notifier_arm = self._arm_notifier_probe(profile)
         try:
             slo.reset()                  # measure THIS run, not setup
             lockrank_before = self._lockrank_count()
@@ -697,6 +789,17 @@ class LoadGen:
             from minio_tpu.obs import device as _dev
             compiles0 = _dev.compiles_total()
             deadline = rec.t0 + profile.duration_s
+            scanner_win: dict = {}
+            scan_t: threading.Thread | None = None
+            if profile.scanner_mid_run and self.server is not None:
+                # spawned BEFORE the client storm, waking itself at the
+                # halfway mark — see _force_scanner on why
+                scan_t = threading.Thread(
+                    target=self._force_scanner,
+                    args=(rec.t0, scanner_win,
+                          rec.t0 + profile.duration_s / 2),
+                    daemon=True, name="loadgen-scanner")
+                scan_t.start()
             ths = self._closed_loop(profile, rec, deadline, body)
             open_t = self._open_loop(profile, rec, deadline, body)
             heal_t: threading.Thread | None = None
@@ -715,15 +818,6 @@ class LoadGen:
                     args=(profile, rec.t0, deadline, chaos),
                     daemon=True, name="loadgen-chaos")
                 chaos_t.start()
-            scanner_win: dict = {}
-            scan_t: threading.Thread | None = None
-            if profile.scanner_mid_run and self.server is not None:
-                time.sleep(profile.duration_s / 2)
-                scan_t = threading.Thread(
-                    target=self._force_scanner,
-                    args=(rec.t0, scanner_win),
-                    daemon=True, name="loadgen-scanner")
-                scan_t.start()
             for t in ths:
                 t.join(timeout=profile.duration_s + 60)
             if open_t is not None:
@@ -742,11 +836,22 @@ class LoadGen:
                     "interactive_lane"]["items"] if dp._global else 0
                 degraded["interactive_lane_items"] = \
                     ia_now - degraded.pop("_ia0", 0)
+            notifier: dict = {}
+            if notifier_arm:
+                st = notifier_arm["store"]
+                notifier = {
+                    "arn": notifier_arm["arn"],
+                    "limit": notifier_arm["limit"],
+                    "queue_count": st._count,
+                    "delivered": st.delivered,
+                    "failed_puts": st.failed_puts,
+                    "send_failures": st.send_failures,
+                }
             return self._report(profile, rec, wall_s, preload_s,
                                 scanner_win, probe, lockrank_before,
                                 chaos, degraded,
                                 _prof.delta_report(run_snap),
-                                compiles0)
+                                compiles0, notifier)
         finally:
             # the armed disk-kill rule is PROCESS-WIDE state: a failure
             # anywhere in the measured phase must not leave every later
@@ -754,6 +859,14 @@ class LoadGen:
             if degraded_rule is not None:
                 from minio_tpu import fault
                 fault.disarm(degraded_rule)
+            if notifier_arm:
+                # detach the dead target so nothing keeps retrying it
+                # (and a later phase on this server starts clean)
+                n = self.server._notifier
+                if n is not None:
+                    n.targets.pop(notifier_arm["arn"], None)
+                    n.stores.pop(notifier_arm["arn"], None)
+                notifier_arm["store"].stop()
 
     @staticmethod
     def _lockrank_count() -> int | None:
@@ -777,7 +890,8 @@ class LoadGen:
                 chaos: dict | None = None,
                 degraded: dict | None = None,
                 run_prof=None,
-                compiles0: int | None = None) -> dict:
+                compiles0: int | None = None,
+                notifier: dict | None = None) -> dict:
         from minio_tpu.obs import slo
         from minio_tpu.obs.health import cluster_snapshot
         rows = rec.snapshot()
@@ -815,14 +929,25 @@ class LoadGen:
             # host is pure tail noise at these sample counts
             d_p50 = during.get("p50_ms", 0.0) / 1e3
             b_p50 = before.get("p50_ms", 0.0) / 1e3
+            # ... corroborated by throughput: under a closed loop the
+            # median tracks queue depth, which climbs with time on a
+            # saturated host whether or not the scanner runs (Little's
+            # law: p50 ~= clients/rps) — but a scanner really stalling
+            # the path collapses the in-window completion rate, while
+            # queueing drift leaves it flat. Both signals or no blame.
+            d_rps = during.get("count", 0) / max(win[1] - win[0], 1e-9)
+            b_rps = before.get("count", 0) / max(win[0] / 2, 1e-9)
             attributable = (
                 during.get("count", 0) >= 10 and (
                     d_avail < min(0.99,
                                   before.get("availability", 1.0)) or
-                    (d_p50 > max(thresh, 4.0 * b_p50))))
+                    (d_p50 > max(thresh, 4.0 * b_p50) and
+                     d_rps < 0.7 * b_rps)))
             scanner_impact = {
                 "window": scanner_win,
                 "during": during, "before": before,
+                "during_rps": round(d_rps, 1),
+                "before_rps": round(b_rps, 1),
                 "latency_threshold_s": thresh,
                 "attributable_breach": attributable,
             }
@@ -883,6 +1008,45 @@ class LoadGen:
             "burn_rate_metrics_live":
                 "minio_tpu_slo_burn_rate" in metrics_text,
         }
+        # per-bucket analytics acceptance (ISSUE 18): however many
+        # tenants the spread drove, the scrape's bucket-label value set
+        # stays within top_n tracked rows plus the `_overflow_` fold.
+        # The bandwidth family is excluded: its rows are config-derived
+        # (one per operator-configured replication limit — the global
+        # monitor outlives any one server in-process), bounded by
+        # configuration rather than tenant traffic
+        from minio_tpu.obs import bucketstats as _bstats
+        bucket_labels: set[str] = set()
+        for line in metrics_text.splitlines():
+            if line.startswith("minio_tpu_bucket_") and \
+                    not line.startswith("minio_tpu_bucket_bandwidth_") \
+                    and 'bucket="' in line:
+                bucket_labels.add(
+                    line.split('bucket="', 1)[1].split('"', 1)[0])
+        verdicts["bucket_metrics_bounded_ok"] = \
+            len(bucket_labels) <= _bstats.top_n() + 1
+        # every breached (class, window-kind) must carry burn
+        # attribution naming an offending bucket — vacuously green on a
+        # clean run, red the moment a breach fires with an empty
+        # top_buckets list
+        breach_named = True
+        for ent in slo_rep.get("classes", {}).values():
+            for kind, hit in ent.get("breach", {}).items():
+                if hit and not ent.get("top_buckets", {}).get(kind):
+                    breach_named = False
+        verdicts["slo_breach_names_bucket_ok"] = breach_named
+        if notifier:
+            # bounded event fan-out: events really routed at the dead
+            # target, the queue never grew past its limit, and any
+            # overflow was counted (store counter + exported metric),
+            # never silently dropped
+            routed = (notifier["queue_count"] + notifier["delivered"] +
+                      notifier["failed_puts"])
+            verdicts["notifier_bounded_ok"] = (
+                routed > 0 and
+                notifier["queue_count"] <= notifier["limit"] and
+                (notifier["failed_puts"] == 0 or
+                 "minio_tpu_notify_events_dropped_total" in metrics_text))
         if degraded:
             # the degraded-mix acceptance set (ISSUE 13): GETs really
             # served through reconstruct on the interactive device
@@ -931,6 +1095,7 @@ class LoadGen:
                 "value_bytes": profile.value_bytes,
                 "open_rps": profile.open_rps,
                 "ramp_s": profile.ramp_s,
+                "buckets": profile.buckets,
             },
             "wall_s": round(wall_s, 3),
             "preload_s": round(preload_s, 3),
@@ -946,6 +1111,13 @@ class LoadGen:
             "degraded": degraded or {},
             "qos_evidence": qos_evidence,
             "host_profile": host_profile,
+            "notifier_probe": notifier or {},
+            "bucket_stats": {
+                "series_label_values": len(bucket_labels),
+                "top_n": _bstats.top_n(),
+                "tracked": _bstats.report().get("tracked", 0),
+                "folds_total": _bstats.report().get("folds", 0),
+            },
             "slo": slo_rep,
             "health": cluster_snapshot(self.server, peers=False)
             if self.server is not None else {},
@@ -997,6 +1169,13 @@ def main(argv: list[str] | None = None) -> int:
                     "forced cycle window (profiler evidence; the "
                     "scanner_cpu_share_ok verdict gates on it)")
     ap.add_argument("--no-probe", action="store_true")
+    ap.add_argument("--buckets", type=int, default=1,
+                    help="spread the key space across N buckets "
+                    "(per-bucket analytics plane under multi-tenant "
+                    "load; the bucket_metrics_bounded_ok verdict "
+                    "proves the scrape stays at top_n+1 labels)")
+    ap.add_argument("--no-notifier-probe", action="store_true",
+                    help="skip the dead-webhook bounded-queue probe")
     ap.add_argument("--degraded", action="store_true",
                     help="kill one disk's shard reads for the measured "
                     "phase: GETs reconstruct on the interactive device "
@@ -1019,6 +1198,8 @@ def main(argv: list[str] | None = None) -> int:
         scanner_mid_run=not args.no_scanner,
         scanner_share_max=args.scanner_share_max,
         overload_probe=not args.no_probe,
+        buckets=args.buckets,
+        notifier_probe=not args.no_notifier_probe,
         degraded=args.degraded,
         chaos_kill_node=args.chaos_kill if args.chaos_kill >= 0
         else None)
