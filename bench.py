@@ -1029,6 +1029,44 @@ def scale_slo_extra() -> dict:
             f"{drep['degraded'].get('interactive_lane_items')} heals="
             f"{drep['degraded'].get('heals')} passed="
             f"{drep['verdicts']['passed']}")
+    # replication-chaos phase (ISSUE 19): a third run on a real 4-node
+    # topology with a replication rule at node 3, the target killed
+    # mid-stream and rejoined — the no_replica_obligation_lost /
+    # replication_backlog_drained / replication_lag_slo_ok verdicts
+    # gate it. MINIO_TPU_SCALE_REPLICATION=0 skips.
+    if os.environ.get("MINIO_TPU_SCALE_REPLICATION", "1") != "0":
+        from tools.loadgen import run_topology_profile
+        rprofile = Profile(
+            objects=int(os.environ.get(
+                "MINIO_TPU_SCALE_REPLICATION_OBJECTS", "128")),
+            clients=int(os.environ.get(
+                "MINIO_TPU_SCALE_REPLICATION_CLIENTS", "8")),
+            duration_s=float(os.environ.get(
+                "MINIO_TPU_SCALE_REPLICATION_DURATION", "6")),
+            open_rps=0.0,
+            scanner_mid_run=False,
+            overload_probe=False,
+            notifier_probe=False,
+            replication_target_node=3,
+        )
+        with tempfile.TemporaryDirectory(prefix="bench-slo-rep-") \
+                as root:
+            rrep = run_topology_profile(root, rprofile, nodes=4,
+                                        disks_per_node=2)
+        rsec = dict(rrep["replication"])
+        rsec.pop("lost_replicas", None)
+        slim["replication"] = {
+            "profile": rrep["profile"],
+            "replication": rsec,
+            "verdicts": {k: v for k, v in rrep["verdicts"].items()
+                         if "replica" in k or "replication" in k or
+                         k == "passed"},
+        }
+        log(f"scale_slo replication: acked="
+            f"{rsec.get('acked_writes')} lost="
+            f"{rsec.get('lost_count')} drain="
+            f"{rsec.get('drain_s')}s passed="
+            f"{rrep['verdicts']['passed']}")
     return {"scale_slo": slim}
 
 
@@ -1071,6 +1109,51 @@ def node_chaos_extra() -> dict:
             gets.append(_t.perf_counter() - t0)
         return {"put": pcts(puts), "get": pcts(gets)}
 
+    def repl_leg(lc, cl, tag, target_idx, kill):
+        """One replication leg (ISSUE 19 trajectory): rule at
+        ``target_idx``, ``ops`` unique PUTs (with a mid-stream
+        kill/restart of the target when ``kill``), then the backlog
+        drained to zero and the per-leg lag quantiles read off a
+        fresh lag window."""
+        from minio_tpu.obs.latency import Window
+        src, dstb = f"rsrc-{tag}", f"rdst-{tag}"
+        cl.request("PUT", f"/{src}")
+        xml = (
+            "<ReplicationConfiguration><Rule><ID>bench</ID>"
+            "<Status>Enabled</Status><Priority>1</Priority>"
+            "<Destination>"
+            f"<Bucket>{dstb}</Bucket><Endpoint>{lc.urls[target_idx]}"
+            "</Endpoint></Destination></Rule>"
+            "</ReplicationConfiguration>").encode()
+        r = cl.request("PUT", f"/{src}", query={"replication": ""},
+                       body=xml)
+        assert r.status_code == 200, r.status_code
+        rs = lc.nodes[0].server.replication_sys
+        rs.lag = Window()        # per-leg quantiles, not cumulative
+        for i in range(ops):
+            if kill and i == ops // 3:
+                lc.kill(target_idx)
+            if kill and i == 2 * ops // 3:
+                lc.restart(target_idx)
+            r = cl.request("PUT", f"/{src}/o{i:03d}", body=body)
+            assert r.status_code == 200, (tag, i, r.status_code)
+        t0 = _t.monotonic()
+        drained = False
+        while _t.monotonic() - t0 < 120:
+            st = rs.stats()
+            if st["queued"] + st["retry_pending"] == 0:
+                drained = True
+                break
+            _t.sleep(0.1)
+        lagr = rs.lag_report()
+        return src, {
+            "lag_p50_ms": round(lagr["lag_p50_s"] * 1e3, 1),
+            "lag_p99_ms": round(lagr["lag_p99_s"] * 1e3, 1),
+            "drain_s": round(_t.monotonic() - t0, 2),
+            "drained": drained,
+            "backlog": lagr["backlog"],
+        }
+
     with tempfile.TemporaryDirectory(prefix="bench-nc-") as root:
         lc = LocalCluster(root, nodes=4, disks_per_node=2, parity=2)
         try:
@@ -1091,13 +1174,40 @@ def node_chaos_extra() -> dict:
                     break
                 _t.sleep(0.25)
             drain_s = round(_t.monotonic() - t0, 2)
+            # replication trajectory (ISSUE 19): lag quantiles + drain
+            # seconds with the target healthy vs killed-and-rejoined
+            # mid-stream, plus a forced full-bucket resync replay
+            rs = getattr(lc.nodes[0].server, "replication_sys", None)
+            replication: dict = {}
+            if rs is not None:
+                _, replication["clean"] = repl_leg(lc, cl, "cl", 1,
+                                                   kill=False)
+                ksrc, replication["kill_target"] = repl_leg(
+                    lc, cl, "kt", 3, kill=True)
+                t0 = _t.monotonic()
+                n_resync = rs.resync(ksrc, force=True)
+                while _t.monotonic() - t0 < 120:
+                    st = rs.stats()
+                    if st["queued"] + st["retry_pending"] == 0:
+                        break
+                    _t.sleep(0.1)
+                replication["resync"] = {
+                    "drain_s": round(_t.monotonic() - t0, 2),
+                    "resynced": n_resync,
+                }
         finally:
             lc.shutdown()
     out = {"clean": clean, "kill_1_of_4": degraded,
-           "heal_drain_s": drain_s, "heal_drained": drained}
+           "heal_drain_s": drain_s, "heal_drained": drained,
+           "replication": replication}
     log(f"node_chaos: clean put p99 {clean['put']['p99_ms']}ms vs "
         f"kill-1-of-4 {degraded['put']['p99_ms']}ms, heal drain "
         f"{drain_s}s")
+    if replication:
+        log(f"node_chaos replication: clean lag p99 "
+            f"{replication['clean']['lag_p99_ms']}ms vs kill-target "
+            f"{replication['kill_target']['lag_p99_ms']}ms, resync "
+            f"drain {replication['resync']['drain_s']}s")
     return {"node_chaos": out}
 
 

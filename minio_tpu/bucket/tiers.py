@@ -22,6 +22,42 @@ from ..utils import errors
 TIERS_PATH = "tiers.json"
 
 
+def _tier_timeout_s() -> float:
+    """Per-call deadline for tier IO (config ``replication.tier_timeout_s``
+    / env): a cold-storage mount that hangs must park the transition for
+    retry, not wedge the scanner cycle (GL019 contract)."""
+    from ..qos.budget import _config_float
+    return _config_float("replication", "tier_timeout_s",
+                         "MINIO_TPU_TIER_TIMEOUT_S", 30.0)
+
+
+def _bounded(fn, timeout_s: float, what: str):
+    """Run one tier IO under a hard deadline. A filesystem tier has no
+    socket timeout to lean on — a dead NFS/fuse mount blocks in
+    uninterruptible IO — so the call runs on a reaper thread and the
+    caller gives up at the deadline (the orphaned thread finishes or
+    dies with the process; durable_write's tmp+rename means an
+    abandoned write can never tear the visible file)."""
+    out: dict = {}
+    done = threading.Event()
+
+    def run():
+        try:
+            out["value"] = fn()
+        except BaseException as e:  # noqa: BLE001 — re-raised below
+            out["error"] = e
+        finally:
+            done.set()
+
+    t = threading.Thread(target=run, daemon=True, name=f"tier-io-{what}")
+    t.start()
+    if not done.wait(timeout_s):
+        raise errors.FaultyDisk(f"tier {what} timed out after {timeout_s}s")
+    if "error" in out:
+        raise out["error"]
+    return out.get("value")
+
+
 class TierFS:
     kind = "fs"
 
@@ -31,21 +67,32 @@ class TierFS:
         os.makedirs(directory, exist_ok=True)
 
     def put(self, key: str, data: bytes) -> None:
+        from .. import fault
+        fault.inject("disk", self.name, "tier_put")
         from ..storage.durability import durable_write
         path = os.path.join(self.dir, key)
         os.makedirs(os.path.dirname(path), exist_ok=True)
-        durable_write(path, data)
+        _bounded(lambda: durable_write(path, data), _tier_timeout_s(),
+                 "put")
 
     def get(self, key: str) -> bytes:
-        try:
+        from .. import fault
+        fault.inject("disk", self.name, "tier_get")
+
+        def read():
             with open(os.path.join(self.dir, key), "rb") as f:
                 return f.read()
+        try:
+            return _bounded(read, _tier_timeout_s(), "get")
         except OSError as e:
             raise errors.FileNotFound(key) from e
 
     def remove(self, key: str) -> None:
+        from .. import fault
+        fault.inject("disk", self.name, "tier_delete")
         try:
-            os.unlink(os.path.join(self.dir, key))
+            _bounded(lambda: os.unlink(os.path.join(self.dir, key)),
+                     _tier_timeout_s(), "delete")
         except OSError:
             pass
 
@@ -70,6 +117,8 @@ class TierS3:
         self.region = region
 
     def _request(self, method: str, key: str, body: bytes = b""):
+        from .. import fault
+        fault.inject("disk", self.name, f"tier_{method.lower()}")
         from ..server.auth import SigV4Verifier
         path = f"/{self.bucket}/" + (f"{self.prefix}/{key}" if self.prefix
                                      else key)
@@ -83,7 +132,7 @@ class TierS3:
         req = urllib.request.Request(
             self.endpoint + urllib.parse.quote(path), data=body or None,
             method=method, headers=headers)
-        return urllib.request.urlopen(req, timeout=30)
+        return urllib.request.urlopen(req, timeout=_tier_timeout_s())
 
     def put(self, key: str, data: bytes) -> None:
         with self._request("PUT", key, data) as resp:

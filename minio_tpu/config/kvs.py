@@ -416,6 +416,25 @@ SUB_SYSTEMS: dict[str, dict[str, KV]] = {
             help="persisted usage snapshots kept for the 1h/24h "
                  "capacity projection windows"),
     },
+    "replication": {
+        "timeout_s": KV(
+            "10", env="MINIO_TPU_REPLICATION_TIMEOUT_S",
+            help="per-RPC deadline for replica/delete shipping "
+                 "(bucket/replicate.py) — a wedged target parks the "
+                 "obligation for retry instead of hanging the worker"),
+        "retry_base_s": KV(
+            "1.0", env="MINIO_TPU_REPLICATION_RETRY_BASE_S",
+            help="exponential-backoff base for failed replication "
+                 "attempts (delay = min(cap, base * 2^attempt))"),
+        "lag_slo_s": KV(
+            "30", env="MINIO_TPU_REPLICATION_LAG_SLO_S",
+            help="replication-lag objective: charge-to-replica-landed "
+                 "p99 seconds the SLO plane holds the async plane to"),
+        "tier_timeout_s": KV(
+            "30", env="MINIO_TPU_TIER_TIMEOUT_S",
+            help="per-call deadline for lifecycle tier IO (TierFS cold "
+                 "writes ride the same bound as TierS3 HTTP calls)"),
+    },
     "notify_postgres": {
         "enable": KV("off", env="MINIO_TPU_NOTIFY_POSTGRES_ENABLE"),
         "address": KV("", env="MINIO_TPU_NOTIFY_POSTGRES_ADDRESS",
@@ -435,7 +454,7 @@ SUB_SYSTEMS: dict[str, dict[str, KV]] = {
 #: an apply callback.
 DYNAMIC = {"api", "scanner", "heal", "dispatch", "bitrot", "qos", "fault",
            "durability", "pipeline", "workloads", "timeline", "slo",
-           "profiler", "device_obs", "bucketstats"}
+           "profiler", "device_obs", "bucketstats", "replication"}
 
 
 class ConfigSys:

@@ -133,6 +133,20 @@ class Node:
         # background plane (scanner/MRF/auto-heal — reference
         # cmd/server-main.go:508-514) once the object layer is live
         server.start_background_services()
+        # cross-node replication plane (bucket/replicate.py): charges
+        # ride the notify chain, debt journals beside the MRF journal
+        # on the first local disk, and a rejoining peer kicks the
+        # backoff park (below)
+        from ..bucket.replicate import ReplicationSys
+        rs = ReplicationSys(self.obj, server.bucket_meta, node=self)
+        disk = next(iter(self.local_disks.values()), None)
+        if disk is not None:
+            import os
+            from ..storage.xlstorage import META_BUCKET
+            rs.attach_persistence(
+                os.path.join(disk.base, META_BUCKET, "replication.json"))
+        server.enable_cross_replication(rs)
+        rs.start()
         return server
 
     def _on_peer_reconnect(self, client) -> None:
@@ -154,6 +168,13 @@ class Node:
         if mrf is not None:
             try:
                 mrf.kick()
+            except Exception:  # noqa: BLE001
+                pass
+        # replication debt owed TO the rejoining peer drains now too
+        rs = getattr(srv, "replication_sys", None)
+        if rs is not None:
+            try:
+                rs.kick()
             except Exception:  # noqa: BLE001
                 pass
 

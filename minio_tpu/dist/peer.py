@@ -158,6 +158,40 @@ class PeerRESTClient:
         admin ``bucketstats?peers=1`` aggregation fans this out."""
         return json.loads(self.rpc.call("bucketstats"))
 
+    # --- cross-node replication (bucket/replicate.py; reference
+    # cmd/bucket-replication.go replicateObject target write) ----------------
+
+    def replicate_object(self, bucket: str, key: str, body,
+                         meta: dict | None = None, version_id: str = "",
+                         timeout: float = 10.0) -> None:
+        """Land one replica object on this peer. The body is the
+        PLAINTEXT source bytes; the peer stamps the REPLICA marker so
+        its own write events can never loop back. Timeout is mandatory
+        (GL019): a wedged target must park the obligation for retry,
+        not hang the replication worker."""
+        self.rpc.call("replicateobject",
+                      {"bucket": bucket, "object": key,
+                       "version_id": version_id,
+                       "meta": json.dumps(meta or {})},
+                      body=bytes(body), timeout=timeout)
+
+    def replicate_delete(self, bucket: str, key: str,
+                         version_id: str = "",
+                         timeout: float = 10.0) -> None:
+        """Propagate a delete obligation to this peer's replica
+        bucket. Missing objects are success (idempotent — replays
+        after a crash re-send deletes)."""
+        self.rpc.call("replicatedelete",
+                      {"bucket": bucket, "object": key,
+                       "version_id": version_id},
+                      timeout=timeout)
+
+    def replication_stats(self, timeout: float = 10.0) -> dict:
+        """The peer's replication-plane stats (backlog, lag, counts) —
+        the admin ``replication?peers=1`` aggregation fans this out."""
+        return json.loads(self.rpc.call("replicationstats",
+                                        timeout=timeout))
+
 
 def _stream_pubsub(pubsub, timeout_s: float, count: int, to_dict=None):
     """Generator of NDJSON event lines from a live pubsub subscription,
@@ -319,5 +353,53 @@ class PeerRESTService:
             rep = bucketstats.report()
             rep["endpoint"] = self.node.local_url
             return json.dumps(rep).encode()
+        if method == "replicateobject":
+            return self._replicate_object(params, body)
+        if method == "replicatedelete":
+            return self._replicate_delete(params)
+        if method == "replicationstats":
+            rs = getattr(getattr(self.node, "server", None),
+                         "replication_sys", None)
+            rep = rs.stats() if rs is not None else {}
+            rep["endpoint"] = self.node.local_url
+            return json.dumps(rep).encode()
         from ..utils import errors
         raise errors.MethodNotSupported(method)
+
+    def _replicate_object(self, params: dict, body: bytes) -> bytes:
+        """Target-side replica landing (reference replicateObject's
+        target PutObject): write the shipped bytes with the REPLICA
+        marker, auto-creating the destination bucket — a rebuilt
+        target starts empty and the first replica must not bounce."""
+        import io
+
+        from ..bucket.replicate import META_REPLICA, REPLICA
+        from ..objectlayer import datatypes as _dt
+        from ..objectlayer.datatypes import ObjectOptions
+        bucket = params.get("bucket", "")
+        key = params.get("object", "")
+        meta = json.loads(params.get("meta") or "{}")
+        ud = dict(meta.get("user_defined") or {})
+        ud[META_REPLICA] = REPLICA
+        opts = ObjectOptions(user_defined=ud)
+        body = body or b""
+        for attempt in range(2):
+            try:
+                self.node.obj.put_object(bucket, key, io.BytesIO(body),
+                                         len(body), opts)
+                break
+            except _dt.BucketNotFound:
+                if attempt:
+                    raise
+                self.node.obj.make_bucket(bucket)
+        return b""
+
+    def _replicate_delete(self, params: dict) -> bytes:
+        from ..objectlayer import datatypes as _dt
+        bucket = params.get("bucket", "")
+        key = params.get("object", "")
+        try:
+            self.node.obj.delete_object(bucket, key)
+        except (_dt.ObjectNotFound, _dt.BucketNotFound):
+            pass  # idempotent: journal replay re-sends deletes
+        return b""

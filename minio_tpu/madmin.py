@@ -140,6 +140,26 @@ class AdminClient:
         self._json("DELETE", "del-config-kv",
                    {"subsys": subsys, "key": key})
 
+    def replication_status(self, peers: bool = False) -> dict:
+        """Cross-node replication plane report (`GET /minio/admin/v3/
+        replication`, docs/replication.md): backlog, retry park depth,
+        completed/failed counts, lag percentiles + SLO verdict.
+        ``peers=True`` merges every node's stats — replication debt
+        lives on whichever node took the write."""
+        return self._json("GET", "replication",
+                          {"peers": "1"} if peers else None)
+
+    def replication_resync(self, bucket: str, force: bool = False) -> dict:
+        """Replay a bucket's replication backlog against its target
+        (`POST /minio/admin/v3/replication?resync=<bucket>`): every
+        object not COMPLETED re-enqueues; ``force=True`` re-ships
+        everything (target rebuilt from scratch). Returns
+        ``{"scheduled": n}``."""
+        q = {"resync": bucket}
+        if force:
+            q["force"] = "1"
+        return self._json("POST", "replication", q)
+
     def add_tier(self, spec: dict) -> None:
         self._json("PUT", "tier", None, json.dumps(spec).encode())
 

@@ -220,6 +220,31 @@ def _g_replication(server) -> list[str]:
             "# TYPE minio_tpu_replication_queued gauge",
             f"minio_tpu_replication_queued {pool.q.qsize()}",
         ]
+    rs = getattr(server, "replication_sys", None)
+    if rs is not None:
+        st = rs.stats()
+        if pool is None:
+            lines += [
+                "# TYPE minio_tpu_replication_completed_total counter",
+                f"minio_tpu_replication_completed_total {st['completed']}",
+                "# TYPE minio_tpu_replication_failed_total counter",
+                f"minio_tpu_replication_failed_total {st['failed']}",
+                "# TYPE minio_tpu_replication_queued gauge",
+                f"minio_tpu_replication_queued {st['queued']}",
+            ]
+        lines += [
+            "# TYPE minio_tpu_replication_backlog gauge",
+            f"minio_tpu_replication_backlog {st['queued']}",
+            "# TYPE minio_tpu_replication_retry_pending gauge",
+            f"minio_tpu_replication_retry_pending {st['retry_pending']}",
+            "# TYPE minio_tpu_replication_resynced_total counter",
+            f"minio_tpu_replication_resynced_total {st['resynced']}",
+            "# TYPE minio_tpu_replication_lag_seconds gauge",
+            'minio_tpu_replication_lag_seconds{quantile="0.5"} '
+            f"{st['lag_p50_s']}",
+            'minio_tpu_replication_lag_seconds{quantile="0.99"} '
+            f"{st['lag_p99_s']}",
+        ]
     from ..bucket.bandwidth import global_monitor
     rep = global_monitor().report()
     stats = rep.get("bucketStats", {})

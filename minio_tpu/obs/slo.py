@@ -233,6 +233,25 @@ def _window_eval(cls: str, obj: dict, win: str, span: int,
     }
 
 
+#: async-plane objectives (replication lag, and whatever async plane
+#: comes next): name -> zero-arg probe returning a verdict dict with at
+#: least {"ok": bool}. Percentile math stays INSIDE the owning plane
+#: (Window-derived — e.g. ReplicationSys.lag_report); this module only
+#: relays the verdict, so the request-class SLO machinery and the async
+#: objectives can't diverge in method.
+_async_probes: dict = {}
+
+
+def register_async_probe(name: str, fn) -> None:
+    """Attach an async-plane objective to the SLO report (latest
+    registration wins — a restarted subsystem re-registers)."""
+    _async_probes[name] = fn
+
+
+def unregister_async_probe(name: str) -> None:
+    _async_probes.pop(name, None)
+
+
 def report(now: float | None = None) -> dict:
     """The standing SLO verdict: per class, the effective objective,
     both windows' compliance + burn rates, the breach verdicts (both
@@ -328,6 +347,15 @@ def report(now: float | None = None) -> dict:
                 _sp.store().contains(worst_tid),
             },
         }
+    probes: dict = {}
+    for name, fn in list(_async_probes.items()):
+        try:
+            probes[name] = fn()
+        except Exception:  # noqa: BLE001 — a dying subsystem must not
+            # take the whole SLO report down with it
+            probes[name] = {"ok": False, "error": "probe failed"}
+    if probes:
+        out["async"] = probes
     return out
 
 

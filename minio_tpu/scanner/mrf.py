@@ -7,23 +7,21 @@ scanner sweeps anything missed).
 PR 6: the queue optionally persists to a small journal
 (``attach_persistence``) committed through ``durable_replace``, so heal
 debt recorded before a crash is re-enqueued after reconstruction instead
-of waiting for the next deep scanner cycle to rediscover it. All journal
-IO runs on the MRF drain thread (throttled by FLUSH_INTERVAL_S, forced
-on idle passes) — add_partial runs on foreground threads signalling
-degraded reads and must never pay serialization + fsyncs. The accepted
-crash window is the marks since the drain loop's last flush, the same
-trade the update tracker makes."""
+of waiting for the next deep scanner cycle to rediscover it.
+
+ISSUE 19: the queue + backoff-park + journal machinery is the shared
+``scanner.park.DebtQueue`` — the replication plane
+(``bucket/replicate.py``) runs the SAME implementation for replication
+debt, so drop-oldest, forget-on-delete and kick-on-peer-reconnect can
+never diverge between the two async planes. This module keeps the heal
+worker (what "paying the debt" means for heal) and the MRF-specific
+retry policy knobs."""
 from __future__ import annotations
 
-import json
 import os
-import queue
 import threading
-import time
 
-#: min seconds between journal rewrites (an add storm must not turn
-#: into a fsync storm); the drain loop flushes pending dirt on idle
-FLUSH_INTERVAL_S = 0.25
+from .park import FLUSH_INTERVAL_S, DebtQueue  # noqa: F401 — re-export
 
 #: failed heals re-enqueue with exponential backoff instead of being
 #: forgotten: a whole NODE being down fails every heal touching its
@@ -50,179 +48,45 @@ def _debt_moot(e: BaseException) -> bool:
 class MRFHealer:
     def __init__(self, objlayer, max_queue: int = 10_000):
         self.obj = objlayer
-        self.q: queue.Queue = queue.Queue(maxsize=max_queue)
+        self.dq = DebtQueue(max_queue=max_queue, mode_field="scan_mode",
+                            sticky_modes=("deep",),
+                            dropped_metric="minio_tpu_mrf_dropped_total")
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
         self.healed = 0
         self.failed = 0
-        self.dropped = 0
-        self._persist_path: str | None = None
-        self._plock = threading.Lock()
-        #: (bucket, object, version_id) -> scan_mode, mirroring queued
-        #: entries for the journal ("deep" wins a dedupe collision);
-        #: bounded by the queue: dequeues AND drop-oldest evictions both
-        #: _forget their key
-        self._persist_entries: dict[tuple, str] = {}
-        self._pdirty = False
-        self._last_flush = 0.0
-        #: single-writer flush gate: two overlapping snapshots would
-        #: race their durable_replace and a stale journal could land
-        #: LAST with the dirty flag already cleared
-        self._flushing = False
-        #: failed heals awaiting retry: [(due_monotonic, item, attempt)]
-        self._retry: list[tuple[float, tuple, int]] = []
-        self._retry_lock = threading.Lock()
+
+    # the queue internals stay addressable where they always were —
+    # chaos tests and the heal metrics group reach through these
+    @property
+    def q(self):
+        return self.dq.q
+
+    @property
+    def dropped(self) -> int:
+        return self.dq.dropped
+
+    @property
+    def _persist_path(self):
+        return self.dq._persist_path
+
+    @_persist_path.setter
+    def _persist_path(self, path):
+        self.dq._persist_path = path
 
     def add_partial(self, bucket: str, object: str, version_id: str = "",
                     scan_mode: str = "normal"):
         """scan_mode='deep' when the enqueuer saw bitrot (a normal heal's
-        size-only check would classify the disk as healthy).
-
-        Overflow policy is drop-OLDEST (heal is best-effort; the scanner
-        sweeps anything missed), retried once: racing producers can
-        refill the freed slot between get and put, and the single-try
-        fallback used to drop the NEWEST entry — the one a request just
-        flagged as degraded. Every lost entry counts in
+        size-only check would classify the disk as healthy). Overflow is
+        drop-oldest; every lost entry counts in
         ``minio_tpu_mrf_dropped_total`` and ``stats()['dropped']``."""
-        from ..obs import metrics as mx
-        item = (bucket, object, version_id, scan_mode)
-        landed = False
-        dropped = 0
-        evicted: list[tuple] = []
-        for attempt in range(3):  # initial put + drop-oldest + one retry
-            try:
-                self.q.put_nowait(item)
-                landed = True
-                break
-            except queue.Full:
-                if attempt == 2:
-                    break
-                try:
-                    evicted.append(self.q.get_nowait())
-                    dropped += 1  # an older entry made room
-                except queue.Empty:
-                    pass
-        if not landed:
-            dropped += 1  # both retries lost the race: the NEW entry
-        if dropped:
-            self.dropped += dropped
-            mx.inc("minio_tpu_mrf_dropped_total", dropped)
-        if self._persist_path is not None:
-            key = (bucket, object, version_id)
-            if landed:
-                with self._plock:
-                    if scan_mode == "deep" or \
-                            key not in self._persist_entries:
-                        self._persist_entries[key] = scan_mode
-                    self._pdirty = True
-            # drop-oldest evictions leave the journal too, or the
-            # persisted set outgrows the queue forever and resurrects
-            # debt the queue already shed — unless an identical-key
-            # duplicate is still queued (the queue does not dedupe):
-            # the journal mirrors the queue's KEY SET, and debt the
-            # queue still holds must survive a crash. Slice, don't
-            # unpack: retry promotions are 5-tuples (attempt count)
-            for ev in evicted:
-                b, o, v = ev[:3]
-                if (b, o, v) != key and not self._queued((b, o, v)):
-                    with self._plock:
-                        self._persist_entries.pop((b, o, v), None)
-                        self._pdirty = True
-            # NO inline flush: add_partial runs on foreground threads
-            # (degraded GETs signal read faults) and must not pay JSON
-            # serialization + strict fsyncs — the drain loop owns all
-            # journal IO; the marks stay dirty until its next pass
-
-    # -- persistence ----------------------------------------------------------
+        self.dq.add(bucket, object, version_id, mode=scan_mode)
 
     def attach_persistence(self, path: str, load: bool = True) -> int:
-        """Point the queue at its on-disk journal; an existing file's
-        entries are re-enqueued (restart recovery). Returns the number
-        of entries recovered.
-
-        The journal mirror is pre-populated with EVERY loaded entry
-        before the first replay add can flush — otherwise that first
-        flush rewrites the on-disk journal as a 1-entry snapshot and a
-        crash mid-replay loses the rest of the recovered heal debt."""
-        self._persist_path = path
-        if not load:
-            return 0
-        try:
-            with open(path, encoding="utf-8") as f:
-                doc = json.load(f)
-        except (OSError, ValueError):
-            return 0
-        loaded = []
-        for e in doc.get("entries", []):
-            try:
-                loaded.append((e["bucket"], e["object"],
-                               e.get("version_id", ""),
-                               e.get("scan_mode", "normal")))
-            except (KeyError, TypeError):
-                continue
-        with self._plock:
-            for b, o, v, m in loaded:
-                if m == "deep" or (b, o, v) not in self._persist_entries:
-                    self._persist_entries[(b, o, v)] = m
-        for b, o, v, m in loaded:
-            self.add_partial(b, o, v, scan_mode=m)
-        return len(loaded)
-
-    def _queued(self, key: tuple) -> bool:
-        """Best-effort 'is this key still in the queue (or parked for
-        retry)' (snapshot under the GIL; evictions and post-heal
-        forgets are rare, the queue is bounded, so the O(n) scan is
-        fine). Retry entries carry an attempt count as a 5th element —
-        slice, don't unpack."""
-        if any(tuple(e[:3]) == key for e in list(self.q.queue)):
-            return True
-        with self._retry_lock:
-            return any(tuple(item[:3]) == key
-                       for _due, item, _a in self._retry)
-
-    def _forget(self, key: tuple) -> None:
-        if self._persist_path is None or self._queued(key):
-            return  # a duplicate still queued keeps the journal entry
-        with self._plock:
-            self._persist_entries.pop(key, None)
-            self._pdirty = True
-
-    def _flush(self, force: bool = False) -> None:
-        """Throttled single-writer journal rewrite via durable_write:
-        the snapshot is taken under the lock, the IO happens outside
-        it, and only ONE flush is ever in flight — a second snapshot
-        racing the first's rename could land a STALE journal last. A
-        skipped flush leaves the dirty flag set; the drain loop's idle
-        pass settles it."""
-        path = self._persist_path
-        if path is None:
-            return
-        now = time.monotonic()
-        with self._plock:
-            if not self._pdirty or self._flushing:
-                return
-            if not force and now - self._last_flush < FLUSH_INTERVAL_S:
-                return  # stays dirty; the drain loop flushes on idle
-            self._flushing = True
-            self._pdirty = False
-            self._last_flush = now
-            entries = [{"bucket": b, "object": o, "version_id": v,
-                        "scan_mode": m}
-                       for (b, o, v), m in self._persist_entries.items()]
-        from ..storage.durability import durable_write
-        try:
-            os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
-            durable_write(path, json.dumps(
-                {"entries": entries}).encode("utf-8"))
-        except OSError:
-            # best-effort, but RETRYABLE: leave the state dirty so the
-            # drain loop's idle pass rewrites once the disk recovers —
-            # otherwise this snapshot is silently gone from the journal
-            with self._plock:
-                self._pdirty = True
-        finally:
-            with self._plock:
-                self._flushing = False
+        """Point the heal queue at its on-disk journal; an existing
+        file's entries are re-enqueued (restart recovery). Returns the
+        number of entries recovered."""
+        return self.dq.attach_persistence(path, load=load)
 
     def start(self):
         self._thread = threading.Thread(target=self._loop, daemon=True,
@@ -231,48 +95,20 @@ class MRFHealer:
         return self
 
     def stats(self) -> dict:
-        with self._retry_lock:
-            retry_pending = len(self._retry)
         return {"healed": self.healed, "failed": self.failed,
-                "queued": self.q.qsize() + retry_pending,
-                "retry_pending": retry_pending, "dropped": self.dropped}
+                **self.dq.stats()}
 
     def kick(self) -> None:
         """Promote every backoff-parked retry to runnable NOW — called
         when a peer node rejoins (rpc on_reconnect): the heal debt its
         absence created should drain immediately, not wait out the
         exponential backoff."""
-        with self._retry_lock:
-            self._retry = [(0.0, item, attempt)
-                           for _due, item, attempt in self._retry]
-
-    def _promote_due_retries(self) -> None:
-        now = time.monotonic()
-        with self._retry_lock:
-            due = [e for e in self._retry if e[0] <= now]
-            if not due:
-                return
-            self._retry = [e for e in self._retry if e[0] > now]
-        for _due, item, attempt in due:
-            try:
-                self.q.put_nowait((*item, attempt))
-            except queue.Full:
-                # queue refilled under load: park it again shortly
-                with self._retry_lock:
-                    self._retry.append((now + RETRY_BASE_S, item, attempt))
-
-    def _park_retry(self, item: tuple, attempt: int) -> None:
-        delay = min(RETRY_CAP_S, RETRY_BASE_S * (1 << min(attempt, 5)))
-        with self._retry_lock:
-            self._retry.append((time.monotonic() + delay, item, attempt))
+        self.dq.kick()
 
     def _loop(self):
         while not self._stop.is_set():
-            self._promote_due_retries()
-            try:
-                entry = self.q.get(timeout=0.5)
-            except queue.Empty:
-                self._flush(force=True)  # idle: settle throttled dirt
+            entry = self.dq.pop(timeout=0.5, repark_s=RETRY_BASE_S)
+            if entry is None:
                 continue
             # queue entries are 4-tuples; retry promotions carry a 5th
             # element with the attempt count
@@ -303,35 +139,26 @@ class MRFHealer:
                     # park with backoff, KEEP the journal entry: the
                     # failure is usually an offline target (a dead
                     # node), and the debt must survive until rejoin
-                    self._park_retry(
-                        (bucket, object, version_id, scan_mode),
-                        attempt + 1)
-                    self._flush()
+                    self.dq.park((bucket, object, version_id, scan_mode),
+                                 attempt + 1, RETRY_BASE_S, RETRY_CAP_S)
+                    self.dq.flush()
                     continue
                 # retries exhausted (or the object is gone): the deep
                 # scanner cycle re-finds anything still genuinely
                 # degraded
-            self._forget((bucket, object, version_id))
-            self._flush()  # on OUR thread, throttled by FLUSH_INTERVAL_S
+            self.dq.settle((bucket, object, version_id))
 
     def flush_journal(self) -> None:
         """Force the persistence journal onto disk (tests/shutdown)."""
-        self._flush(force=True)
+        self.dq.flush(force=True)
 
     def drain(self, timeout: float = 30.0):
         """Block until the queue AND the retry park are empty
         (tests / shutdown)."""
-        import time
-        deadline = time.monotonic() + timeout
-        while time.monotonic() < deadline:
-            with self._retry_lock:
-                parked = len(self._retry)
-            if self.q.empty() and parked == 0:
-                return
-            time.sleep(0.05)
+        self.dq.drain(timeout)
 
     def stop(self):
         self._stop.set()
         if self._thread is not None:
             self._thread.join(timeout=5)
-        self._flush(force=True)
+        self.dq.flush(force=True)

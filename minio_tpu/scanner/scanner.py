@@ -17,11 +17,15 @@ DEEP_SCAN_EVERY = 16  # healDeepScanCycleMultiplier (cmd/data-scanner.go:48)
 class DataScanner:
     def __init__(self, objlayer, interval_s: float = 60.0,
                  mrf=None, lifecycle=None, sleep_per_object: float = 0.001,
-                 compact_least: int | None = None):
+                 compact_least: int | None = None, replication=None):
         self.obj = objlayer
         self.interval = interval_s
         self.mrf = mrf
         self.lifecycle = lifecycle
+        #: optional bucket.replicate.ReplicationSys — the cycle
+        #: re-charges objects stuck PENDING/FAILED (missed charge,
+        #: exhausted retries, debt shed under queue overflow)
+        self.replication = replication
         self.sleep_per_object = sleep_per_object
         self.compact_least = usage_mod.COMPACT_LEAST \
             if compact_least is None else compact_least
@@ -116,7 +120,12 @@ class DataScanner:
             # with zero writes (expiry/transition trigger on age)
             has_lifecycle = self.lifecycle is not None and \
                 bool(self.lifecycle.rules_for(b.name))
+            # same rule for replication: PENDING/FAILED debt must be
+            # re-found even when the bucket saw zero new writes
+            has_replication = self.replication is not None and \
+                bool(self.replication.rules_for(b.name))
             if prev is not None and not deep and not has_lifecycle and \
+                    not has_replication and \
                     not tracker.bucket_dirty(b.name):
                 buckets[b.name] = prev
                 total_objects += prev.get("objects", 0)
@@ -182,6 +191,14 @@ class DataScanner:
             try:
                 if self.lifecycle.apply(bucket, oi):
                     return
+            except Exception:  # noqa: BLE001
+                pass
+        # replication sweep: anything still PENDING/FAILED re-charges
+        # (the safety net under the journal — reference the scanner's
+        # queueReplicationHeal pass in cmd/data-scanner.go)
+        if self.replication is not None:
+            try:
+                self.replication.sweep(bucket, oi)
             except Exception:  # noqa: BLE001
                 pass
         if deep and self.mrf is not None:

@@ -272,6 +272,8 @@ def _dispatch_admin(h, op: str) -> None:
             "counters": counters,
             "last_sweep": getattr(janitor, "last_stats", {}) or {},
         }).encode(), "application/json")
+    if op == "replication":
+        return _replication_op(h)
     if op == "fault":
         return _fault_op(h)
     if op == "bg-heal-status":
@@ -304,6 +306,39 @@ def _dispatch_admin(h, op: str) -> None:
     if _iam_op(h, op):
         return
     h._error("NotImplemented", f"admin op {op}", 501)
+
+
+def _replication_op(h) -> None:
+    """Cross-node replication plane (docs/replication.md): GET reports
+    backlog/lag/status (``?peers=1`` merges every peer's stats —
+    replication debt lives on whichever node took the write); POST
+    ``?resync=<bucket>`` replays the bucket's backlog against its
+    target (``&force=1`` re-ships EVERYTHING — a target rebuilt from
+    scratch). Root credentials only (enforced by handle_admin)."""
+    rs = getattr(h.s3, "replication_sys", None)
+    q = {k: v[0] for k, v in h.query.items()}
+    if h.command == "POST":
+        bucket = q.get("resync", "")
+        if not bucket:
+            return h._error("InvalidArgument", "resync needs ?resync="
+                            "<bucket>", 400)
+        if rs is None:
+            return h._error("InvalidArgument",
+                            "replication plane not enabled", 400)
+        n = rs.resync(bucket, force=q.get("force") == "1")
+        return h._send(200, json.dumps({"scheduled": n}).encode(),
+                       "application/json")
+    out: dict = rs.stats() if rs is not None else {}
+    if rs is not None:
+        out["lag"] = rs.lag_report()
+    if q.get("peers") == "1":
+        for peer in getattr(h.s3, "peers", lambda: [])():
+            try:
+                out.setdefault("peers", []).append(
+                    peer.replication_stats())
+            except Exception:  # noqa: BLE001 — peer down: skip
+                continue
+    return h._send(200, json.dumps(out).encode(), "application/json")
 
 
 def _fault_op(h) -> None:

@@ -282,6 +282,30 @@ class S3Server:
         self.notify = chained
         return pool
 
+    def enable_cross_replication(self, rs):
+        """Attach the cross-node ReplicationSys (bucket/replicate.py):
+        completed writes/deletes charge replication debt through the
+        notify chain, and the scanner re-charges PENDING/FAILED
+        leftovers each cycle. Distinct from ``enable_replication``
+        (the S3-target pool): this plane ships over the dist peer RPC
+        with MRF-style journalled retry."""
+        self.replication_sys = rs
+        prev = self.notify
+
+        def chained(event, bucket, oi, *a):
+            rs.charge(event, bucket, oi)
+            if prev is not None:
+                prev(event, bucket, oi, *a)
+
+        self.notify = chained
+        sc = getattr(self, "scanner", None)
+        if sc is not None:
+            sc.replication = rs
+        # replication lag rides the SLO plane as a real objective
+        from ..obs import slo as _slo
+        _slo.register_async_probe("replication", rs.lag_report)
+        return rs
+
     def enable_events(self, targets: list | None = None,
                       queue_root: str = ""):
         """Attach the event-notification subsystem: persistent per-target
@@ -505,7 +529,7 @@ class S3Server:
         return [obj] if hasattr(obj, "on_partial") else []
 
     def shutdown(self):
-        for svc_name in ("scanner", "autoheal", "mrf"):
+        for svc_name in ("scanner", "autoheal", "mrf", "replication_sys"):
             svc = getattr(self, svc_name, None)
             if svc is not None:
                 try:
@@ -685,6 +709,14 @@ class _S3Handler(BaseHTTPRequestHandler):
     def _send(self, status: int, body: bytes = b"",
               content_type: str = "application/xml",
               headers: dict | None = None):
+        if getattr(self, "_last_status", 0):
+            # a response already started for this request — this is an
+            # error surfacing MID-BODY (e.g. the object was deleted under
+            # a streaming GET). Appending an error document would corrupt
+            # the keep-alive framing: the client would block inside the
+            # truncated body instead of seeing EOF. Cut the connection.
+            self.close_connection = True
+            return
         self.send_response(status)
         for k, v in (headers or {}).items():
             if v is not None and v != "":
@@ -944,7 +976,9 @@ class _S3Handler(BaseHTTPRequestHandler):
         except (BadDigestError, SHA256MismatchError) as e:
             self._error("BadDigest", str(e), 400)
         except BrokenPipeError:
-            pass
+            # client went away mid-response; the half-written reply makes
+            # this connection unusable for keep-alive
+            self.close_connection = True
         except Exception as e:  # noqa: BLE001
             import traceback
             traceback.print_exc()
@@ -1112,6 +1146,8 @@ class _S3Handler(BaseHTTPRequestHandler):
                 return s.put_bucket_notification(ak)
             if s.has_q("lifecycle"):
                 return s.put_bucket_lifecycle(ak)
+            if s.has_q("replication"):
+                return s.put_bucket_replication(ak)
             if s.has_q("object-lock"):
                 return s.put_object_lock_config(ak)
             return s.put_bucket(ak)
@@ -1128,6 +1164,8 @@ class _S3Handler(BaseHTTPRequestHandler):
                 return s.get_bucket_notification(ak)
             if s.has_q("lifecycle"):
                 return s.get_bucket_lifecycle(ak)
+            if s.has_q("replication"):
+                return s.get_bucket_replication(ak)
             if s.has_q("object-lock"):
                 return s.get_object_lock_config(ak)
             if s.has_q("uploads"):
@@ -1146,6 +1184,8 @@ class _S3Handler(BaseHTTPRequestHandler):
                 return s.delete_bucket_policy(ak)
             if s.has_q("lifecycle"):
                 return s.delete_bucket_lifecycle(ak)
+            if s.has_q("replication"):
+                return s.delete_bucket_replication(ak)
             return s.delete_bucket(ak)
         if m == "POST":
             if s.has_q("delete"):
@@ -1953,6 +1993,34 @@ class _S3Handler(BaseHTTPRequestHandler):
         self.s3.bucket_meta.update(self.bucket, lifecycle_xml=b"")
         self._send(204)
 
+    def put_bucket_replication(self, ak):
+        """PUT ?replication (reference PutBucketReplicationConfigHandler):
+        rules validate before persisting — a rule without a destination
+        would charge obligations nothing can ever pay."""
+        self._authorize(ak, "s3:PutReplicationConfiguration")
+        self.s3.obj.get_bucket_info(self.bucket)
+        body = self._read_body()
+        from ..bucket import replicate as repl
+        try:
+            repl.validate_replication(body)
+        except (ET.ParseError, ValueError) as e:
+            return self._error("MalformedXML", str(e), 400)
+        self.s3.bucket_meta.update(self.bucket, replication_xml=body)
+        self._send(200)
+
+    def get_bucket_replication(self, ak):
+        self._authorize(ak, "s3:GetReplicationConfiguration")
+        meta = self.s3.bucket_meta.get(self.bucket)
+        if not meta.replication_xml:
+            return self._error("ReplicationConfigurationNotFoundError",
+                               "no replication config", 404)
+        self._send(200, meta.replication_xml)
+
+    def delete_bucket_replication(self, ak):
+        self._authorize(ak, "s3:PutReplicationConfiguration")
+        self.s3.bucket_meta.update(self.bucket, replication_xml=b"")
+        self._send(204)
+
     def delete_multiple(self, ak):
         self._authorize(ak, "s3:DeleteObject")
         self._last_ak = ak
@@ -2052,6 +2120,13 @@ class _S3Handler(BaseHTTPRequestHandler):
                 user_defined[cz.META_ACTUAL_SIZE] = str(size)
                 stream, put_size = cz.compress_reader(hr), -1
                 opts.etag_source = hr
+        # replication charged at PUT: the status lands IN xl.meta with
+        # the write itself (no post-write meta update to lose in a
+        # crash window) — the notify chain enqueues the debt
+        rs = getattr(self.s3, "replication_sys", None)
+        if rs is not None and rs.heads_up(self.bucket, self.key) is not None:
+            from ..bucket import replicate as repl
+            user_defined[repl.META_REP_STATUS] = repl.PENDING
         opts.user_defined = user_defined
         oi = self.s3.obj.put_object(self.bucket, self.key, stream, put_size,
                                     opts)
@@ -2784,6 +2859,18 @@ class _S3Handler(BaseHTTPRequestHandler):
         opts = self._opts()
         oi = self.s3.obj.complete_multipart_upload(
             self.bucket, self.key, self.q("uploadId"), parts, opts)
+        # multipart-complete is a replication charge point too; the
+        # status rides a meta update since the parts were written long
+        # before the obligation existed
+        rs = getattr(self.s3, "replication_sys", None)
+        if rs is not None and rs.heads_up(self.bucket, self.key) is not None:
+            from ..bucket import replicate as repl
+            try:
+                self.s3.obj.update_object_meta(
+                    self.bucket, self.key,
+                    {repl.META_REP_STATUS: repl.PENDING})
+            except Exception:  # noqa: BLE001 — charge still queues
+                pass
         self._send(200, xu.complete_multipart_xml(
             f"{self.s3.endpoint()}/{self.bucket}/{self.key}",
             self.bucket, self.key, oi.etag),

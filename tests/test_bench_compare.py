@@ -101,6 +101,30 @@ def test_direction_classification():
     assert direction("extra.bucket_stats.fold_hits") == ""
     assert direction("extra.bucket_stats.tracked") == ""
     assert direction("extra.bucket_stats.series_labels") == ""
+    # the replication plane (ISSUE 19): lag quantiles and drain times
+    # gate down-better (clean AND kill-target legs), while backlog
+    # counts, retry bookkeeping, the lag-SLO config echo and the
+    # kill/rejoin schedule stamps stay evidence
+    assert direction("node_chaos.replication.clean.lag_p99_ms") == "down"
+    assert direction(
+        "node_chaos.replication.kill_target.lag_p50_ms") == "down"
+    assert direction(
+        "node_chaos.replication.kill_target.drain_s") == "down"
+    assert direction("node_chaos.replication.resync.drain_s") == "down"
+    assert direction("node_chaos.replication.clean.backlog") == ""
+    assert direction("node_chaos.replication.resync.resynced") == ""
+    assert direction(
+        "scale_slo.replication.replication.lag.lag_p99_s") == "down"
+    assert direction(
+        "scale_slo.replication.replication.lag.threshold_s") == ""
+    assert direction(
+        "scale_slo.replication.replication.stats.retry_pending") == ""
+    assert direction(
+        "scale_slo.replication.replication.target_down_at_s") == ""
+    assert direction(
+        "scale_slo.replication.replication.target_rejoined_at_s") == ""
+    assert direction(
+        "scale_slo.replication.replication.acked_writes") == ""
 
 
 def test_regression_flags_both_directions():

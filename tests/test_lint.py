@@ -1081,3 +1081,84 @@ def test_gl018_registered_and_baseline_empty():
     assert checkers.check_bounded_request_labels in checkers.PER_FILE
     assert graftlint.load_baseline() == {}, \
         "GL018 must hold with an EMPTY baseline"
+
+
+# --------------------------------------------------------------------------
+# GL019 — replication/lifecycle async planes: bounded, chaos-reachable
+
+
+def test_gl019_unbounded_ship_calls_flagged():
+    """Network calls in the async-plane modules without timeout= are
+    findings: peer-RPC ship methods, generic .call, and requests-style
+    HTTP all hang the worker forever on a wedged target."""
+    ctx = ctx_for("""
+        def ship(peer, sess, bucket, key, blob):
+            peer.replicate_object(bucket, key, blob)
+            peer.call("ReplicateDelete", bucket=bucket, key=key)
+            sess.http.post("http://tier/x", data=blob)
+    """, path="minio_tpu/bucket/replicate.py")
+    found = checkers.check_async_plane_bounds(ctx)
+    assert [f.checker for f in found] == ["GL019"] * 3
+    assert {f.token for f in found} == \
+        {"net:replicate_object", "net:call", "net:post"}
+
+
+def test_gl019_bounded_and_out_of_scope_ok():
+    src = """
+        def ship(peer, sess, bucket, key, blob):
+            peer.replicate_object(bucket, key, blob, timeout=10.0)
+            sess.http.post("http://tier/x", data=blob, timeout=5)
+    """
+    # timeout= present -> clean in an async-plane module
+    assert not checkers.check_async_plane_bounds(
+        ctx_for(src, path="minio_tpu/bucket/tiers.py"))
+    # the same calls WITHOUT timeout are fine outside the plane
+    bare = """
+        def ship(peer, bucket, key, blob):
+            peer.replicate_object(bucket, key, blob)
+    """
+    assert not checkers.check_async_plane_bounds(
+        ctx_for(bare, path="minio_tpu/server/s3api.py"))
+
+
+def test_gl019_tier_class_missing_hook_and_deadline_flagged():
+    """A Tier* data-path class with no fault.inject("disk", ...) hook
+    and no deadline surfaces BOTH findings; TierRegistry (pure
+    bookkeeping, no IO) is exempt by name."""
+    ctx = ctx_for("""
+        class TierNFS:
+            def get(self, key):
+                return open(self.root + key, "rb").read()
+
+        class TierRegistry:
+            def lookup(self, name):
+                return self.tiers[name]
+    """, path="minio_tpu/bucket/tiers.py")
+    found = checkers.check_async_plane_bounds(ctx)
+    assert {f.token for f in found} == \
+        {"hook:TierNFS", "deadline:TierNFS"}
+
+
+def test_gl019_tier_class_with_hook_and_deadline_ok():
+    ctx = ctx_for("""
+        from .. import fault
+
+        class TierFS:
+            def get(self, key):
+                fault.inject("disk", self.name, "tier_get")
+                return _bounded(self._read, key)
+    """, path="minio_tpu/bucket/tiers.py")
+    assert not checkers.check_async_plane_bounds(ctx)
+
+
+def test_gl019_registered_and_baseline_empty():
+    """GL019 is an active PER_FILE checker (so test_tree_is_clean
+    proves every live ship/tier site is bounded + chaos-reachable)
+    with an EMPTY baseline, and its file set still exists on disk."""
+    assert checkers.check_async_plane_bounds in checkers.PER_FILE
+    assert graftlint.load_baseline() == {}, \
+        "GL019 must hold with an EMPTY baseline"
+    for relpath in checkers._GL019_FILES:
+        assert os.path.exists(os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            relpath)), f"GL019 covers missing file {relpath}"

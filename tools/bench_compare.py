@@ -55,6 +55,12 @@ import re
 #: tracked/fold_hits/series_labels describe the synthetic storm's
 #: shape — only the scrape `_ms` wall times and the scaling overhead
 #: ratio (all down-better) gate (pinned by tests/test_bench_compare.py)
+#: ... and the replication plane's COUNT/echo leaves (ISSUE 19):
+#: backlog/resynced/retry_pending scale with the chaos schedule,
+#: threshold_s is a config echo and the target_*_at_s stamps are the
+#: kill/rejoin schedule — only the lag quantiles (`lag_p50_ms`/
+#: `lag_p99_ms`/`lag_p50_s`/`lag_p99_s`) and the `drain_s` drain
+#: times gate, all down-better (pinned by tests/test_bench_compare.py)
 NON_HEADLINE = {"duration_s", "ramp_s", "preload_s", "wall_s",
                 "interval_s", "timeout_s", "ttl_s", "expiry_s",
                 "value_bytes", "objects", "clients", "open_rps",
@@ -66,7 +72,9 @@ NON_HEADLINE = {"duration_s", "ramp_s", "preload_s", "wall_s",
                 "acquired_total", "released_total", "donated_total",
                 "flushes", "device_seconds", "compiles_total",
                 "compile_storms_total",
-                "fold_hits", "tracked", "series_labels"}
+                "fold_hits", "tracked", "series_labels",
+                "backlog", "resynced", "retry_pending", "threshold_s",
+                "target_down_at_s", "target_rejoined_at_s"}
 BURN = re.compile(r"burn", re.IGNORECASE)
 HIGHER_BETTER = re.compile(
     r"(gibs|rps|availability|_ratio|^value$|requests_total)",

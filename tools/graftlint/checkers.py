@@ -1,4 +1,4 @@
-"""The graftlint checkers (GL001-GL018).
+"""The graftlint checkers (GL001-GL019).
 
 Each per-file checker takes a ``FileCtx`` and yields ``Finding``s; the
 project-wide checkers take the full list of parsed files (cross-file
@@ -63,6 +63,12 @@ text — nothing in the checked tree is imported.
 |       | helper ``obs/bucketstats.fold_label`` — a raw request string |
 |       | as a label value is an unbounded time-series cardinality     |
 |       | leak (one series per tenant-chosen name)                     |
+| GL019 | the replication + lifecycle async planes are bounded and     |
+|       | chaos-reachable (GL014 extended): every network/ship call    |
+|       | in the plane modules carries ``timeout=``, and every         |
+|       | ``Tier*`` data-path class carries a disk-layer fault hook    |
+|       | plus a deadline — a wedged target/tier parks the obligation  |
+|       | for retry instead of hanging the worker or scanner           |
 """
 from __future__ import annotations
 
@@ -1442,6 +1448,98 @@ def check_bounded_request_labels(ctx: FileCtx) -> list[Finding]:
     return out
 
 
+# --------------------------------------------------------------------------
+# GL019 — replication/lifecycle async planes: bounded, chaos-reachable
+
+#: the async-plane modules GL019 covers (GL014's contract extended
+#: beyond dist/): replication shipping + the ILM tier targets
+_GL019_FILES = {
+    "minio_tpu/bucket/replicate.py",
+    "minio_tpu/bucket/replication.py",
+    "minio_tpu/bucket/tiers.py",
+    "minio_tpu/bucket/transition.py",
+    "minio_tpu/bucket/lifecycle.py",
+}
+
+#: network-shipping attribute calls that must carry an explicit
+#: ``timeout=`` (the peer RPC's default would silently unbound them
+#: if someone removed the kwarg at a call site)
+_GL019_SHIP_CALLS = {"replicate_object", "replicate_delete",
+                     "replication_stats", "call", "urlopen"}
+
+
+def check_async_plane_bounds(ctx: FileCtx) -> list[Finding]:
+    """GL019: the replication + lifecycle planes stay bounded and
+    chaos-reachable. Every network call (requests-style HTTP, the peer
+    RPC ship methods, urlopen) carries ``timeout=`` — a wedged target
+    must park the obligation for retry, never hang the worker or the
+    scanner cycle. Every ``Tier*`` data-path class carries a
+    fault-injection hook (``fault.inject("disk", <tier>, ...)`` — the
+    chaos matrix kills tiers through the disk layer) and a deadline
+    (``timeout=`` or the ``_bounded`` reaper helper)."""
+    if ctx.path not in _GL019_FILES:
+        return []
+    out: list[Finding] = []
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        d = dotted(node.func)
+        tail = d.rsplit(".", 1)[-1]
+        recv = d.rsplit(".", 1)[0] if "." in d else ""
+        http_like = tail in _GL014_HTTP_VERBS and \
+            _GL014_HTTP_RECV_RE.search(recv)
+        ship_like = tail in _GL019_SHIP_CALLS and recv
+        if not http_like and not ship_like:
+            continue
+        if any(kw.arg == "timeout" for kw in node.keywords):
+            continue
+        if ctx.suppressed(node.lineno, "GL019"):
+            continue
+        out.append(Finding(
+            ctx.path, node.lineno, "GL019",
+            f"async-plane network call `{_unparse(node.func)}(...)` "
+            "without a timeout= — a hung replication target or tier "
+            "would pin the worker forever (the obligation must park "
+            "for retry instead)",
+            token=f"net:{tail}", scope=ctx.scope_at(node.lineno)))
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.ClassDef) or \
+                not node.name.startswith("Tier") or \
+                node.name == "TierRegistry":
+            continue
+        has_hook = False
+        has_deadline = False
+        for sub in ast.walk(node):
+            if not isinstance(sub, ast.Call):
+                continue
+            d = dotted(sub.func)
+            if d.endswith("inject") and sub.args and \
+                    isinstance(sub.args[0], ast.Constant) and \
+                    sub.args[0].value == "disk":
+                has_hook = True
+            if any(kw.arg == "timeout" for kw in sub.keywords) or \
+                    "timeout" in d or d.endswith("_bounded"):
+                has_deadline = True
+        if not has_hook and not ctx.suppressed(node.lineno, "GL019"):
+            out.append(Finding(
+                ctx.path, node.lineno, "GL019",
+                f"tier class {node.name} has no disk-layer fault hook "
+                "(`fault.inject(\"disk\", <tier>, ...)`): the chaos "
+                "matrix cannot fail its IO, so transition/restore "
+                "retry paths are untestable",
+                token=f"hook:{node.name}",
+                scope=ctx.scope_at(node.lineno + 1)))
+        if not has_deadline and not ctx.suppressed(node.lineno, "GL019"):
+            out.append(Finding(
+                ctx.path, node.lineno, "GL019",
+                f"tier class {node.name} carries no deadline "
+                "(timeout= kwarg or the _bounded reaper): a dead "
+                "cold-storage mount would wedge the scanner cycle",
+                token=f"deadline:{node.name}",
+                scope=ctx.scope_at(node.lineno + 1)))
+    return out
+
+
 PER_FILE = [
     check_wall_duration,
     check_blocking_under_lock,
@@ -1460,5 +1558,6 @@ PER_FILE = [
     check_thread_names,
     check_tracked_compiles,
     check_bounded_request_labels,
+    check_async_plane_bounds,
 ]
 PROJECT = [check_metrics_documented]

@@ -144,6 +144,20 @@ class Profile:
     #: degraded traffic. Requires value_bytes above the 128 KiB inline
     #: threshold (inlined objects never read shards).
     degraded: bool = False
+    #: async-replication chaos phase (ISSUE 19, needs a LoadGen.cluster
+    #: topology): a replication rule points a source bucket at THIS
+    #: node index's endpoint, a writer streams unique PUTs at the
+    #: source, and mid-stream the TARGET is killed (or partitioned)
+    #: and later rejoined — the settle phase proves no replica
+    #: obligation was lost (every acked source key re-reads bit-exact
+    #: from the replica bucket on the rejoined target) and the
+    #: replication backlog drained to zero. Kill/rejoin timing reuses
+    #: chaos_kill_at_frac / chaos_restart_at_frac.
+    replication_target_node: int | None = None
+    #: partition the target's RPC plane instead of killing the process
+    #: — the ship path sees refused calls while the node stays up
+    replication_partition: bool = False
+    replication_drain_timeout_s: float = 90.0
 
     @classmethod
     def tier1(cls) -> "Profile":
@@ -555,7 +569,7 @@ class LoadGen:
                 if r.status_code == 200:
                     acked[key] = hashlib.md5(body).hexdigest()
             except Exception:  # noqa: BLE001 — unacked: not in ledger
-                pass
+                out["unacked_writes"] = out.get("unacked_writes", 0) + 1
             seq += 1
         out["acked_writes"] = len(acked)
         out["_acked"] = acked
@@ -594,6 +608,126 @@ class LoadGen:
             if not ok:
                 lost.append(key)
         out["lost_writes"] = lost[:16]
+        out["lost_count"] = len(lost)
+
+    def _replication_phase(self, profile: Profile, rec_t0: float,
+                           deadline: float, out: dict) -> None:
+        """Replication-chaos driver (ISSUE 19, its own thread): point a
+        replication rule at the target node through the S3 surface
+        (PutBucketReplication), stream unique PUTs at the source
+        bucket, and mid-stream kill — or partition — the TARGET;
+        rejoin it later in the run. Every 200-acked source key is
+        recorded; the settle phase re-reads each one from the replica
+        bucket on the rejoined target, the no-replica-obligation-lost
+        proof."""
+        import hashlib
+        lc = self.topology
+        idx = profile.replication_target_node
+        src = f"{profile.bucket}-replsrc"
+        dst = f"{profile.bucket}-replica"
+        out["src"], out["dst"], out["target_node"] = src, dst, idx
+        out["mode"] = ("partition" if profile.replication_partition
+                       else "kill")
+        cl = _SigClient(self.endpoint, self.ak, self.sk)
+        cl.request("PUT", f"/{src}")
+        xml = (
+            "<ReplicationConfiguration><Rule><ID>loadgen</ID>"
+            "<Status>Enabled</Status><Priority>1</Priority>"
+            "<DeleteMarkerReplication><Status>Enabled</Status>"
+            "</DeleteMarkerReplication><Destination>"
+            f"<Bucket>{dst}</Bucket><Endpoint>{lc.urls[idx]}"
+            "</Endpoint></Destination></Rule>"
+            "</ReplicationConfiguration>")
+        r = cl.request("PUT", f"/{src}", query={"replication": ""},
+                       body=xml.encode())
+        out["rule_set"] = r.status_code == 200
+        kill_at = rec_t0 + profile.duration_s * profile.chaos_kill_at_frac
+        restart_at = rec_t0 + profile.duration_s * \
+            profile.chaos_restart_at_frac
+        acked: dict[str, str] = {}
+        seq = 0
+        killed = restarted = False
+        part_rule: str | None = None
+        while time.monotonic() < deadline or (killed and not restarted):
+            now = time.monotonic()
+            if not killed and now >= kill_at:
+                if profile.replication_partition:
+                    from minio_tpu.fault import node as fault_node
+                    part_rule = fault_node.partition(lc.urls[idx])
+                else:
+                    lc.kill(idx)
+                out["target_down_at_s"] = round(now - rec_t0, 3)
+                killed = True
+                continue
+            if killed and not restarted and now >= restart_at:
+                if part_rule is not None:
+                    from minio_tpu import fault
+                    fault.disarm(part_rule)
+                else:
+                    lc.restart(idx)
+                out["target_rejoined_at_s"] = round(
+                    time.monotonic() - rec_t0, 3)
+                restarted = True
+                continue
+            body = hashlib.sha256(f"replica{seq}".encode()).digest() * 32
+            key = f"repl/k{seq:06d}"
+            try:
+                r = cl.request("PUT", f"/{src}/{key}", body=body)
+                if r.status_code == 200:
+                    acked[key] = hashlib.md5(body).hexdigest()
+            except Exception:  # noqa: BLE001 — unacked: no obligation
+                out["unacked_writes"] = out.get("unacked_writes", 0) + 1
+            seq += 1
+        out["acked_writes"] = len(acked)
+        out["_acked"] = acked
+
+    def _replication_settle(self, profile: Profile, out: dict) -> None:
+        """Post-run: wait for every live node's replication backlog
+        (queued + retry-parked) to drain to zero, snapshot the lag
+        report, then re-read every acknowledged source key from the
+        replica bucket on the rejoined target — bit-exact."""
+        import hashlib
+        lc = self.topology
+        idx = out.get("target_node") or 0
+        t0 = time.monotonic()
+        deadline = t0 + profile.replication_drain_timeout_s
+        drained = False
+        # rejoin normally kicks the parked debt via _on_peer_reconnect;
+        # the backoff promoter drains it regardless, so this poll only
+        # decides WHEN the settle moves on, never whether debt survives
+        while time.monotonic() < deadline:
+            backlog = 0
+            for node in lc.nodes:
+                srv = getattr(node, "server", None)
+                rs = getattr(srv, "replication_sys", None) if srv \
+                    else None
+                if rs is not None:
+                    st = rs.stats()
+                    backlog += st["queued"] + st["retry_pending"]
+            if backlog == 0:
+                drained = True
+                break
+            time.sleep(0.25)
+        out["drain_s"] = round(time.monotonic() - t0, 3)
+        out["drained"] = drained
+        rs0 = getattr(self.server, "replication_sys", None)
+        if rs0 is not None:
+            out["lag"] = rs0.lag_report()
+            out["stats"] = rs0.stats()
+        acked = out.pop("_acked", {})
+        dst = out.get("dst", "")
+        cl = _SigClient(lc.urls[idx], self.ak, self.sk)
+        lost: list[str] = []
+        for key, md5 in acked.items():
+            try:
+                r = cl.request("GET", f"/{dst}/{key}")
+                ok = r.status_code == 200 and \
+                    hashlib.md5(r.content).hexdigest() == md5
+            except Exception:  # noqa: BLE001
+                ok = False
+            if not ok:
+                lost.append(key)
+        out["lost_replicas"] = lost[:16]
         out["lost_count"] = len(lost)
 
     def _arm_degraded(self) -> tuple[str, str]:
@@ -730,6 +864,16 @@ class LoadGen:
                 raise ValueError(
                     f"chaos_kill_node must be 1..{n_nodes - 1} "
                     "(node 0 is the load endpoint)")
+        if profile.replication_target_node is not None:
+            if getattr(self, "topology", None) is None:
+                raise ValueError(
+                    "the replication phase needs --topology > 1 "
+                    "(a real target node to ship to)")
+            n_nodes = len(self.topology.nodes)
+            if not 0 < profile.replication_target_node < n_nodes:
+                raise ValueError(
+                    f"replication_target_node must be 1..{n_nodes - 1} "
+                    "(node 0 serves the source load)")
         body = random.Random(profile.seed + 1).randbytes(
             profile.value_bytes)
         preload_s = self.preload(profile)
@@ -818,6 +962,15 @@ class LoadGen:
                     args=(profile, rec.t0, deadline, chaos),
                     daemon=True, name="loadgen-chaos")
                 chaos_t.start()
+            repl: dict = {}
+            repl_t: threading.Thread | None = None
+            if profile.replication_target_node is not None and \
+                    getattr(self, "topology", None) is not None:
+                repl_t = threading.Thread(
+                    target=self._replication_phase,
+                    args=(profile, rec.t0, deadline, repl),
+                    daemon=True, name="loadgen-replication")
+                repl_t.start()
             for t in ths:
                 t.join(timeout=profile.duration_s + 60)
             if open_t is not None:
@@ -828,6 +981,9 @@ class LoadGen:
             if chaos_t is not None:
                 chaos_t.join(timeout=profile.duration_s + 120)
                 self._chaos_settle(profile, chaos)
+            if repl_t is not None:
+                repl_t.join(timeout=profile.duration_s + 120)
+                self._replication_settle(profile, repl)
             if heal_t is not None:
                 heal_t.join(timeout=profile.duration_s + 60)
             if degraded_rule is not None:
@@ -851,7 +1007,7 @@ class LoadGen:
                                 scanner_win, probe, lockrank_before,
                                 chaos, degraded,
                                 _prof.delta_report(run_snap),
-                                compiles0, notifier)
+                                compiles0, notifier, repl)
         finally:
             # the armed disk-kill rule is PROCESS-WIDE state: a failure
             # anywhere in the measured phase must not leave every later
@@ -891,7 +1047,8 @@ class LoadGen:
                 degraded: dict | None = None,
                 run_prof=None,
                 compiles0: int | None = None,
-                notifier: dict | None = None) -> dict:
+                notifier: dict | None = None,
+                repl: dict | None = None) -> dict:
         from minio_tpu.obs import slo
         from minio_tpu.obs.health import cluster_snapshot
         rows = rec.snapshot()
@@ -1075,13 +1232,29 @@ class LoadGen:
                 chaos.get("heal_drained", False)
             verdicts["background_slo_availability_ok"] = \
                 not bg_breach.get("availability", False)
-        if compiles0 is not None and not degraded and not chaos:
+        if repl:
+            # the replication-chaos acceptance set (ISSUE 19): every
+            # acknowledged source write survived the target outage as
+            # a bit-exact replica (the obligation parked in the retry
+            # journal and shipped after rejoin — never dropped), the
+            # replication backlog really drained to zero, and the
+            # replication-lag SLO (obs.slo async probe) held at p99
+            verdicts["no_replica_obligation_lost"] = (
+                repl.get("acked_writes", 0) > 0 and
+                repl.get("lost_count", 1) == 0)
+            verdicts["replication_backlog_drained"] = \
+                repl.get("drained", False)
+            verdicts["replication_lag_slo_ok"] = \
+                repl.get("lag", {}).get("ok", False)
+        if compiles0 is not None and not degraded and not chaos \
+                and not repl:
             # steady-state compile oracle (ISSUE 16): zero compiles in
             # the measured phase — a positive delta means a kernel
             # shape the warm-up never saw landed on the hot path.
-            # Skipped for degraded/chaos runs: their mid-run fault
-            # pivots (first reconstruct, rejoin heal) legitimately
-            # compile fresh kernels
+            # Skipped for degraded/chaos/replication runs: their
+            # mid-run fault pivots (first reconstruct, rejoin heal,
+            # post-rejoin backlog ship) legitimately compile fresh
+            # kernels
             from minio_tpu.obs import device as _dev
             steady_compiles = _dev.compiles_total() - compiles0
             verdicts["no_steady_state_compiles"] = steady_compiles == 0
@@ -1108,6 +1281,7 @@ class LoadGen:
             "scanner": scanner_impact,
             "overload_probe": probe,
             "node_chaos": chaos or {},
+            "replication": repl or {},
             "degraded": degraded or {},
             "qos_evidence": qos_evidence,
             "host_profile": host_profile,
@@ -1187,6 +1361,15 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--chaos-kill", type=int, default=-1, metavar="NODE",
                     help="kill this node index mid-run and restart it "
                     "(needs --topology > 1)")
+    ap.add_argument("--replicate-to", type=int, default=-1,
+                    metavar="NODE",
+                    help="replication-chaos phase: replicate a source "
+                    "bucket to this node index and kill it mid-stream, "
+                    "then prove no replica obligation was lost after "
+                    "rejoin (needs --topology > 1)")
+    ap.add_argument("--replication-partition", action="store_true",
+                    help="partition the replication target's RPC plane "
+                    "instead of killing the process")
     ap.add_argument("--out", default="", help="write the report JSON")
     args = ap.parse_args(argv)
     import tempfile
@@ -1202,7 +1385,10 @@ def main(argv: list[str] | None = None) -> int:
         notifier_probe=not args.no_notifier_probe,
         degraded=args.degraded,
         chaos_kill_node=args.chaos_kill if args.chaos_kill >= 0
-        else None)
+        else None,
+        replication_target_node=args.replicate_to
+        if args.replicate_to >= 0 else None,
+        replication_partition=args.replication_partition)
     with tempfile.TemporaryDirectory(prefix="loadgen-") as root:
         if args.topology > 1:
             report = run_topology_profile(
