@@ -61,6 +61,9 @@ class CacheObjects:
         #: per-entry hit counts not yet flushed into cache.json (the
         #: flush throttle must not lose increments between flushes)
         self._pending_hits: dict[str, int] = {}
+        #: per-dir single-flight gate: at most one GC sweep walks a
+        #: cache dir at a time, and readers never wait behind the walk
+        self._gc_busy = [False] * len(self.dirs)
         # per-dir used-bytes tracked incrementally so the hot path never
         # walks the cache; one walk per dir seeds the counters
         self._used = [self._walk_usage(d) for d in self.dirs]
@@ -127,34 +130,46 @@ class CacheObjects:
 
     def _gc(self, di: int) -> None:
         """Evict whole entries by (atime, hits) score until the dir is
-        under quota*low (disk-cache-backend.go gc + scorer)."""
+        under quota*low (disk-cache-backend.go gc + scorer).
+
+        Single-flight: the lock only guards the busy flag, the counters,
+        and a snapshot of pending hits — the disk walk, meta loads, and
+        rmtrees all run outside it so hot-path `_account` callers never
+        block behind seconds of IO. Concurrent triggers for the same dir
+        collapse into the in-flight sweep."""
         with self._lock:
+            if self._gc_busy[di]:
+                return
+            self._gc_busy[di] = True
+            pending = dict(self._pending_hits)
+        try:
             d = self.dirs[di]
             used = self._walk_usage(d)   # re-seed while we're here
-            self._used[di] = used
             target = self.quota * self.low
-            if used <= target:
-                return
-            entries = []
-            for name in os.listdir(d):
-                edir = os.path.join(d, name)
-                if not os.path.isdir(edir):
-                    continue
-                meta = self._load_meta(edir) or {}
-                size = self._walk_usage(edir)
-                # older + colder first; each hit is worth five minutes
-                # of recency, so hot objects survive a sweep
-                hits = meta.get("hits", 0) + self._pending_hits.get(
-                    edir, 0)
-                score = meta.get("atime", 0.0) + 300.0 * hits
-                entries.append((score, size, edir))
-            entries.sort()
-            for _, size, edir in entries:
-                if used <= target:
-                    break
-                shutil.rmtree(edir, ignore_errors=True)
-                used -= size
-            self._used[di] = used
+            if used > target:
+                entries = []
+                for name in os.listdir(d):
+                    edir = os.path.join(d, name)
+                    if not os.path.isdir(edir):
+                        continue
+                    meta = self._load_meta(edir) or {}
+                    size = self._walk_usage(edir)
+                    # older + colder first; each hit is worth five
+                    # minutes of recency, so hot objects survive a sweep
+                    hits = meta.get("hits", 0) + pending.get(edir, 0)
+                    score = meta.get("atime", 0.0) + 300.0 * hits
+                    entries.append((score, size, edir))
+                entries.sort()
+                for _, size, edir in entries:
+                    if used <= target:
+                        break
+                    shutil.rmtree(edir, ignore_errors=True)
+                    used -= size
+            with self._lock:
+                self._used[di] = used
+        finally:
+            with self._lock:
+                self._gc_busy[di] = False
 
     def _drop(self, bucket: str, object: str) -> None:
         di, edir = self._entry_dir(bucket, object)

@@ -651,8 +651,14 @@ def erasure_encode(erasure: Erasure, stream, writers: list,
                 b = _read_full(stream, bs)
             return b, None
         arr = pool.get(bs)
-        with _stages.timed(stc, "body_read"):
-            got = _read_full_into(stream, arr)
+        try:
+            with _stages.timed(stc, "body_read"):
+                got = _read_full_into(stream, arr)
+        except BaseException:
+            # client disconnect mid-read must not leak the pooled
+            # buffer: each drop refills the pool via fresh allocations
+            pool.put(arr)
+            raise
         if got == 0:
             pool.put(arr)
             return b"", None

@@ -70,7 +70,7 @@ class QueueStore:
         except OSError:
             with self._count_lock:
                 self._count -= 1
-            self.failed_puts += 1
+                self.failed_puts += 1
             return False
         self._wake.set()
         return True

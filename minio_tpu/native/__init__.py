@@ -73,7 +73,10 @@ def load_native() -> ctypes.CDLL:
         if _load_error is not None:
             raise _load_error
         try:
-            return _load_native_locked()
+            # deliberate blocking-under-lock: one-time lazy build under
+            # the double-checked init lock — concurrent first callers
+            # MUST wait for the single compile rather than racing it
+            return _load_native_locked()  # graftlint: disable=GL021
         except Exception as e:  # noqa: BLE001
             _load_error = e
             raise

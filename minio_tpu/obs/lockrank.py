@@ -64,6 +64,7 @@ _graph: dict[str, set[str]] = {}          # site -> successor sites
 _edge_stacks: dict[tuple[str, str], dict] = {}   # first-sight evidence
 _reports: list[dict] = []
 _suppressed_reports = 0
+_contended: dict[str, int] = {}   # site -> contended-acquire count
 
 
 class _State(threading.local):
@@ -228,6 +229,11 @@ class TrackedLock:
         a tracked one here would recurse into its own instrumentation."""
         if not _enabled:
             return self._inner.acquire(True, timeout)
+        with _meta:
+            # the dynamic half of the graftlint GL020 cross-check: a
+            # site that ever blocks a thread is demonstrably contended
+            # shared state and must belong to an inferred guard set
+            _contended[self.site] = _contended.get(self.site, 0) + 1
         try:
             from . import profiler as _prof
             _prof.lock_wait_begin(self.site)
@@ -381,6 +387,15 @@ def held_names() -> list[str]:
     return [lk.name for lk in _state.held]
 
 
+def contended_sites() -> dict[str, int]:
+    """``file:line`` lock-creation sites whose acquires have ever
+    blocked, with counts — runtime evidence that the lock guards real
+    cross-thread state (tests/test_lockrank.py checks each against
+    graftlint's statically inferred guard sets)."""
+    with _meta:
+        return dict(_contended)
+
+
 def reports(kind: str | None = None) -> list[dict]:
     with _meta:
         out = [dict(r) for r in _reports]
@@ -399,6 +414,7 @@ def clear() -> None:
         _graph.clear()
         _edge_stacks.clear()
         _reports.clear()
+        _contended.clear()
         _suppressed_reports = 0
 
 
@@ -408,6 +424,7 @@ def stats() -> dict:
             "sites": len(_graph),
             "edges": len(_edge_stacks),
             "reports": len(_reports),
+            "contended_sites": len(_contended),
             "suppressed": _suppressed_reports,
             "enabled": _enabled,
         }

@@ -272,14 +272,17 @@ class S3Server:
         any existing notifier) and GETs of locally-missing objects proxy
         to the bucket's target (reference proxy-to-target on GET miss)."""
         self.replication = pool
-        prev = self.notify
+        # read-chain-store of self.notify must be atomic: an unguarded
+        # enable racing another notifier attach drops one of the links
+        with self._notifier_lock:
+            prev = self.notify
 
-        def chained(event, bucket, oi, *a):
-            pool.on_event(event, bucket, oi)
-            if prev is not None:
-                prev(event, bucket, oi, *a)
+            def chained(event, bucket, oi, *a):
+                pool.on_event(event, bucket, oi)
+                if prev is not None:
+                    prev(event, bucket, oi, *a)
 
-        self.notify = chained
+            self.notify = chained
         return pool
 
     def enable_cross_replication(self, rs):
@@ -290,14 +293,16 @@ class S3Server:
         (the S3-target pool): this plane ships over the dist peer RPC
         with MRF-style journalled retry."""
         self.replication_sys = rs
-        prev = self.notify
+        # same atomic read-chain-store discipline as enable_replication
+        with self._notifier_lock:
+            prev = self.notify
 
-        def chained(event, bucket, oi, *a):
-            rs.charge(event, bucket, oi)
-            if prev is not None:
-                prev(event, bucket, oi, *a)
+            def chained(event, bucket, oi, *a):
+                rs.charge(event, bucket, oi)
+                if prev is not None:
+                    prev(event, bucket, oi, *a)
 
-        self.notify = chained
+            self.notify = chained
         sc = getattr(self, "scanner", None)
         if sc is not None:
             sc.replication = rs

@@ -244,7 +244,13 @@ class XLStorage(StorageAPI):
         self._endpoint = endpoint or self.base
         self._disk_id = ""
         # RLock: _quarantine_meta re-verifies under the lock and is
-        # reached from _load_meta calls that may already hold it
+        # reached from _load_meta calls that may already hold it.
+        # The GL021 pragmas on this lock are deliberate: the per-disk
+        # metadata read-modify-write (load xl.meta -> mutate -> durable
+        # store, plus the dataDir commit rename) IS the critical
+        # section — the bounded single-file IO must stay inside it for
+        # commit atomicity w.r.t. this disk. Only O(subtree) walks are
+        # hoisted out (see reconcile_object's phase structure).
         self._meta_lock = threading.RLock()
         os.makedirs(self.base, exist_ok=True)
         os.makedirs(self._abs(META_TMP), exist_ok=True)
@@ -534,7 +540,7 @@ class XLStorage(StorageAPI):
         dst = self._abs(volume, path, XL_META_CORRUPT_FILE)
         with self._meta_lock:
             try:
-                XLMeta.load(self._read_all_inner(
+                XLMeta.load(self._read_all_inner(  # graftlint: disable=GL021
                     volume, f"{path}/{XL_META_FILE}"))
                 return False  # valid now — a concurrent commit won
             except errors.FileCorrupt:
@@ -542,7 +548,7 @@ class XLStorage(StorageAPI):
             except (errors.StorageError, OSError):
                 return False  # gone/unreadable: nothing to move aside
             try:
-                durable_replace(src, dst)
+                durable_replace(src, dst)  # graftlint: disable=GL021
             except OSError:
                 return False
         from ..obs import metrics as mx
@@ -565,7 +571,7 @@ class XLStorage(StorageAPI):
         with self._op("rename_data", dst_volume, dst_path), \
                 self._meta_lock:
             try:
-                meta = self._load_meta(dst_volume, dst_path)
+                meta = self._load_meta(dst_volume, dst_path)  # graftlint: disable=GL021
             except errors.FileNotFound:
                 meta = XLMeta()
             if fi.data_dir and fi.data is None:
@@ -583,11 +589,11 @@ class XLStorage(StorageAPI):
                 # covering the shard files' CONTENT at the committed
                 # location (their tmp paths are gone after the rename),
                 # dst itself, and the parent dirent
-                durable_replace_dir(src, dst)
+                durable_replace_dir(src, dst)  # graftlint: disable=GL021
                 self._write_step("post_data_rename")
             self._write_step("pre_meta_write")
             old_ddirs = meta.add_version(fi)
-            self._store_meta(dst_volume, dst_path, meta)
+            self._store_meta(dst_volume, dst_path, meta)  # graftlint: disable=GL021
             self._write_step("post_meta_write")
             self._purge_ddirs(dst_volume, dst_path, old_ddirs)
         # clean the tmp parent dir; a failure here leaks tmp space until
@@ -618,19 +624,19 @@ class XLStorage(StorageAPI):
     def write_metadata(self, volume: str, path: str, fi: FileInfo) -> None:
         with self._op("write_metadata", volume, path), self._meta_lock:
             try:
-                meta = self._load_meta(volume, path)
+                meta = self._load_meta(volume, path)  # graftlint: disable=GL021
             except errors.FileNotFound:
                 meta = XLMeta()
             old_ddirs = meta.add_version(fi)
-            self._store_meta(volume, path, meta)
+            self._store_meta(volume, path, meta)  # graftlint: disable=GL021
             self._purge_ddirs(volume, path, old_ddirs)
 
     def update_metadata(self, volume: str, path: str, fi: FileInfo) -> None:
         with self._op("update_metadata", volume, path), self._meta_lock:
-            meta = self._load_meta(volume, path)
+            meta = self._load_meta(volume, path)  # graftlint: disable=GL021
             meta.find_version(fi.version_id)  # must exist
             meta.add_version(fi)
-            self._store_meta(volume, path, meta)
+            self._store_meta(volume, path, meta)  # graftlint: disable=GL021
 
     def read_version(self, volume: str, path: str, version_id: str = "",
                      read_data: bool = False) -> FileInfo:
@@ -649,7 +655,7 @@ class XLStorage(StorageAPI):
 
     def delete_version(self, volume: str, path: str, fi: FileInfo) -> None:
         with self._op("delete_version", volume, path), self._meta_lock:
-            meta = self._load_meta(volume, path)
+            meta = self._load_meta(volume, path)  # graftlint: disable=GL021
             ddir = meta.delete_version(fi)
             if ddir:
                 try:
@@ -657,7 +663,7 @@ class XLStorage(StorageAPI):
                                             recursive=True)
                 except errors.FileNotFound:
                     pass
-            self._store_meta(volume, path, meta)
+            self._store_meta(volume, path, meta)  # graftlint: disable=GL021
 
     def check_parts(self, volume: str, path: str, fi: FileInfo) -> None:
         """Verify all parts exist with the expected shard file size
@@ -778,7 +784,7 @@ class XLStorage(StorageAPI):
             # phase 1 (locked, fast): load/quarantine the journal,
             # snapshot referenced ddirs, list the dir
             with self._meta_lock:
-                referenced = self._reconcile_refs(volume, path, out,
+                referenced = self._reconcile_refs(volume, path, out,  # graftlint: disable=GL021
                                                   age_s, now)
             try:
                 names = os.listdir(obj_dir)
@@ -815,7 +821,7 @@ class XLStorage(StorageAPI):
                 with self._meta_lock:
                     fresh: dict = {"orphan_ddirs": 0, "quarantined": 0,
                                    "has_meta": False}
-                    refs = self._reconcile_refs(volume, path, fresh,
+                    refs = self._reconcile_refs(volume, path, fresh,  # graftlint: disable=GL021
                                                 0.0, now)
                     if name in refs or self._subtree_has_meta(p):
                         continue

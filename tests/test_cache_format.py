@@ -204,3 +204,37 @@ def test_etag_change_never_serves_stale_data(tmp_path):
     sink = io.BytesIO()
     co.get_object("cb", "obj", sink)  # full read: must be v2, not v1
     assert sink.getvalue() == v2
+
+
+def test_gc_single_flight_never_blocks_hot_path(tmp_path):
+    """The GC sweep's disk walk runs OUTSIDE self._lock (graftlint GL021
+    regression): while a sweep is mid-walk, usage() — the hot-path lock —
+    must not block, and a concurrent trigger for the same dir collapses
+    into the in-flight sweep via the busy gate instead of queueing a
+    second walk."""
+    import threading
+    co = CacheObjects(_mk(str(tmp_path / "b")), str(tmp_path / "c"))
+    in_walk, release = threading.Event(), threading.Event()
+    real_walk = co._walk_usage
+
+    def stalled_walk(d):
+        if d in co.dirs:          # the sweep's top-level dir walk
+            in_walk.set()
+            assert release.wait(10)
+        return real_walk(d)
+
+    co._walk_usage = stalled_walk
+    t = threading.Thread(target=co._gc, args=(0,), name="gc")
+    t.start()
+    try:
+        assert in_walk.wait(10)
+        t0 = time.monotonic()
+        assert co.usage() >= 0    # takes self._lock: must be free
+        assert time.monotonic() - t0 < 1.0
+        assert co._gc_busy[0]
+        co._gc(0)                 # collapses; would deadlock pre-fix
+    finally:
+        release.set()
+        t.join(10)
+    assert not t.is_alive()
+    assert not co._gc_busy[0]

@@ -386,3 +386,30 @@ def test_bitrot_chunk_is_16k_and_recorded(tmp_path):
     import shutil as _sh
     _sh.rmtree(str(tmp_path / "d0" / "b" / "o"))
     assert ol.get_object_bytes("b", "o") == data
+
+
+def test_failed_put_returns_block_buffer_to_pool(tmp_path):
+    """A stream that dies mid-read during PUT (client disconnect) must
+    return the pooled block buffer on the exception edge instead of
+    leaking it to the GC (graftlint GL022 regression)."""
+    import io as _io
+    from minio_tpu.objectlayer import ErasureObjects
+    from minio_tpu.runtime.bufpool import global_pool
+    from minio_tpu.storage import XLStorage
+    disks = [XLStorage(str(tmp_path / f"d{i}")) for i in range(4)]
+    ol = ErasureObjects(disks, default_parity=1)
+    ol.make_bucket("b")
+
+    class _Hangup(_io.RawIOBase):
+        def readinto(self, b):           # zero-copy read path
+            raise OSError("client hung up")
+
+        def read(self, n=-1):
+            raise OSError("client hung up")
+
+    pool = global_pool()
+    pool.clear()
+    before = pool.stats()["retained"]
+    with pytest.raises(Exception):
+        ol.put_object("b", "o", _Hangup(), 4 << 20)
+    assert pool.stats()["retained"] > before  # buffer came back pooled

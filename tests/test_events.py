@@ -279,3 +279,58 @@ def test_listen_preserves_replication_chain(tmp_path):
         notifier.unlisten(sub)
     finally:
         srv.shutdown()
+
+
+def test_notifier_attach_serialized_and_chained(tmp_path):
+    """enable_replication / enable_cross_replication read-chain-store
+    self.notify under _notifier_lock (graftlint GL020 regression: an
+    unguarded attach racing another notifier hookup silently drops one
+    link). The attach must wait for the lock, and afterwards BOTH links
+    fire on one notify."""
+    obj = ErasureObjects([XLStorage(str(tmp_path / f"d{i}"))
+                          for i in range(4)], default_parity=1)
+    srv = S3Server(obj, "127.0.0.1", 0, access_key="ak", secret_key="sk")
+    seen = []
+
+    class _Pool:
+        def on_event(self, event, bucket, oi):
+            seen.append(("pool", event))
+
+    class _Rs:
+        def charge(self, event, bucket, oi):
+            seen.append(("rs", event))
+
+        def lag_report(self):
+            return {}
+
+    attached = threading.Event()
+    t = threading.Thread(
+        target=lambda: (srv.enable_replication(_Pool()), attached.set()))
+    with srv._notifier_lock:
+        t.start()
+        time.sleep(0.2)
+        assert not attached.is_set()   # attach serialized behind the lock
+    t.join(10)
+    assert attached.is_set()
+    srv.enable_cross_replication(_Rs())
+    oi = type("OI", (), {"name": "o"})()
+    srv.notify("s3:ObjectCreated:Put", "b", oi)
+    assert ("pool", "s3:ObjectCreated:Put") in seen
+    assert ("rs", "s3:ObjectCreated:Put") in seen
+
+
+def test_failed_put_rollback_consistent(tmp_path, monkeypatch):
+    """A put that fails at the durable-write step rolls back _count and
+    bumps failed_puts in ONE _count_lock section (graftlint GL020
+    regression: the counter write used to sit outside the lock)."""
+    from minio_tpu.storage import durability as dur
+
+    def boom(path, data):
+        raise OSError("disk full")
+
+    monkeypatch.setattr(dur, "durable_write", boom)
+    store = QueueStore(str(tmp_path / "q"), lambda r: None, limit=3)
+    assert store.put({"i": 0}) is False
+    assert store.failed_puts == 1
+    with store._count_lock:
+        assert store._count == 0       # the reservation was rolled back

@@ -186,3 +186,39 @@ def test_report_ring_is_bounded():
             lockrank.note_blocking("device_flush:ring")
     assert len(lockrank.reports()) == cap
     assert lockrank.suppressed_report_count() == 5
+
+
+def test_contended_sites_belong_to_inferred_guard_sets(tmp_path):
+    """lockrank <-> GL020 cross-check: every minio_tpu lock site that
+    blocks a thread at runtime must belong to a guard set the
+    whole-program engine inferred statically — dynamic evidence
+    validates the inference, and drift (a contended lock graftlint
+    cannot see guarding anything) fails loudly."""
+    from minio_tpu.cache import CacheObjects
+    from tools import graftlint
+    from tools.graftlint.program import build_program
+
+    co = CacheObjects(None, str(tmp_path / "c"))
+    assert not lockrank.contended_sites()
+    # deterministic contention: hold the cache lock while a worker
+    # takes the hot path that needs it
+    with co._lock:
+        t = threading.Thread(target=co.usage, name="contender")
+        t.start()
+        deadline = time.monotonic() + 10
+        while not lockrank.contended_sites() \
+                and time.monotonic() < deadline:
+            time.sleep(0.005)
+    t.join(10)
+    assert not t.is_alive()
+    contended = {s for s in lockrank.contended_sites()
+                 if not s.startswith(("test_", "conftest"))}
+    assert contended    # the forced wait was observed at cache.py's site
+
+    ctxs = [c for c in map(graftlint.parse_file,
+                           graftlint.iter_py_files(["minio_tpu"])) if c]
+    guards = {f"{p.rsplit('/', 1)[-1]}:{ln}"
+              for p, ln in build_program(ctxs).guard_sites()}
+    assert contended <= guards, \
+        f"runtime-contended lock sites unknown to GL020 inference: " \
+        f"{sorted(contended - guards)}"

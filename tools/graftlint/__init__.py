@@ -179,19 +179,31 @@ def split_baselined(findings: list[Finding],
 
 
 def run(paths: list[str] | None = None,
-        use_baseline: bool = True
+        use_baseline: bool = True,
+        timings: dict | None = None
         ) -> tuple[list[Finding], list[Finding]]:
     """Lint ``paths`` (default: minio_tpu). Returns (unbaselined,
-    baselined) findings, pragma-suppressed sites already removed."""
+    baselined) findings, pragma-suppressed sites already removed.
+    When ``timings`` is a dict, wall-time per stage is written into it
+    (``parse_s``, ``per_file_s``, ``project_s`` — the CLI's --stats)."""
+    import time as _time
+
     from . import checkers
+    t0 = _time.perf_counter()
     files = iter_py_files(paths or ["minio_tpu"])
     ctxs = [c for c in (parse_file(p) for p in files) if c is not None]
+    t1 = _time.perf_counter()
     findings: list[Finding] = []
     for ctx in ctxs:
         for chk in checkers.PER_FILE:
             findings.extend(chk(ctx))
+    t2 = _time.perf_counter()
     for chk in checkers.PROJECT:
         findings.extend(chk(ctxs))
+    t3 = _time.perf_counter()
+    if timings is not None:
+        timings.update(parse_s=t1 - t0, per_file_s=t2 - t1,
+                       project_s=t3 - t2, files=len(ctxs))
     findings = [f for f in findings
                 if not _ctx_suppressed(ctxs, f)]
     baseline = load_baseline() if use_baseline else {}

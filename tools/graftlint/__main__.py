@@ -2,11 +2,13 @@
 
 Exit codes: 0 = clean (no unbaselined findings), 1 = findings, 2 = bad
 usage. ``--write-baseline`` regenerates tools/graftlint/baseline.json
-(sorted + deterministic) from the current findings.
+(sorted + deterministic) from the current findings. ``--json`` prints a
+machine-readable findings document on stdout for CI consumption.
 """
 from __future__ import annotations
 
 import argparse
+import json
 import sys
 
 from . import load_baseline, run, split_baselined, write_baseline
@@ -22,26 +24,47 @@ def main(argv: list[str] | None = None) -> int:
                     help="report every finding, baselined or not")
     ap.add_argument("--write-baseline", action="store_true",
                     help="rewrite baseline.json from current findings")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="machine-readable findings on stdout")
     ap.add_argument("--stats", action="store_true",
-                    help="per-checker finding counts")
+                    help="per-checker counts + wall-time breakdown")
     args = ap.parse_args(argv)
 
+    timings: dict = {}
     fresh, old = run(args.paths or ["minio_tpu"],
-                     use_baseline=not args.no_baseline)
+                     use_baseline=not args.no_baseline,
+                     timings=timings)
     if args.write_baseline:
         write_baseline(fresh + old)
         print(f"baseline.json written: {len(fresh + old)} findings")
         return 0
     shown = fresh if not args.no_baseline else \
         sorted(fresh + old, key=lambda f: (f.path, f.line, f.checker))
-    for f in shown:
-        print(f.render())
+    if args.as_json:
+        doc = {"findings": [
+            {"file": f.path, "line": f.line, "id": f.checker,
+             "severity": "error", "message": f.message, "key": f.key}
+            for f in shown]}
+        json.dump(doc, sys.stdout, indent=1, sort_keys=True)
+        sys.stdout.write("\n")
+    else:
+        for f in shown:
+            print(f.render())
     if args.stats:
         by: dict[str, int] = {}
         for f in fresh + old:
             by[f.checker] = by.get(f.checker, 0) + 1
         for chk in sorted(by):
             print(f"# {chk}: {by[chk]} total", file=sys.stderr)
+        from .program import LAST_BUILD_STATS as pb
+        print(f"# wall: parse {timings.get('parse_s', 0.0):.2f}s, "
+              f"per-file checkers {timings.get('per_file_s', 0.0):.2f}s, "
+              f"whole-program {timings.get('project_s', 0.0):.2f}s "
+              f"over {timings.get('files', 0)} files", file=sys.stderr)
+        if pb:
+            print(f"# program build: {pb.get('build_s', 0.0):.2f}s, "
+                  f"{pb.get('cache_hits', 0)}/{pb.get('files', 0)} "
+                  f"summaries from cache", file=sys.stderr)
     n_base = len(load_baseline())
     print(f"graftlint: {len(fresh)} unbaselined finding(s), "
           f"{len(old)} baselined (baseline holds {n_base} keys)",

@@ -1560,4 +1560,6 @@ PER_FILE = [
     check_bounded_request_labels,
     check_async_plane_bounds,
 ]
-PROJECT = [check_metrics_documented]
+from .program import check_whole_program  # noqa: E402 — needs Finding above
+
+PROJECT = [check_metrics_documented, check_whole_program]

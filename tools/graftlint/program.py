@@ -1,0 +1,1008 @@
+"""graftlint v2 — whole-program analysis layer (ISSUE 20).
+
+GL001–GL019 are per-file and lexical: GL002 only sees a blocking call
+*textually* inside a ``with lock:`` body, and nothing checks that shared
+mutable state is guarded consistently. This module is the missing
+``-race`` analogue: a project-wide symbol table + call graph over
+``minio_tpu/`` with bounded-depth per-function summaries (locks
+acquired, ``self.`` attributes read/written and under which locks,
+blocking calls reachable, resources acquired/released), cached per file
+by content hash so the tier-1 lint stays fast.
+
+Three checkers ride on top:
+
+* **GL020** — RacerD-style lock-guard inference: if attribute X of
+  class C is written under lock L at ≥ 80 % of its write sites
+  (``__init__`` excluded — construction is single-threaded), the
+  remaining unguarded write sites are findings.
+* **GL021** — interprocedural GL002: a call chain that starts inside a
+  lock scope and reaches ``sleep``/disk IO/``.result()``/flush up to
+  three frames down is a finding even though no single file shows it.
+* **GL022** — acquire/release pairing on all control-flow paths,
+  exception edges included, for the pooled-buffer plane
+  (``runtime/bufpool``), the span plane (``obs/spans``) and the HBM
+  ledger (``obs/device``): an acquire whose release is not reachable on
+  the exception path (no ``try/finally``, no ownership transfer) leaks
+  the resource exactly when things go wrong.
+
+Caveats (see docs/static-analysis.md): dispatch is resolved through
+*declared* types only — ``self._x = SomeClass()`` in the class body
+gives ``self._x.m()`` a target; duck-typed parameters, monkeypatched
+attributes and callables passed as arguments stay unresolved (the
+engine under-approximates, it never guesses).
+"""
+from __future__ import annotations
+
+import ast
+import hashlib
+import json
+import os
+
+from . import FileCtx, Finding
+
+#: summary cache (content-hash keyed); bump SCHEMA to invalidate
+CACHE_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          ".summary-cache.json")
+CACHE_SCHEMA = 3
+
+#: GL020 guard-inference threshold: a lock guarding at least this
+#: fraction of an attribute's write sites is considered THE guard, and
+#: the minority unguarded sites are findings
+GUARD_THRESHOLD = 0.8
+
+#: GL021 call-chain depth (frames below the lock-holding caller)
+MAX_CHAIN_DEPTH = 3
+
+#: wall-time breakdown of the last build_program() call, printed by
+#: ``python -m tools.graftlint --stats``
+LAST_BUILD_STATS: dict = {}
+
+
+# --------------------------------------------------------------------------
+# per-file summary extraction (pure, JSON-native, cacheable)
+
+
+def _module_of(path: str) -> str:
+    mod = path[:-3] if path.endswith(".py") else path
+    parts = mod.replace("\\", "/").split("/")
+    if parts and parts[-1] == "__init__":
+        parts = parts[:-1]
+    return ".".join(parts)
+
+
+def _resolve_relative(module: str, is_pkg: bool, level: int,
+                      name: str) -> str:
+    """``from ..obs import x`` inside minio_tpu.scanner.park →
+    minio_tpu.obs (then + name)."""
+    if level == 0:
+        return name or ""
+    parts = module.split(".")
+    if not is_pkg:
+        parts = parts[:-1]
+    if level > 1:
+        parts = parts[:len(parts) - (level - 1)]
+    base = ".".join(parts)
+    if not name:
+        return base
+    return f"{base}.{name}" if base else name
+
+
+def _iter_functions(tree: ast.AST):
+    """Yield (qualname, class_name, node) for every function in the
+    file — methods as ``Cls.m``, nested defs as ``outer.inner``."""
+
+    def walk(node: ast.AST, prefix: str, cls: str):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                qual = f"{prefix}.{child.name}" if prefix else child.name
+                yield qual, cls, child
+                yield from walk(child, qual, cls)
+            elif isinstance(child, ast.ClassDef):
+                qual = f"{prefix}.{child.name}" if prefix else child.name
+                yield from walk(child, qual, child.name)
+            else:
+                yield from walk(child, prefix, cls)
+
+    yield from walk(tree, "", "")
+
+
+def _returned_hint(value: ast.AST) -> str:
+    """What a return statement hands back: a dotted name, or
+    ``Ctor()`` for a direct constructor call ('' when dynamic)."""
+    from . import checkers as _chk
+    if isinstance(value, ast.Call):
+        d = _chk.dotted(value.func)
+        return f"{d}()" if d else ""
+    if isinstance(value, (ast.Name, ast.Attribute)):
+        return _chk.dotted(value)
+    return ""
+
+
+def file_summary(ctx: FileCtx) -> dict:
+    """Extract the whole-program summary of one parsed file. Pure
+    function of the AST — safe to cache by content hash."""
+    from . import checkers as _chk
+    tree = ctx.tree
+    module = _module_of(ctx.path)
+    is_pkg = ctx.path.endswith("__init__.py")
+    lockish = _chk._lockish_symbols(tree)
+
+    imports: dict[str, str] = {}
+    from_imports: dict[str, list] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                imports[a.asname or a.name.split(".")[0]] = \
+                    a.name if a.asname else a.name.split(".")[0]
+                if a.asname is None and "." in a.name:
+                    imports[a.name] = a.name
+        elif isinstance(node, ast.ImportFrom):
+            base = _resolve_relative(module, is_pkg, node.level,
+                                     node.module or "")
+            for a in node.names:
+                if a.name == "*":
+                    continue
+                from_imports[a.asname or a.name] = [base, a.name]
+
+    #: module-global name -> ctor dotted (best-effort: any
+    #: ``name = Ctor(...)`` assignment in the file, singleton idiom)
+    global_types: dict[str, str] = {}
+    #: module-level lock creation sites: name -> lineno
+    lock_sites: dict[str, int] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            ctor = _chk.dotted(node.value.func)
+            for t in node.targets:
+                d = _chk.dotted(t)
+                if not d or d.startswith("self."):
+                    continue
+                if ctor:
+                    global_types.setdefault(d, ctor)
+                if ctor in _chk._LOCK_CTORS:
+                    lock_sites.setdefault(d, node.lineno)
+
+    classes: dict[str, dict] = {}
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ClassDef):
+            continue
+        info = {"methods": sorted(
+                    c.name for c in node.body
+                    if isinstance(c, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef))),
+                "bases": sorted(filter(None, (_chk.dotted(b)
+                                              for b in node.bases))),
+                "attr_ctors": {},   # attr -> ctor dotted
+                "aliases": {},      # attr -> attr (Condition over lock)
+                "lock_sites": {}}   # attr -> lineno of creation
+        for sub in ast.walk(node):
+            if not (isinstance(sub, ast.Assign)
+                    and isinstance(sub.value, ast.Call)):
+                continue
+            ctor = _chk.dotted(sub.value.func)
+            for t in sub.targets:
+                d = _chk.dotted(t)
+                if not d.startswith("self.") or d.count(".") != 1:
+                    continue
+                attr = d.split(".", 1)[1]
+                if ctor:
+                    info["attr_ctors"].setdefault(attr, ctor)
+                if ctor in _chk._LOCK_CTORS:
+                    info["lock_sites"].setdefault(attr, sub.lineno)
+                    if ctor.endswith("Condition") and sub.value.args:
+                        backing = _chk.dotted(sub.value.args[0])
+                        if backing.startswith("self."):
+                            info["aliases"][attr] = \
+                                backing.split(".", 1)[1]
+        classes[node.name] = info
+
+    functions: dict[str, dict] = {}
+    for qual, cls, fn in _iter_functions(tree):
+        functions[qual] = _extract_function(fn, qual, cls, lockish)
+
+    return {"module": module, "imports": imports,
+            "from_imports": from_imports, "global_types": global_types,
+            "lock_sites": lock_sites, "classes": classes,
+            "functions": functions}
+
+
+def _extract_function(fn: ast.AST, qual: str, cls: str,
+                      lockish: set[str]) -> dict:
+    """Bounded summary of one function body. Nested defs/lambdas are
+    deferred execution — they get their own summary via
+    ``_iter_functions`` and are NOT walked here (a lock held here is
+    not held there)."""
+    from . import checkers as _chk
+    s = {"line": fn.lineno, "cls": cls, "locks": set(),
+         "writes": [], "reads": [], "blocking": [], "cv_waits": [],
+         "calls": [], "returns": []}
+
+    def blocking_reason(call: ast.Call, held_dumps: list[str]):
+        """(reason, is_exempt_cv_wait). Replicates GL002's cv.wait
+        exemption against the full held set at this point."""
+        for d in held_dumps:
+            if _chk._is_blocking_call(call, d) is None \
+                    and _chk._is_blocking_call(call, "") is not None:
+                return None, True           # wait() on a HELD condition
+        return _chk._is_blocking_call(call, ""), False
+
+    def visit(node: ast.AST, held: list, dumps: list):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda, ast.ClassDef)):
+            return
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            new_held, new_dumps = list(held), list(dumps)
+            for it in node.items:
+                visit(it.context_expr, held, dumps)
+                if it.optional_vars is not None:
+                    visit(it.optional_vars, held, dumps)
+                if _chk._is_lock_expr(it.context_expr, lockish):
+                    name = _chk.dotted(it.context_expr)
+                    s["locks"].add(name)
+                    new_held.append(name)
+                    new_dumps.append(ast.dump(it.context_expr))
+            for stmt in node.body:
+                visit(stmt, new_held, new_dumps)
+            return
+        if isinstance(node, ast.Call):
+            d = _chk.dotted(node.func)
+            reason, is_wait = blocking_reason(node, dumps)
+            if is_wait or (reason is not None
+                           and isinstance(node.func, ast.Attribute)
+                           and node.func.attr == "wait"):
+                # ANY x.wait() records the receiver: whether it blocks
+                # a caller depends on which lock that caller holds (the
+                # chain walker canonicalizes and compares), not on the
+                # locks textually held here
+                s["cv_waits"].append(
+                    [node.lineno, _chk.dotted(node.func.value)])
+            elif reason is not None:
+                s["blocking"].append([node.lineno, reason])
+            if d and not d.endswith("()"):
+                s["calls"].append([node.lineno, d, sorted(set(held))])
+            # func's receiver chain is a read (self._pool in
+            # self._pool.get()); the method name itself is not state
+            if isinstance(node.func, ast.Attribute):
+                visit(node.func.value, held, dumps)
+            for a in node.args:
+                visit(a, held, dumps)
+            for kw in node.keywords:
+                visit(kw.value, held, dumps)
+            return
+        if isinstance(node, ast.Attribute) and \
+                isinstance(node.value, ast.Name) and \
+                node.value.id == "self":
+            ev = [node.attr, node.lineno, sorted(set(held))]
+            if isinstance(node.ctx, (ast.Store, ast.Del)):
+                s["writes"].append(ev)
+            else:
+                s["reads"].append(ev)
+            return
+        if isinstance(node, ast.AugAssign):
+            # += is a read AND a write of the same site
+            if isinstance(node.target, ast.Attribute) and \
+                    isinstance(node.target.value, ast.Name) and \
+                    node.target.value.id == "self":
+                ev = [node.target.attr, node.lineno, sorted(set(held))]
+                s["writes"].append(ev)
+                s["reads"].append(ev)
+            else:
+                visit(node.target, held, dumps)
+            visit(node.value, held, dumps)
+            return
+        if isinstance(node, ast.Return) and node.value is not None:
+            hint = _returned_hint(node.value)
+            if hint:
+                s["returns"].append(hint)
+            visit(node.value, held, dumps)
+            return
+        for child in ast.iter_child_nodes(node):
+            visit(child, held, dumps)
+
+    for stmt in fn.body:
+        visit(stmt, [], [])
+    s["locks"] = sorted(s["locks"])
+    s["returns"] = sorted(set(s["returns"]))
+    return s
+
+
+# --------------------------------------------------------------------------
+# program: symbol table + call resolution over the summaries
+
+
+class Program:
+    """Whole-program view: per-file summaries + resolution of
+    ``self.``-dispatch, typed attributes and imported symbols."""
+
+    def __init__(self, files: dict[str, dict]):
+        self.files = files
+        self.modules: dict[str, str] = {
+            s["module"]: p for p, s in sorted(files.items())}
+
+    # -- lookups ----------------------------------------------------------
+
+    def func(self, path: str, qual: str) -> dict | None:
+        s = self.files.get(path)
+        return s["functions"].get(qual) if s else None
+
+    def class_info(self, path: str, cls: str) -> dict | None:
+        s = self.files.get(path)
+        return s["classes"].get(cls) if s else None
+
+    def canonical_lock(self, path: str, cls: str, name: str) -> str:
+        """Fold Condition aliases onto their backing lock:
+        ``self._cv`` over ``self._lock`` canonicalizes to the lock, so
+        writes under either count as guarded by the same mutex."""
+        info = self.class_info(path, cls)
+        if info and name.startswith("self."):
+            attr = name.split(".", 1)[1]
+            seen = set()
+            while attr in info["aliases"] and attr not in seen:
+                seen.add(attr)
+                attr = info["aliases"][attr]
+            return f"self.{attr}"
+        return name
+
+    # -- symbol resolution ------------------------------------------------
+
+    def _class_by_dotted(self, path: str, name: str):
+        """Resolve a ctor/base name as written in ``path`` to
+        (path2, class_name)."""
+        s = self.files.get(path)
+        if s is None or not name:
+            return None
+        head, _, rest = name.partition(".")
+        if not rest and head in s["classes"]:
+            return path, head
+        if head in s["from_imports"]:
+            mod, sym = s["from_imports"][head]
+            tgt = self.modules.get(mod)
+            if tgt is None:
+                return None
+            if not rest and sym in self.files[tgt]["classes"]:
+                return tgt, sym
+            return None
+        if head in s["imports"] and rest and "." not in rest:
+            tgt = self.modules.get(s["imports"][head])
+            if tgt and rest in self.files[tgt]["classes"]:
+                return tgt, rest
+        return None
+
+    def returns_class(self, path: str, qual: str, _depth: int = 0):
+        """Declared-construction return type of a function:
+        ``return BufferPool()`` directly, or ``return _global`` where a
+        ``_global = BufferPool(...)`` assignment exists in the file
+        (the singleton idiom). None when unknown."""
+        if _depth > 2:
+            return None
+        f = self.func(path, qual)
+        s = self.files.get(path)
+        if f is None or s is None:
+            return None
+        for hint in f["returns"]:
+            if hint.endswith("()"):
+                cls = self._class_by_dotted(path, hint[:-2])
+                if cls:
+                    return cls
+                tgt = self.resolve_call(path, qual, hint[:-2])
+                if tgt:
+                    cls = self.returns_class(*tgt, _depth=_depth + 1)
+                    if cls:
+                        return cls
+            else:
+                ctor = s["global_types"].get(hint)
+                if ctor and not ctor.endswith("()"):
+                    cls = self._class_by_dotted(path, ctor)
+                    if cls:
+                        return cls
+        return None
+
+    def attr_class(self, path: str, cls: str, attr: str):
+        """Declared type of ``self.<attr>`` from the class body's
+        ``self._x = SomeClass(...)`` assignments (None = dynamic)."""
+        info = self.class_info(path, cls)
+        if not info:
+            return None
+        ctor = info["attr_ctors"].get(attr)
+        if not ctor:
+            return None
+        hit = self._class_by_dotted(path, ctor)
+        if hit:
+            return hit
+        # self._x = some_factory() — follow the factory's return type
+        tgt = self.resolve_call(path, f"{cls}.__init__", ctor)
+        if tgt:
+            return self.returns_class(*tgt)
+        return None
+
+    def _method_in(self, path: str, cls: str, meth: str, _seen=None):
+        """(path, 'Cls.meth') in cls or a resolvable base class."""
+        _seen = _seen or set()
+        if (path, cls) in _seen:
+            return None
+        _seen.add((path, cls))
+        info = self.class_info(path, cls)
+        if info is None:
+            return None
+        if meth in info["methods"]:
+            return path, f"{cls}.{meth}"
+        for base in info["bases"]:
+            hit = self._class_by_dotted(path, base)
+            if hit:
+                found = self._method_in(*hit, meth, _seen)
+                if found:
+                    return found
+        return None
+
+    def resolve_call(self, path: str, caller_qual: str,
+                     callee: str, _seen: frozenset = frozenset()):
+        """Resolve one call expression (dotted, as written) from inside
+        ``caller_qual`` to a (path, qualname) function key, or None
+        when the target is dynamic / outside the program."""
+        s = self.files.get(path)
+        if s is None or not callee:
+            return None
+        key = (path, caller_qual, callee)
+        if key in _seen or len(_seen) > 8:
+            return None   # factory-type chase hit a cycle: dynamic
+        _seen = _seen | {key}
+        parts = callee.split(".")
+        caller = s["functions"].get(caller_qual)
+        cls = caller["cls"] if caller else ""
+        if parts[0] == "self" and cls:
+            if len(parts) == 2:
+                return self._method_in(path, cls, parts[1])
+            if len(parts) == 3:
+                hit = self.attr_class(path, cls, parts[1])
+                if hit:
+                    return self._method_in(*hit, parts[2])
+            return None
+        if len(parts) == 1:
+            name = parts[0]
+            nested = f"{caller_qual}.{name}"
+            if nested in s["functions"]:
+                return path, nested
+            if cls:    # unqualified helper defined on the module
+                pass
+            if name in s["functions"]:
+                return path, name
+            if name in s["from_imports"]:
+                mod, sym = s["from_imports"][name]
+                tgt = self.modules.get(mod)
+                if tgt is None:
+                    return None
+                if sym in self.files[tgt]["functions"]:
+                    return tgt, sym
+                if sym in self.files[tgt]["classes"]:
+                    hit = self._method_in(tgt, sym, "__init__")
+                    if hit:
+                        return hit
+            return None
+        head, rest = parts[0], parts[1:]
+        if head in s["imports"]:
+            mod = self.modules.get(s["imports"][head])
+            if mod is None:
+                return None
+            ms = self.files[mod]
+            if len(rest) == 1 and rest[0] in ms["functions"]:
+                return mod, rest[0]
+            if len(rest) == 2 and rest[0] in ms["classes"]:
+                return self._method_in(mod, rest[0], rest[1])
+            return None
+        if head in s["from_imports"]:
+            mod, sym = s["from_imports"][head]
+            tgt = self.modules.get(mod)
+            if tgt is None:
+                # `from x import y` where y is a submodule
+                sub = self.modules.get(f"{mod}.{sym}" if mod else sym)
+                if sub and len(rest) == 1 and \
+                        rest[0] in self.files[sub]["functions"]:
+                    return sub, rest[0]
+                return None
+            if sym in self.files[tgt]["classes"] and len(rest) == 1:
+                return self._method_in(tgt, sym, rest[0])
+            return None
+        # local variable with a declared-construction type:
+        # x = Factory(); x.m()
+        if caller and len(parts) == 2:
+            hit = self._local_type(path, caller_qual, parts[0], _seen)
+            if hit:
+                return self._method_in(*hit, parts[1])
+        return None
+
+    def _local_type(self, path: str, qual: str, name: str,
+                    _seen: frozenset = frozenset()):
+        """Type of a local name from its ``name = <call>`` assignment
+        sites recorded in the summary's calls (ctor or factory)."""
+        s = self.files.get(path)
+        ctor = s["global_types"].get(name) if s else None
+        if ctor and not ctor.endswith("()"):
+            hit = self._class_by_dotted(path, ctor)
+            if hit:
+                return hit
+            tgt = self.resolve_call(path, qual, ctor, _seen)
+            if tgt:
+                return self.returns_class(*tgt)
+        return None
+
+    def entry_held(self) -> dict[tuple[str, str], set[str]]:
+        """Locks provably held on ENTRY to private same-class helpers
+        (the ``_refill_locked`` convention): a method whose every
+        intra-class call site holds lock L runs under L even though its
+        own body never takes it. Fixpoint over the call graph so a
+        helper's guarantee propagates through helpers it calls.
+
+        Only leading-underscore methods qualify (public methods are
+        callable from anywhere), and only ``self.``-dispatch sites
+        count — an external caller's lock has a different identity."""
+        entry: dict[tuple[str, str], set[str]] = {}
+        for _round in range(4):
+            changed = False
+            sites: dict[tuple[str, str], list[set[str]]] = {}
+            for path, s in self.files.items():
+                for qual, f in s["functions"].items():
+                    cls = f["cls"]
+                    inherit = entry.get((path, qual), set())
+                    for _ln, callee, held in f["calls"]:
+                        if not callee.startswith("self.") or \
+                                callee.count(".") != 1:
+                            continue
+                        meth = callee.split(".", 1)[1]
+                        if not meth.startswith("_") or \
+                                meth.startswith("__"):
+                            continue
+                        tgt = self._method_in(path, cls, meth) \
+                            if cls else None
+                        if tgt is None or tgt[0] != path:
+                            continue
+                        canon = {self.canonical_lock(path, cls, h)
+                                 for h in held} | inherit
+                        sites.setdefault(tgt, []).append(canon)
+            for key, held_sets in sites.items():
+                common = set.intersection(*held_sets) if held_sets \
+                    else set()
+                if entry.get(key, set()) != common:
+                    entry[key] = common
+                    changed = True
+            if not changed:
+                break
+        return entry
+
+    # -- derived views ----------------------------------------------------
+
+    def guard_sites(self) -> set[tuple[str, int]]:
+        """(path, lineno) of every lock creation site the engine models
+        as a guard — i.e. the lock (or a Condition aliased onto it) is
+        held around at least one attribute access or call somewhere in
+        the program. lockrank keys its runtime evidence on the same
+        creation sites; tests assert runtime ⊆ static."""
+        out: set[tuple[str, int]] = set()
+        for path, s in self.files.items():
+            used: set[str] = set()
+            for f in s["functions"].values():
+                for events in (f["writes"], f["reads"], f["calls"]):
+                    for ev in events:
+                        used.update(ev[2])
+                used.update(f["locks"])
+            for name, ln in s["lock_sites"].items():
+                if name in used:
+                    out.add((path, ln))
+            for cname, info in s["classes"].items():
+                for attr, ln in info["lock_sites"].items():
+                    names = {f"self.{attr}"} | {
+                        f"self.{a}" for a, b in info["aliases"].items()
+                        if b == attr}
+                    if names & used:
+                        out.add((path, ln))
+        return out
+
+    def to_json(self) -> str:
+        """Canonical serialization — two builds of the same tree must
+        produce byte-identical output (pinned by tier-1)."""
+        return json.dumps(self.files, sort_keys=True, indent=None,
+                          separators=(",", ":"))
+
+
+# --------------------------------------------------------------------------
+# build + content-hash cache
+
+
+def _load_cache(cache_path: str) -> dict:
+    try:
+        with open(cache_path, encoding="utf-8") as f:
+            doc = json.load(f)
+        if doc.get("schema") != CACHE_SCHEMA:
+            return {}
+        return doc.get("files", {})
+    except (OSError, ValueError):
+        return {}
+
+
+def build_program(ctxs: list[FileCtx],
+                  cache_path: str | None = CACHE_PATH) -> Program:
+    """Build (or incrementally refresh) the whole-program view. Each
+    file's summary is cached keyed by the sha1 of its source, so a
+    steady-state run re-extracts only edited files."""
+    import time
+    t0 = time.perf_counter()
+    cache = _load_cache(cache_path) if cache_path else {}
+    out: dict[str, dict] = {}
+    new_cache: dict[str, dict] = {}
+    hits = 0
+    for ctx in sorted(ctxs, key=lambda c: c.path):
+        src = "\n".join(ctx.lines)
+        sha = hashlib.sha1(src.encode("utf-8")).hexdigest()
+        ent = cache.get(ctx.path)
+        if ent is not None and ent.get("sha") == sha:
+            out[ctx.path] = ent["summary"]
+            hits += 1
+        else:
+            out[ctx.path] = file_summary(ctx)
+        # only real on-disk files persist (synthetic test ctxs don't)
+        if os.path.isfile(ctx.abspath):
+            new_cache[ctx.path] = {"sha": sha, "summary": out[ctx.path]}
+    if cache_path and new_cache:
+        try:
+            with open(cache_path, "w", encoding="utf-8") as f:
+                json.dump({"schema": CACHE_SCHEMA, "files": new_cache},
+                          f, sort_keys=True)
+        except OSError:
+            pass   # cache is an optimization, never a failure
+    LAST_BUILD_STATS.clear()
+    LAST_BUILD_STATS.update({
+        "files": len(ctxs), "cache_hits": hits,
+        "build_s": time.perf_counter() - t0})
+    return Program(out)
+
+
+# --------------------------------------------------------------------------
+# GL020 — lock-guard inference (RacerD-style)
+
+
+def check_guard_inference(prog: Program) -> list[Finding]:
+    out: list[Finding] = []
+    entry = prog.entry_held()
+    for path in sorted(prog.files):
+        s = prog.files[path]
+        per_class: dict[str, dict[str, list]] = {}
+        for qual in sorted(s["functions"]):
+            f = s["functions"][qual]
+            cls = f["cls"]
+            if not cls:
+                continue
+            meth = qual.rsplit(".", 1)[-1]
+            if meth == "__init__":
+                continue   # construction is single-threaded by contract
+            inherit = entry.get((path, qual), set())
+            for attr, line, held in f["writes"]:
+                canon = sorted({prog.canonical_lock(path, cls, h)
+                               for h in held} | inherit)
+                per_class.setdefault(cls, {}).setdefault(attr, []) \
+                    .append((qual, line, canon))
+        for cls in sorted(per_class):
+            for attr in sorted(per_class[cls]):
+                sites = per_class[cls][attr]
+                total = len(sites)
+                if total < 2:
+                    continue
+                counts: dict[str, int] = {}
+                for _q, _ln, held in sites:
+                    for h in held:
+                        counts[h] = counts.get(h, 0) + 1
+                if not counts:
+                    continue
+                guard = max(sorted(counts), key=lambda k: counts[k])
+                guarded = counts[guard]
+                if guarded == total or guarded / total < GUARD_THRESHOLD:
+                    continue
+                pct = round(100.0 * guarded / total)
+                for qual, line, held in sites:
+                    if guard in held:
+                        continue
+                    out.append(Finding(
+                        path, line, "GL020",
+                        f"`self.{attr}` of {cls} is written under "
+                        f"`{guard}` at {guarded}/{total} sites ({pct}%) "
+                        f"— this write in {qual} is unguarded; take the "
+                        "lock (or pragma with a reviewed reason, e.g. a "
+                        "GIL-atomic counter)",
+                        token=f"{cls}.{attr}", scope=qual))
+    return out
+
+
+# --------------------------------------------------------------------------
+# GL021 — interprocedural blocking-under-lock
+
+
+def _first_blocking_chain(prog: Program, key, depth: int,
+                          seen: set, caller_locks: set,
+                          path0: str, cls0: str):
+    """DFS through summaries: shortest call chain from ``key`` to a
+    direct blocking call (or a cv.wait on a condition DIFFERENT from
+    every lock the original caller holds). Returns (chain, reason)."""
+    if depth > MAX_CHAIN_DEPTH or key in seen:
+        return None
+    seen.add(key)
+    path, qual = key
+    f = prog.func(path, qual)
+    if f is None:
+        return None
+    if f["blocking"]:
+        return [qual], f["blocking"][0][1]
+    for _ln, cv in f["cv_waits"]:
+        canon = prog.canonical_lock(path, f["cls"], cv)
+        # waiting on the very lock the caller holds releases it; any
+        # OTHER held lock convoys behind the wait
+        if path == path0 and f["cls"] == cls0 and \
+                caller_locks <= {canon}:
+            continue
+        return [qual], f"{cv}.wait()"
+    for _ln, callee, _held in f["calls"]:
+        tgt = prog.resolve_call(path, qual, callee)
+        if tgt is None:
+            continue
+        sub = _first_blocking_chain(prog, tgt, depth + 1, seen,
+                                    caller_locks, path0, cls0)
+        if sub is not None:
+            return [qual] + sub[0], sub[1]
+    return None
+
+
+def check_interprocedural_blocking(prog: Program) -> list[Finding]:
+    out: list[Finding] = []
+    for path in sorted(prog.files):
+        s = prog.files[path]
+        for qual in sorted(s["functions"]):
+            f = s["functions"][qual]
+            direct = {ln for ln, _r in f["blocking"]}
+            for ln, callee, held in f["calls"]:
+                if not held or ln in direct:
+                    continue   # GL002 owns the direct case
+                tgt = prog.resolve_call(path, qual, callee)
+                if tgt is None:
+                    continue
+                canon = {prog.canonical_lock(path, f["cls"], h)
+                         for h in held}
+                hit = _first_blocking_chain(
+                    prog, tgt, 1, set(), canon, path, f["cls"])
+                if hit is None:
+                    continue
+                chain, reason = hit
+                lock = sorted(canon)[0]
+                out.append(Finding(
+                    path, ln, "GL021",
+                    f"call `{callee}()` inside `with {lock}` reaches a "
+                    f"blocking call {reason} "
+                    f"({' -> '.join([qual] + chain)}) — hoist the call "
+                    "out of the critical section or split the callee",
+                    token=f"{lock}|{callee}", scope=qual))
+    return out
+
+
+# --------------------------------------------------------------------------
+# GL022 — resource acquire/release pairing on all paths
+
+
+def _resource_kind(prog: Program, ctx: FileCtx, qual: str,
+                   call: ast.Call):
+    """Classify one call as a resource ACQUIRE. Returns
+    (kind, release_names) or None. Kinds: pooled buffers
+    (BufferPool.get), the HBM ledger (device.ledger_acquire) and the
+    span plane's paired entry points."""
+    from . import checkers as _chk
+    d = _chk.dotted(call.func)
+    if not d:
+        return None
+    tgt = prog.resolve_call(ctx.path, qual, d)
+    if tgt is not None:
+        mod = prog.files[tgt[0]]["module"]
+        fn = tgt[1]
+        if mod == "minio_tpu.obs.device" and fn == "ledger_acquire":
+            return "hbm-ledger", {"ledger_release"}
+        if mod == "minio_tpu.obs.spans":
+            if fn == "begin_request":
+                return "span-request", {"finish_request"}
+            if fn == "_begin":
+                return "span-buffer", {"_end"}
+    if isinstance(call.func, ast.Attribute) and call.func.attr == "get":
+        recv = call.func.value
+        recv_d = _chk.dotted(recv)
+        hit = None
+        f = prog.func(ctx.path, qual)
+        cls = f["cls"] if f else ""
+        if recv_d.startswith("self.") and recv_d.count(".") == 1 and cls:
+            hit = prog.attr_class(ctx.path, cls, recv_d.split(".")[1])
+        elif recv_d and "." not in recv_d:
+            hit = prog._local_type(ctx.path, qual, recv_d)
+        elif recv_d.endswith("()"):
+            tgt = prog.resolve_call(ctx.path, qual, recv_d[:-2])
+            if tgt:
+                hit = prog.returns_class(*tgt)
+        if hit and prog.files[hit[0]]["module"] == \
+                "minio_tpu.runtime.bufpool" and hit[1] == "BufferPool":
+            return "bufpool", {"put"}
+    return None
+
+
+def _is_release(call: ast.Call, names: set[str], bound: set[str]) -> bool:
+    from . import checkers as _chk
+    d = _chk.dotted(call.func)
+    attr = d.rsplit(".", 1)[-1] if d else ""
+    if attr not in names:
+        return False
+    for a in call.args:
+        if isinstance(a, ast.Name) and a.id in bound:
+            return True
+        if isinstance(a, ast.Starred):
+            return True
+    # pool.put(x) releases whatever x is; require a bound-name arg when
+    # we know the binding, otherwise any matching release call counts
+    return not bound
+
+
+def _protected_linenos(fn: ast.AST) -> set[int]:
+    """Lines inside a ``finally:`` block or an ``except`` handler —
+    code that still runs on the exception edge."""
+    out: set[int] = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Try):
+            for stmt in node.finalbody:
+                out.update(range(stmt.lineno,
+                                 getattr(stmt, "end_lineno",
+                                         stmt.lineno) + 1))
+            for h in node.handlers:
+                for stmt in h.body:
+                    out.update(range(stmt.lineno,
+                                     getattr(stmt, "end_lineno",
+                                             stmt.lineno) + 1))
+    return out
+
+
+def check_resource_pairing(prog: Program,
+                           ctxs: list[FileCtx]) -> list[Finding]:
+    from . import checkers as _chk
+    out: list[Finding] = []
+    for ctx in sorted(ctxs, key=lambda c: c.path):
+        if ctx.path not in prog.files:
+            continue
+        for qual, _cls, fn in _iter_functions(ctx.tree):
+            protected = _protected_linenos(fn)
+            # statement-level view of this function only (nested defs
+            # have their own entry)
+            for stmt in _stmts_shallow(fn):
+                for call in _calls_in(stmt):
+                    kind = _resource_kind(prog, ctx, qual, call)
+                    if kind is None:
+                        continue
+                    kname, releases = kind
+                    verdict = _pairing_verdict(
+                        fn, stmt, call, releases, protected,
+                        # a pooled buffer handed to a call is being
+                        # USED, not handed off — tokens/contexts passed
+                        # onward ARE ownership transfer
+                        call_arg_escapes=kname != "bufpool")
+                    if verdict is None:
+                        continue
+                    out.append(Finding(
+                        ctx.path, call.lineno, "GL022",
+                        f"{kname} acquire `{_chk._unparse(call, 48)}` "
+                        f"{verdict}",
+                        token=f"{kname}|{_chk.dotted(call.func)}",
+                        scope=qual))
+    return out
+
+
+def _stmts_shallow(fn: ast.AST):
+    """Every statement in fn's body, not descending into nested defs."""
+    stack = list(fn.body)
+    while stack:
+        st = stack.pop(0)
+        if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef,
+                           ast.ClassDef)):
+            continue
+        yield st
+        for field in ("body", "orelse", "finalbody"):
+            stack.extend(getattr(st, field, []) or [])
+        for h in getattr(st, "handlers", []) or []:
+            stack.extend(h.body)
+
+
+def _calls_in(stmt: ast.AST):
+    from . import checkers as _chk
+    for node in _chk._walk_shallow(stmt):
+        if isinstance(node, ast.Call):
+            yield node
+    if isinstance(stmt, ast.Expr) and isinstance(stmt.value, ast.Call):
+        pass
+
+
+def _pairing_verdict(fn: ast.AST, stmt: ast.AST, call: ast.Call,
+                     releases: set[str], protected: set[int],
+                     call_arg_escapes: bool = True):
+    """None = correctly paired. Otherwise a finding message tail.
+
+    Rules (documented in docs/static-analysis.md):
+    * acquire bound by ``with`` → paired by the context manager;
+    * result ESCAPES (passed to another call, returned, yielded or
+      stored into an attribute/container) → ownership transfer, the
+      holder is responsible (under-approximation, not a pass);
+    * a matching release inside a ``finally``/``except`` → paired;
+    * a matching release only in straight-line code with call sites
+      between acquire and release → the exception edge leaks.
+    """
+    from . import checkers as _chk
+    if isinstance(stmt, (ast.With, ast.AsyncWith)) and any(
+            it.context_expr is call for it in stmt.items):
+        return None
+    bound: set[str] = set()
+    is_direct_stmt = False
+    if isinstance(stmt, ast.Assign) and stmt.value is call:
+        is_direct_stmt = True
+        for t in stmt.targets:
+            if isinstance(t, ast.Name):
+                bound.add(t.id)
+            elif isinstance(t, ast.Tuple):
+                bound.update(e.id for e in t.elts
+                             if isinstance(e, ast.Name))
+            else:
+                return None   # stored into self.x / container: escapes
+    elif isinstance(stmt, ast.Expr) and stmt.value is call:
+        is_direct_stmt = True
+        return "result is discarded — the resource can never be " \
+               "released; bind it and release in a finally"
+    if not is_direct_stmt:
+        return None   # nested in a larger expression: escapes inline
+    if not bound:
+        return None
+    acquire_nodes = set(map(id, ast.walk(stmt)))
+    rel_lines: list[int] = []
+    escape_lines: list[int] = []
+    for node in _chk._walk_shallow(fn):
+        if id(node) in acquire_nodes:
+            continue   # the acquire statement's own subexpressions
+        if isinstance(node, ast.Call):
+            if _is_release(node, releases, bound):
+                rel_lines.append(node.lineno)
+            elif call_arg_escapes and any(
+                    isinstance(a, ast.Name) and a.id in bound
+                    for a in ast.walk(node)):
+                escape_lines.append(node.lineno)
+        elif isinstance(node, (ast.Return, ast.Yield, ast.YieldFrom)):
+            v = getattr(node, "value", None)
+            if v is not None and any(
+                    isinstance(n, ast.Name) and n.id in bound
+                    for n in ast.walk(v)):
+                escape_lines.append(node.lineno)
+        elif isinstance(node, ast.Assign) and any(
+                isinstance(n, ast.Name) and n.id in bound
+                for n in ast.walk(node.value)):
+            escape_lines.append(node.lineno)
+    if any(ln in protected for ln in rel_lines):
+        return None   # a finally/except release covers the raise edge
+    safe = rel_lines + escape_lines
+    if not safe:
+        return "is never released on any path in this function " \
+               "(and never escapes) — pair it with a release in a " \
+               "finally"
+    first_safe = min(safe)
+    risky = any(isinstance(n, ast.Call) and id(n) not in acquire_nodes
+                and call.lineno < n.lineno < first_safe
+                and not _is_release(n, releases, bound)
+                for n in _chk._walk_shallow(fn))
+    if risky:
+        return "crosses calls that can raise before its release/" \
+               "handoff — the exception edge leaks it; wrap in " \
+               "try/finally"
+    return None
+
+
+# --------------------------------------------------------------------------
+# registration: one project pass building the program once
+
+
+def check_whole_program(ctxs: list[FileCtx]) -> list[Finding]:
+    """PROJECT checker entry: build the program once, run GL020/021/022."""
+    prog = build_program(ctxs)
+    out = check_guard_inference(prog)
+    out += check_interprocedural_blocking(prog)
+    out += check_resource_pairing(prog, ctxs)
+    return out
